@@ -1,0 +1,36 @@
+"""foundationdb_tpu — a TPU-native transaction-conflict-resolution framework.
+
+Re-implements the capabilities of FoundationDB 7.3.0's Resolver subsystem
+(reference: fdbserver/Resolver.actor.cpp, fdbserver/SkipList.cpp) as a
+TPU-first design: the per-batch MVCC conflict check becomes a pure JAX
+kernel over fixed-shape interval tensors, the version-annotated skip list
+becomes a piecewise-constant "version map" held in device memory as sorted
+boundary tensors with range-max acceleration structures, and multi-resolver
+keyspace sharding becomes a `shard_map` axis with a `min`-combine of
+per-shard verdicts (the exact combine semantics of
+fdbserver/CommitProxyServer.actor.cpp:1551-1567).
+
+Nothing here is a port of the reference's C++ — the data structures are
+re-designed for XLA's compilation model: static shapes, sorts instead of
+pointer-chasing, segment trees and sparse tables instead of skip lists,
+and an alternating fixpoint instead of a sequential intra-batch scan.
+"""
+
+from foundationdb_tpu.config import KernelConfig
+from foundationdb_tpu.models.types import (
+    CommitTransaction,
+    ResolveTransactionBatchRequest,
+    ResolveTransactionBatchReply,
+    TransactionResult,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "KernelConfig",
+    "CommitTransaction",
+    "ResolveTransactionBatchRequest",
+    "ResolveTransactionBatchReply",
+    "TransactionResult",
+    "__version__",
+]

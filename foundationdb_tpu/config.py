@@ -1,0 +1,103 @@
+"""Static kernel configuration.
+
+Every shape in the conflict kernel is static (XLA requirement); this config
+pins the capacities. The host packer pads variable-size batches up to these
+caps. Mirrors the role the reference's knobs play for the resolver
+(fdbclient/ServerKnobs.cpp:36-44 — MVCC window knobs), but as compile-time
+shape parameters rather than runtime constants.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+
+def _ceil_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelConfig:
+    """Compile-time shapes for the conflict-resolution kernel.
+
+    Attributes:
+      max_key_bytes: maximum conflict-range key length the packed
+        representation can hold exactly. Keys are encoded as big-endian
+        uint32 words plus a final length word, which preserves FDB's key
+        ordering contract exactly (byte-lexicographic, shorter-before-longer
+        — fdbserver/SkipList.cpp:123-139).
+      max_txns: txn capacity per batch (B).
+      max_reads: total read-conflict-range capacity per batch (flattened).
+      max_writes: total write-conflict-range capacity per batch (flattened).
+      history_capacity: boundary capacity of the compacted "main" version map.
+      fresh_slots: number of per-batch fresh runs buffered before compaction.
+      fresh_capacity: boundary capacity of one fresh run (>= 2*max_writes).
+      window_versions: MVCC window: newOldestVersion = version - window
+        (reference: MAX_WRITE_TRANSACTION_LIFE_VERSIONS = 5e6,
+        fdbclient/ServerKnobs.cpp:43, used at fdbserver/Resolver.actor.cpp:331).
+    """
+
+    max_key_bytes: int = 24
+    max_txns: int = 1024
+    max_reads: int = 4096
+    max_writes: int = 4096
+    history_capacity: int = 1 << 15
+    fresh_slots: int = 8
+    fresh_capacity: int = 8192
+    window_versions: int = 5_000_000
+
+    def __post_init__(self):
+        if self.max_key_bytes % 4 != 0:
+            raise ValueError("max_key_bytes must be a multiple of 4")
+        if self.fresh_capacity < 2 * self.max_writes:
+            raise ValueError(
+                "fresh_capacity must hold 2*max_writes boundaries "
+                f"({self.fresh_capacity} < {2 * self.max_writes})"
+            )
+        for name in ("max_txns", "max_reads", "max_writes", "history_capacity",
+                     "fresh_capacity"):
+            v = getattr(self, name)
+            if v & (v - 1):
+                raise ValueError(f"{name} must be a power of two, got {v}")
+
+    # ---- derived shapes -------------------------------------------------
+
+    @property
+    def key_words(self) -> int:
+        """uint32 words per packed key: byte words + 1 length word."""
+        return self.max_key_bytes // 4 + 1
+
+    @property
+    def num_points(self) -> int:
+        """Rank-space capacity: every read/write range contributes 2 points."""
+        return 2 * (self.max_reads + self.max_writes)
+
+    @property
+    def segtree_size(self) -> int:
+        """Leaf count of the intra-batch segment tree (pow2 >= num_points)."""
+        return _ceil_pow2(self.num_points)
+
+    @property
+    def segtree_levels(self) -> int:
+        return int(math.log2(self.segtree_size))
+
+    @property
+    def history_log(self) -> int:
+        return int(math.log2(self.history_capacity)) + 1
+
+    def scaled(self, **overrides) -> "KernelConfig":
+        return dataclasses.replace(self, **overrides)
+
+
+#: A deliberately tiny config for CPU-hosted unit tests.
+TEST_CONFIG = KernelConfig(
+    max_key_bytes=8,
+    max_txns=64,
+    max_reads=256,
+    max_writes=256,
+    history_capacity=1 << 10,
+    fresh_slots=4,
+    fresh_capacity=512,
+    window_versions=1000,
+)

@@ -1,0 +1,1 @@
+"""Role models: wire types, the conflict set, and the resolver role."""

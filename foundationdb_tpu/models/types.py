@@ -1,0 +1,72 @@
+"""Wire-level types of the resolution protocol.
+
+Behavioral mirrors of the reference wire structs — same fields, same
+semantics — so a host RPC layer can speak the same protocol:
+
+* CommitTransaction ~ CommitTransactionRef
+  (fdbclient/include/fdbclient/CommitTransaction.h:378-…): read/write
+  conflict ranges, read_snapshot, report_conflicting_keys.
+* ResolveTransactionBatchRequest / Reply ~
+  fdbserver/include/fdbserver/ResolverInterface.h:94-155: the version
+  chain fields (prevVersion, version, lastReceivedVersion) and the
+  per-txn committed verdict array plus conflictingKeyRangeMap.
+
+Mutations/state-transaction plumbing is carried opaquely (this framework's
+scope is conflict resolution; the tlog/storage side consumes `mutations`
+untouched).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional
+
+
+class TransactionResult(enum.IntEnum):
+    """Mirrors ConflictBatch::TransactionCommitResult
+    (fdbserver/include/fdbserver/ConflictSet.h:41-46)."""
+
+    CONFLICT = 0
+    TOO_OLD = 1
+    TENANT_FAILURE = 2
+    COMMITTED = 3
+
+
+KeyRange = tuple[bytes, bytes]
+
+
+@dataclasses.dataclass
+class CommitTransaction:
+    read_conflict_ranges: list[KeyRange] = dataclasses.field(default_factory=list)
+    write_conflict_ranges: list[KeyRange] = dataclasses.field(default_factory=list)
+    read_snapshot: int = 0
+    report_conflicting_keys: bool = False
+    mutations: list[Any] = dataclasses.field(default_factory=list)
+
+    def validate(self) -> None:
+        for b, e in self.read_conflict_ranges + self.write_conflict_ranges:
+            if not (isinstance(b, bytes) and isinstance(e, bytes)):
+                raise TypeError("conflict range keys must be bytes")
+            if b >= e:
+                raise ValueError(f"empty conflict range {b!r} >= {e!r}")
+
+
+@dataclasses.dataclass
+class ResolveTransactionBatchRequest:
+    prev_version: int          # -1 for the first batch (from the master)
+    version: int               # commit version of this batch
+    last_received_version: int  # acks outstanding replies below this
+    transactions: list[CommitTransaction] = dataclasses.field(default_factory=list)
+    proxy_id: Optional[str] = None  # stands in for the reply endpoint address
+    debug_id: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ResolveTransactionBatchReply:
+    committed: list[TransactionResult] = dataclasses.field(default_factory=list)
+    # txn index -> read-conflict-range indices (only for txns that asked)
+    conflicting_key_range_map: dict[int, list[int]] = dataclasses.field(
+        default_factory=dict
+    )
+    debug_id: Optional[str] = None

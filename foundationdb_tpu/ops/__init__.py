@@ -1,0 +1,1 @@
+"""Device-side (JAX) primitives for the conflict kernel."""

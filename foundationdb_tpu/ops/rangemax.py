@@ -1,0 +1,65 @@
+"""Sparse-table range-max/min: O(M log M) build, O(1) vectorized query.
+
+This replaces the skip list's per-level maxVersion "pyramids" (the
+acceleration structure behind fdbserver/SkipList.cpp:443-485's CheckMax
+scan): where the reference answers "max version over the segments a read
+range touches" by descending a pointer structure, we answer it with a
+doubling table over a flat sorted array — branch-free, gather-based, and
+identical in semantics.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+INT32_NEG = jnp.int32(-(2**31) + 1)
+INT32_POS = jnp.int32(2**31 - 1)
+
+
+def _num_levels(m: int) -> int:
+    return max(1, (m - 1).bit_length() + 1)
+
+
+def build(values: jnp.ndarray, *, op: str = "max") -> jnp.ndarray:
+    """Build the doubling table. values: [M] -> table [L, M].
+
+    table[k, i] = op(values[i : i + 2**k]) (clamped at the array end).
+    """
+    m = values.shape[0]
+    fn = jnp.maximum if op == "max" else jnp.minimum
+    levels = [values]
+    for k in range(1, _num_levels(m)):
+        prev = levels[-1]
+        half = 1 << (k - 1)
+        idx = jnp.minimum(jnp.arange(m) + half, m - 1)
+        levels.append(fn(prev, prev[idx]))
+    return jnp.stack(levels)
+
+
+def _floor_log2(n: jnp.ndarray, max_levels: int) -> jnp.ndarray:
+    """Vectorized floor(log2(n)) for n >= 1, exact for all int32."""
+    k = jnp.zeros_like(n)
+    for b in range(max_levels - 1, -1, -1):
+        k = jnp.where((n >> b) > 0, jnp.maximum(k, b), k)
+    return k
+
+
+def query(table: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray, *, op: str = "max"):
+    """Vectorized range query over [lo, hi) per element.
+
+    table: [L, M]; lo, hi: [Q] int32. Empty ranges (hi <= lo) return the
+    op identity (-inf for max, +inf for min).
+    """
+    levels, m = table.shape
+    ident = INT32_NEG if op == "max" else INT32_POS
+    fn = jnp.maximum if op == "max" else jnp.minimum
+    loc = jnp.clip(lo, 0, m)
+    hic = jnp.clip(hi, 0, m)
+    length = jnp.maximum(hic - loc, 1)
+    k = _floor_log2(length, levels)
+    a = jnp.clip(loc, 0, m - 1)
+    b = jnp.clip(hic - (1 << k), 0, m - 1)
+    flat = table.reshape(-1)
+    va = flat[k * m + a]
+    vb = flat[k * m + b]
+    return jnp.where(hic > loc, fn(va, vb), ident)

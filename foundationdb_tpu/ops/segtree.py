@@ -1,0 +1,58 @@
+"""Min-index segment tree: range-update / all-points-read, fully vectorized.
+
+Used by the intra-batch conflict phase: for every elementary segment of the
+batch's rank space we need "the smallest txn index among committed writers
+covering this segment". The reference gets the equivalent effect with a
+sequential bitset sweep in txn order (MiniConflictSet,
+fdbserver/SkipList.cpp:857-899); a sequential sweep is hostile to TPU, so
+we instead do a range-min segment tree: each write interval scatter-mins
+its txn index into O(log V) canonical nodes, then one top-down sweep
+propagates mins to all leaves at once.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+INT32_POS = jnp.int32(2**31 - 1)
+
+
+def min_cover(
+    leaves: int,
+    lo: jnp.ndarray,
+    hi: jnp.ndarray,
+    val: jnp.ndarray,
+) -> jnp.ndarray:
+    """For each leaf v in [0, leaves): min val[j] over updates with lo[j] <= v < hi[j].
+
+    leaves: static pow2 leaf count.
+    lo, hi: [N] int32 rank intervals (half-open); empty/invalid updates must
+      have lo >= hi (they then touch nothing).
+    val: [N] int32 values (use INT32_POS to disable an update).
+    Returns [leaves] int32 of per-leaf minima (INT32_POS where uncovered).
+    """
+    assert leaves & (leaves - 1) == 0
+    log = leaves.bit_length() - 1
+    # Heap-layout tree [2*leaves]; node 1 is the root; leaf v is leaves + v.
+    # One extra trash slot at index 2*leaves absorbs masked updates.
+    tree = jnp.full((2 * leaves + 1,), INT32_POS, jnp.int32)
+    l = jnp.clip(lo, 0, leaves) + leaves
+    r = jnp.clip(hi, 0, leaves) + leaves
+    trash = 2 * leaves
+    for _ in range(log + 1):
+        active = l < r
+        upd_l = active & ((l & 1) == 1)
+        upd_r = active & ((r & 1) == 1)
+        tree = tree.at[jnp.where(upd_l, l, trash)].min(val)
+        tree = tree.at[jnp.where(upd_r, r - 1, trash)].min(val)
+        l = jnp.where(active, (l + (l & 1)) >> 1, l)
+        r = jnp.where(active, (r - (r & 1)) >> 1, r)
+    # Top-down: push each node's min into its children.
+    vals = tree[: 2 * leaves]
+    for lev in range(log):
+        start = 1 << lev
+        parent_vals = vals[start : 2 * start]
+        child_vals = vals[2 * start : 4 * start]
+        pushed = jnp.minimum(child_vals, jnp.repeat(parent_vals, 2))
+        vals = vals.at[2 * start : 4 * start].set(pushed)
+    return vals[leaves:]

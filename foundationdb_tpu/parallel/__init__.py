@@ -1,0 +1,1 @@
+"""Multi-resolver sharding over a device mesh."""

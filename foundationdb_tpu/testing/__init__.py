@@ -1,0 +1,1 @@
+"""Test-support code: the semantic oracle and workload generators."""

@@ -1,0 +1,210 @@
+"""Host-side batch packing: byte-string conflict ranges -> fixed-shape tensors.
+
+This is the boundary where a `ResolveTransactionBatchRequest`'s
+variable-length data (reference wire type:
+fdbserver/include/fdbserver/ResolverInterface.h:94-129) becomes the packed,
+static-shape tensors the TPU kernel consumes. Reads and writes are packed
+*flat* (one row per conflict range, with a txn-id column) rather than
+[B, R, ...] so that sparse per-txn range counts don't waste device FLOPs.
+
+Versions are rebased to int32 offsets from a host-held base version: the
+MVCC window is ~5e6 versions (fdbclient/ServerKnobs.cpp:43) so every live
+version fits comfortably in 31 bits; `Resolver` re-bases periodically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from foundationdb_tpu.config import KernelConfig
+
+# Version offset used for "far in the past" (clamped stale snapshots).
+VERSION_NEG = np.int32(-(2**31) + 1)
+
+
+class KeyTooLongError(ValueError):
+    """A conflict-range key exceeds the packed width.
+
+    The packed representation is exact only up to max_key_bytes; rather than
+    silently truncate (which could change commit decisions — SURVEY.md §7.3
+    names this the #1 parity risk) the packer refuses and the caller must
+    use a wider KernelConfig.
+    """
+
+
+def pack_key(key: bytes, max_key_bytes: int) -> np.ndarray:
+    """bytes -> [W] uint32 (big-endian byte words + length word)."""
+    if len(key) > max_key_bytes:
+        raise KeyTooLongError(f"key of {len(key)} bytes > {max_key_bytes}")
+    padded = key + b"\x00" * (max_key_bytes - len(key))
+    words = np.frombuffer(padded, dtype=">u4").astype(np.uint32)
+    return np.concatenate([words, np.array([len(key)], np.uint32)])
+
+
+def pack_keys(keys: list[bytes], max_key_bytes: int) -> np.ndarray:
+    """[n, W] uint32; vectorized over a list of byte keys."""
+    n = len(keys)
+    w = max_key_bytes // 4 + 1
+    out = np.zeros((n, w), np.uint32)
+    if n == 0:
+        return out
+    buf = np.zeros((n, max_key_bytes), np.uint8)
+    lens = np.empty((n,), np.uint32)
+    for i, k in enumerate(keys):
+        if len(k) > max_key_bytes:
+            raise KeyTooLongError(f"key of {len(k)} bytes > {max_key_bytes}")
+        buf[i, : len(k)] = np.frombuffer(k, np.uint8)
+        lens[i] = len(k)
+    out[:, :-1] = buf.view(">u4").astype(np.uint32).reshape(n, w - 1)
+    out[:, -1] = lens
+    return out
+
+
+def unpack_key(row: np.ndarray) -> bytes:
+    """[W] uint32 -> bytes (inverse of pack_key)."""
+    length = int(row[-1])
+    raw = row[:-1].astype(">u4").tobytes()
+    return raw[:length]
+
+
+@dataclasses.dataclass
+class PackedBatch:
+    """One batch of transactions in kernel form (all numpy, host-side).
+
+    Shapes are exactly the KernelConfig caps; `n_txns`/`n_reads`/`n_writes`
+    give the live prefix sizes (rows past them are masked invalid).
+    """
+
+    # scalars
+    version: np.int32          # commit version offset of this batch
+    new_oldest: np.int32       # MVCC-window floor offset (version - window)
+    n_txns: int
+    n_reads: int
+    n_writes: int
+    # per-txn [B]
+    txn_valid: np.ndarray      # bool
+    snapshot: np.ndarray       # int32 version offsets (clamped at VERSION_NEG)
+    has_reads: np.ndarray      # bool — blind writes are never "too old"
+    # flattened reads [NR]
+    read_begin: np.ndarray     # [NR, W] uint32
+    read_end: np.ndarray       # [NR, W] uint32
+    read_txn: np.ndarray       # int32
+    read_index: np.ndarray     # int32 — index of the range within its txn
+    read_valid: np.ndarray     # bool
+    # flattened writes [NW]
+    write_begin: np.ndarray    # [NW, W] uint32
+    write_end: np.ndarray      # [NW, W] uint32
+    write_txn: np.ndarray      # int32
+    write_valid: np.ndarray    # bool
+
+    def device_args(self):
+        """The pytree handed to the jitted kernel (drops host-only ints)."""
+        return {
+            "version": np.int32(self.version),
+            "new_oldest": np.int32(self.new_oldest),
+            "txn_valid": self.txn_valid,
+            "snapshot": self.snapshot,
+            "has_reads": self.has_reads,
+            "read_begin": self.read_begin,
+            "read_end": self.read_end,
+            "read_txn": self.read_txn,
+            "read_index": self.read_index,
+            "read_valid": self.read_valid,
+            "write_begin": self.write_begin,
+            "write_end": self.write_end,
+            "write_txn": self.write_txn,
+            "write_valid": self.write_valid,
+        }
+
+
+def _clamp_version(v: int, base: int) -> np.int32:
+    off = v - base
+    if off <= int(VERSION_NEG):
+        return VERSION_NEG
+    if off >= 2**31:
+        raise OverflowError(f"version offset {off} overflows int32; rebase")
+    return np.int32(off)
+
+
+def pack_batch(
+    transactions,
+    version: int,
+    base_version: int,
+    config: KernelConfig,
+) -> PackedBatch:
+    """Pack a list of CommitTransaction into kernel tensors.
+
+    `transactions` is any sequence with `.read_conflict_ranges`,
+    `.write_conflict_ranges` (lists of (begin, end) byte pairs) and
+    `.read_snapshot` (int) — the shape of the reference's
+    CommitTransactionRef (fdbclient/include/fdbclient/CommitTransaction.h).
+    """
+    cfg = config
+    b, nr, nw, w = cfg.max_txns, cfg.max_reads, cfg.max_writes, cfg.key_words
+    if len(transactions) > b:
+        raise ValueError(f"{len(transactions)} txns > max_txns {b}")
+
+    txn_valid = np.zeros((b,), bool)
+    snapshot = np.full((b,), VERSION_NEG, np.int32)
+    has_reads = np.zeros((b,), bool)
+
+    r_begin, r_end, r_txn, r_idx = [], [], [], []
+    w_begin, w_end, w_txn = [], [], []
+    for t, tr in enumerate(transactions):
+        txn_valid[t] = True
+        snapshot[t] = _clamp_version(tr.read_snapshot, base_version)
+        has_reads[t] = len(tr.read_conflict_ranges) > 0
+        for i, (kb, ke) in enumerate(tr.read_conflict_ranges):
+            r_begin.append(kb)
+            r_end.append(ke)
+            r_txn.append(t)
+            r_idx.append(i)
+        for kb, ke in tr.write_conflict_ranges:
+            w_begin.append(kb)
+            w_end.append(ke)
+            w_txn.append(t)
+
+    if len(r_txn) > nr:
+        raise ValueError(f"{len(r_txn)} read ranges > max_reads {nr}")
+    if len(w_txn) > nw:
+        raise ValueError(f"{len(w_txn)} write ranges > max_writes {nw}")
+
+    def _flat(begins, ends, cap):
+        kb = np.zeros((cap, w), np.uint32)
+        ke = np.zeros((cap, w), np.uint32)
+        n = len(begins)
+        if n:
+            kb[:n] = pack_keys(begins, cfg.max_key_bytes)
+            ke[:n] = pack_keys(ends, cfg.max_key_bytes)
+        return kb, ke
+
+    rb, re = _flat(r_begin, r_end, nr)
+    wb, we = _flat(w_begin, w_end, nw)
+
+    def _col(vals, cap, dtype=np.int32):
+        out = np.zeros((cap,), dtype)
+        out[: len(vals)] = vals
+        return out
+
+    nread, nwrite = len(r_txn), len(w_txn)
+    return PackedBatch(
+        version=_clamp_version(version, base_version),
+        new_oldest=_clamp_version(version - cfg.window_versions, base_version),
+        n_txns=len(transactions),
+        n_reads=nread,
+        n_writes=nwrite,
+        txn_valid=txn_valid,
+        snapshot=snapshot,
+        has_reads=has_reads,
+        read_begin=rb,
+        read_end=re,
+        read_txn=_col(r_txn, nr),
+        read_index=_col(r_idx, nr),
+        read_valid=_col([True] * nread, nr, bool),
+        write_begin=wb,
+        write_end=we,
+        write_txn=_col(w_txn, nw),
+        write_valid=_col([True] * nwrite, nw, bool),
+    )

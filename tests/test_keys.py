@@ -1,0 +1,73 @@
+"""Packed-key ordering must match Python bytes ordering exactly."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from conftest import random_key
+from foundationdb_tpu.ops import keys as K
+from foundationdb_tpu.utils import packing
+
+MAXB = 8
+
+
+def _pack(bs):
+    return jnp.asarray(packing.pack_keys(bs, MAXB))
+
+
+def test_pack_unpack_roundtrip(rng):
+    ks = [random_key(rng, MAXB, 256) for _ in range(200)]
+    arr = packing.pack_keys(ks, MAXB)
+    for i, k in enumerate(ks):
+        assert packing.unpack_key(arr[i]) == k
+
+
+def test_key_too_long():
+    with pytest.raises(packing.KeyTooLongError):
+        packing.pack_key(b"x" * 9, MAXB)
+
+
+def test_lex_less_matches_bytes(rng):
+    ks = [random_key(rng, MAXB, 3) for _ in range(300)]
+    a = [ks[int(i)] for i in rng.integers(0, len(ks), 500)]
+    b = [ks[int(i)] for i in rng.integers(0, len(ks), 500)]
+    got = np.asarray(K.lex_less(_pack(a), _pack(b)))
+    want = np.array([x < y for x, y in zip(a, b)])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_shorter_before_longer():
+    a = _pack([b"a", b"a\x00", b"a\x00\x00"])
+    assert bool(K.lex_less(a[0:1], a[1:2])[0])
+    assert bool(K.lex_less(a[1:2], a[2:3])[0])
+    assert not bool(K.lex_less(a[1:2], a[0:1])[0])
+
+
+def test_searchsorted_matches_numpy(rng):
+    ks = sorted({random_key(rng, MAXB, 4) for _ in range(100)})
+    queries = [random_key(rng, MAXB, 4) for _ in range(400)] + list(ks)
+    m = 128  # capacity > len(ks), tail = sentinel
+    arr = np.full((m, MAXB // 4 + 1), 0xFFFFFFFF, np.uint32)
+    arr[: len(ks)] = packing.pack_keys(ks, MAXB)
+    q = _pack(queries)
+    for side in ("left", "right"):
+        got = np.asarray(K.searchsorted(jnp.asarray(arr), q, side=side))
+        want = np.array([
+            __import__("bisect").bisect_left(ks, x) if side == "left"
+            else __import__("bisect").bisect_right(ks, x)
+            for x in queries
+        ])
+        np.testing.assert_array_equal(got, want)
+
+
+def test_sort_ranks(rng):
+    ks = [random_key(rng, MAXB, 3) for _ in range(64)]
+    valid = rng.random(64) < 0.8
+    pts = _pack(ks)
+    ranks, ukeys, ucount = K.sort_ranks(pts, jnp.asarray(valid))
+    distinct = sorted({k for k, v in zip(ks, valid) if v})
+    assert int(ucount) == len(distinct)
+    for i, (k, v) in enumerate(zip(ks, valid)):
+        if v:
+            assert int(ranks[i]) == distinct.index(k)
+            assert packing.unpack_key(np.asarray(ukeys[int(ranks[i])])) == k

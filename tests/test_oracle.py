@@ -1,0 +1,112 @@
+"""Sanity tests for the Python semantic oracle itself (hand-built scenarios)."""
+
+from foundationdb_tpu.testing.oracle import (
+    COMMITTED,
+    CONFLICT,
+    TOO_OLD,
+    ConflictOracle,
+    OracleTxn,
+    VersionMap,
+)
+
+
+def T(reads=(), writes=(), snap=0, report=False):
+    return OracleTxn(list(reads), list(writes), snap, report)
+
+
+def test_versionmap_write_query():
+    m = VersionMap()
+    m.write(b"b", b"d", 10)
+    assert m.max_over(b"a", b"b") == 0      # ends before the write
+    assert m.max_over(b"a", b"b\x00") == 10  # touches [b, d)
+    assert m.max_over(b"c", b"z") == 10
+    assert m.max_over(b"d", b"z") == 0      # starts at exclusive end
+    m.write(b"c", b"e", 20)
+    assert m.max_over(b"b", b"c") == 10
+    assert m.max_over(b"c", b"d") == 20
+    assert m.max_over(b"d", b"e") == 20
+    m.write(b"a", b"z", 30)                  # full overwrite
+    assert m.max_over(b"b", b"d") == 30
+
+
+def test_versionmap_exact_end_boundary():
+    m = VersionMap()
+    m.write(b"a", b"c", 5)
+    m.write(b"c", b"e", 7)   # adjacent: boundary at c exists
+    m.write(b"a", b"c", 9)   # rewrite first — must not duplicate boundary c
+    assert m.max_over(b"b", b"c") == 9
+    assert m.max_over(b"c", b"d") == 7
+    assert m.boundaries == sorted(set(m.boundaries))
+
+
+def test_blind_write_always_commits():
+    o = ConflictOracle(window=100)
+    r = o.resolve([T(writes=[(b"a", b"b")], snap=-10**9)], version=1000)
+    assert r.verdicts == [COMMITTED]  # no reads -> never tooOld, never conflicts
+
+
+def test_read_write_conflict_across_batches():
+    o = ConflictOracle(window=10**6)
+    o.resolve([T(writes=[(b"k", b"k\x00")])], version=100)
+    r = o.resolve([T(reads=[(b"k", b"k\x00")], snap=50)], version=200)
+    assert r.verdicts == [CONFLICT]
+    r2 = o.resolve([T(reads=[(b"k", b"k\x00")], snap=150)], version=300)
+    assert r2.verdicts == [COMMITTED]  # snapshot after the write
+
+
+def test_intra_batch_order_dependence():
+    o = ConflictOracle(window=10**6)
+    # t0 writes k; t1 reads k -> t1 conflicts with the *earlier* t0
+    r = o.resolve(
+        [
+            T(writes=[(b"k", b"k\x00")], snap=10),
+            T(reads=[(b"k", b"k\x00")], writes=[(b"m", b"n")], snap=10),
+            T(reads=[(b"m", b"n")], snap=10),  # t1 aborted, so its write is absent
+        ],
+        version=100,
+    )
+    assert r.verdicts == [COMMITTED, CONFLICT, COMMITTED]
+
+
+def test_too_old():
+    o = ConflictOracle(window=100)
+    r = o.resolve(
+        [
+            T(reads=[(b"a", b"b")], snap=10),    # 10 < 1000-100 -> tooOld
+            T(reads=[(b"a", b"b")], snap=950),
+        ],
+        version=1000,
+    )
+    assert r.verdicts == [TOO_OLD, COMMITTED]
+
+
+def test_report_conflicting_keys_first_hit_only_intra():
+    o = ConflictOracle(window=10**6)
+    r = o.resolve(
+        [
+            T(writes=[(b"a", b"b"), (b"c", b"d")], snap=1),
+            # both ranges would hit, but the reference records only the first
+            T(reads=[(b"a", b"b"), (b"c", b"d")], snap=1, report=True),
+        ],
+        version=10,
+    )
+    assert r.verdicts == [COMMITTED, CONFLICT]
+    assert r.conflicting_ranges == {1: [0]}
+
+
+def test_report_conflicting_keys_all_hits_history():
+    o = ConflictOracle(window=10**6)
+    o.resolve([T(writes=[(b"a", b"b"), (b"c", b"d")])], version=10)
+    r = o.resolve(
+        [T(reads=[(b"c", b"d"), (b"a", b"b")], snap=5, report=True)], version=20
+    )
+    assert r.verdicts == [CONFLICT]
+    # history phase records every hit, ordered by begin key: (a,b)=idx1, (c,d)=idx0
+    assert r.conflicting_ranges == {0: [1, 0]}
+
+
+def test_gc_drops_dead_segments():
+    o = ConflictOracle(window=10)
+    for v in range(1, 40):
+        o.resolve([T(writes=[(bytes([v % 7]), bytes([v % 7]) + b"\x00")])], version=v * 10)
+    assert len(o.history.boundaries) < 20  # bounded by live window, not 39 writes

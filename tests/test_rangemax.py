@@ -1,0 +1,61 @@
+"""Sparse-table and segment-tree primitives vs. brute force."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from foundationdb_tpu.ops import rangemax, segtree
+
+
+def test_rangemax_brute(rng):
+    m = 64
+    vals = jnp.asarray(rng.integers(-100, 100, m), jnp.int32)
+    tab = rangemax.build(vals, op="max")
+    lo = rng.integers(-2, m + 2, 300).astype(np.int32)
+    hi = rng.integers(-2, m + 2, 300).astype(np.int32)
+    got = np.asarray(rangemax.query(tab, jnp.asarray(lo), jnp.asarray(hi), op="max"))
+    v = np.asarray(vals)
+    for i in range(len(lo)):
+        a, b = max(int(lo[i]), 0), min(int(hi[i]), m)
+        want = v[a:b].max() if b > a else int(rangemax.INT32_NEG)
+        assert got[i] == want, (lo[i], hi[i])
+
+
+def test_rangemin_brute(rng):
+    m = 32
+    vals = jnp.asarray(rng.integers(-100, 100, m), jnp.int32)
+    tab = rangemax.build(vals, op="min")
+    lo = rng.integers(0, m, 200).astype(np.int32)
+    hi = rng.integers(0, m + 1, 200).astype(np.int32)
+    got = np.asarray(rangemax.query(tab, jnp.asarray(lo), jnp.asarray(hi), op="min"))
+    v = np.asarray(vals)
+    for i in range(len(lo)):
+        a, b = int(lo[i]), int(hi[i])
+        want = v[a:b].min() if b > a else int(rangemax.INT32_POS)
+        assert got[i] == want
+
+
+def test_segtree_min_cover_brute(rng):
+    leaves = 64
+    n = 50
+    lo = rng.integers(0, leaves, n).astype(np.int32)
+    hi = rng.integers(0, leaves + 1, n).astype(np.int32)
+    val = rng.integers(0, 1000, n).astype(np.int32)
+    # disable some updates
+    val[rng.random(n) < 0.3] = int(segtree.INT32_POS)
+    got = np.asarray(
+        segtree.min_cover(leaves, jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(val))
+    )
+    want = np.full(leaves, int(segtree.INT32_POS), np.int64)
+    for j in range(n):
+        for v in range(int(lo[j]), int(hi[j])):
+            want[v] = min(want[v], int(val[j]))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_segtree_empty_updates():
+    leaves = 16
+    lo = jnp.asarray([5, 9], jnp.int32)
+    hi = jnp.asarray([5, 3], jnp.int32)  # empty and inverted
+    val = jnp.asarray([1, 2], jnp.int32)
+    got = np.asarray(segtree.min_cover(leaves, lo, hi, val))
+    assert (got == int(segtree.INT32_POS)).all()
