@@ -1,0 +1,198 @@
+"""Device-resident MVCC write history: the TPU-native ConflictSet state.
+
+The reference keeps committed-write history in a version-annotated skip
+list (fdbserver/SkipList.cpp — one mutable pointer structure, O(log n)
+finger searches). A pointer structure is the wrong shape for a TPU, so the
+same abstract object — a piecewise-constant map keyspace -> last-commit
+version, plus "replace range with version" updates and "max over range"
+queries — is held here as tensors, in two tiers:
+
+* **main**: one sorted boundary array [M, W] with per-segment versions and
+  a sparse range-max table. Immutable between compactions.
+* **fresh runs**: a small ring of per-batch insertions. All writes of one
+  batch commit at a single version (req.version — Resolver.actor.cpp:301),
+  so a fresh run is just a sorted list of *disjoint interval boundaries*
+  plus one scalar version; queries against it are two binary searches
+  (interval-parity test), no range-max needed.
+
+Every `fresh_slots`-ish batches the host triggers `compact()`, which merges
+the ring into main with one lexicographic sort — the amortized analog of
+the skip list's incremental inserts. GC (SkipList::removeBefore
+— :576-608) is free here: whole fresh runs die when their version leaves
+the MVCC window, and main's dead segments collapse at compaction.
+
+All shapes static; all functions pure; state is a NamedTuple pytree that
+callers thread through `jax.jit` with donation.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from foundationdb_tpu.config import KernelConfig
+from foundationdb_tpu.ops import keys as K
+from foundationdb_tpu.ops import rangemax
+
+VERSION_NEG = -(2**31) + 1  # plain int: jnp scalars must not leak into donated pytrees
+
+
+class VersionHistory(NamedTuple):
+    main_keys: jnp.ndarray   # [M, W] uint32 sorted boundaries (tail sentinel)
+    main_ver: jnp.ndarray    # [M] int32 — version of [key_i, key_{i+1});
+    #                          NEG from the last real boundary onward
+    main_tab: jnp.ndarray    # [L, M] int32 sparse range-max table of main_ver
+    fresh_keys: jnp.ndarray  # [F, Mf, W] uint32 — disjoint interval bounds
+    #                          (b0,e0,b1,e1,... sorted; tail sentinel)
+    fresh_ver: jnp.ndarray   # [F] int32 — run version; NEG = slot empty
+    next_slot: jnp.ndarray   # [] int32 ring pointer
+    oldest: jnp.ndarray      # [] int32 current oldestVersion offset
+    overflow: jnp.ndarray    # [] bool — compaction exceeded main capacity
+
+
+def init(config: KernelConfig) -> VersionHistory:
+    m, f, mf, w = (config.history_capacity, config.fresh_slots,
+                   config.fresh_capacity, config.key_words)
+    main_ver = jnp.full((m,), VERSION_NEG, jnp.int32)
+    return VersionHistory(
+        main_keys=K.sentinel_like(m, w),
+        main_ver=main_ver,
+        main_tab=rangemax.build(main_ver, op="max"),
+        fresh_keys=K.sentinel_like(f * mf, w).reshape(f, mf, w),
+        fresh_ver=jnp.full((f,), VERSION_NEG, jnp.int32),
+        next_slot=jnp.int32(0),
+        oldest=jnp.int32(VERSION_NEG),
+        overflow=jnp.asarray(False),
+    )
+
+
+def _interval_parity_hit(flat_bounds: jnp.ndarray, rb: jnp.ndarray, re: jnp.ndarray):
+    """Does [rb, re) intersect the union of disjoint intervals in flat_bounds?
+
+    flat_bounds: [Mf, W] — b0,e0,b1,e1,... ascending, sentinel tail.
+    rb, re: [Q, W]. Returns [Q] bool.
+    A point is inside the union iff an odd number of boundaries are <= it;
+    a range intersects iff its begin is inside, or any boundary falls
+    strictly between begin and end.
+    """
+    i1 = K.searchsorted(flat_bounds, rb, side="right")
+    i2 = K.searchsorted(flat_bounds, re, side="left")
+    return ((i1 & 1) == 1) | (i2 > i1)
+
+
+def query_reads(
+    state: VersionHistory,
+    rb: jnp.ndarray,    # [Q, W] read-range begins
+    re: jnp.ndarray,    # [Q, W] read-range ends
+    snap: jnp.ndarray,  # [Q] int32 read snapshots
+) -> jnp.ndarray:
+    """conflict[q] = (max version over history segments intersecting
+    [rb, re)) > snap — the CheckMax contract (SkipList.cpp:695-759)."""
+    # main tier: segments il..ir intersect the range
+    il = K.searchsorted(state.main_keys, rb, side="right") - 1
+    ir = K.searchsorted(state.main_keys, re, side="left") - 1
+    vmax = rangemax.query(
+        state.main_tab, jnp.maximum(il, 0), ir + 1, op="max"
+    )
+    conflict = vmax > snap
+    # fresh tier: one interval-parity test per live run
+    f = state.fresh_keys.shape[0]
+    for s in range(f):
+        run_hit = _interval_parity_hit(state.fresh_keys[s], rb, re)
+        conflict = conflict | (run_hit & (state.fresh_ver[s] > snap))
+    return conflict
+
+
+def append_run(
+    state: VersionHistory,
+    bounds: jnp.ndarray,  # [Mf, W] sorted disjoint boundaries (sentinel tail)
+    version: jnp.ndarray,  # [] int32
+    nonempty: jnp.ndarray,  # [] bool — empty unions leave the slot dead
+) -> VersionHistory:
+    """Insert one batch's combined committed writes as a fresh run."""
+    slot = state.next_slot
+    fresh_keys = state.fresh_keys.at[slot].set(bounds)
+    fresh_ver = state.fresh_ver.at[slot].set(
+        jnp.where(nonempty, version, VERSION_NEG)
+    )
+    f = state.fresh_ver.shape[0]
+    return state._replace(
+        fresh_keys=fresh_keys,
+        fresh_ver=fresh_ver,
+        next_slot=(slot + 1) % f,
+    )
+
+
+def advance_oldest(state: VersionHistory, new_oldest: jnp.ndarray) -> VersionHistory:
+    """Raise the MVCC floor; whole fresh runs below it die immediately."""
+    oldest = jnp.maximum(state.oldest, new_oldest)
+    dead = state.fresh_ver < oldest
+    fresh_keys = jnp.where(
+        dead[:, None, None],
+        jnp.full_like(state.fresh_keys, K.SENTINEL_WORD),
+        state.fresh_keys,
+    )
+    fresh_ver = jnp.where(dead, VERSION_NEG, state.fresh_ver)
+    return state._replace(fresh_keys=fresh_keys, fresh_ver=fresh_ver, oldest=oldest)
+
+
+def slots_in_use(state: VersionHistory) -> jnp.ndarray:
+    return jnp.sum((state.fresh_ver != VERSION_NEG).astype(jnp.int32))
+
+
+def compact(state: VersionHistory) -> VersionHistory:
+    """Merge all fresh runs into main; drop dead segments; rebuild the table.
+
+    Semantics: the new main is the pointwise max of the old main and every
+    live fresh run, floored to NEG below `oldest` (segments that can never
+    conflict again — removeBefore's invariant), with equal-valued adjacent
+    segments merged.
+    """
+    m, w = state.main_keys.shape
+    f, mf, _ = state.fresh_keys.shape
+    total = m + f * mf
+
+    all_keys = jnp.concatenate(
+        [state.main_keys, state.fresh_keys.reshape(f * mf, w)], axis=0
+    )
+    valid = ~jnp.all(all_keys == K.SENTINEL_WORD, axis=-1)
+    ranks, ukeys, ucount = K.sort_ranks(all_keys, valid)
+
+    # Value of the merged map on the segment starting at each unique key.
+    i_main = K.searchsorted(state.main_keys, ukeys, side="right") - 1
+    val = jnp.where(
+        i_main >= 0, state.main_ver[jnp.maximum(i_main, 0)], VERSION_NEG
+    )
+    for s in range(f):
+        i1 = K.searchsorted(state.fresh_keys[s], ukeys, side="right")
+        covered = (i1 & 1) == 1
+        val = jnp.maximum(
+            val, jnp.where(covered, state.fresh_ver[s], VERSION_NEG)
+        )
+    # Dead floor: versions below the MVCC window can never conflict.
+    val = jnp.where(val < state.oldest, VERSION_NEG, val)
+
+    idx = jnp.arange(total)
+    in_range = idx < ucount
+    prev_val = jnp.concatenate([jnp.full((1,), VERSION_NEG, jnp.int32), val[:-1]])
+    keep = in_range & (val != prev_val)
+
+    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    new_count = jnp.sum(keep.astype(jnp.int32))
+    overflow = state.overflow | (new_count > m)
+    dest = jnp.where(keep & (pos < m), pos, m)  # m = trash row
+
+    new_keys = K.sentinel_like(m + 1, w).at[dest].set(ukeys)[:m]
+    new_ver = jnp.full((m + 1,), VERSION_NEG, jnp.int32).at[dest].set(val)[:m]
+
+    return VersionHistory(
+        main_keys=new_keys,
+        main_ver=new_ver,
+        main_tab=rangemax.build(new_ver, op="max"),
+        fresh_keys=jnp.full_like(state.fresh_keys, K.SENTINEL_WORD),
+        fresh_ver=jnp.full_like(state.fresh_ver, VERSION_NEG),
+        next_slot=jnp.int32(0),
+        oldest=state.oldest,
+        overflow=overflow,
+    )

@@ -12,8 +12,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-INT32_NEG = jnp.int32(-(2**31) + 1)
-INT32_POS = jnp.int32(2**31 - 1)
+INT32_NEG = -(2**31) + 1
+INT32_POS = 2**31 - 1
 
 
 def _num_levels(m: int) -> int:
@@ -51,7 +51,7 @@ def query(table: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray, *, op: str = "ma
     op identity (-inf for max, +inf for min).
     """
     levels, m = table.shape
-    ident = INT32_NEG if op == "max" else INT32_POS
+    ident = jnp.int32(INT32_NEG if op == "max" else INT32_POS)
     fn = jnp.maximum if op == "max" else jnp.minimum
     loc = jnp.clip(lo, 0, m)
     hic = jnp.clip(hi, 0, m)

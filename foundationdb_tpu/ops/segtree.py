@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-INT32_POS = jnp.int32(2**31 - 1)
+from foundationdb_tpu.ops.rangemax import INT32_POS
 
 
 def min_cover(
