@@ -58,6 +58,10 @@ class ResolveTransactionBatchRequest:
     version: int               # commit version of this batch
     last_received_version: int  # acks outstanding replies below this
     transactions: list[CommitTransaction] = dataclasses.field(default_factory=list)
+    # Indices into `transactions` that are metadata ("state") transactions;
+    # their mutations are forwarded to every proxy via reply.state_mutations
+    # (ResolverInterface.h:103 txnStateTransactions).
+    txn_state_transactions: list[int] = dataclasses.field(default_factory=list)
     proxy_id: Optional[str] = None  # stands in for the reply endpoint address
     debug_id: Optional[str] = None
 
@@ -69,4 +73,7 @@ class ResolveTransactionBatchReply:
     conflicting_key_range_map: dict[int, list[int]] = dataclasses.field(
         default_factory=dict
     )
+    # Prior-version state transactions the requesting proxy hasn't seen
+    # (ResolverInterface.h:141 stateMutations).
+    state_mutations: list[Any] = dataclasses.field(default_factory=list)
     debug_id: Optional[str] = None

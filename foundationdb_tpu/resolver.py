@@ -1,0 +1,367 @@
+"""The Resolver role: the host state machine around the TPU conflict kernel.
+
+Behavioral mirror of `fdbserver/Resolver.actor.cpp:219-540` (resolveBatch)
+and its surrounding actor (`resolverCore` :707): everything the reference
+does around `ConflictBatch` — version chaining, duplicate-request replay,
+per-proxy state-transaction delivery, MVCC-window GC, metrics — happens
+here, while the conflict math itself is one jitted call into
+`models.conflict_set.TpuConflictSet`.
+
+Key behaviors reproduced:
+
+* **Version chain.** Requests carry (prev_version, version); a request
+  waits `version.when_at_least(prev_version)` and only the request whose
+  prev_version equals the current version runs the compute phase — others
+  are duplicates (Resolver.actor.cpp:271-307, 525).
+* **Duplicate replay.** Replies are retained per proxy in
+  `outstanding_batches` until the proxy acks them via
+  last_received_version; a duplicate request is answered from the cache,
+  and an unknown version gets no answer at all ("Never") — :319-321,
+  :517-530.
+* **State transactions.** Metadata ("state") transactions committed by any
+  proxy's batch must reach every other proxy in version order: each reply
+  carries the state transactions of versions in [first_unseen_version,
+  req.version) (RecentStateTransactionsInfo :59-123, applied :386-431),
+  trimmed once every proxy has seen them (oldest_proxy_version sweep
+  :449-474).
+* **Memory backpressure.** total_state_bytes over the limit delays new
+  batches until old state is trimmed (:254-268, knob
+  RESOLVER_STATE_MEMORY_LIMIT).
+* **Metrics.** The reference's counters (Resolver.actor.cpp:156-213) and
+  latency samples (resolver/queueWait/compute distributions) with the same
+  names, for the BASELINE p99 comparison.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from foundationdb_tpu.config import KernelConfig
+from foundationdb_tpu.models.conflict_set import TpuConflictSet
+from foundationdb_tpu.models.types import (
+    CommitTransaction,
+    ResolveTransactionBatchReply,
+    ResolveTransactionBatchRequest,
+    TransactionResult,
+)
+from foundationdb_tpu.runtime.flow import Notified, Scheduler, Trigger, any_of
+from foundationdb_tpu.utils.metrics import CounterCollection, LatencySample
+
+#: ServerKnobs.RESOLVER_STATE_MEMORY_LIMIT (fdbclient/ServerKnobs.cpp).
+DEFAULT_STATE_MEMORY_LIMIT = 1_000_000
+
+
+@dataclasses.dataclass
+class StateTransaction:
+    """StateTransactionRef (fdbclient/CommitTransaction.h): one metadata
+    txn forwarded through resolver replies."""
+
+    committed: bool
+    mutations: list[Any]
+
+
+class _ProxyRequestsInfo:
+    """Per-proxy bookkeeping (Resolver.actor.cpp ProxyRequestsInfo)."""
+
+    __slots__ = ("last_version", "outstanding_batches")
+
+    def __init__(self):
+        self.last_version: int = -1
+        self.outstanding_batches: dict[int, ResolveTransactionBatchReply] = {}
+
+
+class _RecentStateTransactionsInfo:
+    """Version -> state txns retained until all proxies have seen them
+    (Resolver.actor.cpp:59-123)."""
+
+    def __init__(self):
+        self._by_version: dict[int, list[StateTransaction]] = {}
+        self._sizes: list[tuple[int, int]] = []  # (version, bytes), ascending
+
+    def add(self, version: int, txns: list[StateTransaction], nbytes: int) -> None:
+        self._by_version[version] = txns
+        if nbytes > 0:
+            self._sizes.append((version, nbytes))
+
+    def erase_up_to(self, oldest_version: int) -> int:
+        for v in [v for v in self._by_version if v <= oldest_version]:
+            del self._by_version[v]
+        erased = 0
+        while self._sizes and self._sizes[0][0] <= oldest_version:
+            erased += self._sizes.pop(0)[1]
+        return erased
+
+    def apply_to_reply(
+        self, reply: ResolveTransactionBatchReply, first_unseen: int, commit_version: int
+    ) -> None:
+        # Prior versions only: the requesting proxy has this version's state
+        # txns already; other proxies will see them as a prior version. One
+        # inner list per version — the wire format's nested VectorRef shape
+        # (ResolverInterface.h:141) — so the proxy applies version by version.
+        for v in sorted(self._by_version):
+            if first_unseen <= v < commit_version:
+                reply.state_mutations.append(self._by_version[v])
+
+    @property
+    def size(self) -> int:
+        return len(self._sizes)
+
+    def first_version(self) -> int:
+        return self._sizes[0][0] if self._sizes else -1
+
+
+class Resolver:
+    """One resolver role instance (Resolver.actor.cpp:126-213 state)."""
+
+    def __init__(
+        self,
+        sched: Scheduler,
+        config: KernelConfig,
+        *,
+        resolver_id: int = 0,
+        resolver_count: int = 1,
+        commit_proxy_count: int = 1,
+        state_memory_limit: int = DEFAULT_STATE_MEMORY_LIMIT,
+        init_version: int = -1,  # reference: Resolver() : version(-1)
+    ):
+        self.sched = sched
+        self.resolver_id = resolver_id
+        self.resolver_count = resolver_count
+        self.commit_proxy_count = commit_proxy_count
+        self.state_memory_limit = state_memory_limit
+
+        self.conflict_set = TpuConflictSet(config)
+        self.version = Notified(init_version)
+        self.needed_version = Notified(-(2**62))
+        self.check_needed_version = Trigger()
+        # Fired whenever needed_version or total_state_bytes changes — the
+        # events the reference's backpressure loop waits on
+        # (`totalStateBytes.onChange() || neededVersion.onChange()`, :261).
+        self._state_changed = Trigger()
+        self.total_state_bytes = 0
+        self.recent_state = _RecentStateTransactionsInfo()
+        self.proxy_info: dict[Optional[str], _ProxyRequestsInfo] = {}
+
+        self.counters = CounterCollection(
+            "ResolverMetrics",
+            [
+                "resolveBatchIn",
+                "resolveBatchStart",
+                "resolveBatchOut",
+                "resolvedTransactions",
+                "resolvedBytes",
+                "resolvedReadConflictRanges",
+                "resolvedWriteConflictRanges",
+                "transactionsAccepted",
+                "transactionsTooOld",
+                "transactionsConflicted",
+                "resolvedStateTransactions",
+                "resolvedStateMutations",
+                "resolvedStateBytes",
+            ],
+        )
+        self.resolver_latency = LatencySample("resolverLatency")
+        self.queue_wait_latency = LatencySample("queueWaitLatency")
+        self.compute_time = LatencySample("computeTime")
+        self.queue_depth = LatencySample("queueDepth")
+        # iops sample feeding the ResolutionBalancer (Resolver.actor.cpp:337-344)
+        self._key_sample: dict[bytes, int] = {}
+
+    def _set_needed_version(self, v: int) -> None:
+        if v > self.needed_version.get():
+            self.needed_version.set(v)
+            self._state_changed.trigger()
+
+    # -- the resolve endpoint --------------------------------------------
+
+    async def resolve(
+        self, req: ResolveTransactionBatchRequest
+    ) -> Optional[ResolveTransactionBatchReply]:
+        """Handle one ResolveTransactionBatchRequest.
+
+        Returns the reply, or None for the reference's `Never()` (an
+        unknown duplicate whose reply was already acked — the proxy will
+        retry elsewhere or die).
+        """
+        request_time = self.sched.now()
+        proxy_key = req.proxy_id if req.prev_version >= 0 else None
+        proxy_info = self.proxy_info.setdefault(proxy_key, _ProxyRequestsInfo())
+        self.counters.add("resolveBatchIn")
+
+        # Memory backpressure (Resolver.actor.cpp:254-268): wait for
+        # needed_version / total_state_bytes to move.
+        while (
+            self.total_state_bytes > self.state_memory_limit
+            and self.recent_state.size
+            and proxy_info.last_version > self.recent_state.first_version()
+            and req.version > self.needed_version.get()
+        ):
+            await self._state_changed.on_trigger()
+
+        # Version chain (:271-293). The loop re-evaluates needed_version on
+        # every check_needed_version trigger (the reference's choose/when),
+        # so a stalled chain can be broken by raising needed_version.
+        while True:
+            if (
+                self.recent_state.size
+                and proxy_info.last_version <= self.recent_state.first_version()
+            ):
+                self._set_needed_version(
+                    max(self.needed_version.get(), req.prev_version)
+                )
+            waiters = self.version.num_waiting()
+            if self.version.get() < req.prev_version:
+                waiters += 1
+            self.queue_depth.sample(waiters)
+            idx, _ = await any_of(
+                [
+                    self.version.when_at_least(req.prev_version),
+                    self.check_needed_version.on_trigger(),
+                ]
+            )
+            if idx == 0:
+                self.queue_depth.sample(self.version.num_waiting())
+                break
+        self.queue_wait_latency.sample(self.sched.now() - request_time)
+
+        if self.version.get() == req.prev_version:
+            # ---- compute phase (no awaits until version.set) -----------
+            begin_compute = self.sched.now()
+            self.counters.add("resolveBatchStart")
+            self.counters.add("resolvedTransactions", len(req.transactions))
+            self.counters.add(
+                "resolvedBytes", sum(_txn_bytes(tr) for tr in req.transactions)
+            )
+
+            if proxy_info.last_version > 0:
+                for v in [
+                    v
+                    for v in proxy_info.outstanding_batches
+                    if v <= req.last_received_version
+                ]:
+                    del proxy_info.outstanding_batches[v]
+
+            first_unseen_version = proxy_info.last_version + 1
+            proxy_info.last_version = req.version
+
+            reply = ResolveTransactionBatchReply(debug_id=req.debug_id)
+            proxy_info.outstanding_batches[req.version] = reply
+
+            for tr in req.transactions:
+                self.counters.add(
+                    "resolvedReadConflictRanges", len(tr.read_conflict_ranges)
+                )
+                self.counters.add(
+                    "resolvedWriteConflictRanges", len(tr.write_conflict_ranges)
+                )
+                if self.resolver_count > 1:
+                    for b, _e in tr.read_conflict_ranges + tr.write_conflict_ranges:
+                        self._key_sample[b] = self._key_sample.get(b, 0) + 1
+
+            result = self.conflict_set.resolve(req.transactions, req.version)
+            reply.committed = result.verdicts
+            reply.conflicting_key_range_map = result.conflicting_key_ranges
+            n_committed = sum(
+                1 for v in result.verdicts if v == TransactionResult.COMMITTED
+            )
+            n_too_old = sum(
+                1 for v in result.verdicts if v == TransactionResult.TOO_OLD
+            )
+            self.counters.add("transactionsAccepted", n_committed)
+            self.counters.add("transactionsTooOld", n_too_old)
+            self.counters.add(
+                "transactionsConflicted",
+                len(req.transactions) - n_committed - n_too_old,
+            )
+
+            # ---- state transactions (:386-431) -------------------------
+            assert req.prev_version >= 0 or not req.txn_state_transactions
+            state_txns: list[StateTransaction] = []
+            state_bytes = 0
+            for t in req.txn_state_transactions:
+                tr = req.transactions[t]
+                state_txns.append(
+                    StateTransaction(
+                        committed=reply.committed[t] == TransactionResult.COMMITTED,
+                        mutations=list(tr.mutations),
+                    )
+                )
+                state_bytes += sum(_mutation_bytes(m) for m in tr.mutations)
+                self.counters.add("resolvedStateMutations", len(tr.mutations))
+            self.counters.add("resolvedStateTransactions", len(req.txn_state_transactions))
+            self.counters.add("resolvedStateBytes", state_bytes)
+            self.recent_state.add(req.version, state_txns, state_bytes)
+            self.recent_state.apply_to_reply(reply, first_unseen_version, req.version)
+
+            # ---- trim state every proxy has seen (:449-474) ------------
+            # The map holds one entry per proxy plus the master's (key None,
+            # created by the recovery request with prev_version < 0); state
+            # is only trimmed once every expected peer has reported in.
+            assert len(self.proxy_info) <= self.commit_proxy_count + 1
+            oldest_proxy_version = req.version
+            for key, info in self.proxy_info.items():
+                if key is not None:
+                    oldest_proxy_version = min(info.last_version, oldest_proxy_version)
+            any_popped = False
+            if (
+                first_unseen_version <= oldest_proxy_version
+                and len(self.proxy_info) == self.commit_proxy_count + 1
+            ):
+                erased = self.recent_state.erase_up_to(oldest_proxy_version)
+                any_popped = erased > 0
+                state_bytes -= erased
+
+            self.version.set(req.version)
+            breached = (
+                self.total_state_bytes <= self.state_memory_limit
+                < self.total_state_bytes + state_bytes
+            )
+            self.total_state_bytes += state_bytes
+            self._state_changed.trigger()
+            if any_popped or breached:
+                self.check_needed_version.trigger()
+            self.compute_time.sample(self.sched.now() - begin_compute)
+        # else: duplicate resolve batch request (:513)
+
+        self.counters.add("resolveBatchOut")
+        self.resolver_latency.sample(self.sched.now() - request_time)
+        out = proxy_info.outstanding_batches.get(req.version)
+        return out  # None == the reference's Never()
+
+    # -- balancer endpoints (ResolverInterface metrics/split) -------------
+
+    def metrics(self) -> int:
+        """ResolutionMetricsRequest: total sampled conflict-range ops."""
+        return sum(self._key_sample.values())
+
+    def split_point(self, begin: bytes, end: bytes, offset_fraction: float) -> bytes:
+        """ResolutionSplitRequest: a key splitting the sampled load in
+        [begin, end) at the given fraction (ResolutionBalancer semantics)."""
+        keys = sorted(k for k in self._key_sample if begin <= k < end)
+        if not keys:
+            return begin
+        total = sum(self._key_sample[k] for k in keys)
+        target = total * offset_fraction
+        acc = 0
+        for k in keys:
+            acc += self._key_sample[k]
+            if acc >= target:
+                return k
+        return keys[-1]
+
+
+def _mutation_bytes(m: Any) -> int:
+    try:
+        return len(m[1]) + len(m[2]) + 8  # (type, param1, param2)
+    except Exception:
+        return 32
+
+
+def _txn_bytes(tr: CommitTransaction) -> int:
+    """CommitTransactionRef::expectedSize analog (conflict ranges + mutations)."""
+    n = sum(
+        len(b) + len(e)
+        for b, e in tr.read_conflict_ranges + tr.write_conflict_ranges
+    )
+    return n + sum(_mutation_bytes(m) for m in tr.mutations)

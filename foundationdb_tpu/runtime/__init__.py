@@ -1,0 +1,21 @@
+from foundationdb_tpu.runtime.flow import (
+    ActorCancelled,
+    Future,
+    FutureStream,
+    Notified,
+    Promise,
+    PromiseStream,
+    Scheduler,
+    TaskPriority,
+)
+
+__all__ = [
+    "ActorCancelled",
+    "Future",
+    "FutureStream",
+    "Notified",
+    "Promise",
+    "PromiseStream",
+    "Scheduler",
+    "TaskPriority",
+]

@@ -1,0 +1,412 @@
+"""A deterministic single-threaded actor runtime: the Flow/Net2 analog.
+
+The reference's entire architecture rests on one idea: every role is an
+actor (a cooperative coroutine) on a single-threaded prioritized run loop
+(`flow/Net2.actor.cpp:1421` run loop; `flow/flow.h` Future/Promise), and
+the same code runs under a simulated clock for deterministic testing
+(`fdbrpc/sim2.actor.cpp`). This module provides the same contract in
+Python, TPU-era style:
+
+* `Scheduler` — the run loop. In `sim` mode time is virtual: when no task
+  is runnable the clock jumps to the next timer, so a whole cluster of
+  actors runs deterministically in one OS process, reproducible from a
+  seed (the Sim2 strategy). In real mode timers use the wall clock.
+* `Future`/`Promise` — single-assignment async values (`flow/flow.h`
+  SAV). Awaitable from any actor coroutine.
+* `PromiseStream`/`FutureStream` — multi-value channels (RPC endpoints).
+* `Notified` — a monotonically increasing value with `when_at_least`,
+  mirroring NotifiedVersion, the primitive behind the resolver/proxy
+  version chains (`fdbserver/Resolver.actor.cpp:283`).
+* Task ordering is strict: (time, -priority, sequence). Two runs with the
+  same seed and the same spawn order execute identically — determinism
+  IS the race detector here, as in the reference (SURVEY.md §5.2).
+
+Actors are plain `async def` functions awaiting these primitives; the
+scheduler drives the coroutines directly (no asyncio), so the event order
+is fully owned by this module.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time as _time
+from typing import Any, Awaitable, Callable, Generator, Iterable, Optional
+
+
+class ActorCancelled(BaseException):
+    """Raised inside an actor when its task is cancelled (actor_cancelled)."""
+
+
+class TaskPriority:
+    """A small slice of the reference's priority lattice (TaskPriority.h)."""
+
+    Max = 1000000
+    RunLoop = 30000
+    DefaultDelay = 7010
+    DefaultEndpoint = 7000
+    ProxyCommit = 8540
+    ProxyResolverReply = 8547
+    ResolutionMetrics = 8700
+    Low = 2000
+    Zero = 0
+
+
+class Future:
+    """Single-assignment future. Await it from an actor coroutine."""
+
+    __slots__ = ("_done", "_value", "_error", "_callbacks")
+
+    def __init__(self):
+        self._done = False
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+        self._callbacks: list[Callable[[Future], None]] = []
+
+    # -- producer side ---------------------------------------------------
+
+    def _set(self, value: Any) -> None:
+        if self._done:
+            raise RuntimeError("future already set")
+        self._done = True
+        self._value = value
+        cbs, self._callbacks = self._callbacks, []
+        for cb in cbs:
+            cb(self)
+
+    def _set_error(self, err: BaseException) -> None:
+        if self._done:
+            raise RuntimeError("future already set")
+        self._done = True
+        self._error = err
+        cbs, self._callbacks = self._callbacks, []
+        for cb in cbs:
+            cb(self)
+
+    # -- consumer side ---------------------------------------------------
+
+    @property
+    def is_ready(self) -> bool:
+        return self._done
+
+    @property
+    def is_error(self) -> bool:
+        return self._done and self._error is not None
+
+    def get(self) -> Any:
+        if not self._done:
+            raise RuntimeError("future not ready")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def add_done_callback(self, cb: Callable[[Future], None]) -> None:
+        if self._done:
+            cb(self)
+        else:
+            self._callbacks.append(cb)
+
+    def __await__(self) -> Generator["Future", None, Any]:
+        if not self._done:
+            yield self
+        return self.get()
+
+
+class Promise:
+    """Producer handle for a Future (reference Promise<T>)."""
+
+    __slots__ = ("future",)
+
+    def __init__(self):
+        self.future = Future()
+
+    def send(self, value: Any = None) -> None:
+        self.future._set(value)
+
+    def send_error(self, err: BaseException) -> None:
+        self.future._set_error(err)
+
+    @property
+    def is_set(self) -> bool:
+        return self.future.is_ready
+
+
+class FutureStream:
+    """Consumer end of a PromiseStream."""
+
+    __slots__ = ("_queue", "_waiters")
+
+    def __init__(self):
+        self._queue: list[Any] = []
+        self._waiters: list[Future] = []
+
+    def next(self) -> Future:
+        f = Future()
+        if self._queue:
+            f._set(self._queue.pop(0))
+        else:
+            self._waiters.append(f)
+        return f
+
+    def is_empty(self) -> bool:
+        return not self._queue
+
+
+class PromiseStream:
+    """Multi-value channel; the shape of an RPC request stream."""
+
+    __slots__ = ("stream",)
+
+    def __init__(self):
+        self.stream = FutureStream()
+
+    def send(self, value: Any) -> None:
+        s = self.stream
+        while s._waiters:
+            w = s._waiters.pop(0)
+            if not w.is_ready:  # waiter may have been cancelled via choose
+                w._set(value)
+                return
+        s._queue.append(value)
+
+
+class Notified:
+    """Monotone value with when_at_least — NotifiedVersion.
+
+    The backbone of the version chains: the resolver waits
+    `version.when_at_least(req.prev_version)` before computing
+    (fdbserver/Resolver.actor.cpp:283), the proxy chains batches the same
+    way (CommitProxyServer.actor.cpp:822-853).
+    """
+
+    def __init__(self, value=0):
+        self._value = value
+        self._waiters: list[tuple[Any, Future]] = []  # (threshold, future)
+
+    def get(self):
+        return self._value
+
+    def set(self, value) -> None:
+        if value < self._value:
+            raise ValueError(f"Notified must not decrease: {value} < {self._value}")
+        self._value = value
+        still = []
+        for threshold, fut in self._waiters:
+            if fut.is_ready:
+                continue
+            if threshold <= value:
+                fut._set(value)
+            else:
+                still.append((threshold, fut))
+        self._waiters = still
+
+    def when_at_least(self, threshold) -> Future:
+        f = Future()
+        if threshold <= self._value:
+            f._set(self._value)
+        else:
+            self._waiters.append((threshold, f))
+        return f
+
+    def num_waiting(self) -> int:
+        return sum(1 for _, f in self._waiters if not f.is_ready)
+
+
+class Trigger:
+    """An edge-triggered signal (AsyncTrigger): on_trigger wakes all waiters."""
+
+    def __init__(self):
+        self._waiters: list[Future] = []
+
+    def on_trigger(self) -> Future:
+        f = Future()
+        self._waiters.append(f)
+        return f
+
+    def trigger(self) -> None:
+        ws, self._waiters = self._waiters, []
+        for f in ws:
+            if not f.is_ready:
+                f._set(None)
+
+
+class Task:
+    """A spawned actor: drives a coroutine over Futures."""
+
+    __slots__ = ("_coro", "_sched", "_priority", "done", "_cancelled", "_name")
+
+    def __init__(self, coro, sched: "Scheduler", priority: int, name: str = ""):
+        self._coro = coro
+        self._sched = sched
+        self._priority = priority
+        self._cancelled = False
+        self._name = name or getattr(coro, "__name__", "actor")
+        self.done = Future()
+
+    def cancel(self) -> None:
+        """Cancel the actor (reference: dropping the last Future reference)."""
+        if self.done.is_ready or self._cancelled:
+            return
+        self._cancelled = True
+        self._sched._schedule(0.0, self._priority, self._step_throw)
+
+    def _step_throw(self) -> None:
+        if self.done.is_ready:
+            return
+        try:
+            self._coro.throw(ActorCancelled())
+        except (StopIteration, ActorCancelled):
+            self.done._set_error(ActorCancelled())
+            return
+        except BaseException as e:  # actor swallowed the cancel and raised
+            self.done._set_error(e)
+            return
+        # Actor caught the cancellation and kept awaiting: treat as done.
+        self.done._set_error(ActorCancelled())
+
+    def _step(self, fut: Optional[Future]) -> None:
+        if self.done.is_ready or self._cancelled:
+            return
+        try:
+            if fut is not None and fut.is_error:
+                waited = self._coro.throw(fut._error)
+            else:
+                # The awaited value is delivered by Future.__await__'s own
+                # `return self.get()`; send just resumes the coroutine.
+                waited = self._coro.send(None)
+        except StopIteration as stop:
+            self.done._set(stop.value)
+            return
+        except ActorCancelled:
+            self.done._set_error(ActorCancelled())
+            return
+        except BaseException as e:
+            self.done._set_error(e)
+            return
+        if not isinstance(waited, Future):
+            raise TypeError(f"actor awaited non-Future {waited!r}")
+        waited.add_done_callback(
+            lambda f: self._sched._schedule(0.0, self._priority, lambda: self._step(f))
+        )
+
+    def __await__(self):
+        return self.done.__await__()
+
+
+class Scheduler:
+    """The single-threaded prioritized run loop (Net2::run / Sim2).
+
+    sim=True — virtual clock: the loop never sleeps, it advances `now` to
+    the next timer when idle. This is what makes whole-cluster tests
+    deterministic and fast (the Sim2 design, fdbrpc/sim2.actor.cpp:977).
+    sim=False — timers wait on the wall clock (time.monotonic).
+    """
+
+    def __init__(self, *, sim: bool = True, start_time: float = 0.0):
+        self.sim = sim
+        self._now = start_time if sim else _time.monotonic()
+        self._seq = 0
+        self._heap: list[tuple[float, int, int, Callable[[], None]]] = []
+        self._running = False
+
+    # -- time -------------------------------------------------------------
+
+    def now(self) -> float:
+        return self._now
+
+    def _schedule(self, delay: float, priority: int, fn: Callable[[], None]) -> None:
+        self._seq += 1
+        due = self._now + max(0.0, delay)
+        heapq.heappush(self._heap, (due, -priority, self._seq, fn))
+
+    def delay(self, seconds: float, priority: int = TaskPriority.DefaultDelay) -> Future:
+        f = Future()
+        self._schedule(seconds, priority, lambda: None if f.is_ready else f._set(None))
+        return f
+
+    # -- actors -----------------------------------------------------------
+
+    def spawn(self, coro, priority: int = TaskPriority.DefaultEndpoint,
+              name: str = "") -> Task:
+        task = Task(coro, self, priority, name)
+        self._schedule(0.0, priority, lambda: task._step(None))
+        return task
+
+    # -- run loop ---------------------------------------------------------
+
+    def run_until(self, fut: Future, *, max_time: float = float("inf")) -> Any:
+        """Drive the loop until `fut` resolves (or the virtual clock passes
+        max_time / the task queue drains)."""
+        self._running = True
+        try:
+            while not fut.is_ready:
+                if not self._heap:
+                    raise RuntimeError("deadlock: run queue drained, future unresolved")
+                due, negpri, seq, fn = heapq.heappop(self._heap)
+                if due > self._now:
+                    if due > max_time:
+                        # Put the event back: a later run must still see it.
+                        heapq.heappush(self._heap, (due, negpri, seq, fn))
+                        raise TimeoutError(
+                            f"virtual clock passed {max_time} awaiting future"
+                        )
+                    if self.sim:
+                        self._now = due
+                    else:
+                        _time.sleep(max(0.0, due - _time.monotonic()))
+                        self._now = _time.monotonic()
+                fn()
+            return fut.get()
+        finally:
+            self._running = False
+
+    def run_for(self, seconds: float) -> None:
+        """Run the loop for a span of (virtual) time."""
+        self.run_until(self.delay(seconds))
+
+
+# -- combinators ----------------------------------------------------------
+
+
+def all_of(futures: Iterable[Future]) -> Future:
+    """waitForAll: resolves with the list of values (first error wins)."""
+    futures = list(futures)
+    out = Future()
+    remaining = [len(futures)]
+    if not futures:
+        out._set([])
+        return out
+
+    def on_done(f: Future) -> None:
+        if out.is_ready:
+            return
+        if f.is_error:
+            out._set_error(f._error)
+            return
+        remaining[0] -= 1
+        if remaining[0] == 0:
+            out._set([x.get() for x in futures])
+
+    for f in futures:
+        f.add_done_callback(on_done)
+    return out
+
+
+def any_of(futures: Iterable[Future]) -> Future:
+    """choose/when: resolves with (index, value) of the first ready future."""
+    futures = list(futures)
+    out = Future()
+
+    def make_cb(i: int):
+        def cb(f: Future) -> None:
+            if out.is_ready:
+                return
+            if f.is_error:
+                out._set_error(f._error)
+            else:
+                out._set((i, f.get()))
+
+        return cb
+
+    for i, f in enumerate(futures):
+        f.add_done_callback(make_cb(i))
+    return out

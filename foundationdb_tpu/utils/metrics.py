@@ -1,0 +1,105 @@
+"""Counters and latency samples: the Stats.h / DDSketch analog.
+
+Mirrors the reference's observability surface for the resolver baseline:
+`Counter`/`CounterCollection::traceCounters`
+(fdbrpc/include/fdbrpc/Stats.h:77-113) and the latency distributions
+(`LatencySample`, DDSketch — fdbrpc/include/fdbrpc/DDSketch.h). The
+sketch here is a log-bucketed histogram with the same relative-error
+contract as DDSketch (gamma = 1 + 2*eps), enough for p50/p95/p99 parity
+reporting without the reference's mergeability machinery.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+
+class Counter:
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def add(self, n: int = 1) -> None:
+        self.value += n
+
+
+class CounterCollection:
+    """Named counter group; `trace()` renders one structured event line."""
+
+    def __init__(self, name: str, counters: list[str] = ()):  # type: ignore[assignment]
+        self.name = name
+        self._counters: dict[str, Counter] = {}
+        for c in counters:
+            self._counters[c] = Counter(c)
+
+    def __getitem__(self, name: str) -> Counter:
+        if name not in self._counters:
+            self._counters[name] = Counter(name)
+        return self._counters[name]
+
+    def add(self, name: str, n: int = 1) -> None:
+        self[name].add(n)
+
+    def get(self, name: str) -> int:
+        return self[name].value
+
+    def as_dict(self) -> dict[str, int]:
+        return {k: c.value for k, c in self._counters.items()}
+
+
+class LatencySample:
+    """Log-bucketed quantile sketch (DDSketch-style, relative error eps)."""
+
+    def __init__(self, name: str, eps: float = 0.01):
+        self.name = name
+        self.eps = eps
+        self._gamma = (1 + eps) / (1 - eps)
+        self._log_gamma = math.log(self._gamma)
+        self._buckets: dict[int, int] = {}
+        self._zero = 0
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def sample(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        self.min = value if self.min is None else min(self.min, value)
+        self.max = value if self.max is None else max(self.max, value)
+        if value <= 0:
+            self._zero += 1
+            return
+        idx = math.ceil(math.log(value) / self._log_gamma)
+        self._buckets[idx] = self._buckets.get(idx, 0) + 1
+
+    def quantile(self, q: float) -> float:
+        if self.count == 0:
+            return 0.0
+        rank = q * (self.count - 1)
+        if rank < self._zero:
+            return 0.0
+        acc = self._zero
+        for idx in sorted(self._buckets):
+            acc += self._buckets[idx]
+            if acc > rank:
+                # midpoint of bucket (gamma^(idx-1), gamma^idx]
+                return 2.0 * self._gamma**idx / (1 + self._gamma)
+        return self.max or 0.0
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def as_dict(self) -> dict[str, float]:
+        return {
+            "count": self.count,
+            "mean": self.mean,
+            "p50": self.quantile(0.50),
+            "p95": self.quantile(0.95),
+            "p99": self.quantile(0.99),
+            "max": self.max or 0.0,
+        }

@@ -1,0 +1,180 @@
+"""Tests for the deterministic actor runtime (runtime/flow.py).
+
+Mirrors the contracts the reference's flow primitives guarantee:
+single-assignment futures, prioritized deterministic ordering, virtual
+time, NotifiedVersion threshold wakeups, actor cancellation.
+"""
+
+import pytest
+
+from foundationdb_tpu.runtime.flow import (
+    ActorCancelled,
+    Notified,
+    Promise,
+    PromiseStream,
+    Scheduler,
+    TaskPriority,
+    Trigger,
+    all_of,
+    any_of,
+)
+
+
+def test_promise_future_roundtrip():
+    sched = Scheduler(sim=True)
+    p = Promise()
+
+    async def consumer():
+        return await p.future
+
+    task = sched.spawn(consumer())
+    sched._schedule(0.0, TaskPriority.Zero, lambda: p.send(42))
+    assert sched.run_until(task.done) == 42
+
+
+def test_delay_advances_virtual_clock():
+    sched = Scheduler(sim=True)
+
+    async def actor():
+        await sched.delay(5.0)
+        return sched.now()
+
+    t = sched.spawn(actor())
+    assert sched.run_until(t.done) == pytest.approx(5.0)
+
+
+def test_deterministic_ordering_two_runs():
+    def run():
+        sched = Scheduler(sim=True)
+        log = []
+
+        async def worker(name, period):
+            for _ in range(5):
+                await sched.delay(period)
+                log.append((name, sched.now()))
+
+        tasks = [sched.spawn(worker("a", 1.0)), sched.spawn(worker("b", 0.7))]
+        sched.run_until(all_of([t.done for t in tasks]))
+        return log
+
+    assert run() == run()
+
+
+def test_priority_ordering_same_time():
+    sched = Scheduler(sim=True)
+    log = []
+    sched._schedule(0.0, TaskPriority.Low, lambda: log.append("low"))
+    sched._schedule(0.0, TaskPriority.Max, lambda: log.append("max"))
+    sched._schedule(0.0, TaskPriority.DefaultEndpoint, lambda: log.append("mid"))
+    done = sched.delay(1.0)
+    sched.run_until(done)
+    assert log == ["max", "mid", "low"]
+
+
+def test_notified_when_at_least():
+    sched = Scheduler(sim=True)
+    n = Notified(0)
+    hits = []
+
+    async def waiter(threshold):
+        await n.when_at_least(threshold)
+        hits.append(threshold)
+
+    tasks = [sched.spawn(waiter(v)) for v in (3, 1, 2)]
+    sched.run_for(0.01)  # let the actors reach their await
+    assert n.num_waiting() == 3
+    n.set(2)
+    sched.run_until(all_of([tasks[1].done, tasks[2].done]))
+    assert sorted(hits) == [1, 2]
+    assert n.num_waiting() == 1
+    n.set(3)
+    sched.run_until(tasks[0].done)
+    assert sorted(hits) == [1, 2, 3]
+    with pytest.raises(ValueError):
+        n.set(1)
+
+
+def test_promise_stream_fifo():
+    sched = Scheduler(sim=True)
+    ps = PromiseStream()
+    got = []
+
+    async def consumer():
+        for _ in range(3):
+            got.append(await ps.stream.next())
+
+    t = sched.spawn(consumer())
+    for v in (1, 2, 3):
+        ps.send(v)
+    sched.run_until(t.done)
+    assert got == [1, 2, 3]
+
+
+def test_actor_cancellation():
+    sched = Scheduler(sim=True)
+    progress = []
+
+    async def actor():
+        progress.append("start")
+        await sched.delay(100.0)
+        progress.append("never")
+
+    t = sched.spawn(actor())
+    sched.run_for(1.0)
+    t.cancel()
+    sched.run_for(1.0)
+    assert progress == ["start"]
+    assert t.done.is_error
+    with pytest.raises(ActorCancelled):
+        t.done.get()
+
+
+def test_any_of_choose():
+    sched = Scheduler(sim=True)
+
+    async def actor():
+        idx, _val = await any_of([sched.delay(5.0), sched.delay(2.0)])
+        return idx
+
+    t = sched.spawn(actor())
+    assert sched.run_until(t.done) == 1
+
+
+def test_trigger_wakes_all():
+    sched = Scheduler(sim=True)
+    trig = Trigger()
+    woke = []
+
+    async def waiter(i):
+        await trig.on_trigger()
+        woke.append(i)
+
+    tasks = [sched.spawn(waiter(i)) for i in range(3)]
+    sched.run_for(0.1)
+    trig.trigger()
+    sched.run_until(all_of([t.done for t in tasks]))
+    assert sorted(woke) == [0, 1, 2]
+
+
+def test_actor_error_propagates():
+    sched = Scheduler(sim=True)
+
+    async def actor():
+        raise RuntimeError("boom")
+
+    t = sched.spawn(actor())
+    sched.run_for(0.1)
+    with pytest.raises(RuntimeError, match="boom"):
+        t.done.get()
+
+
+def test_deadlock_detection():
+    sched = Scheduler(sim=True)
+    p = Promise()
+
+    async def actor():
+        await p.future
+
+    t = sched.spawn(actor())
+    with pytest.raises(RuntimeError, match="deadlock"):
+        sched.run_until(t.done)
