@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Headline bench: resolver throughput at 64K-txn batches.
+
+The TPU conflict kernel (foundationdb_tpu.ops.conflict.resolve_batch,
+replacing fdbserver/SkipList.cpp detectConflicts) versus the measured CPU
+baseline (foundationdb_tpu/native — the stand-in for the reference's
+`fdbserver -r skiplisttest` microbench, fdbserver/SkipList.cpp:1082-1177:
+uniform 1M keyspace, one read + one write range per txn).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": txns/s on device, "unit": "txn/s",
+   "vs_baseline": device_rate / cpu_baseline_rate}
+
+Both sides resolve the identical batch stream, and their commit/abort
+decisions are asserted identical before any timing is reported.
+
+Env overrides: BENCH_TXNS (default 65536), BENCH_BATCHES (default 16),
+BENCH_CPU_BATCHES (default 4).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    n_txns = int(os.environ.get("BENCH_TXNS", 65536))
+    n_batches = int(os.environ.get("BENCH_BATCHES", 16))
+    cpu_batches = int(os.environ.get("BENCH_CPU_BATCHES", 4))
+    keyspace = 1_000_000
+    version_step = 200_000
+    window = 1_000_000  # floor rises after 5 batches -> steady-state GC
+
+    import jax
+
+    from foundationdb_tpu.config import KernelConfig
+    from foundationdb_tpu.models.conflict_set import TpuConflictSet
+    from foundationdb_tpu.testing.benchgen import skiplist_style_batch
+
+    log(f"devices: {jax.devices()}")
+    cap = 1 << (n_txns - 1).bit_length()
+    config = KernelConfig(
+        max_key_bytes=8,
+        max_txns=cap,
+        max_reads=cap,
+        max_writes=cap,
+        history_capacity=8 * cap,  # ~window/version_step batches of writes
+        fresh_slots=8,
+        fresh_capacity=2 * cap,
+        window_versions=window,
+    )
+
+    rng = np.random.default_rng(0)
+    batches = []
+    for i in range(n_batches):
+        version = (i + 1) * version_step
+        batches.append(
+            skiplist_style_batch(
+                rng, config, n_txns, version=version, keyspace=keyspace,
+                key_bytes=8,
+            )
+        )
+    log(f"generated {n_batches} batches of {n_txns} txns")
+
+    # ---- CPU baseline (native C++ ConflictBatch-equivalent) -------------
+    from foundationdb_tpu.native import NativeConflictSet
+
+    def flat(batch, which):
+        begin = batch.read_begin if which == "r" else batch.write_begin
+        end = batch.read_end if which == "r" else batch.write_end
+        txn = batch.read_txn if which == "r" else batch.write_txn
+        n = batch.n_reads if which == "r" else batch.n_writes
+        w = (begin.shape[1] - 1) * 4
+        # interleave begin_i, end_i into one byte blob
+        kb = np.frombuffer(begin[:n, :-1].astype(">u4").tobytes(), np.uint8)
+        ke = np.frombuffer(end[:n, :-1].astype(">u4").tobytes(), np.uint8)
+        blob = np.stack([kb.reshape(n, w), ke.reshape(n, w)], axis=1).reshape(-1)
+        off = np.arange(2 * n + 1, dtype=np.int64) * w
+        return blob, off, txn[:n].astype(np.int32)
+
+    cpu = NativeConflictSet(window=window)
+    cpu_times = []
+    cpu_verdicts = []
+    for i in range(cpu_batches):
+        b = batches[i]
+        rkeys, roff, rtxn = flat(b, "r")
+        wkeys, woff, wtxn = flat(b, "w")
+        snaps = b.snapshot[:n_txns].astype(np.int64)
+        t0 = time.perf_counter()
+        v = cpu.resolve_raw(
+            int(b.version), snaps, rkeys, roff, rtxn, wkeys, woff, wtxn
+        )
+        cpu_times.append(time.perf_counter() - t0)
+        cpu_verdicts.append(v)
+    cpu_rate = n_txns * len(cpu_times) / sum(cpu_times)
+    log(f"cpu baseline: {cpu_rate:,.0f} txn/s "
+        f"(per-batch {[f'{t*1e3:.1f}ms' for t in cpu_times]})")
+
+    # ---- TPU kernel ------------------------------------------------------
+    cs = TpuConflictSet(config)
+    # Warmup/compile on batch 0's shapes (all batches share shapes).
+    t0 = time.perf_counter()
+    out = cs.resolve_packed(batches[0])
+    out.verdict.block_until_ready()
+    log(f"first call (compile+run): {time.perf_counter() - t0:.1f}s")
+
+    # Decision parity vs. the CPU baseline on the first batches.
+    dev_v = np.asarray(out.verdict)[:n_txns]
+    assert (dev_v == cpu_verdicts[0]).all(), "decision mismatch vs CPU baseline"
+
+    dev_times = []
+    for i in range(1, n_batches):
+        b = batches[i]
+        t0 = time.perf_counter()
+        out = cs.resolve_packed(b)
+        out.verdict.block_until_ready()
+        dev_times.append(time.perf_counter() - t0)
+        if i < cpu_batches:
+            dv = np.asarray(out.verdict)[:n_txns]
+            assert (dv == cpu_verdicts[i]).all(), f"mismatch at batch {i}"
+    log("decision parity: OK")
+
+    dev_rate = n_txns * len(dev_times) / sum(dev_times)
+    lat = sorted(dev_times)
+    p50 = lat[len(lat) // 2]
+    p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+    log(
+        f"device: {dev_rate:,.0f} txn/s | batch p50 {p50*1e3:.1f}ms "
+        f"p99 {p99*1e3:.1f}ms | speedup {dev_rate / cpu_rate:.2f}x"
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": f"resolver_txns_per_sec_{n_txns // 1024}k_batch",
+                "value": round(dev_rate, 1),
+                "unit": "txn/s",
+                "vs_baseline": round(dev_rate / cpu_rate, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

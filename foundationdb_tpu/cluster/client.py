@@ -1,0 +1,215 @@
+"""Client API: Database / Transaction with read-your-writes.
+
+Behavioral mirror of the reference client stack:
+
+* `Transaction` (fdbclient/NativeAPI.actor.cpp): lazy GRV
+  (getReadVersion -> GRV proxy batch), reads routed to the storage shard
+  owning the key, commit via a commit proxy, retry loop with backoff
+  (`on_error`).
+* Read-your-writes (fdbclient/ReadYourWrites.actor.cpp / WriteMap.h):
+  uncommitted writes overlay reads — a `get` of a key this txn set
+  returns the new value without adding phantom conflicts; range reads
+  merge the write map over the storage snapshot.
+* Conflict ranges (fdbclient/RYWIterator.cpp semantics): point reads add
+  [k, k+\\x00) read conflicts; range reads add [begin, end); sets add
+  point write conflicts; clears add range write conflicts — matching
+  CommitTransactionRef's contract (fdbclient/CommitTransaction.h).
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Optional
+
+from foundationdb_tpu.cluster.commit_proxy import NotCommitted, TransactionTooOldError
+from foundationdb_tpu.models.types import CommitTransaction
+
+
+def key_after(k: bytes) -> bytes:
+    return k + b"\x00"
+
+
+class WriteMap:
+    """Uncommitted writes: sorted clear ranges + point sets (WriteMap.h)."""
+
+    def __init__(self):
+        self.sets: dict[bytes, bytes] = {}
+        self.clears: list[tuple[bytes, bytes]] = []  # disjoint, sorted
+
+    def set(self, k: bytes, v: bytes) -> None:
+        self.sets[k] = v
+
+    def clear(self, b: bytes, e: bytes) -> None:
+        for k in [k for k in self.sets if b <= k < e]:
+            del self.sets[k]
+        merged = [(b, e)]
+        for cb, ce in self.clears:
+            if ce < b or cb > e:  # disjoint (touching ranges merge)
+                merged.append((cb, ce))
+            else:
+                merged[0] = (min(merged[0][0], cb), max(merged[0][1], ce))
+        self.clears = sorted(merged)
+
+    def lookup(self, k: bytes) -> tuple[bool, Optional[bytes]]:
+        """(known, value): known=True if this txn wrote/cleared k."""
+        if k in self.sets:
+            return True, self.sets[k]
+        for cb, ce in self.clears:
+            if cb <= k < ce:
+                return True, None
+        return False, None
+
+    def overlay(self, items: list[tuple[bytes, bytes]], b: bytes, e: bytes):
+        """Merge the write map over a storage snapshot of [b, e)."""
+        out = {k: v for k, v in items}
+        for cb, ce in self.clears:
+            for k in [k for k in out if cb <= k < ce]:
+                del out[k]
+        for k, v in self.sets.items():
+            if b <= k < e:
+                out[k] = v
+        return sorted(out.items())
+
+
+class Transaction:
+    def __init__(self, db: "Database"):
+        self.db = db
+        self._read_version: Optional[int] = None
+        self.writes = WriteMap()
+        self.mutations: list = []
+        self.read_conflicts: list[tuple[bytes, bytes]] = []
+        self.write_conflicts: list[tuple[bytes, bytes]] = []
+        self.report_conflicting_keys = False
+        self.committed_version: Optional[int] = None
+
+    # -- reads ------------------------------------------------------------
+
+    async def get_read_version(self) -> int:
+        if self._read_version is None:
+            self._read_version = await self.db.grv_proxy.get_read_version().future
+        return self._read_version
+
+    async def get(self, key: bytes, *, snapshot: bool = False) -> Optional[bytes]:
+        known, val = self.writes.lookup(key)
+        if known:
+            return val
+        rv = await self.get_read_version()
+        val = await self.db.storage_for(key).get_value(key, rv)
+        if not snapshot:
+            self.read_conflicts.append((key, key_after(key)))
+        return val
+
+    async def get_range(
+        self, begin: bytes, end: bytes, *, limit: int = 1 << 30,
+        snapshot: bool = False,
+    ) -> list[tuple[bytes, bytes]]:
+        rv = await self.get_read_version()
+        items: list[tuple[bytes, bytes]] = []
+        for ss in self.db.storages_for_range(begin, end):
+            items.extend(await ss.get_key_values(begin, end, rv))
+        merged = self.writes.overlay(items, begin, end)[:limit]
+        if not snapshot:
+            # The reference narrows the conflict range to the keys actually
+            # read when a limit stops the scan early; with a full scan it is
+            # [begin, end).
+            if limit < len(self.writes.overlay(items, begin, end)):
+                hi = key_after(merged[-1][0]) if merged else begin
+                self.read_conflicts.append((begin, hi))
+            else:
+                self.read_conflicts.append((begin, end))
+        return merged
+
+    # -- writes -----------------------------------------------------------
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self.writes.set(key, value)
+        self.mutations.append(("set", key, value))
+        self.write_conflicts.append((key, key_after(key)))
+
+    def clear(self, key: bytes) -> None:
+        self.clear_range(key, key_after(key))
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        self.writes.clear(begin, end)
+        self.mutations.append(("clear", begin, end))
+        self.write_conflicts.append((begin, end))
+
+    def add_read_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self.read_conflicts.append((begin, end))
+
+    def add_write_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self.write_conflicts.append((begin, end))
+
+    # -- commit -----------------------------------------------------------
+
+    async def commit(self) -> int:
+        if not self.mutations and not self.write_conflicts:
+            # Read-only transactions commit client-side at the read version
+            # (Transaction::commit fast path).
+            self.committed_version = await self.get_read_version()
+            return self.committed_version
+        rv = await self.get_read_version()
+        ctr = CommitTransaction(
+            read_conflict_ranges=_dedup(self.read_conflicts),
+            write_conflict_ranges=_dedup(self.write_conflicts),
+            read_snapshot=rv,
+            report_conflicting_keys=self.report_conflicting_keys,
+            mutations=list(self.mutations),
+        )
+        ctr.validate()
+        version = await self.db.commit_proxy().commit(ctr).future
+        self.committed_version = version
+        return version
+
+    def reset(self) -> None:
+        self.__init__(self.db)
+
+
+def _dedup(ranges):
+    return sorted(set(ranges))
+
+
+class Database:
+    """Client handle + the run/retry loop (Database::createTransaction)."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self.sched = cluster.sched
+        self.grv_proxy = cluster.grv_proxy
+        self._next_proxy = 0
+
+    def commit_proxy(self):
+        # round-robin over commit proxies (the reference picks randomly)
+        p = self.cluster.commit_proxies[
+            self._next_proxy % len(self.cluster.commit_proxies)
+        ]
+        self._next_proxy += 1
+        return p
+
+    def storage_for(self, key: bytes):
+        return self.cluster.storage_servers[self.cluster.key_servers.shard_of(key)]
+
+    def storages_for_range(self, begin: bytes, end: bytes):
+        return [
+            self.cluster.storage_servers[s]
+            for s in self.cluster.key_servers.shards_of_range(begin, end)
+        ]
+
+    def create_transaction(self) -> Transaction:
+        return Transaction(self)
+
+    async def run(self, fn, *, max_retries: int = 50):
+        """retry_loop(fn): the standard transaction retry pattern
+        (Transaction::onError — not_committed and too-old retry with a
+        fresh read version)."""
+        backoff = 0.001
+        for _ in range(max_retries):
+            txn = self.create_transaction()
+            try:
+                result = await fn(txn)
+                await txn.commit()
+                return result
+            except (NotCommitted, TransactionTooOldError):
+                await self.sched.delay(backoff)
+                backoff = min(backoff * 2, 0.1)
+        raise RuntimeError("transaction retry limit reached")

@@ -1,0 +1,391 @@
+"""CommitProxy: the 5-phase commit pipeline.
+
+Behavioral mirror of `fdbserver/CommitProxyServer.actor.cpp`:
+
+* `commit_batcher` (:361): accumulates client CommitTransactionRequests
+  into batches bounded by count/bytes/interval.
+* `commit_batch` (:2516-2555) phases:
+  1. pre-resolution (:812): batches are version-ordered; get the
+     (prev_version, version] pair from the Sequencer.
+  2. resolution (:959): ResolutionRequestBuilder splits every txn's
+     conflict ranges across resolvers by the key_resolvers partition
+     (:105-261) — each resolver sees only the pieces in its partition but
+     every resolver sees every batch version (the version chain); state
+     transactions go to all resolvers.
+  3. post-resolution (:2045): committed = min over the verdicts of the
+     resolvers each txn touched (determineCommittedTransactions
+     :1551-1567); metadata mutations of committed state txns apply to the
+     txn-state store (applyMetadataToCommittedTransactions :1596);
+     mutations get storage tags by key_servers shard
+     (assignMutationsToStorageServers :1861).
+  4. transaction logging (:2294): one TLog push per batch, version chained.
+  5. reply (:2333): report the live committed version to the Sequencer,
+     then answer clients (committed version / not_committed with the
+     conflicting-range report).
+
+Batch pipelining: successive batches overlap; ordering is enforced by the
+latest_batch_resolving / latest_batch_logging Notified chains
+(:822-853, 1020), exactly the reference's NotifiedVersion discipline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+from foundationdb_tpu.models.types import (
+    CommitTransaction,
+    ResolveTransactionBatchRequest,
+    TransactionResult,
+)
+from foundationdb_tpu.runtime.flow import (
+    Notified,
+    Promise,
+    PromiseStream,
+    Scheduler,
+    all_of,
+)
+from foundationdb_tpu.utils.metrics import CounterCollection
+
+SYSTEM_PREFIX = b"\xff"
+
+
+class NotCommitted(Exception):
+    """error_code_not_committed; carries the conflicting read-range report."""
+
+    def __init__(self, conflicting_ranges: Optional[list[int]] = None):
+        super().__init__("transaction conflict")
+        self.conflicting_ranges = conflicting_ranges
+
+
+class TransactionTooOldError(Exception):
+    """error_code_transaction_too_old from the resolver verdict."""
+
+
+@dataclasses.dataclass
+class CommitRequest:
+    transaction: CommitTransaction
+    reply: Promise  # -> commit version, or error
+
+
+@dataclasses.dataclass
+class KeyPartition:
+    """Static key-range partition: boundaries[i] starts shard i+1.
+
+    Stands in for the dynamic keyResolvers / keyServers maps
+    (CommitProxyServer.actor.cpp:147-196, fdbclient/SystemData.cpp).
+    """
+
+    boundaries: list[bytes]
+
+    @property
+    def n_shards(self) -> int:
+        return len(self.boundaries) + 1
+
+    def shard_of(self, key: bytes) -> int:
+        s = 0
+        for b in self.boundaries:
+            if key >= b:
+                s += 1
+            else:
+                break
+        return s
+
+    def clip(self, begin: bytes, end: bytes, shard: int):
+        lo = self.boundaries[shard - 1] if shard > 0 else b""
+        hi = self.boundaries[shard] if shard < len(self.boundaries) else None
+        cb = max(begin, lo)
+        ce = end if hi is None else min(end, hi)
+        return (cb, ce) if cb < ce else None
+
+    def shards_of_range(self, begin: bytes, end: bytes) -> list[int]:
+        return [
+            s for s in range(self.n_shards)
+            if self.clip(begin, end, s) is not None
+        ]
+
+
+class CommitProxy:
+    def __init__(
+        self,
+        sched: Scheduler,
+        proxy_id: str,
+        sequencer,
+        resolvers: list,            # objects with .resolve(req) coroutine
+        tlog,                       # TLog
+        key_resolvers: KeyPartition,
+        key_servers: KeyPartition,
+        *,
+        batch_interval: float = 0.005,
+        max_batch_txns: int = 512,
+        on_state_mutation: Optional[Callable[[Any], None]] = None,
+    ):
+        self.sched = sched
+        self.proxy_id = proxy_id
+        self.sequencer = sequencer
+        self.resolvers = resolvers
+        self.tlog = tlog
+        self.key_resolvers = key_resolvers
+        self.key_servers = key_servers
+        self.batch_interval = batch_interval
+        self.max_batch_txns = max_batch_txns
+        self.on_state_mutation = on_state_mutation
+
+        self.requests = PromiseStream()
+        self._batch_num = 0
+        self._request_num = 0
+        self.latest_batch_resolving = Notified(0)
+        self.latest_batch_logging = Notified(0)
+        self.last_received_version = 0
+        self.committed_version = Notified(0)
+        self.counters = CounterCollection(
+            "ProxyMetrics",
+            ["txnCommitIn", "txnCommitOut", "txnConflicts", "commitBatchIn"],
+        )
+        self.failed: Optional[BaseException] = None
+        self._task = None
+
+    def start(self) -> None:
+        self._task = self.sched.spawn(self._batcher(), name=f"{self.proxy_id}-batcher")
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+    # -- client entry -----------------------------------------------------
+
+    def commit(self, txn: CommitTransaction) -> Promise:
+        p = Promise()
+        self.counters.add("txnCommitIn")
+        if self.failed is not None:
+            # A broken proxy fails fast; the reference would be replaced by
+            # recovery (fdbserver/ClusterRecovery.actor.cpp).
+            p.send_error(self.failed)
+            return p
+        self.requests.send(CommitRequest(txn, p))
+        return p
+
+    # -- phase 0: batching (commitBatcher :361) ----------------------------
+
+    async def _batcher(self) -> None:
+        while True:
+            first = await self.requests.stream.next()
+            batch = [first]
+            deadline = self.sched.now() + self.batch_interval
+            while (
+                len(batch) < self.max_batch_txns
+                and not self.requests.stream.is_empty()
+            ):
+                batch.append(await self.requests.stream.next())
+            # allow a short accumulation window
+            while len(batch) < self.max_batch_txns and self.sched.now() < deadline:
+                await self.sched.delay(self.batch_interval / 4)
+                while (
+                    len(batch) < self.max_batch_txns
+                    and not self.requests.stream.is_empty()
+                ):
+                    batch.append(await self.requests.stream.next())
+            self._batch_num += 1
+            self.sched.spawn(
+                self._commit_batch(batch, self._batch_num),
+                name=f"{self.proxy_id}-batch{self._batch_num}",
+            )
+
+    # -- phases 1-5 (commitBatch :2516) ------------------------------------
+
+    async def _commit_batch(self, batch: list[CommitRequest], batch_num: int) -> None:
+        try:
+            await self._commit_batch_impl(batch, batch_num)
+        except BaseException as e:
+            # An internal failure must not strand the clients (their reply
+            # futures) nor leave the error invisible. The version chain may
+            # now have a hole, so the proxy marks itself broken — the
+            # reference's equivalent outcome is a recovery.
+            self.failed = e
+            for r in batch:
+                if not r.reply.is_set:
+                    r.reply.send_error(e)
+            raise
+
+    async def _commit_batch_impl(
+        self, batch: list[CommitRequest], batch_num: int
+    ) -> None:
+        self.counters.add("commitBatchIn")
+        # Phase 1: order batches, get the version pair.
+        await self.latest_batch_resolving.when_at_least(batch_num - 1)
+        self._request_num += 1
+        vreply = await self.sequencer.get_commit_version(
+            self.proxy_id, self._request_num, self._request_num
+        )
+        prev_version, version = vreply.prev_version, vreply.version
+
+        # Phase 2: resolution.
+        txns = [r.transaction for r in batch]
+        reqs, txn_resolver_map, range_maps = self._build_resolution_requests(
+            txns, prev_version, version
+        )
+        self.latest_batch_resolving.set(batch_num)
+        replies = await all_of(
+            [
+                self.sched.spawn(res.resolve(req)).done
+                for res, req in zip(self.resolvers, reqs)
+            ]
+        )
+        self.last_received_version = version
+
+        # Phase 3: post-resolution (order by logging chain).
+        await self.latest_batch_logging.when_at_least(batch_num - 1)
+        verdicts, conflict_reports = self._determine_committed(
+            txns, replies, txn_resolver_map, range_maps
+        )
+
+        # State mutations from other proxies' prior versions first, then
+        # this batch's own committed metadata mutations.
+        if self.on_state_mutation is not None:
+            for group in replies[0].state_mutations:
+                for st in group:
+                    if st.committed:
+                        for m in st.mutations:
+                            self.on_state_mutation(m)
+            for t, tr in enumerate(txns):
+                if verdicts[t] == TransactionResult.COMMITTED:
+                    for m in tr.mutations:
+                        if _is_metadata(m):
+                            self.on_state_mutation(m)
+
+        messages = self._assign_mutations(txns, verdicts)
+
+        # Phase 4: push to the log system.
+        from foundationdb_tpu.cluster.tlog import TLogCommitRequest
+
+        await self.tlog.commit(
+            TLogCommitRequest(
+                prev_version=prev_version, version=version, messages=messages
+            )
+        )
+        self.latest_batch_logging.set(batch_num)
+
+        # Phase 5: reply.
+        self.sequencer.report_live_committed_version(version)
+        self.committed_version.set(version)
+        for t, req in enumerate(batch):
+            v = verdicts[t]
+            if v == TransactionResult.COMMITTED:
+                self.counters.add("txnCommitOut")
+                req.reply.send(version)
+            elif v == TransactionResult.TOO_OLD:
+                req.reply.send_error(TransactionTooOldError())
+            else:
+                self.counters.add("txnConflicts")
+                req.reply.send_error(NotCommitted(conflict_reports.get(t)))
+
+    # -- ResolutionRequestBuilder (:105-261) --------------------------------
+
+    def _build_resolution_requests(self, txns, prev_version, version):
+        n_res = len(self.resolvers)
+        per_res_txns: list[list[CommitTransaction]] = [[] for _ in range(n_res)]
+        per_res_state: list[list[int]] = [[] for _ in range(n_res)]
+        txn_resolver_map: list[dict[int, int]] = []  # t -> {resolver: local idx}
+        range_maps: list[dict[int, list[int]]] = []  # t -> {res: local->orig read idx}
+
+        for t, tr in enumerate(txns):
+            is_state = any(_is_metadata(m) for m in tr.mutations)
+            targets: dict[int, CommitTransaction] = {}
+            ridx: dict[int, list[int]] = {}
+            for i, (b, e) in enumerate(tr.read_conflict_ranges):
+                for s in self.key_resolvers.shards_of_range(b, e):
+                    lt = targets.setdefault(
+                        s,
+                        CommitTransaction(
+                            read_snapshot=tr.read_snapshot,
+                            report_conflicting_keys=tr.report_conflicting_keys,
+                        ),
+                    )
+                    lt.read_conflict_ranges.append(self.key_resolvers.clip(b, e, s))
+                    ridx.setdefault(s, []).append(i)
+            for b, e in tr.write_conflict_ranges:
+                for s in self.key_resolvers.shards_of_range(b, e):
+                    lt = targets.setdefault(
+                        s,
+                        CommitTransaction(
+                            read_snapshot=tr.read_snapshot,
+                            report_conflicting_keys=tr.report_conflicting_keys,
+                        ),
+                    )
+                    lt.write_conflict_ranges.append(self.key_resolvers.clip(b, e, s))
+            if is_state:
+                # state txns go to every resolver (with their mutations)
+                for s in range(n_res):
+                    lt = targets.setdefault(
+                        s,
+                        CommitTransaction(
+                            read_snapshot=tr.read_snapshot,
+                            report_conflicting_keys=tr.report_conflicting_keys,
+                        ),
+                    )
+                    lt.mutations = list(tr.mutations)
+            tmap: dict[int, int] = {}
+            for s, lt in targets.items():
+                tmap[s] = len(per_res_txns[s])
+                per_res_txns[s].append(lt)
+                if is_state:
+                    per_res_state[s].append(tmap[s])
+            txn_resolver_map.append(tmap)
+            range_maps.append(ridx)
+
+        reqs = [
+            ResolveTransactionBatchRequest(
+                prev_version=prev_version,
+                version=version,
+                last_received_version=self.last_received_version,
+                transactions=per_res_txns[s],
+                txn_state_transactions=per_res_state[s],
+                proxy_id=self.proxy_id,
+            )
+            for s in range(n_res)
+        ]
+        return reqs, txn_resolver_map, range_maps
+
+    # -- determineCommittedTransactions (:1551-1567) -------------------------
+
+    def _determine_committed(self, txns, replies, txn_resolver_map, range_maps):
+        verdicts: list[TransactionResult] = []
+        reports: dict[int, list[int]] = {}
+        for t in range(len(txns)):
+            v = TransactionResult.COMMITTED
+            for s, local in txn_resolver_map[t].items():
+                v = min(v, replies[s].committed[local])
+            verdicts.append(TransactionResult(v))
+            if v == TransactionResult.CONFLICT and txns[t].report_conflicting_keys:
+                idxs: set[int] = set()
+                for s, local in txn_resolver_map[t].items():
+                    lmap = range_maps[t].get(s)  # local read idx -> original
+                    for li in replies[s].conflicting_key_range_map.get(local, []):
+                        idxs.add(lmap[li] if lmap is not None else li)
+                reports[t] = sorted(idxs)
+        return verdicts, reports
+
+    # -- assignMutationsToStorageServers (:1861) ------------------------------
+
+    def _assign_mutations(self, txns, verdicts) -> dict[int, list[Any]]:
+        messages: dict[int, list[Any]] = {}
+        for t, tr in enumerate(txns):
+            if verdicts[t] != TransactionResult.COMMITTED:
+                continue
+            for m in tr.mutations:
+                kind = m[0]
+                if kind == "set":
+                    shards = [self.key_servers.shard_of(m[1])]
+                elif kind == "clear":
+                    shards = self.key_servers.shards_of_range(m[1], m[2])
+                else:
+                    raise ValueError(f"unknown mutation {m!r}")
+                for s in shards:
+                    messages.setdefault(s, []).append(m)
+        return messages
+
+
+def _is_metadata(m) -> bool:
+    """Metadata mutations target the \xff system keyspace
+    (the applyMetadataToCommittedTransactions condition)."""
+    return m[1].startswith(SYSTEM_PREFIX)

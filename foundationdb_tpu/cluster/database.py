@@ -1,0 +1,165 @@
+"""Cluster assembly: every role wired into one runnable transaction system.
+
+The single-process analog of the reference's simulated cluster
+(fdbserver/SimulatedCluster.actor.cpp): Sequencer (master), GrvProxy,
+CommitProxies, Resolvers (each wrapping the TPU conflict kernel), one
+TLog, and key-range-sharded StorageServers — connected by the same
+version chains the real system uses. The client stack
+(cluster/client.py) runs real transactions against it.
+
+Role recruitment order mirrors recovery (fdbserver/ClusterRecovery.
+actor.cpp): resolvers get the master's initial batch (prev_version < 0),
+tlog/storage start at the recovery version, then proxies open for
+business.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from foundationdb_tpu.cluster.client import Database
+from foundationdb_tpu.cluster.commit_proxy import CommitProxy, KeyPartition
+from foundationdb_tpu.cluster.grv_proxy import GrvProxy
+from foundationdb_tpu.cluster.sequencer import Sequencer
+from foundationdb_tpu.cluster.storage import StorageServer
+from foundationdb_tpu.cluster.tlog import TLog
+from foundationdb_tpu.config import KernelConfig, TEST_CONFIG
+from foundationdb_tpu.models.types import ResolveTransactionBatchRequest
+from foundationdb_tpu.resolver import Resolver
+from foundationdb_tpu.runtime.flow import Scheduler, all_of
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    n_commit_proxies: int = 1
+    n_grv_proxies: int = 1          # v0: one GRV proxy
+    n_resolvers: int = 1
+    n_storage: int = 2
+    resolver_boundaries: list = None  # len n_resolvers-1; default even bytes
+    storage_boundaries: list = None   # len n_storage-1
+    # Versions advance at ~1e6/s of (virtual) time (Sequencer), so the MVCC
+    # window must be the reference's time-window equivalent (5s = 5e6
+    # versions, fdbclient/ServerKnobs.cpp:43), not the unit-test default.
+    # Keys get headroom over the unit-test config (point-write conflict
+    # ranges append \x00 to the key).
+    kernel_config: KernelConfig = TEST_CONFIG.scaled(
+        window_versions=5_000_000, max_key_bytes=16
+    )
+    commit_batch_interval: float = 0.005
+    window_versions: int = None      # default: kernel_config.window_versions
+
+    def __post_init__(self):
+        if self.resolver_boundaries is None:
+            self.resolver_boundaries = _even_boundaries(self.n_resolvers)
+        if self.storage_boundaries is None:
+            self.storage_boundaries = _even_boundaries(self.n_storage)
+        if self.window_versions is None:
+            self.window_versions = self.kernel_config.window_versions
+
+
+def _even_boundaries(n: int) -> list:
+    """n-way even split of the one-byte-prefix keyspace."""
+    return [bytes([int(256 * (i + 1) / n)]) for i in range(n - 1)]
+
+
+class Cluster:
+    def __init__(self, sched: Scheduler, config: ClusterConfig = None):
+        self.sched = sched
+        self.config = config or ClusterConfig()
+        cfg = self.config
+
+        self.sequencer = Sequencer(sched)
+        self.key_resolvers = KeyPartition(list(cfg.resolver_boundaries))
+        self.key_servers = KeyPartition(list(cfg.storage_boundaries))
+        self.resolvers = [
+            Resolver(
+                sched,
+                cfg.kernel_config,
+                resolver_id=i,
+                resolver_count=cfg.n_resolvers,
+                commit_proxy_count=cfg.n_commit_proxies,
+            )
+            for i in range(cfg.n_resolvers)
+        ]
+        self.tlog = TLog(sched)
+        self.storage_servers = [
+            StorageServer(
+                sched, self.tlog, tag=s, window_versions=cfg.window_versions
+            )
+            for s in range(cfg.n_storage)
+        ]
+        self.txn_state_store: dict[bytes, bytes] = {}
+        self.commit_proxies = [
+            CommitProxy(
+                sched,
+                f"proxy{p}",
+                self.sequencer,
+                self.resolvers,
+                self.tlog,
+                self.key_resolvers,
+                self.key_servers,
+                batch_interval=cfg.commit_batch_interval,
+                # a batch must fit the kernel's static txn capacity
+                max_batch_txns=cfg.kernel_config.max_txns,
+                on_state_mutation=self._apply_state_mutation,
+            )
+            for p in range(cfg.n_commit_proxies)
+        ]
+        self.grv_proxy = GrvProxy(sched, self.sequencer)
+        self._started = False
+
+    def _apply_state_mutation(self, m) -> None:
+        kind = m[0]
+        if kind == "set":
+            self.txn_state_store[m[1]] = m[2]
+        elif kind == "clear":
+            for k in [k for k in self.txn_state_store if m[1] <= k < m[2]]:
+                del self.txn_state_store[k]
+
+    async def _bootstrap(self) -> None:
+        # The master's initial resolver batch (prev_version < 0) — creates
+        # the master entry every resolver's proxy map needs.
+        futs = []
+        for r in self.resolvers:
+            futs.append(
+                self.sched.spawn(
+                    r.resolve(
+                        ResolveTransactionBatchRequest(
+                            prev_version=-1,
+                            version=0,
+                            last_received_version=-1,
+                            transactions=[],
+                        )
+                    )
+                ).done
+            )
+        await all_of(futs)
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        self.sched.run_until(self.sched.spawn(self._bootstrap()).done)
+        for ss in self.storage_servers:
+            ss.start()
+        for cp in self.commit_proxies:
+            cp.start()
+        self.grv_proxy.start()
+
+    def stop(self) -> None:
+        for ss in self.storage_servers:
+            ss.stop()
+        for cp in self.commit_proxies:
+            cp.stop()
+        self.grv_proxy.stop()
+        self._started = False
+
+    def database(self) -> Database:
+        return Database(self)
+
+
+def open_cluster(config: ClusterConfig = None, *, sched: Scheduler = None):
+    sched = sched or Scheduler(sim=True)
+    cluster = Cluster(sched, config)
+    cluster.start()
+    return sched, cluster, cluster.database()

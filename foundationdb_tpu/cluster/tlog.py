@@ -1,0 +1,74 @@
+"""TLog: the replicated, version-ordered durable mutation log.
+
+Behavioral mirror of `fdbserver/TLogServer.actor.cpp`:
+
+* `commit` (tLogCommit :2311): mutations arrive tagged per storage
+  server; versions must arrive in order (prev_version chain); a commit is
+  durable once appended (the in-memory deque stands in for the DiskQueue
+  ring file — fdbserver/DiskQueue.actor.cpp).
+* `peek` (per-tag peek cursors, LogSystemPeekCursor.actor.cpp): a storage
+  server reads messages for its tag strictly after a version, blocking
+  until the log advances past it.
+* `pop` (:popped bookkeeping): once a storage server durably applied a
+  version, the prefix can be discarded.
+
+The version chain uses the same Notified pattern as the resolver; commits
+with a stale prev_version wait, duplicates are idempotent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from foundationdb_tpu.runtime.flow import Notified, Scheduler
+
+Tag = int  # storage tag (the reference's Tag{locality, id})
+
+
+@dataclasses.dataclass
+class TLogCommitRequest:
+    prev_version: int
+    version: int
+    # tag -> list of mutations for that storage server
+    messages: dict[Tag, list[Any]]
+    known_committed_version: int = 0
+
+
+class TLog:
+    """One in-memory tlog instance."""
+
+    def __init__(self, sched: Scheduler, *, recovery_version: int = 0):
+        self.sched = sched
+        self.version = Notified(recovery_version)
+        # tag -> list of (version, mutations)
+        self._messages: dict[Tag, list[tuple[int, list[Any]]]] = {}
+        self._popped: dict[Tag, int] = {}
+
+    async def commit(self, req: TLogCommitRequest) -> int:
+        """Append one version's messages; returns the durable version."""
+        await self.version.when_at_least(req.prev_version)
+        if self.version.get() >= req.version:
+            return self.version.get()  # duplicate (already durable)
+        for tag, msgs in req.messages.items():
+            self._messages.setdefault(tag, []).append((req.version, msgs))
+        self.version.set(req.version)
+        return req.version
+
+    async def peek(self, tag: Tag, after_version: int):
+        """Messages for `tag` with version > after_version; waits until the
+        log has advanced past after_version (peek cursor contract)."""
+        await self.version.when_at_least(after_version + 1)
+        out = [
+            (v, msgs)
+            for v, msgs in self._messages.get(tag, [])
+            if v > after_version
+        ]
+        return out, self.version.get()
+
+    def pop(self, tag: Tag, up_to_version: int) -> None:
+        """Discard tag messages at versions <= up_to_version."""
+        self._popped[tag] = max(self._popped.get(tag, 0), up_to_version)
+        self._messages[tag] = [
+            (v, m) for v, m in self._messages.get(tag, []) if v > up_to_version
+        ]

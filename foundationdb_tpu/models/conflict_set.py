@@ -68,6 +68,13 @@ def _rebase(state: H.VersionHistory, delta):
     )
 
 
+# Module-level jitted kernels: shared across all TpuConflictSet instances
+# so N resolvers with the same KernelConfig compile once, not N times.
+_RESOLVE = jax.jit(C.resolve_batch, donate_argnums=0)
+_COMPACT = jax.jit(H.compact, donate_argnums=0)
+_REBASE = jax.jit(_rebase, donate_argnums=0)
+
+
 class TpuConflictSet:
     """Batch MVCC conflict detection with device-resident history."""
 
@@ -76,9 +83,9 @@ class TpuConflictSet:
         self.base_version = base_version
         self.state = H.init(config)
         self._appends_since_compact = 0
-        self._resolve = jax.jit(C.resolve_batch, donate_argnums=0)
-        self._compact = jax.jit(H.compact, donate_argnums=0)
-        self._rebase = jax.jit(_rebase, donate_argnums=0)
+        self._resolve = _RESOLVE
+        self._compact = _COMPACT
+        self._rebase = _REBASE
 
     # -- ConflictBatch-equivalent API -----------------------------------
 
@@ -106,6 +113,18 @@ class TpuConflictSet:
         self.state, out = self._resolve(self.state, batch.device_args())
         self._appends_since_compact += 1
         return self._build_result(transactions, batch, out)
+
+    def resolve_packed(self, batch: packing.PackedBatch) -> C.BatchVerdict:
+        """Kernel-only path for pre-packed batches (bench / perf tests).
+
+        Skips the Python packer and reply assembly; the caller owns
+        version rebasing (offsets must fit int32).
+        """
+        if self._appends_since_compact >= self.config.fresh_slots:
+            self.compact()
+        self.state, out = self._resolve(self.state, batch.device_args())
+        self._appends_since_compact += 1
+        return out
 
     def compact(self) -> None:
         self.state = self._compact(self.state)

@@ -1,0 +1,162 @@
+"""ctypes bindings for the native (C++) CPU conflict set.
+
+Builds `libconflict.so` on first use with g++ (the image has no pybind11;
+the C ABI + ctypes is the binding seam — same role as the reference's
+fdb_c C ABI, bindings/c/fdb_c.cpp). The native library serves two jobs:
+
+* the measured CPU baseline for bench.py (the stand-in for the
+  reference's `fdbserver -r skiplisttest` microbench), and
+* an independent C++ parity oracle for the JAX kernel.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "conflict_set.cpp")
+_LIB = os.path.join(_DIR, "libconflict.so")
+_lock = threading.Lock()
+_lib = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _build() -> None:
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        "-o", _LIB, _SRC,
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise NativeBuildError(f"g++ failed:\n{proc.stderr}")
+
+
+def load() -> ctypes.CDLL:
+    """Build (if stale) and load the native library."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if (
+            not os.path.exists(_LIB)
+            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        ):
+            _build()
+        lib = ctypes.CDLL(_LIB)
+        lib.cs_create.restype = ctypes.c_void_p
+        lib.cs_create.argtypes = [ctypes.c_int64]
+        lib.cs_destroy.argtypes = [ctypes.c_void_p]
+        lib.cs_resolve.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int32, ctypes.c_void_p,
+        ]
+        lib.cs_history_size.restype = ctypes.c_int64
+        lib.cs_history_size.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+def _flatten(ranges_per_txn):
+    """[(txn, begin, end)] -> (key blob, offsets[2n+1], txn ids[n])."""
+    keys = bytearray()
+    offsets = [0]
+    txn_ids = []
+    for t, b, e in ranges_per_txn:
+        keys.extend(b)
+        offsets.append(len(keys))
+        keys.extend(e)
+        offsets.append(len(keys))
+        txn_ids.append(t)
+    return (
+        np.frombuffer(bytes(keys), np.uint8) if keys else np.zeros(0, np.uint8),
+        np.asarray(offsets, np.int64),
+        np.asarray(txn_ids, np.int32),
+    )
+
+
+class NativeConflictSet:
+    """CPU conflict set with the ConflictBatch verdict contract."""
+
+    def __init__(self, window: int = 5_000_000):
+        self._lib = load()
+        self._cs = self._lib.cs_create(window)
+
+    def __del__(self):
+        if getattr(self, "_cs", None):
+            self._lib.cs_destroy(self._cs)
+            self._cs = None
+
+    def resolve(self, transactions, version: int) -> np.ndarray:
+        """transactions: CommitTransaction-shaped objects. Returns [n] int32
+        verdicts (0=conflict, 1=tooOld, 3=committed)."""
+        n = len(transactions)
+        snapshots = np.asarray(
+            [t.read_snapshot for t in transactions], np.int64
+        )
+        reads = [
+            (t, b, e)
+            for t, tr in enumerate(transactions)
+            for b, e in tr.read_conflict_ranges
+        ]
+        writes = [
+            (t, b, e)
+            for t, tr in enumerate(transactions)
+            for b, e in tr.write_conflict_ranges
+        ]
+        rkeys, roff, rtxn = _flatten(reads)
+        wkeys, woff, wtxn = _flatten(writes)
+        verdict = np.zeros(n, np.int32)
+        c = ctypes.c_void_p
+        self._lib.cs_resolve(
+            self._cs, version, n,
+            snapshots.ctypes.data_as(c),
+            rkeys.ctypes.data_as(c), roff.ctypes.data_as(c),
+            rtxn.ctypes.data_as(c), len(rtxn),
+            wkeys.ctypes.data_as(c), woff.ctypes.data_as(c),
+            wtxn.ctypes.data_as(c), len(wtxn),
+            verdict.ctypes.data_as(c),
+        )
+        return verdict
+
+    def resolve_raw(
+        self,
+        version: int,
+        snapshots: np.ndarray,   # [n] int64
+        rkeys: np.ndarray,       # uint8 blob: begin_i/end_i interleaved
+        roff: np.ndarray,        # [2*n_reads+1] int64 offsets into rkeys
+        rtxn: np.ndarray,        # [n_reads] int32
+        wkeys: np.ndarray,
+        woff: np.ndarray,
+        wtxn: np.ndarray,
+    ) -> np.ndarray:
+        """Zero-copy path for pre-flattened batches (bench hot loop)."""
+        n = snapshots.shape[0]
+        verdict = np.zeros(n, np.int32)
+        c = ctypes.c_void_p
+        self._lib.cs_resolve(
+            self._cs, version, n,
+            np.ascontiguousarray(snapshots, np.int64).ctypes.data_as(c),
+            np.ascontiguousarray(rkeys, np.uint8).ctypes.data_as(c),
+            np.ascontiguousarray(roff, np.int64).ctypes.data_as(c),
+            np.ascontiguousarray(rtxn, np.int32).ctypes.data_as(c), len(rtxn),
+            np.ascontiguousarray(wkeys, np.uint8).ctypes.data_as(c),
+            np.ascontiguousarray(woff, np.int64).ctypes.data_as(c),
+            np.ascontiguousarray(wtxn, np.int32).ctypes.data_as(c), len(wtxn),
+            verdict.ctypes.data_as(c),
+        )
+        return verdict
+
+    @property
+    def history_size(self) -> int:
+        return self._lib.cs_history_size(self._cs)

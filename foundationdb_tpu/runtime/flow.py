@@ -280,6 +280,18 @@ class Task:
             self.done._set_error(ActorCancelled())
             return
         except BaseException as e:
+            if not self.done._callbacks:
+                # Fire-and-forget actor crashed with nobody awaiting: surface
+                # it (a silent death here stalls whatever chains on the
+                # actor's side effects — the hardest deadlock to debug).
+                import sys
+                import traceback
+
+                print(
+                    f"[flow] unhandled error in actor {self._name!r}:",
+                    file=sys.stderr,
+                )
+                traceback.print_exception(e, file=sys.stderr)
             self.done._set_error(e)
             return
         if not isinstance(waited, Future):

@@ -1,0 +1,108 @@
+"""C++ native conflict set: build, semantics, and three-way parity.
+
+The native library is an independent implementation of the ConflictBatch
+contract; here it is cross-checked against the Python oracle on random
+workloads (three-way parity with the JAX kernel happens transitively via
+test_conflict_parity.py, which pins kernel == oracle).
+"""
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.testing.oracle import ConflictOracle, OracleTxn
+from foundationdb_tpu.testing.workloads import WorkloadConfig, make_batch
+
+native = pytest.importorskip("foundationdb_tpu.native")
+
+
+@pytest.fixture(scope="module")
+def lib():
+    try:
+        native.load()
+    except native.NativeBuildError as e:  # no g++ in env
+        pytest.skip(f"native build unavailable: {e}")
+    return native
+
+
+def to_oracle(txns):
+    return [
+        OracleTxn(
+            read_conflict_ranges=t.read_conflict_ranges,
+            write_conflict_ranges=t.write_conflict_ranges,
+            read_snapshot=t.read_snapshot,
+        )
+        for t in txns
+    ]
+
+
+def test_native_basic_semantics(lib):
+    from foundationdb_tpu.models.types import CommitTransaction
+
+    cs = native.NativeConflictSet(window=1000)
+    v = cs.resolve(
+        [CommitTransaction(write_conflict_ranges=[(b"a", b"b")])], 10
+    )
+    assert v.tolist() == [3]
+    v = cs.resolve(
+        [
+            CommitTransaction(
+                read_conflict_ranges=[(b"a", b"b")], read_snapshot=5
+            )
+        ],
+        20,
+    )
+    assert v.tolist() == [0]  # stale read of the v10 write
+    v = cs.resolve(
+        [
+            CommitTransaction(
+                read_conflict_ranges=[(b"a", b"b")], read_snapshot=20
+            )
+        ],
+        30,
+    )
+    assert v.tolist() == [3]
+    # tooOld: snapshot below the MVCC window
+    v = cs.resolve(
+        [
+            CommitTransaction(
+                read_conflict_ranges=[(b"x", b"y")], read_snapshot=-2000
+            )
+        ],
+        1500,
+    )
+    assert v.tolist() == [1]
+
+
+def test_native_intra_batch_order(lib):
+    from foundationdb_tpu.models.types import CommitTransaction
+
+    cs = native.NativeConflictSet(window=1000)
+    batch = [
+        CommitTransaction(write_conflict_ranges=[(b"k", b"l")]),
+        CommitTransaction(
+            read_conflict_ranges=[(b"k", b"l")], read_snapshot=5
+        ),
+        # reads of later writes do NOT conflict
+        CommitTransaction(read_conflict_ranges=[(b"z", b"zz")], read_snapshot=5),
+        CommitTransaction(write_conflict_ranges=[(b"z", b"zz")]),
+    ]
+    v = cs.resolve(batch, 10)
+    assert v.tolist() == [3, 0, 3, 3]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_native_oracle_parity_random(lib, seed):
+    cfg = WorkloadConfig(
+        n_txns=40, keyspace=64, key_width=6, stale_fraction=0.05, zipf=1.2
+    )
+    window = 500
+    cs = native.NativeConflictSet(window=window)
+    oracle = ConflictOracle(window=window)
+    rng = np.random.default_rng(seed)
+    version = 0
+    for _ in range(15):
+        version += int(rng.integers(1, 60))
+        txns = make_batch(rng, cfg, version, window)
+        got = cs.resolve(txns, version).tolist()
+        want = oracle.resolve(to_oracle(txns), version).verdicts
+        assert got == want
