@@ -187,11 +187,11 @@ class Database:
         return p
 
     def storage_for(self, key: bytes):
-        return self.cluster.storage_servers[self.cluster.key_servers.shard_of(key)]
+        return self.cluster.client_storages[self.cluster.key_servers.shard_of(key)]
 
     def storages_for_range(self, begin: bytes, end: bytes):
         return [
-            self.cluster.storage_servers[s]
+            self.cluster.client_storages[s]
             for s in self.cluster.key_servers.shards_of_range(begin, end)
         ]
 

@@ -35,6 +35,9 @@ class ClusterConfig:
     n_grv_proxies: int = 1          # v0: one GRV proxy
     n_resolvers: int = 1
     n_storage: int = 2
+    # When set, role-to-role calls go through a SimNetwork with this seed
+    # (deterministic latency; clogging/partition fault injection).
+    sim_seed: int = None
     resolver_boundaries: list = None  # len n_resolvers-1; default even bytes
     storage_boundaries: list = None   # len n_storage-1
     # Versions advance at ~1e6/s of (virtual) time (Sequencer), so the MVCC
@@ -89,13 +92,28 @@ class Cluster:
             for s in range(cfg.n_storage)
         ]
         self.txn_state_store: dict[bytes, bytes] = {}
+
+        self.net = None
+        if cfg.sim_seed is not None:
+            from foundationdb_tpu.sim.network import SimNetwork
+
+            self.net = SimNetwork(sched, seed=cfg.sim_seed)
+
+        def wrapped(src, dst, obj, methods):
+            if self.net is None:
+                return obj
+            return self.net.wrap(src, dst, obj, methods)
+
         self.commit_proxies = [
             CommitProxy(
                 sched,
                 f"proxy{p}",
                 self.sequencer,
-                self.resolvers,
-                self.tlog,
+                [
+                    wrapped(f"proxy{p}", f"resolver{i}", r, ["resolve"])
+                    for i, r in enumerate(self.resolvers)
+                ],
+                wrapped(f"proxy{p}", "tlog0", self.tlog, ["commit"]),
                 self.key_resolvers,
                 self.key_servers,
                 batch_interval=cfg.commit_batch_interval,
@@ -106,7 +124,33 @@ class Cluster:
             for p in range(cfg.n_commit_proxies)
         ]
         self.grv_proxy = GrvProxy(sched, self.sequencer)
+        # What clients actually talk to (network-wrapped under simulation).
+        self.client_storages = [
+            wrapped("client", f"storage{s}", ss, ["get_value", "get_key_values"])
+            for s, ss in enumerate(self.storage_servers)
+        ]
         self._started = False
+
+    def reboot_storage(self, s: int) -> None:
+        """Kill storage server s and bring up a replacement from its durable
+        state — the SaveAndKill/restart-test path (SURVEY.md §4): the new
+        process resumes pulling the log from its durable version."""
+        old = self.storage_servers[s]
+        old.stop()
+        new = StorageServer(
+            self.sched, self.tlog, tag=s,
+            window_versions=self.config.window_versions,
+        )
+        new.restore(old.snapshot())
+        self.storage_servers[s] = new
+        if self.net is None:
+            self.client_storages[s] = new
+        else:
+            self.client_storages[s] = self.net.wrap(
+                "client", f"storage{s}", new, ["get_value", "get_key_values"]
+            )
+        if self._started:
+            new.start()
 
     def _apply_state_mutation(self, m) -> None:
         kind = m[0]

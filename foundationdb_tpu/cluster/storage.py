@@ -122,6 +122,26 @@ class StorageServer:
         else:
             raise ValueError(f"unknown mutation {m!r}")
 
+    # -- checkpoint / resume ---------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The durable on-disk state a restart would recover from
+        (storage servers persist at durable_version and replay the log
+        tail — storageserver.actor.cpp recovery path)."""
+        return {
+            "keys": list(self._keys),
+            "data": dict(self._data),
+            "durable_version": self.durable_version,
+        }
+
+    def restore(self, snap: dict) -> None:
+        self._keys = list(snap["keys"])
+        self._data = dict(snap["data"])
+        self.durable_version = snap["durable_version"]
+        self.oldest_version = snap["durable_version"]
+        self.version = Notified(snap["durable_version"])
+        self._window = []
+
     # -- read path -----------------------------------------------------------
 
     async def _wait_for_version(self, version: int) -> None:

@@ -27,14 +27,17 @@ REPS = 5
 
 
 def timeit(name, fn, *args):
+    t0 = time.perf_counter()
     out = fn(*args)  # compile
     jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     for _ in range(REPS):
         out = fn(*args)
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / REPS
-    print(f"{name:35s} {dt * 1e3:9.2f} ms")
+    print(f"{name:35s} {dt * 1e3:9.2f} ms   (compile {compile_s:5.1f}s)",
+          flush=True)
     return out
 
 
@@ -64,6 +67,11 @@ def main():
 
     nr = batch["read_valid"].shape[0]
     nw = batch["write_valid"].shape[0]
+
+    # ---- full kernel first (most important number) -----------------------
+    st2 = jax.tree.map(jnp.copy, state)
+    timeit("FULL resolve_batch", step, st2, batch)
+    timeit("compact", jax.jit(H.compact), jax.tree.map(jnp.copy, state))
 
     # ---- stage: sort_ranks ----------------------------------------------
     points = jnp.concatenate(
@@ -125,10 +133,6 @@ def main():
     mw = seg_only(committed0)
     timeit("  rangemax.build only", jax.jit(lambda x: rangemax.build(x, op='min')), mw)
 
-    # ---- full kernel + compact ------------------------------------------
-    st2 = jax.tree.map(jnp.copy, state)
-    timeit("FULL resolve_batch", step, st2, batch)
-    timeit("compact", jax.jit(H.compact), state)
 
 
 if __name__ == "__main__":
