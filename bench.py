@@ -5,14 +5,18 @@ The TPU conflict kernel (foundationdb_tpu.ops.conflict.resolve_batch,
 replacing fdbserver/SkipList.cpp detectConflicts) versus the measured CPU
 baseline (foundationdb_tpu/native — the stand-in for the reference's
 `fdbserver -r skiplisttest` microbench, fdbserver/SkipList.cpp:1082-1177:
-uniform 1M keyspace, one read + one write range per txn).
+uniform 1M keyspace, one read + one write range per txn; snapshots lag up
+to two batch-versions so reads really contend with history).
 
 Prints ONE JSON line:
   {"metric": ..., "value": txns/s on device, "unit": "txn/s",
    "vs_baseline": device_rate / cpu_baseline_rate}
 
-Both sides resolve the identical batch stream, and their commit/abort
-decisions are asserted identical before any timing is reported.
+Phases: (1) CPU baseline timing + verdicts; (2) parity phase — the TPU
+kernel resolves the same stream and decisions are asserted identical;
+(3) pipelined throughput — a fresh kernel instance re-runs the stream
+with async dispatch (state donation chains batches on-device), timed
+end-to-end; (4) per-batch latency probe with blocking calls.
 
 Env overrides: BENCH_TXNS (default 65536), BENCH_BATCHES (default 16),
 BENCH_CPU_BATCHES (default 4).
@@ -37,6 +41,7 @@ def main():
     keyspace = 1_000_000
     version_step = 200_000
     window = 1_000_000  # floor rises after 5 batches -> steady-state GC
+    snapshot_lag = 2 * version_step  # spans ~2 batches: history conflicts real
 
     import jax
 
@@ -51,9 +56,10 @@ def main():
         max_txns=cap,
         max_reads=cap,
         max_writes=cap,
-        history_capacity=8 * cap,  # ~window/version_step batches of writes
-        fresh_slots=8,
-        fresh_capacity=2 * cap,
+        # hard bound on live boundaries: window/step = 5 live batches x
+        # 2*writes/batch = 10*cap (coalescing only shrinks it; overflow
+        # raises, never lies)
+        history_capacity=10 * cap,
         window_versions=window,
     )
 
@@ -64,7 +70,7 @@ def main():
         batches.append(
             skiplist_style_batch(
                 rng, config, n_txns, version=version, keyspace=keyspace,
-                key_bytes=8,
+                key_bytes=8, snapshot_lag=snapshot_lag,
             )
         )
     log(f"generated {n_batches} batches of {n_txns} txns")
@@ -88,8 +94,7 @@ def main():
     cpu = NativeConflictSet(window=window)
     cpu_times = []
     cpu_verdicts = []
-    for i in range(cpu_batches):
-        b = batches[i]
+    for i, b in enumerate(batches):
         rkeys, roff, rtxn = flat(b, "r")
         wkeys, woff, wtxn = flat(b, "w")
         snaps = b.snapshot[:n_txns].astype(np.int64)
@@ -98,42 +103,53 @@ def main():
             int(b.version), snaps, rkeys, roff, rtxn, wkeys, woff, wtxn
         )
         cpu_times.append(time.perf_counter() - t0)
-        cpu_verdicts.append(v)
-    cpu_rate = n_txns * len(cpu_times) / sum(cpu_times)
-    log(f"cpu baseline: {cpu_rate:,.0f} txn/s "
-        f"(per-batch {[f'{t*1e3:.1f}ms' for t in cpu_times]})")
-
-    # ---- TPU kernel ------------------------------------------------------
-    cs = TpuConflictSet(config)
-    # Warmup/compile on batch 0's shapes (all batches share shapes).
-    t0 = time.perf_counter()
-    out = cs.resolve_packed(batches[0])
-    out.verdict.block_until_ready()
-    log(f"first call (compile+run): {time.perf_counter() - t0:.1f}s")
-
-    # Decision parity vs. the CPU baseline on the first batches.
-    dev_v = np.asarray(out.verdict)[:n_txns]
-    assert (dev_v == cpu_verdicts[0]).all(), "decision mismatch vs CPU baseline"
-
-    dev_times = []
-    for i in range(1, n_batches):
-        b = batches[i]
-        t0 = time.perf_counter()
-        out = cs.resolve_packed(b)
-        out.verdict.block_until_ready()
-        dev_times.append(time.perf_counter() - t0)
         if i < cpu_batches:
-            dv = np.asarray(out.verdict)[:n_txns]
-            assert (dv == cpu_verdicts[i]).all(), f"mismatch at batch {i}"
-    log("decision parity: OK")
+            cpu_verdicts.append(v)
+    # steady-state rate: skip the warm-up batches before the window fills
+    steady = cpu_times[len(cpu_times) // 2 :]
+    cpu_rate = n_txns * len(steady) / sum(steady)
+    log(f"cpu baseline: {cpu_rate:,.0f} txn/s steady "
+        f"(per-batch {[f'{t*1e3:.0f}ms' for t in cpu_times]})")
 
-    dev_rate = n_txns * len(dev_times) / sum(dev_times)
-    lat = sorted(dev_times)
-    p50 = lat[len(lat) // 2]
-    p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+    # ---- phase 2: decision parity ---------------------------------------
+    cs = TpuConflictSet(config)
+    t0 = time.perf_counter()
+    for i in range(cpu_batches):
+        out = cs.resolve_packed(batches[i])
+        dv = np.asarray(out.verdict)[:n_txns]
+        n_commit = int((dv == 3).sum())
+        n_conflict = int((dv == 0).sum())
+        assert (dv == cpu_verdicts[i]).all(), f"decision mismatch at batch {i}"
+    log(f"decision parity: OK ({cpu_batches} batches, last: "
+        f"{n_commit} committed / {n_conflict} conflicted; "
+        f"incl. compile {time.perf_counter() - t0:.1f}s)")
+
+    # ---- phase 3: pipelined throughput ----------------------------------
+    cs2 = TpuConflictSet(config)
+    outs = []
+    t0 = time.perf_counter()
+    for b in batches:
+        outs.append(cs2.resolve_packed(b))  # async dispatch; state chains
+    jax.block_until_ready(outs[-1].verdict)
+    total = time.perf_counter() - t0
+    dev_rate = n_txns * n_batches / total
+    cs2.check_overflow()
+
+    # ---- phase 4: per-batch latency probe -------------------------------
+    cs3 = TpuConflictSet(config)
+    lat = []
+    for b in batches:
+        t0 = time.perf_counter()
+        out = cs3.resolve_packed(b)
+        out.verdict.block_until_ready()
+        lat.append(time.perf_counter() - t0)
+    lat_s = sorted(lat[1:])
+    p50 = lat_s[len(lat_s) // 2]
+    p99 = lat_s[min(len(lat_s) - 1, int(len(lat_s) * 0.99))]
+
     log(
-        f"device: {dev_rate:,.0f} txn/s | batch p50 {p50*1e3:.1f}ms "
-        f"p99 {p99*1e3:.1f}ms | speedup {dev_rate / cpu_rate:.2f}x"
+        f"device: {dev_rate:,.0f} txn/s pipelined | latency p50 {p50*1e3:.0f}ms "
+        f"p99 {p99*1e3:.0f}ms | speedup {dev_rate / cpu_rate:.2f}x"
     )
 
     print(

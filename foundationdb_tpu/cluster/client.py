@@ -119,6 +119,16 @@ class Transaction:
                 self.read_conflicts.append((begin, end))
         return merged
 
+    async def watch(self, key: bytes):
+        """Watch `key`: returns a Future firing when its value changes from
+        what this transaction observes (Transaction::watch semantics —
+        registered against the owning storage server)."""
+        value = await self.get(key, snapshot=True)
+        ss = self.db.cluster.storage_servers[
+            self.db.cluster.key_servers.shard_of(key)
+        ]
+        return ss.watch(key, value)
+
     # -- writes -----------------------------------------------------------
 
     def set(self, key: bytes, value: bytes) -> None:

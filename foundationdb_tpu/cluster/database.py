@@ -123,7 +123,10 @@ class Cluster:
             )
             for p in range(cfg.n_commit_proxies)
         ]
-        self.grv_proxy = GrvProxy(sched, self.sequencer)
+        from foundationdb_tpu.cluster.ratekeeper import Ratekeeper
+
+        self.ratekeeper = Ratekeeper(sched, self.sequencer, self.storage_servers)
+        self.grv_proxy = GrvProxy(sched, self.sequencer, ratekeeper=self.ratekeeper)
         # What clients actually talk to (network-wrapped under simulation).
         self.client_storages = [
             wrapped("client", f"storage{s}", ss, ["get_value", "get_key_values"])
@@ -189,6 +192,7 @@ class Cluster:
         for cp in self.commit_proxies:
             cp.start()
         self.grv_proxy.start()
+        self.ratekeeper.start()
 
     def stop(self) -> None:
         for ss in self.storage_servers:
@@ -196,6 +200,7 @@ class Cluster:
         for cp in self.commit_proxies:
             cp.stop()
         self.grv_proxy.stop()
+        self.ratekeeper.stop()
         self._started = False
 
     def database(self) -> Database:

@@ -25,13 +25,13 @@ class GrvProxy:
         sched: Scheduler,
         sequencer,
         *,
+        ratekeeper=None,
         batch_interval: float = 0.001,
-        rate_budget_per_batch: int = 1 << 30,
     ):
         self.sched = sched
         self.sequencer = sequencer
+        self.ratekeeper = ratekeeper
         self.batch_interval = batch_interval
-        self.rate_budget_per_batch = rate_budget_per_batch
         self.requests = PromiseStream()
         self.counters = CounterCollection(
             "GrvProxyMetrics", ["txnRequestIn", "txnRequestOut", "grvBatches"]
@@ -52,15 +52,32 @@ class GrvProxy:
         return p
 
     async def _starter(self) -> None:
+        # Token bucket fed by the Ratekeeper budget (transactionStarter's
+        # "transactionRate" accounting, GrvProxyServer.actor.cpp:824).
+        pending: list[Promise] = []
+        tokens = 0.0
+        last = self.sched.now()
         while True:
-            first = await self.requests.stream.next()
-            batch = [first]
+            if not pending:
+                pending.append(await self.requests.stream.next())
             await self.sched.delay(self.batch_interval)
-            while (
-                len(batch) < self.rate_budget_per_batch
-                and not self.requests.stream.is_empty()
-            ):
-                batch.append(await self.requests.stream.next())
+            while not self.requests.stream.is_empty():
+                pending.append(await self.requests.stream.next())
+
+            now = self.sched.now()
+            if self.ratekeeper is not None:
+                tps = self.ratekeeper.get_rate_info()
+                tokens = min(
+                    tokens + tps * (now - last), max(tps * 0.1, 1.0)
+                )
+            else:
+                tokens = float(len(pending))
+            last = now
+            n = min(len(pending), int(tokens))
+            if n == 0:
+                continue
+            tokens -= n
+            batch, pending = pending[:n], pending[n:]
             version = self.sequencer.get_live_committed_version()
             self.counters.add("grvBatches")
             for p in batch:

@@ -1,0 +1,83 @@
+"""Ratekeeper: cluster-wide admission control.
+
+Behavioral mirror of `fdbserver/Ratekeeper.actor.cpp`: a control loop
+samples the health of the write pipeline (here: storage-server version
+lag behind the sequencer — the v0 stand-in for storage/TLog queue bytes)
+and computes a transactions-per-second budget; GRV proxies fetch the
+budget (`GetRateInfoRequest`, served at :475) and release read versions
+no faster than that, which throttles new transactions at the front door
+— the same backpressure point the reference uses.
+
+The control law is a simplified version of the reference's: full speed
+while the worst storage lag is under `lag_target`, then multiplicative
+backoff toward `min_rate` as lag approaches `lag_limit`.
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.runtime.flow import ActorCancelled, Scheduler
+from foundationdb_tpu.utils.metrics import CounterCollection
+
+
+class Ratekeeper:
+    def __init__(
+        self,
+        sched: Scheduler,
+        sequencer,
+        storage_servers: list,
+        *,
+        interval: float = 0.25,
+        lag_target: float = 2_000_000,   # versions (~2s)
+        lag_limit: float = 4_500_000,    # near the 5s MVCC window: hard clamp
+        max_tps: float = 1e7,
+        min_tps: float = 10.0,
+    ):
+        self.sched = sched
+        self.sequencer = sequencer
+        self.storage_servers = storage_servers
+        self.interval = interval
+        self.lag_target = lag_target
+        self.lag_limit = lag_limit
+        self.max_tps = max_tps
+        self.min_tps = min_tps
+        self.tps_budget = max_tps
+        self.counters = CounterCollection("RkMetrics", ["loops", "throttled"])
+        self._task = None
+
+    def start(self) -> None:
+        self._task = self.sched.spawn(self._loop(), name="ratekeeper")
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+    def worst_lag(self) -> float:
+        head = self.sequencer.live_committed.get()
+        return max(
+            (head - ss.version.get() for ss in self.storage_servers),
+            default=0.0,
+        )
+
+    def get_rate_info(self) -> float:
+        """GetRateInfoRequest: the current per-second txn budget."""
+        return self.tps_budget
+
+    async def _loop(self) -> None:
+        try:
+            while True:
+                await self.sched.delay(self.interval)
+                self.counters.add("loops")
+                lag = self.worst_lag()
+                if lag <= self.lag_target:
+                    self.tps_budget = self.max_tps
+                elif lag >= self.lag_limit:
+                    self.tps_budget = self.min_tps
+                    self.counters.add("throttled")
+                else:
+                    frac = (self.lag_limit - lag) / (
+                        self.lag_limit - self.lag_target
+                    )
+                    self.tps_budget = max(self.min_tps, self.max_tps * frac)
+                    self.counters.add("throttled")
+        except ActorCancelled:
+            raise

@@ -54,6 +54,8 @@ class StorageServer:
         self._data: dict[bytes, bytes] = {}
         # MVCC window: ascending (version, [mutations])
         self._window: list[tuple[int, list[Any]]] = []
+        # watches: key -> [(expected_value, promise)]
+        self._watches: dict[bytes, list] = {}
         self._update_task = None
 
     def start(self) -> None:
@@ -112,6 +114,7 @@ class StorageServer:
             if k not in self._data:
                 bisect.insort(self._keys, k)
             self._data[k] = val
+            self._fire_watches(k)
         elif kind == "clear":
             _, b, e = m
             lo = bisect.bisect_left(self._keys, b)
@@ -119,8 +122,40 @@ class StorageServer:
             for k in self._keys[lo:hi]:
                 del self._data[k]
             del self._keys[lo:hi]
+            for k in [k for k in self._watches if b <= k < e]:
+                self._fire_watches(k)
         else:
             raise ValueError(f"unknown mutation {m!r}")
+
+    # -- watches (storageserver.actor.cpp watchValueSendReply: fire when
+    # the value differs from the watched one) --------------------------------
+
+    def watch(self, key: bytes, expected):
+        """Returns a Future firing (with the commit version) once key's
+        value != expected."""
+        from foundationdb_tpu.runtime.flow import Promise
+
+        p = Promise()
+        if self._data.get(key) != expected:
+            p.send(self.version.get())  # already different
+        else:
+            self._watches.setdefault(key, []).append((expected, p))
+        return p.future
+
+    def _fire_watches(self, key: bytes) -> None:
+        if key not in self._watches:
+            return
+        current = self._data.get(key)
+        still = []
+        for expected, p in self._watches[key]:
+            if current != expected:
+                p.send(self.version.get())
+            else:
+                still.append((expected, p))
+        if still:
+            self._watches[key] = still
+        else:
+            del self._watches[key]
 
     # -- checkpoint / resume ---------------------------------------------
 

@@ -30,9 +30,10 @@ class KernelConfig:
       max_txns: txn capacity per batch (B).
       max_reads: total read-conflict-range capacity per batch (flattened).
       max_writes: total write-conflict-range capacity per batch (flattened).
-      history_capacity: boundary capacity of the compacted "main" version map.
-      fresh_slots: number of per-batch fresh runs buffered before compaction.
-      fresh_capacity: boundary capacity of one fresh run (>= 2*max_writes).
+      history_capacity: boundary capacity of the "main" version map. Must
+        hold the live MVCC window's write boundaries (~2*max_writes per
+        batch x window/version-step batches); overflow raises, never
+        silently drops.
       window_versions: MVCC window: newOldestVersion = version - window
         (reference: MAX_WRITE_TRANSACTION_LIFE_VERSIONS = 5e6,
         fdbclient/ServerKnobs.cpp:43, used at fdbserver/Resolver.actor.cpp:331).
@@ -43,20 +44,15 @@ class KernelConfig:
     max_reads: int = 4096
     max_writes: int = 4096
     history_capacity: int = 1 << 15
-    fresh_slots: int = 8
-    fresh_capacity: int = 8192
     window_versions: int = 5_000_000
 
     def __post_init__(self):
         if self.max_key_bytes % 4 != 0:
             raise ValueError("max_key_bytes must be a multiple of 4")
-        if self.fresh_capacity < 2 * self.max_writes:
-            raise ValueError(
-                "fresh_capacity must hold 2*max_writes boundaries "
-                f"({self.fresh_capacity} < {2 * self.max_writes})"
-            )
-        for name in ("max_txns", "max_reads", "max_writes", "history_capacity",
-                     "fresh_capacity"):
+        # history_capacity may be any size (nothing in the kernel needs it
+        # to be a power of two); the batch caps must be pow2 for the rank
+        # space / cover structures.
+        for name in ("max_txns", "max_reads", "max_writes"):
             v = getattr(self, name)
             if v & (v - 1):
                 raise ValueError(f"{name} must be a power of two, got {v}")
@@ -97,7 +93,5 @@ TEST_CONFIG = KernelConfig(
     max_reads=256,
     max_writes=256,
     history_capacity=1 << 10,
-    fresh_slots=4,
-    fresh_capacity=512,
     window_versions=1000,
 )

@@ -6,11 +6,13 @@ history plus a batch-at-a-time detect API. Differences are all
 TPU-motivated:
 
 * State lives on device as `ops.history.VersionHistory`; each batch is one
-  jitted call (`ops.conflict.resolve_batch`) with donated state buffers.
-* Compaction (the amortized analog of the skip list's in-place inserts)
-  is triggered here, before the fresh-run ring would wrap.
+  jitted call (`ops.conflict.resolve_batch`) with donated state buffers —
+  committed writes merge into the single-tier history inside the same
+  call (no separate compaction step).
 * Versions are rebased to int32 offsets of `base_version`; the rebase
   shifts every stored offset on device when the window drifts too far.
+* Capacity overflow is latched on device and checked host-side every
+  OVERFLOW_CHECK_INTERVAL batches (each check is a device sync).
 
 The conflicting-key report follows the reference's recording order:
 history-phase hits record every conflicting read-range index in
@@ -63,7 +65,6 @@ def _rebase(state: H.VersionHistory, delta):
     return state._replace(
         main_ver=shift(state.main_ver),
         main_tab=shift(state.main_tab),
-        fresh_ver=shift(state.fresh_ver),
         oldest=shift(state.oldest),
     )
 
@@ -71,8 +72,11 @@ def _rebase(state: H.VersionHistory, delta):
 # Module-level jitted kernels: shared across all TpuConflictSet instances
 # so N resolvers with the same KernelConfig compile once, not N times.
 _RESOLVE = jax.jit(C.resolve_batch, donate_argnums=0)
-_COMPACT = jax.jit(H.compact, donate_argnums=0)
 _REBASE = jax.jit(_rebase, donate_argnums=0)
+
+#: Overflow is checked host-side every this many batches (each check
+#: forces a device sync; the merge itself is async).
+OVERFLOW_CHECK_INTERVAL = 32
 
 
 class TpuConflictSet:
@@ -82,9 +86,8 @@ class TpuConflictSet:
         self.config = config
         self.base_version = base_version
         self.state = H.init(config)
-        self._appends_since_compact = 0
+        self._batches_since_check = 0
         self._resolve = _RESOLVE
-        self._compact = _COMPACT
         self._rebase = _REBASE
 
     # -- ConflictBatch-equivalent API -----------------------------------
@@ -104,14 +107,11 @@ class TpuConflictSet:
             self.state = self._rebase(self.state, np.int32(delta))
             self.base_version += delta
 
-        if self._appends_since_compact >= self.config.fresh_slots:
-            self.compact()
-
         batch = packing.pack_batch(
             transactions, version, self.base_version, self.config
         )
         self.state, out = self._resolve(self.state, batch.device_args())
-        self._appends_since_compact += 1
+        self._maybe_check_overflow()
         return self._build_result(transactions, batch, out)
 
     def resolve_packed(self, batch: packing.PackedBatch) -> C.BatchVerdict:
@@ -120,15 +120,18 @@ class TpuConflictSet:
         Skips the Python packer and reply assembly; the caller owns
         version rebasing (offsets must fit int32).
         """
-        if self._appends_since_compact >= self.config.fresh_slots:
-            self.compact()
         self.state, out = self._resolve(self.state, batch.device_args())
-        self._appends_since_compact += 1
+        self._maybe_check_overflow()
         return out
 
-    def compact(self) -> None:
-        self.state = self._compact(self.state)
-        self._appends_since_compact = 0
+    def _maybe_check_overflow(self) -> None:
+        self._batches_since_check += 1
+        if self._batches_since_check >= OVERFLOW_CHECK_INTERVAL:
+            self.check_overflow()
+
+    def check_overflow(self) -> None:
+        """Device sync: raise if a merge ever exceeded history_capacity."""
+        self._batches_since_check = 0
         if bool(np.asarray(self.state.overflow)):
             raise HistoryOverflowError(
                 f"history_capacity={self.config.history_capacity} exceeded; "

@@ -32,8 +32,10 @@ and every stage has an exact tensor equivalent here:
                            rank segment) plus one range-min query per read.
 * combineWriteConflictRanges -> coverage-parity prefix sum over the rank
                            space (:996-1011's sweep, vectorized).
-* mergeWriteConflictRanges -> history.append_run at the batch version.
-* removeBefore GC        -> history.advance_oldest.
+* mergeWriteConflictRanges + removeBefore GC -> history.merge_writes:
+                           one sort + associative scans folds the batch's
+                           combined writes into the single-tier map and
+                           drops segments below the MVCC floor.
 
 Decisions are bit-identical to the reference by construction; the parity
 tests drive randomized batches against the Python oracle.
@@ -202,16 +204,16 @@ def resolve_batch(state: H.VersionHistory, batch: dict):
     covered = jnp.cumsum(delta) > 0  # covered[v]: segment [u_v, u_{v+1})
     prev_covered = jnp.concatenate([jnp.zeros((1,), bool), covered[:-1]])
     is_boundary = covered != prev_covered
-    mf = state.fresh_keys.shape[1]
+    # Coverage can only flip at write begin/end keys, so the combined run
+    # has at most 2*NW boundaries.
+    mf = 2 * nw
     pos = jnp.cumsum(is_boundary.astype(jnp.int32)) - 1
     dest = jnp.where(is_boundary & (pos < mf), pos, mf)  # mf = trash row
     w = points.shape[1]
     run_bounds = K.sentinel_like(mf + 1, w).at[dest].set(_ukeys)[:mf]
-    nonempty = jnp.any(is_boundary)
 
-    # ---- phase 4: merge + GC ------------------------------------------
-    state = H.append_run(state, run_bounds, version, nonempty)
-    state = H.advance_oldest(state, new_oldest)
+    # ---- phase 4: merge + GC (one sort + scans, history.merge_writes) --
+    state = H.merge_writes(state, run_bounds, version, new_oldest)
 
     out = BatchVerdict(
         verdict=verdict,

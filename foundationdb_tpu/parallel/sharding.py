@@ -155,7 +155,6 @@ class ShardedConflictSet:
         self.mesh = mesh
         self.n_shards = n_shards
         self.base_version = base_version
-        self._appends_since_compact = 0
 
         lo, hi = make_partition(boundaries, config)
         shard = NamedSharding(mesh, P(AXIS))
@@ -180,34 +179,18 @@ class ShardedConflictSet:
             ),
             donate_argnums=0,
         )
-        self._compact = jax.jit(
-            jax.shard_map(
-                lambda s: jax.tree.map(
-                    lambda x: x[None],
-                    H.compact(jax.tree.map(lambda x: x[0], s)),
-                ),
-                mesh=mesh,
-                in_specs=(spec_state,),
-                out_specs=spec_state,
-            ),
-            donate_argnums=0,
-        )
 
     def resolve(self, transactions, version: int) -> ShardedVerdict:
         """Resolve one batch across all shards; returns combined verdicts."""
-        if self._appends_since_compact >= self.config.fresh_slots:
-            self.compact()
         batch = packing.pack_batch(
             transactions, version, self.base_version, self.config
         )
         self.state, out = self._resolve(
             self.state, batch.device_args(), self.part_lo, self.part_hi
         )
-        self._appends_since_compact += 1
         return out
 
-    def compact(self) -> None:
-        self.state = self._compact(self.state)
-        self._appends_since_compact = 0
+    def check_overflow(self) -> None:
+        """Device sync: raise if any shard's history merge overflowed."""
         if bool(np.any(np.asarray(self.state.overflow))):
             raise RuntimeError("a shard's history_capacity overflowed")

@@ -47,6 +47,7 @@ from foundationdb_tpu.models.types import (
 )
 from foundationdb_tpu.runtime.flow import Notified, Scheduler, Trigger, any_of
 from foundationdb_tpu.utils.metrics import CounterCollection, LatencySample
+from foundationdb_tpu.utils import trace
 
 #: ServerKnobs.RESOLVER_STATE_MEMORY_LIMIT (fdbclient/ServerKnobs.cpp).
 DEFAULT_STATE_MEMORY_LIMIT = 1_000_000
@@ -188,6 +189,12 @@ class Resolver:
         proxy_key = req.proxy_id if req.prev_version >= 0 else None
         proxy_info = self.proxy_info.setdefault(proxy_key, _ProxyRequestsInfo())
         self.counters.add("resolveBatchIn")
+        # Same micro-event locations as the reference, for commit-path
+        # latency debugging (Resolver.actor.cpp:244,266,320,509).
+        if req.debug_id is not None:
+            trace.g_trace_batch.add_event(
+                "CommitDebug", req.debug_id, "Resolver.resolveBatch.Before"
+            )
 
         # Memory backpressure (Resolver.actor.cpp:254-268): wait for
         # needed_version / total_state_bytes to move.
@@ -224,6 +231,10 @@ class Resolver:
                 self.queue_depth.sample(self.version.num_waiting())
                 break
         self.queue_wait_latency.sample(self.sched.now() - request_time)
+        if req.debug_id is not None:
+            trace.g_trace_batch.add_event(
+                "CommitDebug", req.debug_id, "Resolver.resolveBatch.AfterOrderer"
+            )
 
         if self.version.get() == req.prev_version:
             # ---- compute phase (no awaits until version.set) -----------
@@ -326,6 +337,10 @@ class Resolver:
 
         self.counters.add("resolveBatchOut")
         self.resolver_latency.sample(self.sched.now() - request_time)
+        if req.debug_id is not None:
+            trace.g_trace_batch.add_event(
+                "CommitDebug", req.debug_id, "Resolver.resolveBatch.After"
+            )
         out = proxy_info.outstanding_batches.get(req.version)
         return out  # None == the reference's Never()
 

@@ -1,0 +1,135 @@
+"""Knobs: typed runtime constants with randomize-under-test, plus BUGGIFY.
+
+Behavioral mirror of the reference's knob system (`flow/Knobs.cpp`,
+`fdbclient/ServerKnobs.cpp`): every tunable is a named, typed constant;
+under simulation a seeded fraction of knobs take randomized values to
+widen coverage (the `randomize && BUGGIFY` idiom, e.g.
+ServerKnobs.cpp:43-44), and `buggify(...)` deterministically enables rare
+code paths per seed (flow/include/flow/flow.h:63-81 BUGGIFY).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class _KnobDef:
+    name: str
+    default: Any
+    ktype: type
+    randomize: Optional[Callable[[np.random.Generator], Any]] = None
+
+
+class Knobs:
+    """A named knob collection (FLOW_KNOBS / SERVER_KNOBS shape)."""
+
+    def __init__(self, name: str):
+        object.__setattr__(self, "_name", name)
+        object.__setattr__(self, "_defs", {})
+        object.__setattr__(self, "_values", {})
+
+    def define(self, name: str, default, *, randomize=None) -> None:
+        d = _KnobDef(name, default, type(default), randomize)
+        self._defs[name] = d
+        self._values[name] = default
+
+    def __getattr__(self, name: str):
+        try:
+            return object.__getattribute__(self, "_values")[name]
+        except KeyError:
+            raise AttributeError(f"unknown knob {name!r}") from None
+
+    def __setattr__(self, name: str, value) -> None:
+        self.set(name, value)
+
+    def set(self, name: str, value) -> None:
+        """--knob_<name>=<value> (type-checked against the default)."""
+        if name not in self._defs:
+            raise KeyError(f"unknown knob {name!r}")
+        d = self._defs[name]
+        if not isinstance(value, d.ktype):
+            value = d.ktype(value)
+        self._values[name] = value
+
+    def reset(self) -> None:
+        for n, d in self._defs.items():
+            self._values[n] = d.default
+
+    def randomize_under_test(self, rng: np.random.Generator, prob: float = 0.5):
+        """Seeded knob randomization (ServerKnobs' randomize && BUGGIFY)."""
+        chosen = {}
+        for n, d in self._defs.items():
+            if d.randomize is not None and rng.random() < prob:
+                self._values[n] = chosen[n] = d.randomize(rng)
+        return chosen
+
+    def as_dict(self) -> dict:
+        return dict(self._values)
+
+
+class Buggifier:
+    """Deterministic rare-branch activation (BUGGIFY).
+
+    Each call site (identified by its string tag) is enabled once per
+    seed with `activation_prob`; enabled sites then fire with
+    `fire_prob` per evaluation — the reference's two-level scheme
+    (flow/flow.h:63-81: P_ENABLED per site, P_FIRE per hit).
+    """
+
+    def __init__(self, seed: int = 0, *, enabled: bool = False,
+                 activation_prob: float = 0.25, fire_prob: float = 0.05):
+        self.enabled = enabled
+        self.activation_prob = activation_prob
+        self.fire_prob = fire_prob
+        self._rng = np.random.default_rng(seed)
+        self._site_enabled: dict[str, bool] = {}
+
+    def __call__(self, site: str) -> bool:
+        if not self.enabled:
+            return False
+        if site not in self._site_enabled:
+            self._site_enabled[site] = (
+                float(self._rng.random()) < self.activation_prob
+            )
+        return self._site_enabled[site] and (
+            float(self._rng.random()) < self.fire_prob
+        )
+
+
+#: Global buggifier — off outside simulation, like the reference's.
+BUGGIFY = Buggifier()
+
+
+def make_server_knobs() -> Knobs:
+    """The resolver-relevant server knobs with reference defaults
+    (fdbclient/ServerKnobs.cpp:36-44, 549-550 + resolver/commit knobs)."""
+    k = Knobs("ServerKnobs")
+    k.define("VERSIONS_PER_SECOND", 1_000_000)
+    k.define(
+        "MAX_READ_TRANSACTION_LIFE_VERSIONS",
+        5_000_000,
+        randomize=lambda r: int(
+            r.choice([1_000_000, 2_000_000, 5_000_000])
+        ),
+    )
+    k.define(
+        "MAX_WRITE_TRANSACTION_LIFE_VERSIONS",
+        5_000_000,
+        randomize=lambda r: int(
+            r.choice([1_000_000, 2_000_000, 5_000_000])
+        ),
+    )
+    k.define("RESOLVER_STATE_MEMORY_LIMIT", 1_000_000)
+    k.define(
+        "COMMIT_TRANSACTION_BATCH_INTERVAL_MIN", 0.005,
+        randomize=lambda r: float(r.choice([0.001, 0.005, 0.01])),
+    )
+    k.define("RESOLVER_BACKEND", "tpu")  # the resolver_backend knob
+    return k
+
+
+SERVER_KNOBS = make_server_knobs()

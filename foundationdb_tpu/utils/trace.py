@@ -1,0 +1,122 @@
+"""Structured trace logging: TraceEvent + the commit-path micro-events.
+
+Behavioral mirror of `flow/Trace.cpp`:
+
+* `TraceEvent(type).detail(k, v)` builds one structured event; events
+  carry severity, (virtual) time, role id; sinks render JSON lines (the
+  reference's JsonTraceLogFormatter) to memory or a file with rolling.
+* `trace_batch` (`g_traceBatch`, flow/Trace.h:576): low-overhead
+  commit/GRV-path micro-events with Location strings
+  ("Resolver.resolveBatch.Before"...) used for latency debugging — the
+  TPU resolver emits the same locations so the reference's
+  commit-debugging methodology (contrib/commit_debug.py) transfers.
+* `trace_counters` (fdbrpc/Stats.h:93): periodic counter snapshot events.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Optional
+
+SEV_DEBUG = 5
+SEV_INFO = 10
+SEV_WARN = 20
+SEV_WARN_ALWAYS = 30
+SEV_ERROR = 40
+
+
+class TraceEvent:
+    def __init__(self, event_type: str, *, severity: int = SEV_INFO,
+                 logger: "TraceLog" = None):
+        self.type = event_type
+        self.severity = severity
+        self.fields: dict[str, Any] = {}
+        self._logger = logger or g_trace
+
+    def detail(self, key: str, value) -> "TraceEvent":
+        self.fields[key] = value
+        return self
+
+    def log(self) -> None:
+        self._logger.emit(self)
+
+    # context-manager sugar: `with TraceEvent("X") as e: e.detail(...)`
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.log()
+        return False
+
+
+class TraceLog:
+    """In-memory + optional JSONL-file sink with severity filtering."""
+
+    def __init__(self, *, min_severity: int = SEV_INFO,
+                 clock: Optional[Callable[[], float]] = None,
+                 path: Optional[str] = None, max_events: int = 100_000):
+        self.min_severity = min_severity
+        self.clock = clock or (lambda: 0.0)
+        self.events: list[dict] = []
+        self.max_events = max_events
+        self._fh = open(path, "a") if path else None
+
+    def emit(self, ev: TraceEvent) -> None:
+        if ev.severity < self.min_severity:
+            return
+        rec = {"Type": ev.type, "Severity": ev.severity,
+               "Time": round(self.clock(), 6), **ev.fields}
+        self.events.append(rec)
+        if len(self.events) > self.max_events:  # rolling, like file rolls
+            del self.events[: self.max_events // 2]
+        if self._fh:
+            self._fh.write(json.dumps(_jsonable(rec)) + "\n")
+
+    def find(self, event_type: str) -> list[dict]:
+        return [e for e in self.events if e["Type"] == event_type]
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+def _jsonable(rec):
+    return {
+        k: (v.decode("latin-1") if isinstance(v, bytes) else v)
+        for k, v in rec.items()
+    }
+
+
+class TraceBatch:
+    """g_traceBatch: (name, id, location) micro-events on the hot path."""
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None):
+        self.clock = clock or (lambda: 0.0)
+        self.events: list[tuple[float, str, str, str]] = []
+        self.enabled = True
+
+    def add_event(self, name: str, ident: str, location: str) -> None:
+        if self.enabled:
+            self.events.append((self.clock(), name, ident, location))
+
+    def add_attach(self, name: str, ident: str, to: str) -> None:
+        if self.enabled:
+            self.events.append((self.clock(), name, ident, f"attach:{to}"))
+
+    def dump(self) -> list[tuple[float, str, str, str]]:
+        out, self.events = self.events, []
+        return out
+
+
+def trace_counters(logger: TraceLog, name: str, ident: str, counters) -> None:
+    """Periodic counter snapshot (CounterCollection::traceCounters)."""
+    ev = TraceEvent(name, logger=logger).detail("ID", ident)
+    for k, v in counters.as_dict().items():
+        ev.detail(k, v)
+    ev.log()
+
+
+#: process-global default sinks (swappable in tests / roles)
+g_trace = TraceLog()
+g_trace_batch = TraceBatch()

@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Primitive-level TPU experiments for the conflict-kernel redesign.
+
+Candidates measured against the current implementations:
+  1. k-ary searchsorted (fewer sequential gather rounds) vs binary
+  2. sparse-table interval min-cover (2 scatters total) vs segment tree
+  3. scan-based value lookup via one co-sort (the searchsorted-free plan)
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from foundationdb_tpu.ops import keys as K
+from foundationdb_tpu.ops import rangemax, segtree
+from foundationdb_tpu.ops.rangemax import INT32_POS
+
+Q = 1 << 17   # query points (2 per read range at 64K)
+M = 1 << 19   # history boundaries
+N = 1 << 17   # write intervals for cover
+P = 1 << 18   # rank-space points
+REPS = 5
+
+
+def timeit(name, fn, *args):
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    c = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / REPS
+    print(f"{name:42s} {dt * 1e3:8.2f} ms  (compile {c:5.1f}s)", flush=True)
+    return out
+
+
+def kary_searchsorted(keys_arr, queries, *, k=8, side="right"):
+    """k-ary search: each round gathers k-1 splitters per query."""
+    m = keys_arr.shape[0]
+    q = queries.shape[0]
+    lo = jnp.zeros((q,), jnp.int32)
+    span = jnp.full((q,), m, jnp.int32)
+    rounds = 1
+    while k**rounds < m:
+        rounds += 1
+    for _ in range(rounds):
+        step = (span + k - 1) // k
+        # probe positions lo + step, lo + 2*step, ... lo + (k-1)*step
+        ge_count = jnp.zeros((q,), jnp.int32)
+        for j in range(1, k):
+            pos = jnp.minimum(lo + j * step, m - 1)
+            pk = keys_arr[pos]
+            if side == "right":
+                go = ~K.lex_less(queries, pk)  # keys[pos] <= q
+            else:
+                go = K.lex_less(pk, queries)
+            ge_count += (go & (lo + j * step < m)).astype(jnp.int32)
+        lo = lo + ge_count * step
+        span = step
+    return lo
+
+
+def sparse_min_cover(leaves: int, lo, hi, val):
+    """Sparse-table cover: each interval scatters at ONE level; one
+    downward sweep propagates. 2 scatter calls total."""
+    log = leaves.bit_length() - 1
+    levels = log + 1
+    length = jnp.maximum(hi - lo, 0)
+    k = jnp.clip(
+        jnp.ceil(jnp.log2(jnp.maximum(length.astype(jnp.float32), 1.0))
+                 ).astype(jnp.int32) - 0,
+        0, log)
+    # largest pow2 <= length: floor_log2
+    fl = jnp.zeros_like(length)
+    for b in range(log, -1, -1):
+        fl = jnp.where((length >> b) > 0, jnp.maximum(fl, b), fl)
+    k = fl
+    valid = length > 0
+    trash = levels * leaves
+    idx1 = jnp.where(valid, k * leaves + lo, trash)
+    idx2 = jnp.where(valid, k * leaves + hi - (1 << k), trash)
+    table = jnp.full((levels * leaves + 1,), INT32_POS, jnp.int32)
+    table = table.at[idx1].min(val).at[idx2].min(val)
+    t = table[:-1].reshape(levels, leaves)
+    # downward sweep: level j covers [i, i+2^j); push to level j-1
+    for j in range(log, 0, -1):
+        half = 1 << (j - 1)
+        upper = t[j]
+        shifted = jnp.concatenate([jnp.full((half,), INT32_POS, jnp.int32),
+                                   upper[:-half]])
+        t = t.at[j - 1].set(jnp.minimum(t[j - 1], jnp.minimum(upper, shifted)))
+    return t[0]
+
+
+def scan_lookup(main_keys, main_ver, queries):
+    """Value-at-query via co-sort + cummax scan (no searchsorted)."""
+    m, w = main_keys.shape
+    q = queries.shape[0]
+    all_keys = jnp.concatenate([main_keys, queries], axis=0)
+    src = jnp.concatenate([
+        jnp.arange(m, dtype=jnp.int32),                 # main idx
+        jnp.full((q,), -1, jnp.int32),
+    ])
+    qidx = jnp.concatenate([
+        jnp.full((m,), -1, jnp.int32),
+        jnp.arange(q, dtype=jnp.int32),
+    ])
+    # tiebreak: main boundary sorts BEFORE equal query (side='right":
+    # value at key includes segment starting at key) -> main first via the
+    # src operand ascending? main src>=0, query=-1; want main first: use
+    # tb = 0 for main, 1 for query.
+    tb = jnp.concatenate([jnp.zeros((m,), jnp.int32), jnp.ones((q,), jnp.int32)])
+    ops = [all_keys[:, i] for i in range(w)] + [tb, src, qidx]
+    s = jax.lax.sort(ops, num_keys=w + 1)
+    s_src, s_qidx = s[w + 1], s[w + 2]
+    run = jax.lax.associative_scan(jnp.maximum, jnp.where(s_src >= 0, s_src, -1))
+    vals = jnp.where(run >= 0, main_ver[jnp.maximum(run, 0)], -(2**31) + 1)
+    out = jnp.zeros((q,), jnp.int32).at[
+        jnp.where(s_qidx >= 0, s_qidx, q)
+    ].set(jnp.where(s_qidx >= 0, vals, 0)[: m + q], mode="drop")
+    return out
+
+
+def main():
+    print("device:", jax.devices()[0], flush=True)
+    rng = np.random.default_rng(0)
+    w = 3
+    mk = np.sort(rng.integers(0, 2**31, size=M).astype(np.uint32))
+    main_keys = jnp.stack(
+        [jnp.asarray(mk),
+         jnp.zeros(M, jnp.uint32),
+         jnp.full((M,), 8, jnp.uint32)], axis=1)
+    main_ver = jnp.asarray(rng.integers(0, 1000, size=M), jnp.int32)
+    qk = rng.integers(0, 2**31, size=Q).astype(np.uint32)
+    queries = jnp.stack(
+        [jnp.asarray(qk), jnp.zeros(Q, jnp.uint32),
+         jnp.full((Q,), 8, jnp.uint32)], axis=1)
+
+    f_bin = jax.jit(lambda a, b: K.searchsorted(a, b, side="right"))
+    r_bin = timeit("binary searchsorted (128K q, 512K m)", f_bin, main_keys, queries)
+    for k in (4, 16):
+        f_k = jax.jit(lambda a, b, k=k: kary_searchsorted(a, b, k=k))
+        r_k = timeit(f"{k}-ary searchsorted", f_k, main_keys, queries)
+        same = bool(jnp.all(r_k == r_bin))
+        print(f"   matches binary: {same}", flush=True)
+
+    lo = rng.integers(0, P - 2, size=N).astype(np.int32)
+    ln = rng.integers(1, 64, size=N).astype(np.int32)
+    hi = np.minimum(lo + ln, P - 1).astype(np.int32)
+    val = rng.integers(0, 1 << 20, size=N).astype(np.int32)
+    lo, hi, val = jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(val)
+
+    f_seg = jax.jit(lambda l, h, v: segtree.min_cover(P, l, h, v))
+    r_seg = timeit("segtree min_cover (128K upd, 256K lv)", f_seg, lo, hi, val)
+    f_sp = jax.jit(lambda l, h, v: sparse_min_cover(P, l, h, v))
+    r_sp = timeit("sparse-table min_cover", f_sp, lo, hi, val)
+    print("   matches segtree:", bool(jnp.all(r_seg == r_sp)), flush=True)
+
+    f_scan = jax.jit(scan_lookup)
+    r_scan = timeit("scan_lookup (co-sort + scan)", f_scan,
+                    main_keys, main_ver, queries)
+    # reference: value at query = main_ver[searchsorted_right - 1]
+    ref = jnp.where(r_bin - 1 >= 0, main_ver[jnp.maximum(r_bin - 1, 0)],
+                    -(2**31) + 1)
+    print("   matches searchsorted path:", bool(jnp.all(r_scan == ref)), flush=True)
+
+    # rangemax build+query at bench sizes for reference
+    tab = timeit("rangemax.build (512K)", jax.jit(lambda v: rangemax.build(v, op="max")), main_ver)
+    ql = jnp.asarray(rng.integers(0, M - 1, size=Q), jnp.int32)
+    qh = jnp.minimum(ql + 100, M)
+    timeit("rangemax.query (128K q)", jax.jit(lambda t, a, b: rangemax.query(t, a, b, op="max")), tab, ql, qh)
+
+
+if __name__ == "__main__":
+    main()

@@ -20,13 +20,11 @@ CFG = KernelConfig(
     max_reads=256,
     max_writes=256,
     history_capacity=1 << 11,
-    fresh_slots=4,
-    fresh_capacity=512,
     window_versions=50,
 )
 
 
-def run_parity(seed, wcfg, n_batches, version_step=7, kcfg=CFG, compact_every=None):
+def run_parity(seed, wcfg, n_batches, version_step=7, kcfg=CFG):
     rng = np.random.default_rng(seed)
     cs = TpuConflictSet(kcfg)
     oracle = ConflictOracle(window=kcfg.window_versions)
@@ -62,8 +60,7 @@ def run_parity(seed, wcfg, n_batches, version_step=7, kcfg=CFG, compact_every=No
             f"seed={seed} batch={b}: conflicting-range mismatch\n"
             f"got  {got.conflicting_key_ranges}\nwant {want_ckr}"
         )
-        if compact_every and (b + 1) % compact_every == 0:
-            cs.compact()
+    cs.check_overflow()
 
 
 @pytest.mark.parametrize("seed", range(8))
@@ -89,15 +86,9 @@ def test_parity_stale_snapshots(seed):
 
 
 def test_parity_long_run_with_gc():
-    # enough batches that the MVCC window slides and fresh runs die;
-    # multiple compactions happen via the fresh-ring trigger
+    # enough batches that the MVCC window slides and merged history GCs
     w = workloads.WorkloadConfig(n_txns=16, keyspace=24, stale_fraction=0.1)
     run_parity(300, w, n_batches=24, version_step=11)
-
-
-def test_parity_explicit_compaction_every_batch():
-    w = workloads.WorkloadConfig(n_txns=16, keyspace=16)
-    run_parity(400, w, n_batches=6, compact_every=1)
 
 
 def test_parity_blind_writes_and_reports():
