@@ -1,19 +1,33 @@
-"""foundationdb_tpu — a TPU-native transaction-conflict-resolution framework.
+"""foundationdb_tpu — a TPU-native distributed transactional KV framework.
 
-Re-implements the capabilities of FoundationDB 7.3.0's Resolver subsystem
-(reference: fdbserver/Resolver.actor.cpp, fdbserver/SkipList.cpp) as a
-TPU-first design: the per-batch MVCC conflict check becomes a pure JAX
-kernel over fixed-shape interval tensors, the version-annotated skip list
-becomes a piecewise-constant "version map" held in device memory as sorted
-boundary tensors with range-max acceleration structures, and multi-resolver
-keyspace sharding becomes a `shard_map` axis with a `min`-combine of
-per-shard verdicts (the exact combine semantics of
+Re-implements the capabilities of FoundationDB 7.3.0 (reference layout in
+SURVEY.md) as a TPU-first design centered on the Resolver subsystem: the
+per-batch MVCC conflict check (fdbserver/Resolver.actor.cpp +
+fdbserver/SkipList.cpp) becomes a pure JAX kernel over fixed-shape
+interval tensors, the version-annotated skip list becomes a sorted
+boundary "version map" merged by sort+scan passes in device memory, and
+multi-resolver keyspace sharding becomes a `shard_map` mesh axis with a
+`min`-combine of per-shard verdicts (the exact combine semantics of
 fdbserver/CommitProxyServer.actor.cpp:1551-1567).
 
-Nothing here is a port of the reference's C++ — the data structures are
-re-designed for XLA's compilation model: static shapes, sorts instead of
-pointer-chasing, segment trees and sparse tables instead of skip lists,
-and an alternating fixpoint instead of a sequential intra-batch scan.
+Around the kernel, the full transaction system is here, idiomatic rather
+than ported:
+
+- `runtime/` — deterministic single-threaded actor runtime (the
+  Flow/Net2/Sim2 analog): futures, streams, Notified version chains,
+  virtual time.
+- `resolver.py` — the resolver role state machine (version chaining,
+  duplicate replay, state-transaction forwarding, backpressure).
+- `cluster/` — sequencer, tlog, storage (MVCC window + watches), commit
+  proxies (5-phase pipeline), GRV proxy, ratekeeper, resolution
+  balancer, status, backup/restore, client Database/Transaction with
+  read-your-writes, atomic ops, and versionstamps.
+- `parallel/` — multi-device resolver sharding over a mesh.
+- `sim/` — seeded network fault injection (latency, clogging,
+  partitions) for whole-cluster deterministic tests.
+- `layers/` — the tuple layer and subspaces.
+- `native/` — the C++ CPU conflict set (baseline + independent oracle).
+- `cli.py` — the fdbcli-equivalent admin surface.
 """
 
 from foundationdb_tpu.config import KernelConfig
@@ -24,7 +38,18 @@ from foundationdb_tpu.models.types import (
     TransactionResult,
 )
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
+
+
+def open_cluster(config=None, *, sched=None):
+    """Boot an in-process cluster; returns (scheduler, cluster, database).
+
+    The one-call entry point: `sched, cluster, db = fdb_tpu.open_cluster()`.
+    """
+    from foundationdb_tpu.cluster.database import open_cluster as _open
+
+    return _open(config, sched=sched)
+
 
 __all__ = [
     "KernelConfig",
@@ -32,5 +57,6 @@ __all__ = [
     "ResolveTransactionBatchRequest",
     "ResolveTransactionBatchReply",
     "TransactionResult",
+    "open_cluster",
     "__version__",
 ]

@@ -1,0 +1,129 @@
+"""fdbcli-equivalent: interactive admin commands against a cluster.
+
+Behavioral mirror of `fdbcli/` (one command per module there; one handler
+here): status (human + json), point/range reads and writes guarded by
+writemode, backup/restore, rebalance, and watch — driven either
+programmatically (`run_command`) or as a REPL on a real scheduler.
+"""
+
+from __future__ import annotations
+
+import json
+import shlex
+
+from foundationdb_tpu.cluster.status import cluster_status
+
+
+class CliSession:
+    def __init__(self, cluster, db):
+        self.cluster = cluster
+        self.db = db
+        self.write_mode = False
+
+    async def run_command(self, line: str) -> str:
+        """Execute one command line; returns the output text."""
+        parts = shlex.split(line)
+        if not parts:
+            return ""
+        cmd, *args = parts
+        handler = getattr(self, f"_cmd_{cmd}", None)
+        if handler is None:
+            return f"ERROR: unknown command `{cmd}`"
+        return await handler(args)
+
+    # -- commands ---------------------------------------------------------
+
+    async def _cmd_status(self, args) -> str:
+        st = cluster_status(self.cluster)
+        if args and args[0] == "json":
+            return json.dumps(st, indent=2)
+        c = st["cluster"]
+        w = c["workload"]["transactions"]
+        return (
+            "Configuration:\n"
+            f"  commit_proxies      - {c['configuration']['commit_proxies']}\n"
+            f"  resolvers           - {c['configuration']['resolvers']}\n"
+            f"  storage_servers     - {c['configuration']['storage_servers']}\n"
+            f"  resolver_backend    - {c['configuration']['resolver_backend']}\n"
+            "Workload:\n"
+            f"  started             - {w['started']}\n"
+            f"  committed           - {w['committed']}\n"
+            f"  conflicted          - {w['conflicted']}\n"
+            f"  live version        - {c['live_committed_version']}\n"
+        )
+
+    async def _cmd_writemode(self, args) -> str:
+        if args and args[0] in ("on", "off"):
+            self.write_mode = args[0] == "on"
+            return ""
+        return "ERROR: writemode [on|off]"
+
+    def _need_write(self):
+        if not self.write_mode:
+            return "ERROR: writemode must be enabled to modify the database"
+        return None
+
+    async def _cmd_get(self, args) -> str:
+        txn = self.db.create_transaction()
+        v = await txn.get(args[0].encode())
+        if v is None:
+            return f"`{args[0]}': not found"
+        return f"`{args[0]}' is `{v.decode('latin-1')}'"
+
+    async def _cmd_getrange(self, args) -> str:
+        txn = self.db.create_transaction()
+        limit = int(args[2]) if len(args) > 2 else 25
+        items = await txn.get_range(args[0].encode(), args[1].encode(), limit=limit)
+        lines = [f"`{k.decode('latin-1')}' is `{v.decode('latin-1')}'"
+                 for k, v in items]
+        return "\n".join(lines) if lines else "Range is empty"
+
+    async def _cmd_set(self, args) -> str:
+        if err := self._need_write():
+            return err
+        txn = self.db.create_transaction()
+        txn.set(args[0].encode(), args[1].encode())
+        await txn.commit()
+        return "Committed"
+
+    async def _cmd_clear(self, args) -> str:
+        if err := self._need_write():
+            return err
+        txn = self.db.create_transaction()
+        txn.clear(args[0].encode())
+        await txn.commit()
+        return "Committed"
+
+    async def _cmd_clearrange(self, args) -> str:
+        if err := self._need_write():
+            return err
+        txn = self.db.create_transaction()
+        txn.clear_range(args[0].encode(), args[1].encode())
+        await txn.commit()
+        return "Committed"
+
+    async def _cmd_watch(self, args) -> str:
+        txn = self.db.create_transaction()
+        fut = await txn.watch(args[0].encode())
+        v = await fut
+        return f"`{args[0]}' changed at version {v}"
+
+    async def _cmd_rebalance(self, args) -> str:
+        moved = self.cluster.balancer.rebalance_once()
+        return "Moved a resolver boundary" if moved else "Balanced"
+
+    async def _cmd_backup(self, args) -> str:
+        from foundationdb_tpu.cluster.backup import BackupAgent, DirBackupContainer
+
+        agent = BackupAgent(self.db, DirBackupContainer(args[0]))
+        version = await agent.snapshot()
+        return f"Snapshot complete at version {version}"
+
+    async def _cmd_restore(self, args) -> str:
+        if err := self._need_write():
+            return err
+        from foundationdb_tpu.cluster.backup import BackupAgent, DirBackupContainer
+
+        agent = BackupAgent(self.db, DirBackupContainer(args[0]))
+        version = await agent.restore()
+        return f"Restored to version {version}"

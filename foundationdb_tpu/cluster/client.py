@@ -30,18 +30,24 @@ def key_after(k: bytes) -> bytes:
 
 
 class WriteMap:
-    """Uncommitted writes: sorted clear ranges + point sets (WriteMap.h)."""
+    """Uncommitted writes: sorted clear ranges + point sets + pending
+    atomics over unknown bases (WriteMap.h)."""
 
     def __init__(self):
         self.sets: dict[bytes, bytes] = {}
         self.clears: list[tuple[bytes, bytes]] = []  # disjoint, sorted
+        # key -> [(op, param)] applied over the server value at read time
+        self.atomics: dict[bytes, list] = {}
 
     def set(self, k: bytes, v: bytes) -> None:
         self.sets[k] = v
+        self.atomics.pop(k, None)
 
     def clear(self, b: bytes, e: bytes) -> None:
         for k in [k for k in self.sets if b <= k < e]:
             del self.sets[k]
+        for k in [k for k in self.atomics if b <= k < e]:
+            del self.atomics[k]
         merged = [(b, e)]
         for cb, ce in self.clears:
             if ce < b or cb > e:  # disjoint (touching ranges merge)
@@ -61,6 +67,8 @@ class WriteMap:
 
     def overlay(self, items: list[tuple[bytes, bytes]], b: bytes, e: bytes):
         """Merge the write map over a storage snapshot of [b, e)."""
+        from foundationdb_tpu.utils.atomic import apply_atomic
+
         out = {k: v for k, v in items}
         for cb, ce in self.clears:
             for k in [k for k in out if cb <= k < ce]:
@@ -68,6 +76,15 @@ class WriteMap:
         for k, v in self.sets.items():
             if b <= k < e:
                 out[k] = v
+        for k, ops in self.atomics.items():
+            if b <= k < e:
+                v = out.get(k)
+                for op, param in ops:
+                    v = apply_atomic(op, v, param)
+                if v is None:
+                    out.pop(k, None)
+                else:
+                    out[k] = v
         return sorted(out.items())
 
 
@@ -81,6 +98,7 @@ class Transaction:
         self.write_conflicts: list[tuple[bytes, bytes]] = []
         self.report_conflicting_keys = False
         self.committed_version: Optional[int] = None
+        self._versionstamp: Optional[bytes] = None
 
     # -- reads ------------------------------------------------------------
 
@@ -91,12 +109,17 @@ class Transaction:
 
     async def get(self, key: bytes, *, snapshot: bool = False) -> Optional[bytes]:
         known, val = self.writes.lookup(key)
-        if known:
-            return val
-        rv = await self.get_read_version()
-        val = await self.db.storage_for(key).get_value(key, rv)
-        if not snapshot:
-            self.read_conflicts.append((key, key_after(key)))
+        if not known:
+            rv = await self.get_read_version()
+            val = await self.db.storage_for(key).get_value(key, rv)
+            if not snapshot:
+                self.read_conflicts.append((key, key_after(key)))
+        # RYW over atomics on an unknown base: apply pending ops to the
+        # snapshot value (ReadYourWrites' read-modify view).
+        from foundationdb_tpu.utils.atomic import apply_atomic
+
+        for op, param in self.writes.atomics.get(key, []):
+            val = apply_atomic(op, val, param)
         return val
 
     async def get_range(
@@ -122,12 +145,10 @@ class Transaction:
     async def watch(self, key: bytes):
         """Watch `key`: returns a Future firing when its value changes from
         what this transaction observes (Transaction::watch semantics —
-        registered against the owning storage server)."""
+        registered against the owning storage server via the same
+        network-wrapped endpoint as reads)."""
         value = await self.get(key, snapshot=True)
-        ss = self.db.cluster.storage_servers[
-            self.db.cluster.key_servers.shard_of(key)
-        ]
-        return ss.watch(key, value)
+        return self.db.storage_for(key).watch(key, value)
 
     # -- writes -----------------------------------------------------------
 
@@ -144,11 +165,54 @@ class Transaction:
         self.mutations.append(("clear", begin, end))
         self.write_conflicts.append((begin, end))
 
+    def atomic_op(self, op: str, key: bytes, param: bytes) -> None:
+        """Atomic read-modify-write mutation (Transaction::atomicOp;
+        MutationRef types — utils/atomic.py has the semantics)."""
+        from foundationdb_tpu.utils.atomic import ATOMIC_OPS, apply_atomic
+
+        if op not in ATOMIC_OPS:
+            raise ValueError(f"unknown atomic op {op!r}")
+        known, val = self.writes.lookup(key)
+        if known:
+            new = apply_atomic(op, val, param)
+            if new is None:
+                self.writes.clear(key, key_after(key))
+            else:
+                self.writes.set(key, new)
+        else:
+            self.writes.atomics.setdefault(key, []).append((op, param))
+        self.mutations.append(("atomic", op, key, param))
+        self.write_conflicts.append((key, key_after(key)))
+
+    def add(self, key: bytes, value: int, width: int = 8) -> None:
+        """fdb's ADD convenience: little-endian integer add."""
+        self.atomic_op("add", key, value.to_bytes(width, "little", signed=True))
+
     def add_read_conflict_range(self, begin: bytes, end: bytes) -> None:
         self.read_conflicts.append((begin, end))
 
     def add_write_conflict_range(self, begin: bytes, end: bytes) -> None:
         self.write_conflicts.append((begin, end))
+
+    def set_versionstamped_key(
+        self, prefix: bytes, suffix: bytes, value: bytes
+    ) -> None:
+        """SET_VERSIONSTAMPED_KEY: final key = prefix + 10-byte commit
+        versionstamp + suffix, assigned at commit (MutationRef::
+        SetVersionstampedKey)."""
+        self.mutations.append(("vs_key", prefix, suffix, value))
+        self.write_conflicts.append((prefix, prefix + b"\xff" * 11))
+
+    def set_versionstamped_value(self, key: bytes, value_prefix: bytes) -> None:
+        """SET_VERSIONSTAMPED_VALUE: value gets the stamp appended."""
+        self.mutations.append(("vs_value", key, value_prefix))
+        self.writes.atomics.pop(key, None)
+        self.write_conflicts.append((key, key_after(key)))
+
+    @property
+    def versionstamp(self) -> Optional[bytes]:
+        """The commit versionstamp (after a successful commit)."""
+        return self._versionstamp
 
     # -- commit -----------------------------------------------------------
 
@@ -167,9 +231,10 @@ class Transaction:
             mutations=list(self.mutations),
         )
         ctr.validate()
-        version = await self.db.commit_proxy().commit(ctr).future
-        self.committed_version = version
-        return version
+        commit_id = await self.db.commit_proxy().commit(ctr).future
+        self.committed_version = commit_id.version
+        self._versionstamp = commit_id.versionstamp
+        return commit_id.version
 
     def reset(self) -> None:
         self.__init__(self.db)

@@ -63,9 +63,18 @@ class TransactionTooOldError(Exception):
 
 
 @dataclasses.dataclass
+class CommitID:
+    """Commit reply payload (the reference's CommitID): the version plus
+    the 10-byte versionstamp (8B big-endian version + 2B batch order)."""
+
+    version: int
+    versionstamp: bytes
+
+
+@dataclasses.dataclass
 class CommitRequest:
     transaction: CommitTransaction
-    reply: Promise  # -> commit version, or error
+    reply: Promise  # -> CommitID, or error
 
 
 @dataclasses.dataclass
@@ -143,6 +152,12 @@ class CommitProxy:
             ["txnCommitIn", "txnCommitOut", "txnConflicts", "commitBatchIn"],
         )
         self.failed: Optional[BaseException] = None
+        # Ranges recently moved between resolvers (ResolutionBalancer):
+        # the next batch injects a synthetic blind write over each so the
+        # receiving resolver's empty history can't miss stale-read
+        # conflicts (the reference applies resolverChanges with the same
+        # conservative effect at the transition version).
+        self.conservative_writes: list[tuple[bytes, bytes]] = []
         self._task = None
 
     def start(self) -> None:
@@ -221,6 +236,20 @@ class CommitProxy:
 
         # Phase 2: resolution.
         txns = [r.transaction for r in batch]
+        if self.conservative_writes:
+            moved, self.conservative_writes = self.conservative_writes, []
+            # PREPENDED: intra-batch conflicts only see lower-indexed
+            # writers, so the synthetic write must come before every user
+            # transaction to abort same-batch stale reads of the moved
+            # span (the reference applies resolverChanges before the
+            # batch's transactions).
+            batch = [
+                CommitRequest(
+                    CommitTransaction(write_conflict_ranges=list(moved)),
+                    Promise(),
+                )
+            ] + batch
+            txns = [r.transaction for r in batch]
         reqs, txn_resolver_map, range_maps = self._build_resolution_requests(
             txns, prev_version, version
         )
@@ -253,7 +282,7 @@ class CommitProxy:
                         if _is_metadata(m):
                             self.on_state_mutation(m)
 
-        messages = self._assign_mutations(txns, verdicts)
+        messages = self._assign_mutations(txns, verdicts, version)
 
         # Phase 4: push to the log system.
         from foundationdb_tpu.cluster.tlog import TLogCommitRequest
@@ -272,7 +301,7 @@ class CommitProxy:
             v = verdicts[t]
             if v == TransactionResult.COMMITTED:
                 self.counters.add("txnCommitOut")
-                req.reply.send(version)
+                req.reply.send(CommitID(version, _stamp(version, t)))
             elif v == TransactionResult.TOO_OLD:
                 req.reply.send_error(TransactionTooOldError())
             else:
@@ -367,15 +396,27 @@ class CommitProxy:
 
     # -- assignMutationsToStorageServers (:1861) ------------------------------
 
-    def _assign_mutations(self, txns, verdicts) -> dict[int, list[Any]]:
+    def _assign_mutations(self, txns, verdicts, version: int) -> dict[int, list[Any]]:
         messages: dict[int, list[Any]] = {}
         for t, tr in enumerate(txns):
             if verdicts[t] != TransactionResult.COMMITTED:
                 continue
             for m in tr.mutations:
                 kind = m[0]
+                if kind == "vs_key":
+                    # SetVersionstampedKey: splice the commit stamp into
+                    # the key, then it is an ordinary set.
+                    _, prefix, suffix, value = m
+                    m = ("set", prefix + _stamp(version, t) + suffix, value)
+                    kind = "set"
+                elif kind == "vs_value":
+                    _, key, value_prefix = m
+                    m = ("set", key, value_prefix + _stamp(version, t))
+                    kind = "set"
                 if kind == "set":
                     shards = [self.key_servers.shard_of(m[1])]
+                elif kind == "atomic":
+                    shards = [self.key_servers.shard_of(m[2])]
                 elif kind == "clear":
                     shards = self.key_servers.shards_of_range(m[1], m[2])
                 else:
@@ -385,7 +426,13 @@ class CommitProxy:
         return messages
 
 
+def _stamp(version: int, order: int) -> bytes:
+    """10-byte versionstamp: 8B big-endian commit version + 2B txn order."""
+    return version.to_bytes(8, "big") + order.to_bytes(2, "big")
+
+
 def _is_metadata(m) -> bool:
     """Metadata mutations target the \xff system keyspace
     (the applyMetadataToCommittedTransactions condition)."""
-    return m[1].startswith(SYSTEM_PREFIX)
+    key = m[2] if m[0] == "atomic" else m[1]
+    return key.startswith(SYSTEM_PREFIX)

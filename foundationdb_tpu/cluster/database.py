@@ -123,8 +123,12 @@ class Cluster:
             )
             for p in range(cfg.n_commit_proxies)
         ]
+        from foundationdb_tpu.cluster.balancer import ResolutionBalancer
         from foundationdb_tpu.cluster.ratekeeper import Ratekeeper
 
+        self.balancer = ResolutionBalancer(
+            sched, self.resolvers, self.key_resolvers, self.commit_proxies
+        )
         self.ratekeeper = Ratekeeper(sched, self.sequencer, self.storage_servers)
         self.grv_proxy = GrvProxy(sched, self.sequencer, ratekeeper=self.ratekeeper)
         # What clients actually talk to (network-wrapped under simulation).
@@ -193,8 +197,10 @@ class Cluster:
             cp.start()
         self.grv_proxy.start()
         self.ratekeeper.start()
+        self.balancer.start()
 
     def stop(self) -> None:
+        self.balancer.stop()
         for ss in self.storage_servers:
             ss.stop()
         for cp in self.commit_proxies:

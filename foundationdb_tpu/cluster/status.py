@@ -1,0 +1,81 @@
+"""Cluster status: the machine-readable health/metrics document.
+
+Behavioral mirror of `fdbserver/Status.actor.cpp` (schema shape from
+fdbclient/Schemas.cpp): one JSON-able dict aggregating every role's
+counters, versions, latencies, and configuration — what `fdbcli status`
+and monitoring consume.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def cluster_status(cluster) -> dict[str, Any]:
+    seq = cluster.sequencer
+    data = {
+        "cluster": {
+            "configuration": {
+                "commit_proxies": len(cluster.commit_proxies),
+                "grv_proxies": 1,
+                "resolvers": len(cluster.resolvers),
+                "storage_servers": len(cluster.storage_servers),
+                "resolver_backend": "tpu",
+            },
+            "datacenter_lag": {"versions": 0},
+            "latest_version": seq.version,
+            "live_committed_version": seq.live_committed.get(),
+            "qos": {
+                "transactions_per_second_limit": cluster.ratekeeper.tps_budget,
+                "worst_storage_lag_versions": cluster.ratekeeper.worst_lag(),
+            },
+            "workload": {
+                "transactions": {
+                    "committed": sum(
+                        p.counters.get("txnCommitOut")
+                        for p in cluster.commit_proxies
+                    ),
+                    "conflicted": sum(
+                        p.counters.get("txnConflicts")
+                        for p in cluster.commit_proxies
+                    ),
+                    "started": sum(
+                        p.counters.get("txnCommitIn")
+                        for p in cluster.commit_proxies
+                    ),
+                },
+                "grv": cluster.grv_proxy.counters.as_dict(),
+            },
+            "processes": {},
+        }
+    }
+    procs = data["cluster"]["processes"]
+    for i, r in enumerate(cluster.resolvers):
+        procs[f"resolver{i}"] = {
+            "role": "resolver",
+            "version": r.version.get(),
+            "counters": r.counters.as_dict(),
+            "latency": {
+                "resolver": r.resolver_latency.as_dict(),
+                "queue_wait": r.queue_wait_latency.as_dict(),
+                "compute": r.compute_time.as_dict(),
+            },
+            "total_state_bytes": r.total_state_bytes,
+        }
+    for i, p in enumerate(cluster.commit_proxies):
+        procs[f"proxy{i}"] = {
+            "role": "commit_proxy",
+            "committed_version": p.committed_version.get(),
+            "counters": p.counters.as_dict(),
+            "failed": p.failed is not None,
+        }
+    for i, ss in enumerate(cluster.storage_servers):
+        procs[f"storage{i}"] = {
+            "role": "storage",
+            "version": ss.version.get(),
+            "durable_version": ss.durable_version,
+            "keys": len(ss._keys),
+        }
+    procs["tlog0"] = {"role": "log", "version": cluster.tlog.version.get()}
+    procs["sequencer"] = {"role": "master", "version": seq.version}
+    return data

@@ -115,6 +115,20 @@ class StorageServer:
                 bisect.insort(self._keys, k)
             self._data[k] = val
             self._fire_watches(k)
+        elif kind == "atomic":
+            from foundationdb_tpu.utils.atomic import apply_atomic
+
+            _, op, k, param = m
+            new = apply_atomic(op, self._data.get(k), param)
+            if new is None:
+                if k in self._data:
+                    del self._data[k]
+                    self._keys.remove(k)
+            else:
+                if k not in self._data:
+                    bisect.insort(self._keys, k)
+                self._data[k] = new
+            self._fire_watches(k)
         elif kind == "clear":
             _, b, e = m
             lo = bisect.bisect_left(self._keys, b)

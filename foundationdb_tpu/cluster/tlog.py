@@ -43,7 +43,11 @@ class TLog:
         self.version = Notified(recovery_version)
         # tag -> list of (version, mutations)
         self._messages: dict[Tag, list[tuple[int, list[Any]]]] = {}
-        self._popped: dict[Tag, int] = {}
+        # consumer -> tag -> popped-through version. Messages are retained
+        # until EVERY registered consumer has popped them (the reference's
+        # per-tag popped bookkeeping generalized to backup workers, which
+        # read every tag — fdbserver/BackupWorker.actor.cpp).
+        self._popped: dict[str, dict[Tag, int]] = {"storage": {}}
 
     async def commit(self, req: TLogCommitRequest) -> int:
         """Append one version's messages; returns the durable version."""
@@ -66,9 +70,27 @@ class TLog:
         ]
         return out, self.version.get()
 
-    def pop(self, tag: Tag, up_to_version: int) -> None:
-        """Discard tag messages at versions <= up_to_version."""
-        self._popped[tag] = max(self._popped.get(tag, 0), up_to_version)
+    def register_consumer(self, name: str) -> None:
+        """Retain messages for an extra consumer from this point on."""
+        self._popped.setdefault(name, {})
+
+    def unregister_consumer(self, name: str) -> None:
+        if name != "storage":
+            self._popped.pop(name, None)
+            for tag in list(self._messages):
+                self._trim(tag)
+
+    def pop(self, tag: Tag, up_to_version: int, consumer: str = "storage") -> None:
+        """Mark `consumer` done with tag messages <= up_to_version; discard
+        what every consumer has popped."""
+        marks = self._popped.setdefault(consumer, {})
+        marks[tag] = max(marks.get(tag, 0), up_to_version)
+        self._trim(tag)
+
+    def _trim(self, tag: Tag) -> None:
+        floor = min(
+            (marks.get(tag, 0) for marks in self._popped.values()), default=0
+        )
         self._messages[tag] = [
-            (v, m) for v, m in self._messages.get(tag, []) if v > up_to_version
+            (v, m) for v, m in self._messages.get(tag, []) if v > floor
         ]

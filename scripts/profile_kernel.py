@@ -1,10 +1,14 @@
 #!/usr/bin/env python
-"""Stage-by-stage timing of the conflict kernel at bench shapes.
+"""Stage-by-stage timing of the v2 conflict kernel at bench shapes.
 
 Times each stage of ops.conflict.resolve_batch in isolation on the
-current default device to find where the batch milliseconds go:
-  sort_ranks | history query (main/fresh) | intra fixpoint | combine |
-  append+GC | full kernel | compact
+current default device:
+  full kernel | sort_ranks | history query | merge_writes |
+  intra iteration (sparse cover + rmq build + query)
+
+Note (measured, see MEMORY): through the axon tunnel, block_until_ready
+can under-report small ops — treat sub-10ms readings as suspect and
+re-check with serialized-in-jit timing (scripts/experiments.py style).
 """
 
 import sys
@@ -28,7 +32,7 @@ REPS = 5
 
 def timeit(name, fn, *args):
     t0 = time.perf_counter()
-    out = fn(*args)  # compile
+    out = fn(*args)
     jax.block_until_ready(out)
     compile_s = time.perf_counter() - t0
     t0 = time.perf_counter()
@@ -36,7 +40,7 @@ def timeit(name, fn, *args):
         out = fn(*args)
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / REPS
-    print(f"{name:35s} {dt * 1e3:9.2f} ms   (compile {compile_s:5.1f}s)",
+    print(f"{name:38s} {dt * 1e3:9.2f} ms   (compile {compile_s:5.1f}s)",
           flush=True)
     return out
 
@@ -46,21 +50,20 @@ def main():
     cap = 1 << (N - 1).bit_length()
     config = KernelConfig(
         max_key_bytes=8, max_txns=cap, max_reads=cap, max_writes=cap,
-        history_capacity=8 * cap, fresh_slots=8, fresh_capacity=2 * cap,
-        window_versions=1_000_000,
+        history_capacity=10 * cap, window_versions=1_000_000,
     )
     rng = np.random.default_rng(0)
     batch = skiplist_style_batch(
-        rng, config, N, version=200_000, keyspace=1_000_000, key_bytes=8
+        rng, config, N, version=1_200_000, keyspace=1_000_000, key_bytes=8,
+        snapshot_lag=400_000,
     ).device_args()
     batch = jax.device_put(batch)
     state = jax.device_put(H.init(config))
-    # Pre-populate: run a few batches through so history is non-trivial.
     step = jax.jit(C.resolve_batch)
-    for i in range(3):
+    for i in range(5):  # reach steady-state history
         b2 = skiplist_style_batch(
-            rng, config, N, version=200_000 * (i + 2), keyspace=1_000_000,
-            key_bytes=8,
+            rng, config, N, version=200_000 * (i + 1), keyspace=1_000_000,
+            key_bytes=8, snapshot_lag=400_000,
         ).device_args()
         state, _ = step(state, b2)
     jax.block_until_ready(state)
@@ -68,52 +71,35 @@ def main():
     nr = batch["read_valid"].shape[0]
     nw = batch["write_valid"].shape[0]
 
-    # ---- full kernel first (most important number) -----------------------
     st2 = jax.tree.map(jnp.copy, state)
     timeit("FULL resolve_batch", step, st2, batch)
-    timeit("compact", jax.jit(H.compact), jax.tree.map(jnp.copy, state))
 
-    # ---- stage: sort_ranks ----------------------------------------------
     points = jnp.concatenate(
         [batch["read_begin"], batch["read_end"],
          batch["write_begin"], batch["write_end"]], axis=0)
     pt_valid = jnp.concatenate(
         [batch["read_valid"], batch["read_valid"],
          batch["write_valid"], batch["write_valid"]])
-    sort_fn = jax.jit(K.sort_ranks)
-    ranks, ukeys, ucount = timeit("sort_ranks (256K pts)", sort_fn, points, pt_valid)
+    ranks, ukeys, _ = timeit(
+        "sort_ranks", jax.jit(K.sort_ranks), points, pt_valid
+    )
 
-    # ---- stage: history query -------------------------------------------
     snap = batch["snapshot"][batch["read_txn"]]
-    q_fn = jax.jit(H.query_reads)
-    timeit("history query (main+fresh)", q_fn,
+    timeit("history query", jax.jit(H.query_reads),
            state, batch["read_begin"], batch["read_end"], snap)
 
-    def q_main(state, rb, re, snap):
-        il = K.searchsorted(state.main_keys, rb, side="right") - 1
-        ir = K.searchsorted(state.main_keys, re, side="left") - 1
-        vmax = rangemax.query(state.main_tab, jnp.maximum(il, 0), ir + 1, op="max")
-        return vmax > snap
-    timeit("  main tier only", jax.jit(q_main),
-           state, batch["read_begin"], batch["read_end"], snap)
+    run_bounds = K.sentinel_like(2 * nw, config.key_words)
+    timeit("merge_writes", jax.jit(H.merge_writes),
+           jax.tree.map(jnp.copy, state), run_bounds,
+           jnp.int32(1_200_000), jnp.int32(200_000))
 
-    def q_fresh(state, rb, re, snap):
-        conflict = jnp.zeros(rb.shape[0], bool)
-        for s in range(state.fresh_keys.shape[0]):
-            hit = H._interval_parity_hit(state.fresh_keys[s], rb, re)
-            conflict |= hit & (state.fresh_ver[s] > snap)
-        return conflict
-    timeit("  fresh tier only (8 runs)", jax.jit(q_fresh),
-           state, batch["read_begin"], batch["read_end"], snap)
-
-    # ---- stage: one intra-batch iteration --------------------------------
     leaves = 1 << int(np.ceil(np.log2(points.shape[0])))
     rb_rank, re_rank = ranks[:nr], ranks[nr:2 * nr]
     wb_rank = ranks[2 * nr:2 * nr + nw]
     we_rank = ranks[2 * nr + nw:]
+    wl = batch["write_valid"]
     write_txn = batch["write_txn"]
     read_txn = batch["read_txn"]
-    wl = batch["write_valid"]
 
     def intra_once(committed):
         writer = jnp.where(committed[write_txn] & wl, write_txn, INT32_POS)
@@ -122,17 +108,8 @@ def main():
         mintab = rangemax.build(mw, op="min")
         min_writer = rangemax.query(mintab, rb_rank, re_rank, op="min")
         return (min_writer < read_txn) & batch["read_valid"]
-    committed0 = batch["txn_valid"]
-    timeit("intra iteration (segtree+rmq)", jax.jit(intra_once), committed0)
 
-    def seg_only(committed):
-        writer = jnp.where(committed[write_txn] & wl, write_txn, INT32_POS)
-        return segtree.min_cover(leaves, jnp.where(wl, wb_rank, 0),
-                                 jnp.where(wl, we_rank, 0), writer)
-    timeit("  min_cover only", jax.jit(seg_only), committed0)
-    mw = seg_only(committed0)
-    timeit("  rangemax.build only", jax.jit(lambda x: rangemax.build(x, op='min')), mw)
-
+    timeit("intra iteration", jax.jit(intra_once), batch["txn_valid"])
 
 
 if __name__ == "__main__":

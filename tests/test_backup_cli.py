@@ -1,0 +1,155 @@
+"""Backup/restore, versionstamps, and CLI tests."""
+
+import pytest
+
+from foundationdb_tpu.cli import CliSession
+from foundationdb_tpu.cluster.backup import BackupAgent, BackupContainer
+from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+
+
+def run(sched, coro):
+    return sched.run_until(sched.spawn(coro).done)
+
+
+@pytest.fixture
+def world():
+    sched, cluster, db = open_cluster(ClusterConfig(n_storage=2))
+    yield sched, cluster, db
+    cluster.stop()
+
+
+def test_snapshot_restore_roundtrip(world):
+    sched, cluster, db = world
+    agent = BackupAgent(db, BackupContainer())
+
+    async def body():
+        txn = db.create_transaction()
+        for i in range(20):
+            txn.set(b"bk%02d" % i, b"v%d" % i)
+        await txn.commit()
+
+        v = await agent.snapshot()
+
+        # post-snapshot damage: must be undone by restore
+        txn = db.create_transaction()
+        txn.clear_range(b"bk00", b"bk99")
+        txn.set(b"junk", b"x")
+        await txn.commit()
+
+        await agent.restore()
+        txn = db.create_transaction()
+        items = await txn.get_range(b"", b"\xff")
+        return v, items
+
+    v, items = run(sched, body())
+    assert v > 0
+    assert [k for k, _ in items] == [b"bk%02d" % i for i in range(20)]
+
+
+def test_log_backup_point_in_time(world):
+    sched, cluster, db = world
+    agent = BackupAgent(db, BackupContainer())
+
+    async def body():
+        txn = db.create_transaction()
+        txn.set(b"pit", b"one")
+        await txn.commit()
+
+        await agent.snapshot()
+        agent.start_log_backup(cluster)
+
+        txn = db.create_transaction()
+        txn.set(b"pit", b"two")
+        txn.add(b"pitctr", 7)
+        await txn.commit()
+        mid_version = txn.committed_version
+
+        await sched.delay(0.1)  # let the backup worker drain the log
+
+        txn = db.create_transaction()
+        txn.set(b"pit", b"three")
+        await txn.commit()
+        await sched.delay(0.1)
+        agent.stop_log_backup()
+
+        # restore to the mid point: "two" visible, "three" not
+        await agent.restore(target_version=mid_version)
+        txn = db.create_transaction()
+        return await txn.get(b"pit"), await txn.get(b"pitctr")
+
+    pit, ctr = run(sched, body())
+    assert pit == b"two"
+    assert ctr == (7).to_bytes(8, "little")
+
+
+def test_versionstamped_key_and_value(world):
+    sched, cluster, db = world
+
+    async def body():
+        txn = db.create_transaction()
+        txn.set_versionstamped_key(b"log/", b"/end", b"payload")
+        txn.set_versionstamped_value(b"last", b"at=")
+        v = await txn.commit()
+        stamp = txn.versionstamp
+
+        txn = db.create_transaction()
+        items = await txn.get_range(b"log/", b"log0")
+        last = await txn.get(b"last")
+        return v, stamp, items, last
+
+    v, stamp, items, last = run(sched, body())
+    assert len(stamp) == 10
+    assert int.from_bytes(stamp[:8], "big") == v
+    assert items == [(b"log/" + stamp + b"/end", b"payload")]
+    assert last == b"at=" + stamp
+
+
+def test_cli_commands(world):
+    sched, cluster, db = world
+    cli = CliSession(cluster, db)
+
+    async def body():
+        out = []
+        out.append(await cli.run_command("set k v"))        # blocked
+        out.append(await cli.run_command("writemode on"))
+        out.append(await cli.run_command("set k v"))
+        out.append(await cli.run_command("get k"))
+        out.append(await cli.run_command("getrange a z"))
+        out.append(await cli.run_command("clear k"))
+        out.append(await cli.run_command("get k"))
+        out.append(await cli.run_command("status"))
+        out.append(await cli.run_command("status json"))
+        out.append(await cli.run_command("bogus"))
+        return out
+
+    (blocked, _, set_ok, get_ok, rng, clr, gone, status, status_json,
+     unknown) = run(sched, body())
+    assert blocked.startswith("ERROR: writemode")
+    assert set_ok == "Committed"
+    assert get_ok == "`k' is `v'"
+    assert "`k' is `v'" in rng
+    assert clr == "Committed"
+    assert gone == "`k': not found"
+    assert "resolver_backend    - tpu" in status
+    assert '"resolvers"' in status_json
+    assert unknown.startswith("ERROR: unknown command")
+
+
+def test_cli_backup_restore(tmp_path, world):
+    sched, cluster, db = world
+    cli = CliSession(cluster, db)
+    path = str(tmp_path / "bk")
+
+    async def body():
+        await cli.run_command("writemode on")
+        await cli.run_command("set persist me")
+        out1 = await cli.run_command(f"backup {path}")
+        await cli.run_command("clear persist")
+        out2 = await cli.run_command(f"restore {path}")
+        out3 = await cli.run_command("get persist")
+        return out1, out2, out3
+
+    out1, out2, out3 = run(sched, body())
+    assert out1.startswith("Snapshot complete")
+    assert out2.startswith("Restored")
+    assert out3 == "`persist' is `me'"

@@ -1,0 +1,180 @@
+"""Tuple layer, atomic ops, status JSON, resolution balancer tests."""
+
+import json
+import uuid
+
+import pytest
+
+from foundationdb_tpu.layers import tuple as fdbtuple
+from foundationdb_tpu.layers.tuple import Subspace
+from foundationdb_tpu.utils.atomic import apply_atomic
+
+
+# -- tuple layer ----------------------------------------------------------
+
+CASES = [
+    (),
+    (None,),
+    (b"bytes", b"with\x00null"),
+    ("unicode ☃",),
+    (0, 1, -1, 255, 256, -255, -256, 2**48, -(2**48)),
+    (3.14, -2.5, 0.0),
+    (True, False),
+    (uuid.UUID(int=0x1234567890ABCDEF1234567890ABCDEF),),
+    (b"nested", ("inner", 42, None), b"after"),
+]
+
+
+@pytest.mark.parametrize("t", CASES)
+def test_tuple_roundtrip(t):
+    assert fdbtuple.unpack(fdbtuple.pack(t)) == t
+
+
+def test_tuple_order_preserving():
+    import random
+
+    rng = random.Random(0)
+    vals = []
+    for _ in range(200):
+        kind = rng.randrange(3)
+        if kind == 0:
+            vals.append((rng.randint(-2**40, 2**40),))
+        elif kind == 1:
+            vals.append((bytes(rng.randrange(256) for _ in range(rng.randrange(6))),))
+        else:
+            vals.append((rng.random() * 1000 - 500,))
+    # within same type class, byte order == natural order
+    ints = sorted(v for v in vals if isinstance(v[0], int))
+    assert [fdbtuple.unpack(p) for p in sorted(fdbtuple.pack(v) for v in ints)] == ints
+    floats = sorted(v for v in vals if isinstance(v[0], float))
+    assert [
+        fdbtuple.unpack(p) for p in sorted(fdbtuple.pack(v) for v in floats)
+    ] == floats
+    byteses = sorted(v for v in vals if isinstance(v[0], bytes))
+    assert [
+        fdbtuple.unpack(p) for p in sorted(fdbtuple.pack(v) for v in byteses)
+    ] == byteses
+
+
+def test_subspace():
+    users = Subspace(("users",))
+    k = users.pack((42, "alice"))
+    assert users.contains(k)
+    assert users.unpack(k) == (42, "alice")
+    b, e = users.range()
+    assert b < k < e
+    sub = users[42]
+    assert sub.pack(("alice",)) == k
+
+
+# -- atomic op semantics --------------------------------------------------
+
+def test_atomic_add_wraps_and_creates():
+    assert apply_atomic("add", None, (5).to_bytes(8, "little")) == (5).to_bytes(8, "little")
+    v = apply_atomic("add", (250).to_bytes(1, "little"), (10).to_bytes(1, "little"))
+    assert v == (4).to_bytes(1, "little")  # wraps mod 256
+
+
+def test_atomic_bitwise_and_minmax():
+    assert apply_atomic("bit_and", None, b"\xff") == b"\x00"
+    assert apply_atomic("bit_or", b"\x0f", b"\xf0") == b"\xff"
+    assert apply_atomic("bit_xor", b"\xff", b"\x0f") == b"\xf0"
+    assert apply_atomic("max", b"\x01\x00", b"\x02\x00") == b"\x02\x00"
+    assert apply_atomic("min", b"\x01\x00", b"\x02\x00") == b"\x01\x00"
+    assert apply_atomic("byte_max", b"a", b"b") == b"b"
+    assert apply_atomic("byte_min", b"a", b"b") == b"a"
+    assert apply_atomic("append_if_fits", b"ab", b"cd") == b"abcd"
+    assert apply_atomic("compare_and_clear", b"x", b"x") is None
+    assert apply_atomic("compare_and_clear", b"y", b"x") == b"y"
+
+
+def test_atomic_through_cluster():
+    from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+
+    sched, cluster, db = open_cluster(ClusterConfig())
+
+    async def body():
+        txn = db.create_transaction()
+        txn.add(b"ctr", 5)
+        assert await txn.get(b"ctr") == (5).to_bytes(8, "little")  # RYW
+        await txn.commit()
+
+        txn = db.create_transaction()
+        txn.add(b"ctr", -2)
+        await txn.commit()
+
+        txn = db.create_transaction()
+        v = await txn.get(b"ctr")
+        txn.atomic_op("byte_max", b"m", b"hello")
+        txn.atomic_op("compare_and_clear", b"ctr", (3).to_bytes(8, "little"))
+        await txn.commit()
+
+        txn = db.create_transaction()
+        return v, await txn.get(b"ctr"), await txn.get(b"m")
+
+    v, ctr, m = sched.run_until(sched.spawn(body()).done)
+    assert v == (3).to_bytes(8, "little")
+    assert ctr is None  # compare_and_clear hit
+    assert m == b"hello"
+    cluster.stop()
+
+
+# -- status + balancer ----------------------------------------------------
+
+def test_status_json():
+    from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+    from foundationdb_tpu.cluster.status import cluster_status
+
+    sched, cluster, db = open_cluster(
+        ClusterConfig(n_commit_proxies=2, n_resolvers=2)
+    )
+
+    async def body():
+        txn = db.create_transaction()
+        txn.set(b"s", b"1")
+        await txn.commit()
+
+    sched.run_until(sched.spawn(body()).done)
+    st = cluster_status(cluster)
+    json.dumps(st)  # must be JSON-able
+    assert st["cluster"]["configuration"]["resolvers"] == 2
+    assert st["cluster"]["workload"]["transactions"]["committed"] >= 1
+    assert st["cluster"]["processes"]["resolver0"]["role"] == "resolver"
+    assert st["cluster"]["live_committed_version"] > 0
+    cluster.stop()
+
+
+def test_balancer_moves_boundary_toward_hot_shard():
+    from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+
+    sched, cluster, db = open_cluster(
+        ClusterConfig(n_commit_proxies=1, n_resolvers=2)
+    )
+    (orig_boundary,) = list(cluster.key_resolvers.boundaries)
+
+    async def body():
+        # hammer keys on resolver 0's shard (below the boundary)
+        for i in range(30):
+            txn = db.create_transaction()
+            txn.set(b"\x01hot%02d" % (i % 10), b"x")
+            await txn.get(b"\x01hot%02d" % ((i + 1) % 10))
+            try:
+                await txn.commit()
+            except Exception:
+                pass
+        # let the balancer loop run
+        await sched.delay(2.0)
+
+    sched.run_until(sched.spawn(body()).done)
+    assert cluster.balancer.counters.get("moves") >= 1
+    assert cluster.key_resolvers.boundaries[0] != orig_boundary
+    # cluster still works after the move
+    async def after():
+        txn = db.create_transaction()
+        txn.set(b"\x01post", b"1")
+        await txn.commit()
+        txn = db.create_transaction()
+        return await txn.get(b"\x01post")
+
+    assert sched.run_until(sched.spawn(after()).done) == b"1"
+    cluster.stop()
