@@ -21,7 +21,12 @@ from __future__ import annotations
 import bisect
 from typing import Optional
 
-from foundationdb_tpu.cluster.commit_proxy import NotCommitted, TransactionTooOldError
+from foundationdb_tpu.cluster.commit_proxy import (
+    CommitUnknownResult,
+    NotCommitted,
+    TransactionTooOldError,
+)
+from foundationdb_tpu.cluster.grv_proxy import GrvProxyFailedError
 from foundationdb_tpu.models.types import CommitTransaction
 
 
@@ -250,8 +255,12 @@ class Database:
     def __init__(self, cluster):
         self.cluster = cluster
         self.sched = cluster.sched
-        self.grv_proxy = cluster.grv_proxy
         self._next_proxy = 0
+
+    @property
+    def grv_proxy(self):
+        # resolved per call: recovery replaces the GRV proxy generation
+        return self.cluster.grv_proxy
 
     def commit_proxy(self):
         # round-robin over commit proxies (the reference picks randomly)
@@ -284,7 +293,15 @@ class Database:
                 result = await fn(txn)
                 await txn.commit()
                 return result
-            except (NotCommitted, TransactionTooOldError):
+            except (
+                NotCommitted,
+                TransactionTooOldError,
+                CommitUnknownResult,
+                GrvProxyFailedError,
+            ):
+                # commit_unknown_result retries like the reference's
+                # onError (the commit MAY have applied — same caveat);
+                # proxy-generation failures re-resolve on the next try.
                 await self.sched.delay(backoff)
                 backoff = min(backoff * 2, 0.1)
         raise RuntimeError("transaction retry limit reached")

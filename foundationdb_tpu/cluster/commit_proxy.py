@@ -62,6 +62,12 @@ class TransactionTooOldError(Exception):
     """error_code_transaction_too_old from the resolver verdict."""
 
 
+class CommitUnknownResult(Exception):
+    """error_code_commit_unknown_result: the proxy died mid-commit; the
+    transaction may or may not have committed (retryable, as in the
+    reference's client onError)."""
+
+
 @dataclasses.dataclass
 class CommitID:
     """Commit reply payload (the reference's CommitID): the version plus
@@ -125,11 +131,13 @@ class CommitProxy:
         key_resolvers: KeyPartition,
         key_servers: KeyPartition,
         *,
+        epoch: int = 1,
         batch_interval: float = 0.005,
         max_batch_txns: int = 512,
         on_state_mutation: Optional[Callable[[Any], None]] = None,
     ):
         self.sched = sched
+        self.epoch = epoch
         self.proxy_id = proxy_id
         self.sequencer = sequencer
         self.resolvers = resolvers
@@ -159,6 +167,7 @@ class CommitProxy:
         # conservative effect at the transition version).
         self.conservative_writes: list[tuple[bytes, bytes]] = []
         self._task = None
+        self._inflight: set = set()
 
     def start(self) -> None:
         self._task = self.sched.spawn(self._batcher(), name=f"{self.proxy_id}-batcher")
@@ -166,16 +175,31 @@ class CommitProxy:
     def stop(self) -> None:
         if self._task is not None:
             self._task.cancel()
+            self._task = None
+        # In-flight batches may be wedged on a dead peer's version chain
+        # (e.g. a partitioned resolver); cancel them — the error path
+        # answers their clients with commit_unknown_result.
+        for task in list(self._inflight):
+            task.cancel()
+        self._inflight.clear()
+        # Queued-but-unbatched requests would otherwise dangle forever;
+        # the reference's clients see broken_promise from a dead proxy.
+        queue = self.requests.stream._queue
+        while queue:
+            req = queue.pop(0)
+            if not req.reply.is_set:
+                req.reply.send_error(CommitUnknownResult())
 
     # -- client entry -----------------------------------------------------
 
     def commit(self, txn: CommitTransaction) -> Promise:
         p = Promise()
         self.counters.add("txnCommitIn")
-        if self.failed is not None:
-            # A broken proxy fails fast; the reference would be replaced by
-            # recovery (fdbserver/ClusterRecovery.actor.cpp).
-            p.send_error(self.failed)
+        if self.failed is not None or self._task is None:
+            # Dead/stopped proxy: the retryable commit_unknown_result, as
+            # the reference's clients see while recovery replaces the
+            # generation (fdbserver/ClusterRecovery.actor.cpp).
+            p.send_error(CommitUnknownResult())
             return p
         self.requests.send(CommitRequest(txn, p))
         return p
@@ -201,9 +225,13 @@ class CommitProxy:
                 ):
                     batch.append(await self.requests.stream.next())
             self._batch_num += 1
-            self.sched.spawn(
+            task = self.sched.spawn(
                 self._commit_batch(batch, self._batch_num),
                 name=f"{self.proxy_id}-batch{self._batch_num}",
+            )
+            self._inflight.add(task)
+            task.done.add_done_callback(
+                lambda _f, t=task: self._inflight.discard(t)
             )
 
     # -- phases 1-5 (commitBatch :2516) ------------------------------------
@@ -219,7 +247,7 @@ class CommitProxy:
             self.failed = e
             for r in batch:
                 if not r.reply.is_set:
-                    r.reply.send_error(e)
+                    r.reply.send_error(CommitUnknownResult())
             raise
 
     async def _commit_batch_impl(
@@ -289,7 +317,8 @@ class CommitProxy:
 
         await self.tlog.commit(
             TLogCommitRequest(
-                prev_version=prev_version, version=version, messages=messages
+                prev_version=prev_version, version=version, messages=messages,
+                epoch=self.epoch,
             )
         )
         self.latest_batch_logging.set(batch_num)

@@ -99,30 +99,7 @@ class Cluster:
 
             self.net = SimNetwork(sched, seed=cfg.sim_seed)
 
-        def wrapped(src, dst, obj, methods):
-            if self.net is None:
-                return obj
-            return self.net.wrap(src, dst, obj, methods)
-
-        self.commit_proxies = [
-            CommitProxy(
-                sched,
-                f"proxy{p}",
-                self.sequencer,
-                [
-                    wrapped(f"proxy{p}", f"resolver{i}", r, ["resolve"])
-                    for i, r in enumerate(self.resolvers)
-                ],
-                wrapped(f"proxy{p}", "tlog0", self.tlog, ["commit"]),
-                self.key_resolvers,
-                self.key_servers,
-                batch_interval=cfg.commit_batch_interval,
-                # a batch must fit the kernel's static txn capacity
-                max_batch_txns=cfg.kernel_config.max_txns,
-                on_state_mutation=self._apply_state_mutation,
-            )
-            for p in range(cfg.n_commit_proxies)
-        ]
+        self.build_proxies(epoch=1)
         from foundationdb_tpu.cluster.balancer import ResolutionBalancer
         from foundationdb_tpu.cluster.ratekeeper import Ratekeeper
 
@@ -133,10 +110,44 @@ class Cluster:
         self.grv_proxy = GrvProxy(sched, self.sequencer, ratekeeper=self.ratekeeper)
         # What clients actually talk to (network-wrapped under simulation).
         self.client_storages = [
-            wrapped("client", f"storage{s}", ss, ["get_value", "get_key_values"])
+            self._wrapped(
+                "client", f"storage{s}", ss, ["get_value", "get_key_values"]
+            )
             for s, ss in enumerate(self.storage_servers)
         ]
+        from foundationdb_tpu.cluster.recovery import ClusterController
+
+        self.controller = ClusterController(self)
         self._started = False
+
+    def _wrapped(self, src, dst, obj, methods):
+        if self.net is None:
+            return obj
+        return self.net.wrap(src, dst, obj, methods)
+
+    def build_proxies(self, epoch: int) -> None:
+        """(Re)recruit the commit-proxy generation (recovery re-enters)."""
+        cfg = self.config
+        self.commit_proxies = [
+            CommitProxy(
+                self.sched,
+                f"proxy{p}.{epoch}" if epoch > 1 else f"proxy{p}",
+                self.sequencer,
+                [
+                    self._wrapped(f"proxy{p}", f"resolver{i}", r, ["resolve"])
+                    for i, r in enumerate(self.resolvers)
+                ],
+                self._wrapped(f"proxy{p}", "tlog0", self.tlog, ["commit"]),
+                self.key_resolvers,
+                self.key_servers,
+                epoch=epoch,
+                batch_interval=cfg.commit_batch_interval,
+                # a batch must fit the kernel's static txn capacity
+                max_batch_txns=cfg.kernel_config.max_txns,
+                on_state_mutation=self._apply_state_mutation,
+            )
+            for p in range(cfg.n_commit_proxies)
+        ]
 
     def reboot_storage(self, s: int) -> None:
         """Kill storage server s and bring up a replacement from its durable
@@ -198,8 +209,10 @@ class Cluster:
         self.grv_proxy.start()
         self.ratekeeper.start()
         self.balancer.start()
+        self.controller.start()
 
     def stop(self) -> None:
+        self.controller.stop()
         self.balancer.stop()
         for ss in self.storage_servers:
             ss.stop()

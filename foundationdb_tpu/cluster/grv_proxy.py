@@ -19,6 +19,11 @@ from foundationdb_tpu.runtime.flow import Promise, PromiseStream, Scheduler
 from foundationdb_tpu.utils.metrics import CounterCollection
 
 
+class GrvProxyFailedError(Exception):
+    """Retryable: this GRV proxy generation died (recovery replaced it);
+    the client's retry loop re-resolves the current generation."""
+
+
 class GrvProxy:
     def __init__(
         self,
@@ -36,6 +41,7 @@ class GrvProxy:
         self.counters = CounterCollection(
             "GrvProxyMetrics", ["txnRequestIn", "txnRequestOut", "grvBatches"]
         )
+        self._pending: list[Promise] = []
         self._task = None
 
     def start(self) -> None:
@@ -44,6 +50,18 @@ class GrvProxy:
     def stop(self) -> None:
         if self._task is not None:
             self._task.cancel()
+            self._task = None
+        # Fail everything queued or batched: a dangling read-version
+        # promise would strand its client forever across a recovery.
+        for p in self._pending:
+            if not p.is_set:
+                p.send_error(GrvProxyFailedError())
+        self._pending = []
+        queue = self.requests.stream._queue
+        while queue:
+            p = queue.pop(0)
+            if not p.is_set:
+                p.send_error(GrvProxyFailedError())
 
     def get_read_version(self) -> Promise:
         p = Promise()
@@ -54,7 +72,7 @@ class GrvProxy:
     async def _starter(self) -> None:
         # Token bucket fed by the Ratekeeper budget (transactionStarter's
         # "transactionRate" accounting, GrvProxyServer.actor.cpp:824).
-        pending: list[Promise] = []
+        pending = self._pending
         tokens = 0.0
         last = self.sched.now()
         while True:
@@ -77,7 +95,8 @@ class GrvProxy:
             if n == 0:
                 continue
             tokens -= n
-            batch, pending = pending[:n], pending[n:]
+            batch = pending[:n]
+            del pending[:n]
             version = self.sequencer.get_live_committed_version()
             self.counters.add("grvBatches")
             for p in batch:

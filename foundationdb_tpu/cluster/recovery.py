@@ -1,0 +1,170 @@
+"""Cluster recovery: rebuild the transaction system in a new generation.
+
+Behavioral mirror of `fdbserver/ClusterRecovery.actor.cpp` +
+`ClusterController.actor.cpp` (states in RecoveryState.h:31-41),
+compressed to the essentials:
+
+* A ClusterController actor watches the transaction-path roles; any
+  commit-proxy failure (our `proxy.failed` latch — the stand-in for
+  waitFailure) triggers a full recovery, exactly as in the reference:
+  the transaction system is recovered as a unit, never patched.
+* Recovery: stop the old generation's proxies/GRV, pick the recovery
+  version (the durable log's version — reads stay correct), recruit NEW
+  resolvers with EMPTY conflict state (the reference's key fact:
+  resolvers are stateless across recoveries, Resolver.actor.cpp builds a
+  fresh ConflictSet; correctness holds because in-flight transactions
+  with pre-recovery read snapshots are aborted conservatively), recruit
+  new proxies at the next epoch, and re-open for business.
+* Conservative abort of in-flight txns: the first batch of the new
+  generation carries a blind write over the whole keyspace, so any
+  transaction whose snapshot predates recovery conflicts — the same
+  effect the reference gets from the recovery transaction's version
+  bump + lastEpochEnd conflict range (ApplyMetadataMutation /
+  CommitProxyServer recovery handling).
+
+Storage servers and the TLog survive recovery untouched (their state is
+durable); only the stateless roles are rebuilt.
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.cluster.commit_proxy import CommitProxy
+from foundationdb_tpu.cluster.grv_proxy import GrvProxy
+from foundationdb_tpu.cluster.sequencer import Sequencer
+from foundationdb_tpu.models.types import ResolveTransactionBatchRequest
+from foundationdb_tpu.resolver import Resolver
+from foundationdb_tpu.runtime.flow import ActorCancelled, Scheduler, all_of
+from foundationdb_tpu.utils.metrics import CounterCollection
+from foundationdb_tpu.utils.trace import TraceEvent
+
+
+class ClusterController:
+    """Failure watcher + recovery driver (the CC's recovery loop)."""
+
+    def __init__(self, cluster, *, check_interval: float = 0.05):
+        self.cluster = cluster
+        self.check_interval = check_interval
+        self.epoch = 1
+        self.counters = CounterCollection("CCMetrics", ["recoveries", "checks"])
+        self._task = None
+        self._recovering = False
+
+    def start(self) -> None:
+        self._task = self.cluster.sched.spawn(
+            self._watch(), name="cluster-controller"
+        )
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+    async def _watch(self) -> None:
+        try:
+            while True:
+                await self.cluster.sched.delay(self.check_interval)
+                self.counters.add("checks")
+                if self._recovering:
+                    continue
+                if any(p.failed is not None for p in self.cluster.commit_proxies):
+                    await self.recover()
+        except ActorCancelled:
+            raise
+
+    async def recover(self) -> int:
+        """Run one full recovery; returns the new epoch."""
+        self._recovering = True
+        try:
+            cluster = self.cluster
+            sched: Scheduler = cluster.sched
+            self.epoch += 1
+            self.counters.add("recoveries")
+            TraceEvent("MasterRecoveryState").detail("Epoch", self.epoch).detail(
+                "StatusCode", "reading_transaction_system_state"
+            ).log()
+
+            # 1. Stop the old generation and LOCK the log system: pushes
+            #    from the old epoch now fail with tlog_stopped, so no old
+            #    in-flight batch can slip in a commit after this point
+            #    (the reference's coordinated-state lock + tlog epoch
+            #    lock). Their clients get commit_unknown_result.
+            for p in cluster.commit_proxies:
+                p.stop()
+            cluster.grv_proxy.stop()
+            cluster.balancer.stop()
+            cluster.tlog.lock(self.epoch)
+
+            # 2. Recovery version: strictly above anything the old
+            #    generation could have allocated, plus a safety gap
+            #    (lastEpochEnd + MAX_VERSIONS_IN_FLIGHT in the reference)
+            #    so old and new versions can never collide.
+            recovery_version = (
+                max(cluster.tlog.version.get(), cluster.sequencer.version)
+                + 1_000_000
+            )
+            # Complete the old epoch at the recovery version so the first
+            # new-generation push chains (lastEpochEnd).
+            cluster.tlog.lock(self.epoch, recovery_version)
+            cluster.sequencer = Sequencer(
+                sched, recovery_version=recovery_version
+            )
+
+            # 3. New resolvers, empty conflict state.
+            cfg = cluster.config
+            cluster.resolvers = [
+                Resolver(
+                    sched,
+                    cfg.kernel_config,
+                    resolver_id=i,
+                    resolver_count=cfg.n_resolvers,
+                    commit_proxy_count=cfg.n_commit_proxies,
+                    init_version=-1,
+                )
+                for i in range(cfg.n_resolvers)
+            ]
+            boots = [
+                sched.spawn(
+                    r.resolve(
+                        ResolveTransactionBatchRequest(
+                            prev_version=-1,
+                            version=recovery_version,
+                            last_received_version=-1,
+                            transactions=[],
+                        )
+                    )
+                ).done
+                for r in cluster.resolvers
+            ]
+            await all_of(boots)
+
+            # 4. Recruit the new generation's proxies and GRV.
+            cluster.build_proxies(epoch=self.epoch)
+            for p in cluster.commit_proxies:
+                p.last_received_version = recovery_version
+                # Conservative abort of pre-recovery snapshots: the first
+                # batch writes the whole keyspace.
+                p.conservative_writes.append((b"", b"\xff\xff"))
+                p.start()
+            cluster.grv_proxy = GrvProxy(
+                sched, cluster.sequencer, ratekeeper=cluster.ratekeeper
+            )
+            cluster.grv_proxy.start()
+            cluster.ratekeeper.sequencer = cluster.sequencer
+            cluster.balancer.resolvers = cluster.resolvers
+            cluster.balancer.commit_proxies = cluster.commit_proxies
+            cluster.balancer.start()
+
+            # 5. The recovery transaction: an immediate empty commit
+            #    pushes the log (and so every storage server) past the
+            #    recovery version — without it, reads at the new read
+            #    version would stall until the first client commit
+            #    (the reference's recoveryTransactionVersion commit).
+            from foundationdb_tpu.models.types import CommitTransaction
+
+            await cluster.commit_proxies[0].commit(CommitTransaction()).future
+
+            TraceEvent("MasterRecoveryState").detail("Epoch", self.epoch).detail(
+                "StatusCode", "fully_recovered"
+            ).log()
+            return self.epoch
+        finally:
+            self._recovering = False

@@ -33,6 +33,12 @@ class TLogCommitRequest:
     # tag -> list of mutations for that storage server
     messages: dict[Tag, list[Any]]
     known_committed_version: int = 0
+    epoch: int = 1  # generation of the pushing proxy
+
+
+class TLogStoppedError(Exception):
+    """error_code_tlog_stopped: a previous-generation push after the log
+    was locked by recovery (TagPartitionedLogSystem epoch locking)."""
 
 
 class TLog:
@@ -40,6 +46,7 @@ class TLog:
 
     def __init__(self, sched: Scheduler, *, recovery_version: int = 0):
         self.sched = sched
+        self.epoch = 1
         self.version = Notified(recovery_version)
         # tag -> list of (version, mutations)
         self._messages: dict[Tag, list[tuple[int, list[Any]]]] = {}
@@ -49,9 +56,23 @@ class TLog:
         # read every tag — fdbserver/BackupWorker.actor.cpp).
         self._popped: dict[str, dict[Tag, int]] = {"storage": {}}
 
+    def lock(self, epoch: int, recovery_version: int = None) -> None:
+        """Recovery locks the log to a new generation: pushes from older
+        epochs fail from here on (the coordinated-state lock step). When
+        the new generation's recovery version is known, the log version
+        jumps to it (lastEpochEnd completion) so the first new-epoch push
+        (prev_version == recovery_version) can chain."""
+        self.epoch = max(self.epoch, epoch)
+        if recovery_version is not None and recovery_version > self.version.get():
+            self.version.set(recovery_version)
+
     async def commit(self, req: TLogCommitRequest) -> int:
         """Append one version's messages; returns the durable version."""
+        if req.epoch < self.epoch:
+            raise TLogStoppedError(f"epoch {req.epoch} < locked {self.epoch}")
         await self.version.when_at_least(req.prev_version)
+        if req.epoch < self.epoch:  # may have been locked while waiting
+            raise TLogStoppedError(f"epoch {req.epoch} < locked {self.epoch}")
         if self.version.get() >= req.version:
             return self.version.get()  # duplicate (already durable)
         for tag, msgs in req.messages.items():

@@ -1,0 +1,134 @@
+"""Cluster recovery tests: transaction-system failure -> new generation.
+
+Mirrors the reference's recovery contract (ClusterRecovery.actor.cpp,
+SURVEY.md §5.3): stateless roles (proxies, resolvers, sequencer) are
+rebuilt as a unit, resolvers restart with empty conflict state, durable
+state (tlog, storage) survives, in-flight pre-recovery snapshots are
+conservatively aborted, and clients ride through via the retry loop.
+"""
+
+import pytest
+
+from foundationdb_tpu.cluster.commit_proxy import (
+    CommitUnknownResult,
+    NotCommitted,
+)
+from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+
+
+def run(sched, coro):
+    return sched.run_until(sched.spawn(coro).done)
+
+
+@pytest.fixture
+def world():
+    sched, cluster, db = open_cluster(
+        ClusterConfig(n_commit_proxies=2, n_resolvers=2, n_storage=2)
+    )
+    yield sched, cluster, db
+    cluster.stop()
+
+
+def break_proxy(cluster):
+    """Simulate a proxy process death mid-operation."""
+    p = cluster.commit_proxies[0]
+    p.failed = RuntimeError("simulated proxy crash")
+    p.stop()
+
+
+def test_recovery_preserves_data_and_resumes(world):
+    sched, cluster, db = world
+
+    async def body():
+        txn = db.create_transaction()
+        for i in range(5):
+            txn.set(b"pre%d" % i, b"v%d" % i)
+        await txn.commit()
+
+        break_proxy(cluster)
+        await sched.delay(1.0)  # controller notices + recovers
+        assert cluster.controller.epoch == 2
+
+        # new generation accepts commits; old data survived
+        async def w(txn):
+            txn.set(b"post", b"1")
+
+        await db.run(w)
+        txn = db.create_transaction()
+        pre = await txn.get_range(b"pre", b"prf")
+        post = await txn.get(b"post")
+        return pre, post
+
+    pre, post = run(sched, body())
+    assert len(pre) == 5
+    assert post == b"1"
+
+
+def test_recovery_aborts_stale_snapshots(world):
+    sched, cluster, db = world
+
+    async def body():
+        init = db.create_transaction()
+        init.set(b"stale", b"0")
+        await init.commit()
+
+        # txn reads before recovery, commits after -> must abort
+        t1 = db.create_transaction()
+        await t1.get(b"stale")
+        t1.set(b"other", b"x")
+
+        break_proxy(cluster)
+        await sched.delay(1.0)
+        assert cluster.controller.epoch == 2
+
+        try:
+            await t1.commit()
+            return "committed"
+        except (NotCommitted, CommitUnknownResult):
+            return "aborted"
+
+    assert run(sched, body()) == "aborted"
+
+
+def test_resolvers_rebuilt_empty(world):
+    sched, cluster, db = world
+
+    async def body():
+        txn = db.create_transaction()
+        txn.set(b"k", b"v")
+        await txn.commit()
+        old_resolvers = list(cluster.resolvers)
+
+        break_proxy(cluster)
+        await sched.delay(1.0)
+
+        assert all(r not in old_resolvers for r in cluster.resolvers)
+        # fresh conflict state: post-recovery snapshots read/commit fine
+        async def w(txn):
+            assert await txn.get(b"k") == b"v"
+            txn.set(b"k", b"v2")
+
+        await db.run(w)
+        txn = db.create_transaction()
+        return await txn.get(b"k")
+
+    assert run(sched, body()) == b"v2"
+
+
+def test_repeated_recoveries(world):
+    sched, cluster, db = world
+
+    async def body():
+        for round_ in range(3):
+            async def w(txn, round_=round_):
+                txn.set(b"r%d" % round_, b"x")
+
+            await db.run(w)
+            break_proxy(cluster)
+            await sched.delay(1.0)
+        txn = db.create_transaction()
+        return await txn.get_range(b"r", b"s")
+
+    items = run(sched, body())
+    assert len(items) == 3
+    assert cluster.controller.epoch == 4

@@ -104,7 +104,9 @@ def test_clogging_slows_but_preserves_correctness():
     cluster.stop()
 
 
-def test_partition_fails_commits_then_heals():
+def test_partition_breaks_proxy_then_recovery_heals():
+    from foundationdb_tpu.cluster.commit_proxy import CommitUnknownResult
+
     sched, cluster, db = build(seed=2)
     cluster.net.partition("proxy0", "resolver1")
     cluster.net.partition("proxy1", "resolver1")
@@ -115,18 +117,26 @@ def test_partition_fails_commits_then_heals():
         try:
             await txn.commit()
             return "committed"
-        except PartitionedError:
-            return "partitioned"
+        except CommitUnknownResult:
+            return "unknown-result"
 
-    assert run(sched, attempt()) == "partitioned"
+    assert run(sched, attempt()) == "unknown-result"
     cluster.net.heal("proxy0", "resolver1")
     cluster.net.heal("proxy1", "resolver1")
-    # Note: proxy0 is now broken (its batch died mid-chain) — the
-    # reference would run a recovery; clients fail over to proxy1-like
-    # behavior is future work. Heal + fresh proxy path still works:
-    ok_proxy = [p for p in cluster.commit_proxies if p.failed is None]
-    assert len(ok_proxy) >= 0  # partition surfaced, nothing hung
+    # The cluster controller notices the broken proxy and recovers a new
+    # generation; the retry loop rides through.
+    async def after():
+        await db.run(lambda txn: _set(txn, b"\xf0post", b"1"))
+        txn = db.create_transaction()
+        return await txn.get(b"\xf0post")
+
+    assert run(sched, after()) == b"1"
+    assert cluster.controller.epoch >= 2
     cluster.stop()
+
+
+async def _set(txn, k, v):
+    txn.set(k, v)
 
 
 def test_storage_reboot_resumes_from_durable_state():
