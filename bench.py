@@ -19,7 +19,9 @@ with async dispatch (state donation chains batches on-device), timed
 end-to-end; (4) per-batch latency probe with blocking calls.
 
 Env overrides: BENCH_TXNS (default 65536), BENCH_BATCHES (default 16),
-BENCH_CPU_BATCHES (default 4).
+BENCH_CPU_BATCHES (default 4), BENCH_MODE (uniform | zipf | range —
+BASELINE.json configs 1-3: uniform 1M keyspace; Zipf-0.99-style hot-key
+contention; wide range reads vs point writes).
 """
 
 import json
@@ -38,10 +40,16 @@ def main():
     n_txns = int(os.environ.get("BENCH_TXNS", 65536))
     n_batches = int(os.environ.get("BENCH_BATCHES", 16))
     cpu_batches = int(os.environ.get("BENCH_CPU_BATCHES", 4))
+    mode = os.environ.get("BENCH_MODE", "uniform")
     keyspace = 1_000_000
     version_step = 200_000
     window = 1_000_000  # floor rises after 5 batches -> steady-state GC
     snapshot_lag = 2 * version_step  # spans ~2 batches: history conflicts real
+    gen_kw = {
+        "uniform": {},
+        "zipf": {"zipf": 1.1, "keyspace": 10_000_000},  # hot-key contention
+        "range": {"range_len": 500},  # wide scans vs point-ish writes
+    }[mode]
 
     import jax
 
@@ -67,10 +75,11 @@ def main():
     batches = []
     for i in range(n_batches):
         version = (i + 1) * version_step
+        kw = {"keyspace": keyspace, **gen_kw}
         batches.append(
             skiplist_style_batch(
-                rng, config, n_txns, version=version, keyspace=keyspace,
-                key_bytes=8, snapshot_lag=snapshot_lag,
+                rng, config, n_txns, version=version,
+                key_bytes=8, snapshot_lag=snapshot_lag, **kw,
             )
         )
     log(f"generated {n_batches} batches of {n_txns} txns")
@@ -152,10 +161,11 @@ def main():
         f"p99 {p99*1e3:.0f}ms | speedup {dev_rate / cpu_rate:.2f}x"
     )
 
+    suffix = "" if mode == "uniform" else f"_{mode}"
     print(
         json.dumps(
             {
-                "metric": f"resolver_txns_per_sec_{n_txns // 1024}k_batch",
+                "metric": f"resolver_txns_per_sec_{n_txns // 1024}k_batch{suffix}",
                 "value": round(dev_rate, 1),
                 "unit": "txn/s",
                 "vs_baseline": round(dev_rate / cpu_rate, 3),
