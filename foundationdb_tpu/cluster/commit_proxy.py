@@ -166,6 +166,10 @@ class CommitProxy:
         # conflicts (the reference applies resolverChanges with the same
         # conservative effect at the transition version).
         self.conservative_writes: list[tuple[bytes, bytes]] = []
+        # DataDistribution dual-tagging during shard moves: mutations in
+        # [begin, end) ALSO go to `tag` (the serverKeys intermediate
+        # state of MoveKeys).
+        self.extra_tag_ranges: list[tuple[bytes, bytes, int]] = []
         self._task = None
         self._inflight: set = set()
 
@@ -443,13 +447,19 @@ class CommitProxy:
                     m = ("set", key, value_prefix + _stamp(version, t))
                     kind = "set"
                 if kind == "set":
+                    span = (m[1], m[1] + b"\x00")
                     shards = [self.key_servers.shard_of(m[1])]
                 elif kind == "atomic":
+                    span = (m[2], m[2] + b"\x00")
                     shards = [self.key_servers.shard_of(m[2])]
                 elif kind == "clear":
+                    span = (m[1], m[2])
                     shards = self.key_servers.shards_of_range(m[1], m[2])
                 else:
                     raise ValueError(f"unknown mutation {m!r}")
+                for b, e, tag in self.extra_tag_ranges:
+                    if span[0] < e and b < span[1] and tag not in shards:
+                        shards.append(tag)
                 for s in shards:
                     messages.setdefault(s, []).append(m)
         return messages

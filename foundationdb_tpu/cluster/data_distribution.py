@@ -1,0 +1,141 @@
+"""DataDistribution: shard tracking and the MoveKeys protocol.
+
+Behavioral mirror of the reference's DD subsystem in miniature
+(fdbserver/DataDistribution.actor.cpp shard tracker + DDRelocationQueue;
+fdbserver/MoveKeys.actor.cpp for the authoritative move protocol;
+storage-side fetchKeys at storageserver.actor.cpp:7378):
+
+MoveKeys of [begin, end) from its owner to `dest`:
+  1. **Dual-tag**: commit proxies start tagging the range's mutations to
+     BOTH owners (the reference's serverKeys intermediate state), so the
+     destination's log stream is complete from some version Vd onward.
+  2. **Fence**: a barrier commit through a proxy pins Vd and guarantees
+     every later commit is dual-tagged.
+  3. **fetchKeys**: the destination buffers its incoming mutations for
+     the range and fetches a snapshot at Vf >= Vd from the old owner.
+  4. **Install**: snapshot + buffered mutations > Vf replay in order;
+     the destination is now complete and current.
+  5. **Flip**: the keyServers ShardMap routes the range to `dest`;
+     dual-tagging stops; the old owner drops the range's data.
+
+The control loop balances by key count (the reference balances by bytes
+via storage metrics): when the largest storage server holds more than
+`imbalance_ratio` times the smallest's keys, its largest shard moves.
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.models.types import CommitTransaction
+from foundationdb_tpu.runtime.flow import ActorCancelled, Scheduler
+from foundationdb_tpu.utils.metrics import CounterCollection
+from foundationdb_tpu.utils.trace import TraceEvent
+
+
+class DataDistributor:
+    def __init__(self, cluster, *, interval: float = 1.0,
+                 imbalance_ratio: float = 2.0):
+        self.cluster = cluster
+        self.sched: Scheduler = cluster.sched
+        self.interval = interval
+        self.imbalance_ratio = imbalance_ratio
+        self.counters = CounterCollection("DDMetrics", ["loops", "moves"])
+        self._task = None
+        self._moving = False
+
+    def start(self) -> None:
+        self._task = self.sched.spawn(self._loop(), name="data-distributor")
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+    # -- MoveKeys ---------------------------------------------------------
+
+    async def move_shard(self, begin: bytes, end: bytes, dest: int) -> None:
+        """Move [begin, end) to storage server `dest` (end=None -> +inf)."""
+        cluster = self.cluster
+        shard_map = cluster.key_servers
+        src_owners = {
+            owner for _b, _e, owner in shard_map.segments_in(
+                begin, end if end is not None else b"\xff" * 64
+            )
+        }
+        if src_owners == {dest}:
+            return
+        self._moving = True
+        try:
+            dest_ss = cluster.storage_servers[dest]
+            fence_end = end if end is not None else b"\xff" * 64
+
+            # 1+2. dual-tag on every proxy, then fence so Vd is pinned.
+            for p in cluster.commit_proxies:
+                p.extra_tag_ranges.append((begin, fence_end, dest))
+            dest_ss.begin_fetch(begin, fence_end)
+            fence = await cluster.commit_proxies[0].commit(
+                CommitTransaction()
+            ).future
+            vd = fence.version
+
+            # 3. fetch the snapshot at Vf >= Vd from the current owners.
+            items: list = []
+            for b, e, owner in shard_map.segments_in(begin, fence_end):
+                if owner == dest:
+                    continue
+                src = cluster.client_storages[owner]
+                items.extend(await src.get_key_values(b, e, vd))
+
+            # 4. install + replay buffer.
+            dest_ss.install_shard(begin, fence_end, items, vd)
+
+            # 5. flip routing; stop dual-tagging; old owners drop data.
+            old_segments = shard_map.segments_in(begin, fence_end)
+            shard_map.move(begin, end, dest)
+            for p in cluster.commit_proxies:
+                if (begin, fence_end, dest) in p.extra_tag_ranges:
+                    p.extra_tag_ranges.remove((begin, fence_end, dest))
+            for b, e, owner in old_segments:
+                if owner != dest:
+                    cluster.storage_servers[owner].drop_shard(b, e)
+            self.counters.add("moves")
+            TraceEvent("RelocateShard").detail("Begin", begin).detail(
+                "End", fence_end
+            ).detail("Dest", dest).log()
+        finally:
+            self._moving = False
+
+    # -- shard tracker / balancer loop ------------------------------------
+
+    def key_counts(self) -> list[int]:
+        return [len(ss._keys) for ss in self.cluster.storage_servers]
+
+    async def _loop(self) -> None:
+        try:
+            while True:
+                await self.sched.delay(self.interval)
+                self.counters.add("loops")
+                if self._moving:
+                    continue
+                counts = self.key_counts()
+                if len(counts) < 2 or sum(counts) == 0:
+                    continue
+                big = max(range(len(counts)), key=lambda i: counts[i])
+                small = min(range(len(counts)), key=lambda i: counts[i])
+                if counts[big] <= self.imbalance_ratio * max(counts[small], 1):
+                    continue
+                # move the upper half of the big server's largest segment
+                segs = [
+                    (b, e) for b, e, owner in self.cluster.key_servers.ranges()
+                    if owner == big
+                ]
+                if not segs:
+                    continue
+                b, e = segs[0]
+                ss = self.cluster.storage_servers[big]
+                keys = [k for k in ss._keys
+                        if k >= b and (e is None or k < e)]
+                if len(keys) < 2:
+                    continue
+                mid = keys[len(keys) // 2]
+                await self.move_shard(mid, e, small)
+        except ActorCancelled:
+            raise

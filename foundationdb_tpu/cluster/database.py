@@ -71,9 +71,11 @@ class Cluster:
         self.config = config or ClusterConfig()
         cfg = self.config
 
+        from foundationdb_tpu.cluster.shardmap import ShardMap
+
         self.sequencer = Sequencer(sched)
         self.key_resolvers = KeyPartition(list(cfg.resolver_boundaries))
-        self.key_servers = KeyPartition(list(cfg.storage_boundaries))
+        self.key_servers = ShardMap.even(list(cfg.storage_boundaries))
         self.resolvers = [
             Resolver(
                 sched,
@@ -115,9 +117,11 @@ class Cluster:
             )
             for s, ss in enumerate(self.storage_servers)
         ]
+        from foundationdb_tpu.cluster.data_distribution import DataDistributor
         from foundationdb_tpu.cluster.recovery import ClusterController
 
         self.controller = ClusterController(self)
+        self.data_distributor = DataDistributor(self)
         self._started = False
 
     def _wrapped(self, src, dst, obj, methods):
@@ -210,8 +214,10 @@ class Cluster:
         self.ratekeeper.start()
         self.balancer.start()
         self.controller.start()
+        self.data_distributor.start()
 
     def stop(self) -> None:
+        self.data_distributor.stop()
         self.controller.stop()
         self.balancer.stop()
         for ss in self.storage_servers:

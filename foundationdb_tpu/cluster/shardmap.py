@@ -1,0 +1,90 @@
+"""ShardMap: the keyServers mapping — key range -> owning storage server.
+
+Behavioral mirror of the reference's `keyServers/` system mapping
+(fdbclient/SystemData.cpp; consulted by proxies when tagging mutations,
+CommitProxyServer.actor.cpp:1861, and by clients when routing reads):
+a sorted list of boundaries with an owner per segment, supporting the
+shard split/move operations DataDistribution performs via MoveKeys
+(fdbserver/MoveKeys.actor.cpp).
+"""
+
+from __future__ import annotations
+
+import bisect
+
+
+class ShardMap:
+    def __init__(self, boundaries: list[bytes], owners: list[int]):
+        """segment i = [boundaries[i-1], boundaries[i]) owned by owners[i];
+        boundaries has len(owners)-1 interior split keys."""
+        if len(owners) != len(boundaries) + 1:
+            raise ValueError("need len(owners) == len(boundaries) + 1")
+        self.boundaries = list(boundaries)
+        self.owners = list(owners)
+
+    @classmethod
+    def even(cls, boundaries: list[bytes]) -> "ShardMap":
+        return cls(boundaries, list(range(len(boundaries) + 1)))
+
+    # -- lookup (keyServers reads) ----------------------------------------
+
+    def shard_of(self, key: bytes) -> int:
+        return self.owners[bisect.bisect_right(self.boundaries, key)]
+
+    def shards_of_range(self, begin: bytes, end: bytes) -> list[int]:
+        lo = bisect.bisect_right(self.boundaries, begin)
+        hi = bisect.bisect_left(self.boundaries, end)
+        return sorted(set(self.owners[lo : hi + 1]))
+
+    def ranges(self) -> list[tuple[bytes, bytes, int]]:
+        """[(begin, end, owner)]; end=None for the last segment."""
+        out = []
+        for i, owner in enumerate(self.owners):
+            b = self.boundaries[i - 1] if i > 0 else b""
+            e = self.boundaries[i] if i < len(self.boundaries) else None
+            out.append((b, e, owner))
+        return out
+
+    def segments_in(self, begin: bytes, end: bytes):
+        """Segments (clipped) intersecting [begin, end)."""
+        out = []
+        for b, e, owner in self.ranges():
+            cb = max(b, begin)
+            ce = end if e is None else min(e, end)
+            if cb < ce:
+                out.append((cb, ce, owner))
+        return out
+
+    # -- mutation (MoveKeys) ----------------------------------------------
+
+    def split(self, key: bytes) -> None:
+        """Insert a boundary at `key` (no ownership change)."""
+        i = bisect.bisect_right(self.boundaries, key)
+        if i > 0 and self.boundaries[i - 1] == key:
+            return
+        self.boundaries.insert(i, key)
+        self.owners.insert(i, self.owners[i])
+
+    def move(self, begin: bytes, end: bytes, new_owner: int) -> None:
+        """Assign [begin, end) to new_owner (splitting as needed);
+        end=None means to the end of the keyspace."""
+        if begin:
+            self.split(begin)
+        if end is not None:
+            self.split(end)
+        # After splitting, every segment lies entirely in or out of range.
+        for i in range(len(self.owners)):
+            seg_begin = self.boundaries[i - 1] if i > 0 else b""
+            if seg_begin >= begin and (end is None or seg_begin < end):
+                self.owners[i] = new_owner
+        self._coalesce()
+
+    def _coalesce(self) -> None:
+        """Merge adjacent segments with the same owner."""
+        i = 0
+        while i < len(self.boundaries):
+            if self.owners[i] == self.owners[i + 1]:
+                del self.boundaries[i]
+                del self.owners[i + 1]
+            else:
+                i += 1

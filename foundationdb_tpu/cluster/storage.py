@@ -56,6 +56,10 @@ class StorageServer:
         self._window: list[tuple[int, list[Any]]] = []
         # watches: key -> [(expected_value, promise)]
         self._watches: dict[bytes, list] = {}
+        # in-progress shard fetches: (begin, end) -> buffered mutations
+        # [(version, mutation)] arriving on our tag before install
+        # (the fetchKeys buffer, storageserver.actor.cpp:7378)
+        self._fetching: dict[tuple, list] = {}
         self._update_task = None
 
     def start(self) -> None:
@@ -100,7 +104,22 @@ class StorageServer:
             if v <= self.durable_version:
                 continue  # already applied
             for m in msgs:
-                self._apply_durable(m)
+                if m[0] == "clear" and self._fetching:
+                    # clears may straddle a fetching range: buffer the
+                    # clipped overlap for post-install replay AND apply
+                    # the clear now (the fetching span holds no data yet,
+                    # so the immediate apply only affects owned keys).
+                    for (b, e), buf in self._fetching.items():
+                        cb, ce = max(m[1], b), min(m[2], e)
+                        if cb < ce:
+                            buf.append((v, ("clear", cb, ce)))
+                    self._apply_durable(m)
+                    continue
+                rng = self._fetch_range_of(m)
+                if rng is not None:
+                    self._fetching[rng].append((v, m))  # buffer until install
+                else:
+                    self._apply_durable(m)
         self.durable_version = max(self.durable_version, up_to)
         new_oldest = max(self.oldest_version, up_to - self.window_versions)
         self._window = [(v, m) for v, m in self._window if v > new_oldest]
@@ -170,6 +189,48 @@ class StorageServer:
             self._watches[key] = still
         else:
             del self._watches[key]
+
+    # -- shard moves (fetchKeys, storageserver.actor.cpp:7378) ------------
+
+    def begin_fetch(self, begin: bytes, end: bytes) -> None:
+        """Start receiving a shard: mutations for [begin, end) arriving on
+        our tag are buffered until the snapshot is installed."""
+        self._fetching[(begin, end)] = []
+
+    def install_shard(
+        self, begin: bytes, end: bytes,
+        items: list[tuple[bytes, bytes]], fetch_version: int,
+    ) -> None:
+        """Install the fetched snapshot (taken at fetch_version) and replay
+        buffered mutations newer than it, in version order."""
+        buffered = self._fetching.pop((begin, end))
+        for k, v in items:
+            self._apply_durable(("set", k, v))
+        for v, m in buffered:
+            if v > fetch_version:
+                self._apply_durable(m)
+
+    def drop_shard(self, begin: bytes, end: bytes) -> None:
+        """Release a moved-away shard's data (MoveKeys cleanup)."""
+        self._apply_durable(("clear", begin, end))
+
+    def _fetch_range_of(self, m):
+        if not self._fetching:
+            return None
+        kind = m[0]
+        if kind == "set":
+            keys = (m[1], m[1])
+        elif kind == "atomic":
+            keys = (m[2], m[2])
+        else:  # clear
+            keys = (m[1], m[2])
+        for (b, e), _buf in self._fetching.items():
+            if kind == "clear":
+                if keys[0] < e and b < keys[1]:
+                    return (b, e)
+            elif b <= keys[0] < e:
+                return (b, e)
+        return None
 
     # -- checkpoint / resume ---------------------------------------------
 

@@ -1,0 +1,148 @@
+"""ShardMap + DataDistribution / MoveKeys tests."""
+
+import pytest
+
+from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+from foundationdb_tpu.cluster.shardmap import ShardMap
+
+
+def run(sched, coro):
+    return sched.run_until(sched.spawn(coro).done)
+
+
+# -- ShardMap -------------------------------------------------------------
+
+def test_shardmap_lookup_and_move():
+    sm = ShardMap.even([b"h", b"p"])  # 3 shards: [..h) [h..p) [p..)
+    assert sm.shard_of(b"a") == 0
+    assert sm.shard_of(b"h") == 1
+    assert sm.shard_of(b"z") == 2
+    assert sm.shards_of_range(b"g", b"q") == [0, 1, 2]
+
+    sm.move(b"j", b"m", 0)  # carve [j, m) out of shard 1 for server 0
+    assert sm.shard_of(b"k") == 0
+    assert sm.shard_of(b"i") == 1
+    assert sm.shard_of(b"n") == 1
+    assert sm.shards_of_range(b"i", b"n") == [0, 1]
+
+    sm.move(b"", None, 2)  # everything to server 2 -> coalesces to 1 seg
+    assert sm.boundaries == []
+    assert sm.owners == [2]
+
+
+def test_shardmap_segments_in():
+    sm = ShardMap.even([b"h"])
+    segs = sm.segments_in(b"d", b"z")
+    assert segs == [(b"d", b"h", 0), (b"h", b"z", 1)]
+
+
+# -- MoveKeys through the live cluster ------------------------------------
+
+@pytest.fixture
+def world():
+    sched, cluster, db = open_cluster(
+        ClusterConfig(n_commit_proxies=2, n_storage=2)
+    )
+    yield sched, cluster, db
+    cluster.stop()
+
+
+def test_move_shard_preserves_data_and_routing(world):
+    sched, cluster, db = world
+    dd = cluster.data_distributor
+
+    async def body():
+        txn = db.create_transaction()
+        for i in range(20):
+            txn.set(b"mv%02d" % i, b"v%d" % i)  # all on shard 0 (< 0x80)
+        await txn.commit()
+        assert cluster.key_servers.shard_of(b"mv05") == 0
+
+        await dd.move_shard(b"mv05", b"mv15", 1)
+        assert cluster.key_servers.shard_of(b"mv07") == 1
+        assert cluster.key_servers.shard_of(b"mv04") == 0
+        # moved span lives on server 1 now, dropped from server 0
+        assert b"mv07" in cluster.storage_servers[1]._data
+        assert b"mv07" not in cluster.storage_servers[0]._data
+        assert b"mv04" in cluster.storage_servers[0]._data
+
+        # reads still see everything, writes route to the new owner
+        txn = db.create_transaction()
+        items = await txn.get_range(b"mv", b"mw")
+        txn.set(b"mv09", b"updated")
+        await txn.commit()
+        txn = db.create_transaction()
+        return items, await txn.get(b"mv09")
+
+    items, updated = run(sched, body())
+    assert [k for k, _ in items] == [b"mv%02d" % i for i in range(20)]
+    assert updated == b"updated"
+    assert b"mv09" in cluster.storage_servers[1]._data
+
+
+def test_move_shard_with_concurrent_writes(world):
+    sched, cluster, db = world
+    dd = cluster.data_distributor
+
+    async def writer(stop_flag):
+        i = 0
+        while not stop_flag:
+            txn = db.create_transaction()
+            txn.set(b"cw%02d" % (i % 15), b"gen%d" % i)
+            try:
+                await txn.commit()
+            except Exception:
+                pass
+            i += 1
+            await sched.delay(0.002)
+
+    async def body():
+        txn = db.create_transaction()
+        for i in range(15):
+            txn.set(b"cw%02d" % i, b"init")
+        await txn.commit()
+
+        stop_flag = []
+        w = sched.spawn(writer(stop_flag))
+        await sched.delay(0.02)
+        await dd.move_shard(b"cw", b"cx", 1)
+        await sched.delay(0.05)  # writes continue against the new owner
+        stop_flag.append(True)
+        w.cancel()
+
+        txn = db.create_transaction()
+        items = await txn.get_range(b"cw", b"cx")
+        # the new owner's data must match what clients read
+        ss1 = {k: v for k, v in cluster.storage_servers[1]._data.items()
+               if k.startswith(b"cw")}
+        return items, ss1
+
+    items, ss1 = run(sched, body())
+    assert len(items) == 15
+    assert dict(items) == ss1
+
+
+def test_dd_balancer_moves_hot_shard(world):
+    sched, cluster, db = world
+
+    async def body():
+        # pile 40 keys onto shard 0; shard 1 has 2 keys
+        txn = db.create_transaction()
+        for i in range(40):
+            txn.set(b"hot%03d" % i, b"x")
+        txn.set(b"\xf0a", b"x")
+        txn.set(b"\xf0b", b"x")
+        await txn.commit()
+        await sched.delay(3.0)  # let the DD loop rebalance
+        return cluster.data_distributor.key_counts()
+
+    counts = run(sched, body())
+    assert cluster.data_distributor.counters.get("moves") >= 1
+    # no data lost
+    assert sum(counts) == 42
+
+    async def verify():
+        txn = db.create_transaction()
+        return len(await txn.get_range(b"hot", b"hou"))
+
+    assert run(sched, verify()) == 40
