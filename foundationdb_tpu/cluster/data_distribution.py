@@ -55,58 +55,94 @@ class DataDistributor:
         """Move [begin, end) to storage server `dest` (end=None -> +inf)."""
         cluster = self.cluster
         shard_map = cluster.key_servers
-        src_owners = {
-            owner for _b, _e, owner in shard_map.segments_in(
-                begin, end if end is not None else b"\xff" * 64
-            )
-        }
-        if src_owners == {dest}:
+        fence_end = end if end is not None else b"\xff" * 64
+        # only the segments dest does NOT already own actually move —
+        # dest-owned spans keep applying their mutations normally
+        moving = [
+            (b, e, owner)
+            for b, e, owner in shard_map.segments_in(begin, fence_end)
+            if owner != dest
+        ]
+        if not moving:
             return
         self._moving = True
+        dest_ss = cluster.storage_servers[dest]
+        tagged = False
+        fetching: list[tuple[bytes, bytes]] = []
         try:
-            dest_ss = cluster.storage_servers[dest]
-            fence_end = end if end is not None else b"\xff" * 64
-
-            # 1+2. dual-tag on every proxy, then fence so Vd is pinned.
-            for p in cluster.commit_proxies:
-                p.extra_tag_ranges.append((begin, fence_end, dest))
-            dest_ss.begin_fetch(begin, fence_end)
+            # 1+2. dual-tag the moving segments on every proxy + start
+            # buffering on dest, then fence so Vd is pinned.
+            for b, e, _o in moving:
+                for p in cluster.commit_proxies:
+                    p.extra_tag_ranges.append((b, e, dest))
+                dest_ss.begin_fetch(b, e)
+                fetching.append((b, e))
+            tagged = True
             fence = await cluster.commit_proxies[0].commit(
                 CommitTransaction()
             ).future
             vd = fence.version
 
-            # 3. fetch the snapshot at Vf >= Vd from the current owners.
-            items: list = []
-            for b, e, owner in shard_map.segments_in(begin, fence_end):
-                if owner == dest:
-                    continue
+            # 3+4. fetch each segment's snapshot at Vd and install it.
+            for b, e, owner in moving:
                 src = cluster.client_storages[owner]
-                items.extend(await src.get_key_values(b, e, vd))
+                items = await src.get_key_values(b, e, vd)
+                dest_ss.install_shard(b, e, items, vd)
+                fetching.remove((b, e))
 
-            # 4. install + replay buffer.
-            dest_ss.install_shard(begin, fence_end, items, vd)
-
-            # 5. flip routing; stop dual-tagging; old owners drop data.
-            old_segments = shard_map.segments_in(begin, fence_end)
+            # 5. flip routing; stop dual-tagging.
             shard_map.move(begin, end, dest)
-            for p in cluster.commit_proxies:
-                if (begin, fence_end, dest) in p.extra_tag_ranges:
-                    p.extra_tag_ranges.remove((begin, fence_end, dest))
-            for b, e, owner in old_segments:
-                if owner != dest:
-                    cluster.storage_servers[owner].drop_shard(b, e)
+            for b, e, _o in moving:
+                for p in cluster.commit_proxies:
+                    if (b, e, dest) in p.extra_tag_ranges:
+                        p.extra_tag_ranges.remove((b, e, dest))
+
+            # 6. Old owners drop their data — but only once they have
+            #    applied every mutation that was tagged to them before
+            #    the flip. A post-flip fence through every proxy bounds
+            #    those versions; each old owner waits past it.
+            fences = [
+                p.commit(CommitTransaction()).future
+                for p in cluster.commit_proxies
+            ]
+            vmax = 0
+            for f in fences:
+                reply = await f
+                vmax = max(vmax, reply.version)
+            for b, e, owner in moving:
+                self.sched.spawn(
+                    self._drop_after(owner, b, e, vmax),
+                    name=f"dd-drop-{owner}",
+                )
             self.counters.add("moves")
             TraceEvent("RelocateShard").detail("Begin", begin).detail(
                 "End", fence_end
             ).detail("Dest", dest).log()
+        except BaseException:
+            # unwind: stop dual-tagging, discard fetch buffers — the
+            # old owners remain authoritative, nothing was flipped
+            if tagged:
+                for b, e, _o in moving:
+                    for p in cluster.commit_proxies:
+                        if (b, e, dest) in p.extra_tag_ranges:
+                            p.extra_tag_ranges.remove((b, e, dest))
+            for b, e in fetching:
+                dest_ss.cancel_fetch(b, e)
+            raise
         finally:
             self._moving = False
+
+    async def _drop_after(self, owner: int, b: bytes, e: bytes, version: int):
+        ss = self.cluster.storage_servers[owner]
+        await ss.version.when_at_least(version)
+        ss.drop_shard(b, e)
 
     # -- shard tracker / balancer loop ------------------------------------
 
     def key_counts(self) -> list[int]:
-        return [len(ss._keys) for ss in self.cluster.storage_servers]
+        # live keys only — the versioned store retains cleared keys'
+        # histories until GC, which must not count as load
+        return [ss._live_count for ss in self.cluster.storage_servers]
 
     async def _loop(self) -> None:
         try:
@@ -122,20 +158,21 @@ class DataDistributor:
                 small = min(range(len(counts)), key=lambda i: counts[i])
                 if counts[big] <= self.imbalance_ratio * max(counts[small], 1):
                     continue
-                # move the upper half of the big server's largest segment
-                segs = [
-                    (b, e) for b, e, owner in self.cluster.key_servers.ranges()
-                    if owner == big
-                ]
-                if not segs:
-                    continue
-                b, e = segs[0]
+                # move the upper half of the big server's LARGEST segment
                 ss = self.cluster.storage_servers[big]
-                keys = [k for k in ss._keys
-                        if k >= b and (e is None or k < e)]
-                if len(keys) < 2:
+                data = ss._data  # live view
+                best, best_keys = None, []
+                for b, e, owner in self.cluster.key_servers.ranges():
+                    if owner != big:
+                        continue
+                    keys = sorted(
+                        k for k in data if k >= b and (e is None or k < e)
+                    )
+                    if len(keys) > len(best_keys):
+                        best, best_keys = (b, e), keys
+                if best is None or len(best_keys) < 2:
                     continue
-                mid = keys[len(keys) // 2]
-                await self.move_shard(mid, e, small)
+                mid = best_keys[len(best_keys) // 2]
+                await self.move_shard(mid, best[1], small)
         except ActorCancelled:
             raise

@@ -1,22 +1,25 @@
-"""Storage server: MVCC-windowed versioned KV store fed from the TLog.
+"""Storage server: versioned MVCC KV store fed from the TLog.
 
 Behavioral mirror of `fdbserver/storageserver.actor.cpp`:
 
 * `update` loop (:9117): pulls its tag's mutations from the TLog in
-  version order, applies them to the in-memory versioned window, advances
-  `version`, then makes them durable and pops the log.
+  version order and applies them to the versioned store.
+* The store is the reference's VersionedMap
+  (fdbclient/include/fdbclient/VersionedMap.h) in spirit: every key maps
+  to its version history within the MVCC window, so a read AT version v
+  sees exactly the state as of v — the property that makes read-only
+  transactions (which commit client-side without conflict checking)
+  serializable. Old versions garbage-collect as the window floor rises.
 * Reads (`getValueQ` :2119, `getKeyValuesQ` :4201): wait for the store to
   reach the request version (waitForVersion); reading below the MVCC
-  window raises transaction_too_old; reads merge the versioned window
-  over the durable map at the request version.
-* The versioned window is the reference's VersionedMap-over-PTree
-  (fdbclient/include/fdbclient/VersionedMap.h) in spirit: here a list of
-  (version, mutations) plus a sorted durable dict — O(window) merge reads,
-  fine for the simulation scale; the TPU build's hot path is the
-  resolver, not storage.
+  window raises transaction_too_old.
+* Shard moves (fetchKeys :7378): while a shard is being fetched, its
+  incoming mutations buffer; the snapshot installs at the fetch version
+  and the buffer replays above it.
 
-Mutations are ("set", key, value) / ("clear", begin, end) tuples — the
-two core MutationRef types (fdbclient/CommitTransaction.h:32-41).
+Mutations are ("set", key, value) / ("clear", begin, end) /
+("atomic", op, key, param) tuples (MutationRef,
+fdbclient/CommitTransaction.h:32-71).
 """
 
 from __future__ import annotations
@@ -49,17 +52,22 @@ class StorageServer:
         self.durable_version = recovery_version
         self.oldest_version = recovery_version
         self.window_versions = window_versions
-        # durable store: sorted key list + dict
+        # The versioned store: sorted key list + per-key version history
+        # [(version, value-or-None)], ascending; None = cleared.
         self._keys: list[bytes] = []
-        self._data: dict[bytes, bytes] = {}
-        # MVCC window: ascending (version, [mutations])
-        self._window: list[tuple[int, list[Any]]] = []
+        self._hist: dict[bytes, list[tuple[int, Optional[bytes]]]] = {}
         # watches: key -> [(expected_value, promise)]
         self._watches: dict[bytes, list] = {}
-        # in-progress shard fetches: (begin, end) -> buffered mutations
-        # [(version, mutation)] arriving on our tag before install
-        # (the fetchKeys buffer, storageserver.actor.cpp:7378)
+        # in-progress shard fetches: (begin, end) -> buffered [(v, mutation)]
         self._fetching: dict[tuple, list] = {}
+        # shards acquired by a move are only readable from their fetch
+        # version: [(begin, end, available_from)] — the reference returns
+        # wrong_shard_server for older reads; we raise too-old (both make
+        # the client retry at a fresh version)
+        self._shard_floors: list[tuple[bytes, bytes, int]] = []
+        # live (non-cleared) key count, maintained incrementally
+        self._live_count = 0
+        self._last_gc = recovery_version
         self._update_task = None
 
     def start(self) -> None:
@@ -79,98 +87,129 @@ class StorageServer:
                 )
                 for v, msgs in entries:
                     assert v > self.version.get()
-                    self._window.append((v, msgs))
+                    for m in msgs:
+                        self._ingest(v, m)
                     self.version.set(v)
                 # Version leveling: advance to the log's version even when
-                # no mutations touched this tag — commits elsewhere still
-                # move every storage server's version forward (the peek
-                # cursor contract; storageserver.actor.cpp update loop),
-                # otherwise reads at fresh read versions would hang on
-                # untouched shards.
+                # no mutations touched this tag (peek cursor contract).
                 if log_version > self.version.get():
                     self.version.set(log_version)
-                # make durable immediately (no disk lag in v0), keep a
-                # window of versions for rollback/read-at-version
-                self._make_durable(self.version.get())
-                # caught up; wait for the log to advance
+                self.durable_version = self.version.get()
+                self._gc(self.durable_version - self.window_versions)
+                self.tlog.pop(self.tag, self.durable_version)
                 await self.tlog.version.when_at_least(self.version.get() + 1)
         except ActorCancelled:
             raise
 
-    def _make_durable(self, up_to: int) -> None:
-        for v, msgs in self._window:
-            if v > up_to:
-                break
-            if v <= self.durable_version:
-                continue  # already applied
-            for m in msgs:
-                if m[0] == "clear" and self._fetching:
-                    # clears may straddle a fetching range: buffer the
-                    # clipped overlap for post-install replay AND apply
-                    # the clear now (the fetching span holds no data yet,
-                    # so the immediate apply only affects owned keys).
-                    for (b, e), buf in self._fetching.items():
-                        cb, ce = max(m[1], b), min(m[2], e)
-                        if cb < ce:
-                            buf.append((v, ("clear", cb, ce)))
-                    self._apply_durable(m)
-                    continue
-                rng = self._fetch_range_of(m)
-                if rng is not None:
-                    self._fetching[rng].append((v, m))  # buffer until install
-                else:
-                    self._apply_durable(m)
-        self.durable_version = max(self.durable_version, up_to)
-        new_oldest = max(self.oldest_version, up_to - self.window_versions)
-        self._window = [(v, m) for v, m in self._window if v > new_oldest]
-        self.oldest_version = new_oldest
-        self.tlog.pop(self.tag, self.durable_version)
+    def _ingest(self, v: int, m) -> None:
+        """Route one mutation: buffer if its span is being fetched."""
+        if self._fetching and m[0] == "clear":
+            # clears may straddle a fetching range: buffer the clipped
+            # overlap for post-install replay AND apply now (the fetching
+            # span holds no data yet, so this only affects owned keys).
+            for (b, e), buf in self._fetching.items():
+                cb, ce = max(m[1], b), min(m[2], e)
+                if cb < ce:
+                    buf.append((v, ("clear", cb, ce)))
+            self._apply(v, m)
+            return
+        rng = self._fetch_range_of(m)
+        if rng is not None:
+            self._fetching[rng].append((v, m))
+        else:
+            self._apply(v, m)
 
-    def _apply_durable(self, m) -> None:
+    def _record(self, v: int, k: bytes, value: Optional[bytes]) -> None:
+        if k not in self._hist:
+            if value is None:
+                return  # clearing a key that never existed
+            bisect.insort(self._keys, k)
+            self._hist[k] = []
+        h = self._hist[k]
+        was_live = bool(h) and h[-1][1] is not None
+        if h and h[-1][0] == v:
+            h[-1] = (v, value)
+        else:
+            h.append((v, value))
+        now_live = value is not None
+        self._live_count += int(now_live) - int(was_live)
+
+    @staticmethod
+    def _at_or_below(h: list, v: int) -> int:
+        """Index just past the rightmost entry with version <= v.
+        (Manual binary search: values may be None, so tuple bisect would
+        compare None with bytes.)"""
+        lo, hi = 0, len(h)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if h[mid][0] <= v:
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo
+
+    def _value_at(self, k: bytes, v: int) -> Optional[bytes]:
+        h = self._hist.get(k)
+        if not h:
+            return None
+        i = self._at_or_below(h, v)
+        if i == 0:
+            return None
+        return h[i - 1][1]
+
+    def _apply(self, v: int, m) -> None:
         kind = m[0]
         if kind == "set":
-            _, k, val = m
-            if k not in self._data:
-                bisect.insort(self._keys, k)
-            self._data[k] = val
-            self._fire_watches(k)
+            self._record(v, m[1], m[2])
+            self._fire_watches(m[1])
         elif kind == "atomic":
             from foundationdb_tpu.utils.atomic import apply_atomic
 
             _, op, k, param = m
-            new = apply_atomic(op, self._data.get(k), param)
-            if new is None:
-                if k in self._data:
-                    del self._data[k]
-                    self._keys.remove(k)
-            else:
-                if k not in self._data:
-                    bisect.insort(self._keys, k)
-                self._data[k] = new
+            self._record(v, k, apply_atomic(op, self._value_at(k, v), param))
             self._fire_watches(k)
         elif kind == "clear":
             _, b, e = m
             lo = bisect.bisect_left(self._keys, b)
             hi = bisect.bisect_left(self._keys, e)
             for k in self._keys[lo:hi]:
-                del self._data[k]
-            del self._keys[lo:hi]
+                if self._value_at(k, v) is not None:
+                    self._record(v, k, None)
             for k in [k for k in self._watches if b <= k < e]:
                 self._fire_watches(k)
         else:
             raise ValueError(f"unknown mutation {m!r}")
 
-    # -- watches (storageserver.actor.cpp watchValueSendReply: fire when
-    # the value differs from the watched one) --------------------------------
+    def _gc(self, floor: int) -> None:
+        """Raise the MVCC floor: keep one entry at-or-below it per key;
+        drop keys whose only state is an old clear. The full-store sweep
+        is batched (every ~window/64 of version advance) so steady
+        commits don't pay O(all keys) per update tick."""
+        if floor <= self.oldest_version:
+            return
+        self.oldest_version = floor
+        if floor - self._last_gc < self.window_versions // 64:
+            return
+        self._last_gc = floor
+        dead = []
+        for k, h in self._hist.items():
+            i = self._at_or_below(h, floor) - 1
+            if i > 0:
+                del h[:i]
+            if len(h) == 1 and h[0][1] is None and h[0][0] <= floor:
+                dead.append(k)
+        for k in dead:
+            del self._hist[k]
+            self._keys.remove(k)
+
+    # -- watches (watchValueSendReply: fire when the value changes) --------
 
     def watch(self, key: bytes, expected):
-        """Returns a Future firing (with the commit version) once key's
-        value != expected."""
         from foundationdb_tpu.runtime.flow import Promise
 
         p = Promise()
-        if self._data.get(key) != expected:
-            p.send(self.version.get())  # already different
+        if self._value_at(key, self.version.get()) != expected:
+            p.send(self.version.get())
         else:
             self._watches.setdefault(key, []).append((expected, p))
         return p.future
@@ -178,7 +217,7 @@ class StorageServer:
     def _fire_watches(self, key: bytes) -> None:
         if key not in self._watches:
             return
-        current = self._data.get(key)
+        current = self._value_at(key, 1 << 62)  # latest, incl. in-apply
         still = []
         for expected, p in self._watches[key]:
             if current != expected:
@@ -190,67 +229,69 @@ class StorageServer:
         else:
             del self._watches[key]
 
-    # -- shard moves (fetchKeys, storageserver.actor.cpp:7378) ------------
+    # -- shard moves (fetchKeys) ------------------------------------------
 
     def begin_fetch(self, begin: bytes, end: bytes) -> None:
-        """Start receiving a shard: mutations for [begin, end) arriving on
-        our tag are buffered until the snapshot is installed."""
         self._fetching[(begin, end)] = []
 
     def install_shard(
         self, begin: bytes, end: bytes,
         items: list[tuple[bytes, bytes]], fetch_version: int,
     ) -> None:
-        """Install the fetched snapshot (taken at fetch_version) and replay
-        buffered mutations newer than it, in version order."""
+        """Install the fetched snapshot (state as of fetch_version) and
+        replay buffered mutations newer than it, in version order. The
+        shard is only readable from fetch_version on."""
         buffered = self._fetching.pop((begin, end))
         for k, v in items:
-            self._apply_durable(("set", k, v))
+            self._record(fetch_version, k, v)
         for v, m in buffered:
             if v > fetch_version:
-                self._apply_durable(m)
+                self._apply(v, m)
+        self._shard_floors.append((begin, end, fetch_version))
+
+    def cancel_fetch(self, begin: bytes, end: bytes) -> None:
+        """Abort a fetch (move failed before the routing flip): the
+        buffered mutations belong to the still-current owner — discard."""
+        self._fetching.pop((begin, end), None)
 
     def drop_shard(self, begin: bytes, end: bytes) -> None:
-        """Release a moved-away shard's data (MoveKeys cleanup)."""
-        self._apply_durable(("clear", begin, end))
+        self._apply(self.version.get(), ("clear", begin, end))
+        self._shard_floors = [
+            f for f in self._shard_floors
+            if not (f[0] >= begin and f[1] <= end)
+        ]
 
     def _fetch_range_of(self, m):
         if not self._fetching:
             return None
-        kind = m[0]
-        if kind == "set":
-            keys = (m[1], m[1])
-        elif kind == "atomic":
-            keys = (m[2], m[2])
-        else:  # clear
-            keys = (m[1], m[2])
+        key = m[2] if m[0] == "atomic" else m[1]
         for (b, e), _buf in self._fetching.items():
-            if kind == "clear":
-                if keys[0] < e and b < keys[1]:
-                    return (b, e)
-            elif b <= keys[0] < e:
+            if b <= key < e:
                 return (b, e)
         return None
 
     # -- checkpoint / resume ---------------------------------------------
 
     def snapshot(self) -> dict:
-        """The durable on-disk state a restart would recover from
-        (storage servers persist at durable_version and replay the log
-        tail — storageserver.actor.cpp recovery path)."""
+        """The durable on-disk state a restart recovers from."""
         return {
             "keys": list(self._keys),
-            "data": dict(self._data),
+            "hist": {k: list(h) for k, h in self._hist.items()},
             "durable_version": self.durable_version,
+            "oldest_version": self.oldest_version,
+            "live_count": self._live_count,
+            "shard_floors": list(self._shard_floors),
         }
 
     def restore(self, snap: dict) -> None:
         self._keys = list(snap["keys"])
-        self._data = dict(snap["data"])
+        self._hist = {k: list(h) for k, h in snap["hist"].items()}
         self.durable_version = snap["durable_version"]
-        self.oldest_version = snap["durable_version"]
+        self.oldest_version = snap["oldest_version"]
+        self._live_count = snap["live_count"]
+        self._shard_floors = list(snap["shard_floors"])
+        self._last_gc = snap["oldest_version"]
         self.version = Notified(snap["durable_version"])
-        self._window = []
 
     # -- read path -----------------------------------------------------------
 
@@ -259,18 +300,40 @@ class StorageServer:
             raise TransactionTooOld(version)
         await self.version.when_at_least(version)
 
+    def _check_shard_floor(self, begin: bytes, end: bytes, version: int) -> None:
+        for b, e, floor in self._shard_floors:
+            if begin < e and b < end and version < floor:
+                # a recently-moved-in shard has no history below its
+                # fetch version; the client retries at a fresh version
+                raise TransactionTooOld(version)
+
     async def get_value(self, key: bytes, version: int) -> Optional[bytes]:
         await self._wait_for_version(version)
-        # v0 applies durably as soon as versions arrive, so the durable map
-        # already reflects `version`; a lagging-durable design would merge
-        # self._window here.
-        return self._data.get(key)
+        self._check_shard_floor(key, key + b"\x00", version)
+        return self._value_at(key, version)
 
     async def get_key_values(
         self, begin: bytes, end: bytes, version: int, *, limit: int = 1 << 30
     ) -> list[tuple[bytes, bytes]]:
         await self._wait_for_version(version)
+        self._check_shard_floor(begin, end, version)
         lo = bisect.bisect_left(self._keys, begin)
         hi = bisect.bisect_left(self._keys, end)
-        ks = self._keys[lo:hi][:limit]
-        return [(k, self._data[k]) for k in ks]
+        out = []
+        for k in self._keys[lo:hi]:
+            v = self._value_at(k, version)
+            if v is not None:
+                out.append((k, v))
+                if len(out) >= limit:
+                    break
+        return out
+
+    # test/inspection helper: the latest-version view of the data
+    @property
+    def _data(self) -> dict[bytes, bytes]:
+        v = self.version.get()
+        return {
+            k: val
+            for k in self._keys
+            if (val := self._value_at(k, v)) is not None
+        }

@@ -61,6 +61,9 @@ def test_move_shard_preserves_data_and_routing(world):
         await dd.move_shard(b"mv05", b"mv15", 1)
         assert cluster.key_servers.shard_of(b"mv07") == 1
         assert cluster.key_servers.shard_of(b"mv04") == 0
+        # the old owner drops once it has applied everything tagged to it
+        # before the flip (the post-flip fence) — let that land
+        await sched.delay(0.1)
         # moved span lives on server 1 now, dropped from server 0
         assert b"mv07" in cluster.storage_servers[1]._data
         assert b"mv07" not in cluster.storage_servers[0]._data
