@@ -113,6 +113,10 @@ class Transaction:
         return self._read_version
 
     async def get(self, key: bytes, *, snapshot: bool = False) -> Optional[bytes]:
+        if key.startswith(b"\xff\xff"):
+            # the special key space: virtual management reads
+            # (fdbclient/SpecialKeySpace.actor.cpp)
+            return self.db.special_key(key)
         known, val = self.writes.lookup(key)
         if not known:
             rv = await self.get_read_version()
@@ -281,6 +285,21 @@ class Database:
 
     def create_transaction(self) -> Transaction:
         return Transaction(self)
+
+    def special_key(self, key: bytes):
+        """The \\xff\\xff special key space (SpecialKeySpace.actor.cpp):
+        virtual reads of management/status information."""
+        import json
+
+        if key == b"\xff\xff/status/json":
+            from foundationdb_tpu.cluster.status import cluster_status
+
+            return json.dumps(cluster_status(self.cluster)).encode()
+        if key == b"\xff\xff/cluster/epoch":
+            return str(self.cluster.controller.epoch).encode()
+        if key == b"\xff\xff/cluster/live_committed_version":
+            return str(self.cluster.sequencer.live_committed.get()).encode()
+        return None
 
     async def run(self, fn, *, max_retries: int = 50):
         """retry_loop(fn): the standard transaction retry pattern

@@ -1,0 +1,134 @@
+"""The directory layer: hierarchical namespaces over short key prefixes.
+
+Behavioral mirror of the reference bindings' DirectoryLayer
+(bindings/python/fdb/directory_impl.py and friends): a directory maps a
+path like ("app", "users") to a short allocated prefix, stored in a
+node subtree under `\\xfe`; contents live under the allocated prefix via
+a Subspace. create/open/move/remove/list compose transactionally with
+ordinary operations.
+
+The prefix allocator is a simplified monotonic counter (the reference
+uses the HCA — high-contention allocator — for parallel allocation;
+the counter lives in the same keyspace and is allocated through the
+same transaction, so allocation is still transactional and conflict-
+checked, just not contention-optimized).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from foundationdb_tpu.layers import tuple as fdbtuple
+from foundationdb_tpu.layers.tuple import Subspace
+
+NODE_PREFIX = b"\xfe"
+COUNTER_KEY = NODE_PREFIX + b"hca"
+
+
+class DirectoryAlreadyExists(Exception):
+    pass
+
+
+class DirectoryDoesNotExist(Exception):
+    pass
+
+
+class DirectorySubspace(Subspace):
+    def __init__(self, path: tuple, prefix: bytes, layer: "DirectoryLayer"):
+        super().__init__((), prefix)
+        self.path = path
+        self._layer = layer
+
+    async def create_or_open(self, txn, subpath) -> "DirectorySubspace":
+        return await self._layer.create_or_open(
+            txn, self.path + tuple(subpath)
+        )
+
+    async def list(self, txn) -> list:
+        return await self._layer.list(txn, self.path)
+
+
+class DirectoryLayer:
+    def __init__(self):
+        self._nodes = Subspace((), NODE_PREFIX)
+
+    def _node_key(self, path: tuple) -> bytes:
+        return self._nodes.pack(("node",) + tuple(path))
+
+    async def _allocate_prefix(self, txn) -> bytes:
+        raw = await txn.get(COUNTER_KEY)
+        n = int.from_bytes(raw, "little") if raw else 0
+        txn.set(COUNTER_KEY, (n + 1).to_bytes(8, "little"))
+        # short prefixes under \x15... (tuple-int region), like the HCA's
+        return b"\x15" + fdbtuple.pack((n,))
+
+    # -- operations -------------------------------------------------------
+
+    async def find(self, txn, path) -> Optional[DirectorySubspace]:
+        prefix = await txn.get(self._node_key(tuple(path)))
+        if prefix is None:
+            return None
+        return DirectorySubspace(tuple(path), prefix, self)
+
+    async def create(self, txn, path, *, prefix: bytes = None) -> DirectorySubspace:
+        path = tuple(path)
+        if await self.find(txn, path) is not None:
+            raise DirectoryAlreadyExists(path)
+        # parents are created implicitly (reference semantics)
+        if len(path) > 1:
+            if await self.find(txn, path[:-1]) is None:
+                await self.create(txn, path[:-1])
+        if prefix is None:
+            prefix = await self._allocate_prefix(txn)
+        txn.set(self._node_key(path), prefix)
+        return DirectorySubspace(path, prefix, self)
+
+    async def create_or_open(self, txn, path) -> DirectorySubspace:
+        found = await self.find(txn, tuple(path))
+        if found is not None:
+            return found
+        return await self.create(txn, path)
+
+    async def open(self, txn, path) -> DirectorySubspace:
+        found = await self.find(txn, tuple(path))
+        if found is None:
+            raise DirectoryDoesNotExist(tuple(path))
+        return found
+
+    async def list(self, txn, path=()) -> list:
+        base = ("node",) + tuple(path)
+        b, e = self._nodes.range(base)
+        out = []
+        for k, _v in await txn.get_range(b, e):
+            sub = self._nodes.unpack(k)
+            rel = sub[len(base):]
+            if len(rel) == 1:  # immediate children only
+                out.append(rel[0])
+        return out
+
+    async def move(self, txn, old_path, new_path) -> DirectorySubspace:
+        old_path, new_path = tuple(old_path), tuple(new_path)
+        d = await self.open(txn, old_path)
+        if await self.find(txn, new_path) is not None:
+            raise DirectoryAlreadyExists(new_path)
+        # move the node and every descendant node entry
+        b, e = self._nodes.range(("node",) + old_path)
+        for k, v in await txn.get_range(b, e):
+            sub = self._nodes.unpack(k)
+            rel = sub[len(("node",) + old_path):]
+            txn.set(self._node_key(new_path + rel), v)
+            txn.clear(k)
+        txn.clear(self._node_key(old_path))
+        txn.set(self._node_key(new_path), d.key)
+        return DirectorySubspace(new_path, d.key, self)
+
+    async def remove(self, txn, path) -> None:
+        path = tuple(path)
+        d = await self.open(txn, path)
+        # clear contents of this directory and every descendant
+        b, e = self._nodes.range(("node",) + path)
+        for k, v in await txn.get_range(b, e):
+            txn.clear_range(v, v + b"\xff")
+            txn.clear(k)
+        txn.clear_range(d.key, d.key + b"\xff")
+        txn.clear(self._node_key(path))

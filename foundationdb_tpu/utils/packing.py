@@ -25,26 +25,34 @@ VERSION_NEG = np.int32(-(2**31) + 1)
 
 
 class KeyTooLongError(ValueError):
-    """A conflict-range key exceeds the packed width.
+    """Kept for API compatibility; the packer no longer raises it."""
 
-    The packed representation is exact only up to max_key_bytes; rather than
-    silently truncate (which could change commit decisions — SURVEY.md §7.3
-    names this the #1 parity risk) the packer refuses and the caller must
-    use a wider KernelConfig.
+
+def pack_key(key: bytes, max_key_bytes: int, *, round_up: bool = False) -> np.ndarray:
+    """bytes -> [W] uint32 (big-endian byte words + length word).
+
+    Keys longer than max_key_bytes degrade CONSERVATIVELY (SURVEY.md §7.3
+    names exact long-key order the #1 parity risk): a truncated begin key
+    keeps length == max (sorts at-or-before the original), a truncated
+    end key gets length max+1 — "just past every key with this prefix" —
+    so it sorts after them. Ranges only ever EXPAND, which can add
+    spurious conflicts for >max-byte keys but can never miss one.
     """
-
-
-def pack_key(key: bytes, max_key_bytes: int) -> np.ndarray:
-    """bytes -> [W] uint32 (big-endian byte words + length word)."""
     if len(key) > max_key_bytes:
-        raise KeyTooLongError(f"key of {len(key)} bytes > {max_key_bytes}")
+        length = max_key_bytes + 1 if round_up else max_key_bytes
+        key = key[:max_key_bytes]
+    else:
+        length = len(key)
     padded = key + b"\x00" * (max_key_bytes - len(key))
     words = np.frombuffer(padded, dtype=">u4").astype(np.uint32)
-    return np.concatenate([words, np.array([len(key)], np.uint32)])
+    return np.concatenate([words, np.array([length], np.uint32)])
 
 
-def pack_keys(keys: list[bytes], max_key_bytes: int) -> np.ndarray:
-    """[n, W] uint32; vectorized over a list of byte keys."""
+def pack_keys(
+    keys: list[bytes], max_key_bytes: int, *, round_up: bool = False
+) -> np.ndarray:
+    """[n, W] uint32; vectorized over a list of byte keys (see pack_key
+    for the conservative long-key handling)."""
     n = len(keys)
     w = max_key_bytes // 4 + 1
     out = np.zeros((n, w), np.uint32)
@@ -54,9 +62,11 @@ def pack_keys(keys: list[bytes], max_key_bytes: int) -> np.ndarray:
     lens = np.empty((n,), np.uint32)
     for i, k in enumerate(keys):
         if len(k) > max_key_bytes:
-            raise KeyTooLongError(f"key of {len(k)} bytes > {max_key_bytes}")
+            lens[i] = max_key_bytes + 1 if round_up else max_key_bytes
+            k = k[:max_key_bytes]
+        else:
+            lens[i] = len(k)
         buf[i, : len(k)] = np.frombuffer(k, np.uint8)
-        lens[i] = len(k)
     out[:, :-1] = buf.view(">u4").astype(np.uint32).reshape(n, w - 1)
     out[:, -1] = lens
     return out
@@ -177,7 +187,7 @@ def pack_batch(
         n = len(begins)
         if n:
             kb[:n] = pack_keys(begins, cfg.max_key_bytes)
-            ke[:n] = pack_keys(ends, cfg.max_key_bytes)
+            ke[:n] = pack_keys(ends, cfg.max_key_bytes, round_up=True)
         return kb, ke
 
     rb, re = _flat(r_begin, r_end, nr)

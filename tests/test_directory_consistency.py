@@ -1,0 +1,141 @@
+"""Directory layer, special key space, and consistency check tests."""
+
+import pytest
+
+from foundationdb_tpu.cluster.consistency import check_cluster
+from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+from foundationdb_tpu.layers.directory import (
+    DirectoryAlreadyExists,
+    DirectoryDoesNotExist,
+    DirectoryLayer,
+)
+
+
+def run(sched, coro):
+    return sched.run_until(sched.spawn(coro).done)
+
+
+@pytest.fixture
+def world():
+    sched, cluster, db = open_cluster(ClusterConfig(n_storage=2))
+    yield sched, cluster, db
+    cluster.stop()
+
+
+def test_directory_create_open_list(world):
+    sched, cluster, db = world
+    dl = DirectoryLayer()
+
+    async def body():
+        txn = db.create_transaction()
+        users = await dl.create_or_open(txn, ("app", "users"))
+        logs = await dl.create_or_open(txn, ("app", "logs"))
+        txn.set(users.pack((42,)), b"alice")
+        txn.set(logs.pack((1,)), b"started")
+        await txn.commit()
+
+        txn = db.create_transaction()
+        users2 = await dl.open(txn, ("app", "users"))
+        assert users2.key == users.key
+        val = await txn.get(users2.pack((42,)))
+        children = await dl.list(txn, ("app",))
+        top = await dl.list(txn)
+        return val, sorted(children), top
+
+    val, children, top = run(sched, body())
+    assert val == b"alice"
+    assert children == ["logs", "users"]
+    assert top == ["app"]
+
+
+def test_directory_errors_and_move_remove(world):
+    sched, cluster, db = world
+    dl = DirectoryLayer()
+
+    async def body():
+        txn = db.create_transaction()
+        d = await dl.create(txn, ("a", "b"))
+        txn.set(d.pack(("k",)), b"v")
+        await txn.commit()
+
+        txn = db.create_transaction()
+        with pytest.raises(DirectoryAlreadyExists):
+            await dl.create(txn, ("a", "b"))
+        with pytest.raises(DirectoryDoesNotExist):
+            await dl.open(txn, ("nope",))
+
+        moved = await dl.move(txn, ("a", "b"), ("a", "c"))
+        assert await txn.get(moved.pack(("k",))) == b"v"
+        await txn.commit()
+
+        txn = db.create_transaction()
+        assert await dl.find(txn, ("a", "b")) is None
+        await dl.remove(txn, ("a",))
+        await txn.commit()
+
+        txn = db.create_transaction()
+        return await dl.find(txn, ("a", "c")), await txn.get(moved.pack(("k",)))
+
+    gone_dir, gone_val = run(sched, body())
+    assert gone_dir is None
+    assert gone_val is None
+
+
+def test_special_key_space(world):
+    sched, cluster, db = world
+
+    async def body():
+        txn = db.create_transaction()
+        txn.set(b"x", b"1")
+        await txn.commit()
+        txn = db.create_transaction()
+        status = await txn.get(b"\xff\xff/status/json")
+        epoch = await txn.get(b"\xff\xff/cluster/epoch")
+        missing = await txn.get(b"\xff\xff/unknown")
+        return status, epoch, missing
+
+    status, epoch, missing = run(sched, body())
+    import json
+
+    assert json.loads(status)["cluster"]["configuration"]["resolver_backend"] == "tpu"
+    assert epoch == b"1"
+    assert missing is None
+
+
+def test_consistency_check_clean_and_after_moves(world):
+    sched, cluster, db = world
+
+    async def body():
+        txn = db.create_transaction()
+        for i in range(30):
+            txn.set(b"cc%02d" % i, b"v")
+        await txn.commit()
+        await sched.delay(0.05)
+        stats1 = check_cluster(cluster)
+
+        await cluster.data_distributor.move_shard(b"cc10", b"cc20", 1)
+        await sched.delay(0.2)  # let the deferred drop land
+        stats2 = check_cluster(cluster)
+        return stats1, stats2
+
+    stats1, stats2 = run(sched, body())
+    assert stats1["keys_checked"] >= 30
+    assert stats2["shards_checked"] >= 3  # the move split the map
+
+
+def test_consistency_check_detects_corruption(world):
+    sched, cluster, db = world
+
+    async def body():
+        txn = db.create_transaction()
+        txn.set(b"zz", b"v")
+        await txn.commit()
+        await sched.delay(0.05)
+
+    run(sched, body())
+    ss = cluster.storage_servers[cluster.key_servers.shard_of(b"zz")]
+    ss._live_count += 1  # simulate accounting corruption
+    with pytest.raises(Exception):
+        check_cluster(cluster)
+    ss._live_count -= 1
+    check_cluster(cluster)  # clean again

@@ -22,9 +22,17 @@ def test_pack_unpack_roundtrip(rng):
         assert packing.unpack_key(arr[i]) == k
 
 
-def test_key_too_long():
-    with pytest.raises(packing.KeyTooLongError):
-        packing.pack_key(b"x" * 9, MAXB)
+def test_long_key_conservative_truncation():
+    # over-width keys truncate; end keys round UP (length = max+1) so
+    # packed ranges are supersets of the real ones
+    begin = packing.pack_key(b"x" * 9, MAXB)
+    end = packing.pack_key(b"x" * 9, MAXB, round_up=True)
+    exact = packing.pack_key(b"x" * 8, MAXB)
+    assert begin[-1] == MAXB
+    assert end[-1] == MAXB + 1
+    assert (begin[:-1] == exact[:-1]).all()
+    # order: begin (len 8) < end (len 9) at equal bytes
+    assert tuple(begin) < tuple(end)
 
 
 def test_lex_less_matches_bytes(rng):
