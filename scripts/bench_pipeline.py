@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""End-to-end commit-pipeline bench: YCSB-A-style load through the full
+cluster (GRV -> proxy batching -> TPU resolver -> tlog -> storage).
+
+BASELINE.json config 5 shape: many in-flight client transactions doing
+50% read-modify-write / 50% read over a hot record set, measuring
+committed transactions per second of virtual time and the wall-clock
+cost of the whole simulation (the Python roles are the harness; the
+conflict kernel is the device-bound stage).
+
+Usage: python scripts/bench_pipeline.py [n_clients] [n_ops]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+from foundationdb_tpu.cluster.commit_proxy import NotCommitted
+from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+from foundationdb_tpu.config import KernelConfig
+from foundationdb_tpu.runtime.flow import all_of
+
+
+def main():
+    n_clients = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    n_ops = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+    kcfg = KernelConfig(
+        max_key_bytes=16, max_txns=256, max_reads=1024, max_writes=1024,
+        history_capacity=1 << 14, window_versions=5_000_000,
+    )
+    sched, cluster, db = open_cluster(
+        ClusterConfig(
+            n_commit_proxies=2, n_resolvers=2, n_storage=2,
+            kernel_config=kcfg,
+        )
+    )
+
+    stats = {"committed": 0, "conflicted": 0, "reads": 0}
+
+    async def client(cid: int):
+        rng = np.random.default_rng(cid)
+        for _ in range(n_ops):
+            key = b"ycsb%05d" % int(rng.zipf(1.2) % 1000)
+            txn = db.create_transaction()
+            try:
+                if rng.random() < 0.5:  # read-modify-write
+                    v = await txn.get(key)
+                    n = int.from_bytes(v or b"\0" * 8, "little")
+                    txn.set(key, (n + 1).to_bytes(8, "little"))
+                    await txn.commit()
+                    stats["committed"] += 1
+                else:
+                    await txn.get(key)
+                    stats["reads"] += 1
+            except NotCommitted:
+                stats["conflicted"] += 1
+
+    t0 = time.perf_counter()
+    tasks = [sched.spawn(client(i), name=f"ycsb{i}") for i in range(n_clients)]
+    sched.run_until(all_of([t.done for t in tasks]))
+    wall = time.perf_counter() - t0
+    virtual = sched.now()
+
+    total = stats["committed"] + stats["reads"] + stats["conflicted"]
+    print(f"clients={n_clients} ops={total} committed={stats['committed']} "
+          f"reads={stats['reads']} conflicted={stats['conflicted']}")
+    print(f"virtual time {virtual:.2f}s -> "
+          f"{total / virtual:,.0f} txn/s virtual | wall {wall:.1f}s "
+          f"-> {total / wall:,.0f} txn/s wall")
+    from foundationdb_tpu.cluster.consistency import check_cluster
+
+    check_cluster(cluster)
+    print("consistency check: OK")
+    cluster.stop()
+
+
+if __name__ == "__main__":
+    main()
