@@ -104,6 +104,7 @@ class Transaction:
         self.report_conflicting_keys = False
         self.committed_version: Optional[int] = None
         self._versionstamp: Optional[bytes] = None
+        self.idempotency_id: Optional[bytes] = None
 
     # -- reads ------------------------------------------------------------
 
@@ -223,6 +224,18 @@ class Transaction:
         """The commit versionstamp (after a successful commit)."""
         return self._versionstamp
 
+    def set_idempotency_id(self, ident: Optional[bytes] = None) -> bytes:
+        """AUTOMATIC_IDEMPOTENCY (fdbclient/IdempotencyId.actor.cpp): the
+        commit also records `\\xff/idmp/<id>`, so a retry after
+        commit_unknown_result can detect that the first attempt really
+        committed instead of applying twice."""
+        if ident is None:
+            import uuid
+
+            ident = uuid.uuid4().bytes
+        self.idempotency_id = ident
+        return ident
+
     # -- commit -----------------------------------------------------------
 
     async def commit(self) -> int:
@@ -232,12 +245,17 @@ class Transaction:
             self.committed_version = await self.get_read_version()
             return self.committed_version
         rv = await self.get_read_version()
+        mutations = list(self.mutations)
+        if self.idempotency_id is not None:
+            mutations.append(
+                ("set", b"\xff/idmp/" + self.idempotency_id, b"\x01")
+            )
         ctr = CommitTransaction(
             read_conflict_ranges=_dedup(self.read_conflicts),
             write_conflict_ranges=_dedup(self.write_conflicts),
             read_snapshot=rv,
             report_conflicting_keys=self.report_conflicting_keys,
-            mutations=list(self.mutations),
+            mutations=mutations,
         )
         ctr.validate()
         commit_id = await self.db.commit_proxy().commit(ctr).future
@@ -301,26 +319,36 @@ class Database:
             return str(self.cluster.sequencer.live_committed.get()).encode()
         return None
 
-    async def run(self, fn, *, max_retries: int = 50):
+    async def run(self, fn, *, max_retries: int = 50, idempotent: bool = False):
         """retry_loop(fn): the standard transaction retry pattern
         (Transaction::onError — not_committed and too-old retry with a
-        fresh read version)."""
+        fresh read version). With idempotent=True, commit_unknown_result
+        retries first check the idempotency record so a commit that DID
+        apply is not applied twice."""
         backoff = 0.001
+        idemp_id = None
         for _ in range(max_retries):
             txn = self.create_transaction()
+            if idempotent:
+                idemp_id = txn.set_idempotency_id(idemp_id)
             try:
                 result = await fn(txn)
                 await txn.commit()
                 return result
-            except (
-                NotCommitted,
-                TransactionTooOldError,
-                CommitUnknownResult,
-                GrvProxyFailedError,
-            ):
-                # commit_unknown_result retries like the reference's
-                # onError (the commit MAY have applied — same caveat);
-                # proxy-generation failures re-resolve on the next try.
+            except CommitUnknownResult:
+                if idemp_id is not None:
+                    probe = self.create_transaction()
+                    try:
+                        mark = await probe.get(
+                            b"\xff/idmp/" + idemp_id, snapshot=True
+                        )
+                    except (TransactionTooOldError, GrvProxyFailedError):
+                        mark = None
+                    if mark is not None:
+                        return result  # the first attempt committed
+                await self.sched.delay(backoff)
+                backoff = min(backoff * 2, 0.1)
+            except (NotCommitted, TransactionTooOldError, GrvProxyFailedError):
                 await self.sched.delay(backoff)
                 backoff = min(backoff * 2, 0.1)
         raise RuntimeError("transaction retry limit reached")

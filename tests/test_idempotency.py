@@ -1,0 +1,83 @@
+"""Idempotency-id tests (automatic commit idempotency)."""
+
+from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+
+
+def run(sched, coro):
+    return sched.run_until(sched.spawn(coro).done)
+
+
+def test_idempotency_record_written_and_detectable():
+    sched, cluster, db = open_cluster(ClusterConfig())
+
+    async def body():
+        txn = db.create_transaction()
+        ident = txn.set_idempotency_id()
+        txn.set(b"idk", b"v")
+        await txn.commit()
+
+        # the retry probe a client would run after commit_unknown_result
+        probe = db.create_transaction()
+        mark = await probe.get(b"\xff/idmp/" + ident, snapshot=True)
+        return mark
+
+    assert run(sched, body()) == b"\x01"
+    cluster.stop()
+
+
+def test_run_idempotent_normal_path():
+    sched, cluster, db = open_cluster(ClusterConfig())
+
+    async def w(txn):
+        txn.add(b"ict", 1)
+
+    async def body():
+        for _ in range(3):
+            await db.run(w, idempotent=True)
+        txn = db.create_transaction()
+        return await txn.get(b"ict")
+
+    assert int.from_bytes(run(sched, body()), "little") == 3
+    cluster.stop()
+
+
+def test_run_idempotent_skips_reapply_after_unknown_result():
+    """Force the ambiguous case: the commit applies but the client sees
+    commit_unknown_result — the idempotent retry must NOT double-apply."""
+    sched, cluster, db = open_cluster(ClusterConfig())
+    proxy = cluster.commit_proxies[0]
+    real_commit = proxy.commit
+    fired = []
+
+    def sabotaged_commit(ctr):
+        from foundationdb_tpu.cluster.commit_proxy import CommitUnknownResult
+        from foundationdb_tpu.runtime.flow import Promise
+
+        p = real_commit(ctr)
+        if not fired:
+            fired.append(True)
+            # deliver the commit, but report ambiguity to the client
+            broken = Promise()
+
+            def relay(f):
+                if not broken.is_set:
+                    broken.send_error(CommitUnknownResult())
+
+            p.future.add_done_callback(relay)
+            return broken
+        return p
+
+    proxy.commit = sabotaged_commit
+
+    async def w(txn):
+        txn.add(b"amb", 1)
+
+    async def body():
+        await db.run(w, idempotent=True)
+        await db.run(w, idempotent=True)
+        txn = db.create_transaction()
+        return await txn.get(b"amb")
+
+    # two logical increments -> exactly 2, despite the ambiguous retry
+    assert int.from_bytes(run(sched, body()), "little") == 2
+    cluster.stop()
