@@ -172,6 +172,7 @@ class CommitProxy:
         self.extra_tag_ranges: list[tuple[bytes, bytes, int]] = []
         self._task = None
         self._inflight: set = set()
+        self._collecting: list[CommitRequest] = []
 
     def start(self) -> None:
         self._task = self.sched.spawn(self._batcher(), name=f"{self.proxy_id}-batcher")
@@ -186,8 +187,13 @@ class CommitProxy:
         for task in list(self._inflight):
             task.cancel()
         self._inflight.clear()
-        # Queued-but-unbatched requests would otherwise dangle forever;
-        # the reference's clients see broken_promise from a dead proxy.
+        # Queued, collected-but-undispatched, or in-stream requests would
+        # otherwise dangle forever; the reference's clients see
+        # broken_promise from a dead proxy.
+        for req in self._collecting:
+            if not req.reply.is_set:
+                req.reply.send_error(CommitUnknownResult())
+        self._collecting = []
         queue = self.requests.stream._queue
         while queue:
             req = queue.pop(0)
@@ -213,7 +219,9 @@ class CommitProxy:
     async def _batcher(self) -> None:
         while True:
             first = await self.requests.stream.next()
-            batch = [first]
+            # self._collecting is visible to stop(): requests gathered but
+            # not yet dispatched must not die silently with the batcher.
+            batch = self._collecting = [first]
             deadline = self.sched.now() + self.batch_interval
             while (
                 len(batch) < self.max_batch_txns
@@ -228,6 +236,7 @@ class CommitProxy:
                     and not self.requests.stream.is_empty()
                 ):
                     batch.append(await self.requests.stream.next())
+            self._collecting = []
             self._batch_num += 1
             task = self.sched.spawn(
                 self._commit_batch(batch, self._batch_num),
