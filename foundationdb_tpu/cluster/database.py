@@ -48,6 +48,9 @@ class ClusterConfig:
     kernel_config: KernelConfig = TEST_CONFIG.scaled(
         window_versions=5_000_000, max_key_bytes=16
     )
+    # resolver_backend knob: "tpu" (the JAX kernel) or "cpu" (host model);
+    # None defers to SERVER_KNOBS.RESOLVER_BACKEND
+    resolver_backend: str = None
     commit_batch_interval: float = 0.005
     window_versions: int = None      # default: kernel_config.window_versions
 
@@ -83,6 +86,7 @@ class Cluster:
                 resolver_id=i,
                 resolver_count=cfg.n_resolvers,
                 commit_proxy_count=cfg.n_commit_proxies,
+                backend=cfg.resolver_backend,
             )
             for i in range(cfg.n_resolvers)
         ]

@@ -118,6 +118,7 @@ class ClusterController:
                     resolver_count=cfg.n_resolvers,
                     commit_proxy_count=cfg.n_commit_proxies,
                     init_version=-1,
+                    backend=cfg.resolver_backend,
                 )
                 for i in range(cfg.n_resolvers)
             ]

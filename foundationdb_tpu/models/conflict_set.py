@@ -167,3 +167,60 @@ class TpuConflictSet:
             elif intra_first[t] >= 0:
                 conflicting[t] = [int(intra_first[t])]
         return BatchResult(verdicts=verdicts, conflicting_key_ranges=conflicting)
+
+
+class CpuConflictSet:
+    """CPU fallback behind the resolver_backend knob: the same
+    ConflictBatch interface served by the exact host-side semantic model
+    (testing.oracle.ConflictOracle — the reference's SkipList semantics
+    without a device). Mirrors BASELINE.json's contract that the CPU
+    path stays available (`resolver_backend=cpu`), e.g. for
+    deterministic simulation without device calls."""
+
+    def __init__(self, config: KernelConfig, base_version: int = 0):
+        from foundationdb_tpu.testing.oracle import ConflictOracle, OracleTxn
+
+        self.config = config
+        self._oracle_txn = OracleTxn
+        self._oracle = ConflictOracle(window=config.window_versions)
+
+    def resolve(
+        self, transactions: list[CommitTransaction], version: int
+    ) -> BatchResult:
+        res = self._oracle.resolve(
+            [
+                self._oracle_txn(
+                    t.read_conflict_ranges,
+                    t.write_conflict_ranges,
+                    t.read_snapshot,
+                    t.report_conflicting_keys,
+                )
+                for t in transactions
+            ],
+            version,
+        )
+        verdicts = [TransactionResult(v) for v in res.verdicts]
+        conflicting = {
+            t: idxs
+            for t, idxs in res.conflicting_ranges.items()
+            if transactions[t].report_conflicting_keys
+            and verdicts[t] == TransactionResult.CONFLICT
+        }
+        return BatchResult(verdicts=verdicts, conflicting_key_ranges=conflicting)
+
+    def check_overflow(self) -> None:
+        pass  # unbounded host memory
+
+
+def make_conflict_set(config: KernelConfig, backend: str = None):
+    """The resolver_backend knob gate (BASELINE.json: the TPU path sits
+    behind a knob; the CPU path remains selectable)."""
+    if backend is None:
+        from foundationdb_tpu.utils.knobs import SERVER_KNOBS
+
+        backend = SERVER_KNOBS.RESOLVER_BACKEND
+    if backend == "tpu":
+        return TpuConflictSet(config)
+    if backend == "cpu":
+        return CpuConflictSet(config)
+    raise ValueError(f"unknown resolver_backend {backend!r}")

@@ -38,7 +38,6 @@ import dataclasses
 from typing import Any, Optional
 
 from foundationdb_tpu.config import KernelConfig
-from foundationdb_tpu.models.conflict_set import TpuConflictSet
 from foundationdb_tpu.models.types import (
     CommitTransaction,
     ResolveTransactionBatchReply,
@@ -125,14 +124,17 @@ class Resolver:
         commit_proxy_count: int = 1,
         state_memory_limit: int = DEFAULT_STATE_MEMORY_LIMIT,
         init_version: int = -1,  # reference: Resolver() : version(-1)
+        backend: str = None,  # resolver_backend knob: "tpu" | "cpu"
     ):
+        from foundationdb_tpu.models.conflict_set import make_conflict_set
+
         self.sched = sched
         self.resolver_id = resolver_id
         self.resolver_count = resolver_count
         self.commit_proxy_count = commit_proxy_count
         self.state_memory_limit = state_memory_limit
 
-        self.conflict_set = TpuConflictSet(config)
+        self.conflict_set = make_conflict_set(config, backend)
         self.version = Notified(init_version)
         self.needed_version = Notified(-(2**62))
         self.check_needed_version = Trigger()

@@ -1,0 +1,71 @@
+"""resolver_backend knob tests: the CPU path beside the TPU path."""
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+from foundationdb_tpu.config import TEST_CONFIG
+from foundationdb_tpu.models.conflict_set import (
+    CpuConflictSet,
+    TpuConflictSet,
+    make_conflict_set,
+)
+from foundationdb_tpu.testing import workloads
+
+
+def test_knob_gate_selects_backend():
+    assert isinstance(make_conflict_set(TEST_CONFIG, "tpu"), TpuConflictSet)
+    assert isinstance(make_conflict_set(TEST_CONFIG, "cpu"), CpuConflictSet)
+    # the default comes from SERVER_KNOBS.RESOLVER_BACKEND (= "tpu")
+    assert isinstance(make_conflict_set(TEST_CONFIG), TpuConflictSet)
+    with pytest.raises(ValueError):
+        make_conflict_set(TEST_CONFIG, "gpu")
+
+
+def test_backends_agree_on_random_workload():
+    rng = np.random.default_rng(5)
+    wcfg = workloads.WorkloadConfig(n_txns=24, keyspace=32, report_fraction=1.0)
+    tpu = make_conflict_set(TEST_CONFIG, "tpu")
+    cpu = make_conflict_set(TEST_CONFIG, "cpu")
+    version = 0
+    for _ in range(6):
+        version += 13
+        txns = workloads.make_batch(rng, wcfg, version, TEST_CONFIG.window_versions)
+        a = tpu.resolve(txns, version)
+        b = cpu.resolve(txns, version)
+        assert [int(v) for v in a.verdicts] == [int(v) for v in b.verdicts]
+        assert a.conflicting_key_ranges == b.conflicting_key_ranges
+
+
+def test_cluster_runs_on_cpu_backend():
+    sched, cluster, db = open_cluster(
+        ClusterConfig(n_resolvers=2, resolver_backend="cpu")
+    )
+
+    async def body():
+        txn = db.create_transaction()
+        txn.set(b"cpu", b"backend")
+        await txn.commit()
+
+        t1 = db.create_transaction()
+        t2 = db.create_transaction()
+        v1 = await t1.get(b"cpu")
+        await t2.get(b"cpu")
+        t1.set(b"cpu", b"one")
+        t2.set(b"cpu", b"two")
+        await t1.commit()
+        from foundationdb_tpu.cluster.commit_proxy import NotCommitted
+
+        try:
+            await t2.commit()
+            return v1, "both"
+        except NotCommitted:
+            return v1, "conflict"
+
+    v1, outcome = sched.run_until(sched.spawn(body()).done)
+    assert v1 == b"backend"
+    assert outcome == "conflict"
+    from foundationdb_tpu.models.conflict_set import CpuConflictSet as C
+
+    assert all(isinstance(r.conflict_set, C) for r in cluster.resolvers)
+    cluster.stop()
