@@ -278,6 +278,7 @@ class Database:
         self.cluster = cluster
         self.sched = cluster.sched
         self._next_proxy = 0
+        self._read_rr = 0  # replica rotation (loadBalance's next-replica)
 
     @property
     def grv_proxy(self):
@@ -292,13 +293,24 @@ class Database:
         self._next_proxy += 1
         return p
 
+    def _pick_replica(self, team: tuple) -> int:
+        """Rotate over the LIVE members of a team (fdbrpc/LoadBalance:
+        replica selection; dead replicas are skipped — the failure-
+        monitor contract)."""
+        live = [s for s in team if self.cluster.storage_live[s]]
+        if not live:
+            live = list(team)  # nothing marked live: fall back, will hang
+        self._read_rr += 1
+        return live[self._read_rr % len(live)]
+
     def storage_for(self, key: bytes):
-        return self.cluster.client_storages[self.cluster.key_servers.shard_of(key)]
+        team = self.cluster.key_servers.team_of(key)
+        return self.cluster.client_storages[self._pick_replica(team)]
 
     def storages_for_range(self, begin: bytes, end: bytes):
         return [
-            self.cluster.client_storages[s]
-            for s in self.cluster.key_servers.shards_of_range(begin, end)
+            self.cluster.client_storages[self._pick_replica(team)]
+            for team in self.cluster.key_servers.teams_of_range(begin, end)
         ]
 
     def create_transaction(self) -> Transaction:

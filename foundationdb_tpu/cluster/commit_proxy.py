@@ -457,13 +457,13 @@ class CommitProxy:
                     kind = "set"
                 if kind == "set":
                     span = (m[1], m[1] + b"\x00")
-                    shards = [self.key_servers.shard_of(m[1])]
+                    shards = list(self.key_servers.team_of(m[1]))
                 elif kind == "atomic":
                     span = (m[2], m[2] + b"\x00")
-                    shards = [self.key_servers.shard_of(m[2])]
+                    shards = list(self.key_servers.team_of(m[2]))
                 elif kind == "clear":
                     span = (m[1], m[2])
-                    shards = self.key_servers.shards_of_range(m[1], m[2])
+                    shards = self.key_servers.tags_of_range(m[1], m[2])
                 else:
                     raise ValueError(f"unknown mutation {m!r}")
                 for b, e, tag in self.extra_tag_ranges:

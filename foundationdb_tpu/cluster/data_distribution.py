@@ -51,56 +51,67 @@ class DataDistributor:
 
     # -- MoveKeys ---------------------------------------------------------
 
-    async def move_shard(self, begin: bytes, end: bytes, dest: int) -> None:
-        """Move [begin, end) to storage server `dest` (end=None -> +inf)."""
+    async def move_shard(self, begin: bytes, end: bytes, dest) -> None:
+        """Move [begin, end) to team `dest` — an int or a tuple of server
+        ids (end=None -> +inf). Each joining member fetches the segment;
+        each leaving member drops it after the post-flip fence."""
+        from foundationdb_tpu.cluster.shardmap import _team
+
         cluster = self.cluster
         shard_map = cluster.key_servers
+        dest_team = _team(dest)
         fence_end = end if end is not None else b"\xff" * 64
-        # only the segments dest does NOT already own actually move —
-        # dest-owned spans keep applying their mutations normally
-        moving = [
-            (b, e, owner)
-            for b, e, owner in shard_map.segments_in(begin, fence_end)
-            if owner != dest
-        ]
+        # (segment, old_team, joining members) — only joiners fetch;
+        # members already on the team keep applying normally
+        moving = []
+        for b, e, team in shard_map.segments_in(begin, fence_end):
+            joiners = tuple(s for s in dest_team if s not in team)
+            if team != dest_team:
+                moving.append((b, e, team, joiners))
         if not moving:
             return
         self._moving = True
-        dest_ss = cluster.storage_servers[dest]
         tagged = False
-        fetching: list[tuple[bytes, bytes]] = []
+        fetching: list[tuple[bytes, bytes, int]] = []
         try:
-            # 1+2. dual-tag the moving segments on every proxy + start
-            # buffering on dest, then fence so Vd is pinned.
-            for b, e, _o in moving:
-                for p in cluster.commit_proxies:
-                    p.extra_tag_ranges.append((b, e, dest))
-                dest_ss.begin_fetch(b, e)
-                fetching.append((b, e))
+            # 1+2. dual-tag the moving segments to every joiner on every
+            # proxy + start buffering, then fence so Vd is pinned.
+            for b, e, _team, joiners in moving:
+                for j in joiners:
+                    for p in cluster.commit_proxies:
+                        p.extra_tag_ranges.append((b, e, j))
+                    cluster.storage_servers[j].begin_fetch(b, e)
+                    fetching.append((b, e, j))
             tagged = True
             fence = await cluster.commit_proxies[0].commit(
                 CommitTransaction()
             ).future
             vd = fence.version
 
-            # 3+4. fetch each segment's snapshot at Vd and install it.
-            for b, e, owner in moving:
-                src = cluster.client_storages[owner]
+            # 3+4. fetch each segment's snapshot at Vd from a live old
+            # member and install it on every joiner.
+            for b, e, team, joiners in moving:
+                src_id = next(
+                    (s for s in team if cluster.storage_live[s]), team[0]
+                )
+                src = cluster.client_storages[src_id]
                 items = await src.get_key_values(b, e, vd)
-                dest_ss.install_shard(b, e, items, vd)
-                fetching.remove((b, e))
+                for j in joiners:
+                    cluster.storage_servers[j].install_shard(b, e, items, vd)
+                    fetching.remove((b, e, j))
 
             # 5. flip routing; stop dual-tagging.
-            shard_map.move(begin, end, dest)
-            for b, e, _o in moving:
-                for p in cluster.commit_proxies:
-                    if (b, e, dest) in p.extra_tag_ranges:
-                        p.extra_tag_ranges.remove((b, e, dest))
+            shard_map.move(begin, end, dest_team)
+            for b, e, _team, joiners in moving:
+                for j in joiners:
+                    for p in cluster.commit_proxies:
+                        if (b, e, j) in p.extra_tag_ranges:
+                            p.extra_tag_ranges.remove((b, e, j))
 
-            # 6. Old owners drop their data — but only once they have
-            #    applied every mutation that was tagged to them before
-            #    the flip. A post-flip fence through every proxy bounds
-            #    those versions; each old owner waits past it.
+            # 6. Leaving members drop their data — but only once they
+            #    have applied every mutation tagged to them before the
+            #    flip. A post-flip fence through every proxy bounds those
+            #    versions; each leaver waits past it.
             fences = [
                 p.commit(CommitTransaction()).future
                 for p in cluster.commit_proxies
@@ -109,25 +120,28 @@ class DataDistributor:
             for f in fences:
                 reply = await f
                 vmax = max(vmax, reply.version)
-            for b, e, owner in moving:
-                self.sched.spawn(
-                    self._drop_after(owner, b, e, vmax),
-                    name=f"dd-drop-{owner}",
-                )
+            for b, e, team, _joiners in moving:
+                for leaver in team:
+                    if leaver not in dest_team:
+                        self.sched.spawn(
+                            self._drop_after(leaver, b, e, vmax),
+                            name=f"dd-drop-{leaver}",
+                        )
             self.counters.add("moves")
             TraceEvent("RelocateShard").detail("Begin", begin).detail(
                 "End", fence_end
-            ).detail("Dest", dest).log()
+            ).detail("Dest", str(dest_team)).log()
         except BaseException:
             # unwind: stop dual-tagging, discard fetch buffers — the
-            # old owners remain authoritative, nothing was flipped
+            # old team remains authoritative, nothing was flipped
             if tagged:
-                for b, e, _o in moving:
-                    for p in cluster.commit_proxies:
-                        if (b, e, dest) in p.extra_tag_ranges:
-                            p.extra_tag_ranges.remove((b, e, dest))
-            for b, e in fetching:
-                dest_ss.cancel_fetch(b, e)
+                for b, e, _team, joiners in moving:
+                    for j in joiners:
+                        for p in cluster.commit_proxies:
+                            if (b, e, j) in p.extra_tag_ranges:
+                                p.extra_tag_ranges.remove((b, e, j))
+            for b, e, j in fetching:
+                cluster.storage_servers[j].cancel_fetch(b, e)
             raise
         finally:
             self._moving = False
@@ -136,6 +150,37 @@ class DataDistributor:
         ss = self.cluster.storage_servers[owner]
         await ss.version.when_at_least(version)
         ss.drop_shard(b, e)
+
+    async def repair(self, dead: int, replacement: int = None) -> int:
+        """Re-replicate every shard that lost `dead` (DDTeamCollection's
+        team repair after a storage failure): each affected segment gets
+        a live server not already on its team — the preferred
+        `replacement` when possible, any other live server otherwise, or
+        the team simply shrinks when no candidate exists. Returns the
+        number of segments repaired."""
+        cluster = self.cluster
+        sm = cluster.key_servers
+        repaired = 0
+        for b, e, team in list(sm.ranges()):
+            if dead not in team:
+                continue
+            candidates = [
+                s for s in range(len(cluster.storage_servers))
+                if cluster.storage_live[s] and s not in team
+            ]
+            if replacement in candidates:
+                pick = replacement
+            elif candidates:
+                pick = candidates[0]
+            else:
+                pick = None  # no spare server: drop to a smaller team
+            new_team = tuple(
+                pick if s == dead else s for s in team
+                if not (s == dead and pick is None)
+            )
+            await self.move_shard(b, e, new_team)
+            repaired += 1
+        return repaired
 
     # -- shard tracker / balancer loop ------------------------------------
 
@@ -151,6 +196,10 @@ class DataDistributor:
                 self.counters.add("loops")
                 if self._moving:
                     continue
+                # auto-balancing only steers single-replica maps; with
+                # teams, rebalancing choices belong to team repair logic
+                if any(len(t) > 1 for t in self.cluster.key_servers.owners):
+                    continue
                 counts = self.key_counts()
                 if len(counts) < 2 or sum(counts) == 0:
                     continue
@@ -163,7 +212,7 @@ class DataDistributor:
                 data = ss._data  # live view
                 best, best_keys = None, []
                 for b, e, owner in self.cluster.key_servers.ranges():
-                    if owner != big:
+                    if owner != (big,):
                         continue
                     keys = sorted(
                         k for k in data if k >= b and (e is None or k < e)

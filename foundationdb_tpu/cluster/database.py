@@ -35,6 +35,8 @@ class ClusterConfig:
     n_grv_proxies: int = 1          # v0: one GRV proxy
     n_resolvers: int = 1
     n_storage: int = 2
+    # replicas per shard (storage teams); 1 = no replication
+    replication_factor: int = 1
     # When set, role-to-role calls go through a SimNetwork with this seed
     # (deterministic latency; clogging/partition fault injection).
     sim_seed: int = None
@@ -78,7 +80,11 @@ class Cluster:
 
         self.sequencer = Sequencer(sched)
         self.key_resolvers = KeyPartition(list(cfg.resolver_boundaries))
-        self.key_servers = ShardMap.even(list(cfg.storage_boundaries))
+        self.key_servers = ShardMap.even(
+            list(cfg.storage_boundaries),
+            replication=cfg.replication_factor,
+            n_servers=cfg.n_storage,
+        )
         self.resolvers = [
             Resolver(
                 sched,
@@ -97,6 +103,9 @@ class Cluster:
             )
             for s in range(cfg.n_storage)
         ]
+        # failure-monitor view of storage liveness (clients skip dead
+        # replicas; see fdbrpc/FailureMonitor.actor.cpp)
+        self.storage_live = [True] * cfg.n_storage
         self.txn_state_store: dict[bytes, bytes] = {}
 
         self.net = None
@@ -169,6 +178,7 @@ class Cluster:
         )
         new.restore(old.snapshot())
         self.storage_servers[s] = new
+        self.storage_live[s] = True
         if self.net is None:
             self.client_storages[s] = new
         else:
@@ -177,6 +187,11 @@ class Cluster:
             )
         if self._started:
             new.start()
+
+    def kill_storage(self, s: int) -> None:
+        """Mark a storage server dead (reads fail over to team peers)."""
+        self.storage_servers[s].stop()
+        self.storage_live[s] = False
 
     def _apply_state_mutation(self, m) -> None:
         kind = m[0]

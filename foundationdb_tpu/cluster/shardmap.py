@@ -1,11 +1,14 @@
-"""ShardMap: the keyServers mapping — key range -> owning storage server.
+"""ShardMap: the keyServers mapping — key range -> owning storage team.
 
 Behavioral mirror of the reference's `keyServers/` system mapping
 (fdbclient/SystemData.cpp; consulted by proxies when tagging mutations,
 CommitProxyServer.actor.cpp:1861, and by clients when routing reads):
-a sorted list of boundaries with an owner per segment, supporting the
-shard split/move operations DataDistribution performs via MoveKeys
-(fdbserver/MoveKeys.actor.cpp).
+a sorted list of boundaries with an owner TEAM per segment (the
+reference's storage teams — every replica of a shard receives its
+mutations and can serve its reads), supporting the split/move operations
+DataDistribution performs via MoveKeys (fdbserver/MoveKeys.actor.cpp).
+
+Owners are tuples of server ids; single-replica maps are teams of one.
 """
 
 from __future__ import annotations
@@ -13,28 +16,54 @@ from __future__ import annotations
 import bisect
 
 
+def _team(owner) -> tuple:
+    return tuple(owner) if isinstance(owner, (tuple, list)) else (owner,)
+
+
 class ShardMap:
-    def __init__(self, boundaries: list[bytes], owners: list[int]):
-        """segment i = [boundaries[i-1], boundaries[i]) owned by owners[i];
-        boundaries has len(owners)-1 interior split keys."""
+    def __init__(self, boundaries: list[bytes], owners: list):
+        """segment i = [boundaries[i-1], boundaries[i]) owned by team
+        owners[i]; boundaries has len(owners)-1 interior split keys."""
         if len(owners) != len(boundaries) + 1:
             raise ValueError("need len(owners) == len(boundaries) + 1")
         self.boundaries = list(boundaries)
-        self.owners = list(owners)
+        self.owners = [_team(o) for o in owners]
 
     @classmethod
-    def even(cls, boundaries: list[bytes]) -> "ShardMap":
-        return cls(boundaries, list(range(len(boundaries) + 1)))
+    def even(cls, boundaries: list[bytes], *, replication: int = 1,
+             n_servers: int = None) -> "ShardMap":
+        n_shards = len(boundaries) + 1
+        n_servers = n_servers or n_shards
+        owners = [
+            tuple((i + j) % n_servers for j in range(replication))
+            for i in range(n_shards)
+        ]
+        return cls(boundaries, owners)
 
     # -- lookup (keyServers reads) ----------------------------------------
 
-    def shard_of(self, key: bytes) -> int:
+    def team_of(self, key: bytes) -> tuple:
         return self.owners[bisect.bisect_right(self.boundaries, key)]
 
-    def shards_of_range(self, begin: bytes, end: bytes) -> list[int]:
+    def shard_of(self, key: bytes) -> int:
+        """Primary member of the owning team (single-replica callers)."""
+        return self.team_of(key)[0]
+
+    def teams_of_range(self, begin: bytes, end: bytes) -> list[tuple]:
         lo = bisect.bisect_right(self.boundaries, begin)
         hi = bisect.bisect_left(self.boundaries, end)
         return sorted(set(self.owners[lo : hi + 1]))
+
+    def tags_of_range(self, begin: bytes, end: bytes) -> list[int]:
+        """Every server holding any part of [begin, end)."""
+        out = set()
+        for team in self.teams_of_range(begin, end):
+            out.update(team)
+        return sorted(out)
+
+    def shards_of_range(self, begin: bytes, end: bytes) -> list[int]:
+        """Primary members only (single-replica read routing)."""
+        return sorted({t[0] for t in self.teams_of_range(begin, end)})
 
     def ranges(self) -> list[tuple[bytes, bytes, int]]:
         """[(begin, end, owner)]; end=None for the last segment."""
@@ -65,9 +94,10 @@ class ShardMap:
         self.boundaries.insert(i, key)
         self.owners.insert(i, self.owners[i])
 
-    def move(self, begin: bytes, end: bytes, new_owner: int) -> None:
-        """Assign [begin, end) to new_owner (splitting as needed);
+    def move(self, begin: bytes, end: bytes, new_owner) -> None:
+        """Assign [begin, end) to team new_owner (splitting as needed);
         end=None means to the end of the keyspace."""
+        new_owner = _team(new_owner)
         if begin:
             self.split(begin)
         if end is not None:

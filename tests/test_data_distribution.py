@@ -27,13 +27,21 @@ def test_shardmap_lookup_and_move():
 
     sm.move(b"", None, 2)  # everything to server 2 -> coalesces to 1 seg
     assert sm.boundaries == []
-    assert sm.owners == [2]
+    assert sm.owners == [(2,)]
 
 
 def test_shardmap_segments_in():
     sm = ShardMap.even([b"h"])
     segs = sm.segments_in(b"d", b"z")
-    assert segs == [(b"d", b"h", 0), (b"h", b"z", 1)]
+    assert segs == [(b"d", b"h", (0,)), (b"h", b"z", (1,))]
+
+
+def test_shardmap_teams():
+    sm = ShardMap.even([b"h", b"p"], replication=2, n_servers=3)
+    assert sm.owners == [(0, 1), (1, 2), (2, 0)]
+    assert sm.team_of(b"a") == (0, 1)
+    assert sm.shard_of(b"a") == 0
+    assert sm.tags_of_range(b"a", b"z") == [0, 1, 2]
 
 
 # -- MoveKeys through the live cluster ------------------------------------
