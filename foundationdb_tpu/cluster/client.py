@@ -138,8 +138,8 @@ class Transaction:
     ) -> list[tuple[bytes, bytes]]:
         rv = await self.get_read_version()
         items: list[tuple[bytes, bytes]] = []
-        for ss in self.db.storages_for_range(begin, end):
-            items.extend(await ss.get_key_values(begin, end, rv))
+        for seg_b, seg_e, ss in self.db.segment_reads(begin, end):
+            items.extend(await ss.get_key_values(seg_b, seg_e, rv))
         merged = self.writes.overlay(items, begin, end)[:limit]
         if not snapshot:
             # The reference narrows the conflict range to the keys actually
@@ -307,10 +307,13 @@ class Database:
         team = self.cluster.key_servers.team_of(key)
         return self.cluster.client_storages[self._pick_replica(team)]
 
-    def storages_for_range(self, begin: bytes, end: bytes):
+    def segment_reads(self, begin: bytes, end: bytes):
+        """[(seg_begin, seg_end, storage)] — one live replica per owning
+        segment, each queried only for its own span (no overlapping
+        scans across teams)."""
         return [
-            self.cluster.client_storages[self._pick_replica(team)]
-            for team in self.cluster.key_servers.teams_of_range(begin, end)
+            (b, e, self.cluster.client_storages[self._pick_replica(team)])
+            for b, e, team in self.cluster.key_servers.segments_in(begin, end)
         ]
 
     def create_transaction(self) -> Transaction:

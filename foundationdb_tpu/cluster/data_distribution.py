@@ -89,11 +89,17 @@ class DataDistributor:
             vd = fence.version
 
             # 3+4. fetch each segment's snapshot at Vd from a live old
-            # member and install it on every joiner.
+            # member and install it on every joiner. A fully-dead old
+            # team means the data is unrecoverable — fail (and unwind)
+            # rather than hang on a frozen server.
             for b, e, team, joiners in moving:
                 src_id = next(
-                    (s for s in team if cluster.storage_live[s]), team[0]
+                    (s for s in team if cluster.storage_live[s]), None
                 )
+                if src_id is None:
+                    raise RuntimeError(
+                        f"no live replica of [{b!r}, {e!r}) to fetch from"
+                    )
                 src = cluster.client_storages[src_id]
                 items = await src.get_key_values(b, e, vd)
                 for j in joiners:
@@ -164,6 +170,11 @@ class DataDistributor:
         for b, e, team in list(sm.ranges()):
             if dead not in team:
                 continue
+            if not any(cluster.storage_live[s] for s in team):
+                # every replica dead: unrecoverable without a reboot —
+                # leave the team for reboot_storage to revive
+                TraceEvent("TeamUnrecoverable").detail("Begin", b).log()
+                continue
             candidates = [
                 s for s in range(len(cluster.storage_servers))
                 if cluster.storage_live[s] and s not in team
@@ -180,6 +191,10 @@ class DataDistributor:
             )
             await self.move_shard(b, e, new_team)
             repaired += 1
+        if repaired and all(dead not in t for t in sm.owners):
+            # fully decommissioned: release the dead tag's log backlog
+            # (the reference's exclusion -> tlog pop path)
+            cluster.tlog.pop(dead, 1 << 62)
         return repaired
 
     # -- shard tracker / balancer loop ------------------------------------

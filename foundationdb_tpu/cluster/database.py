@@ -57,6 +57,11 @@ class ClusterConfig:
     window_versions: int = None      # default: kernel_config.window_versions
 
     def __post_init__(self):
+        if self.replication_factor > self.n_storage:
+            raise ValueError(
+                f"replication_factor {self.replication_factor} > "
+                f"n_storage {self.n_storage}"
+            )
         if self.resolver_boundaries is None:
             self.resolver_boundaries = _even_boundaries(self.n_resolvers)
         if self.storage_boundaries is None:
@@ -121,7 +126,10 @@ class Cluster:
         self.balancer = ResolutionBalancer(
             sched, self.resolvers, self.key_resolvers, self.commit_proxies
         )
-        self.ratekeeper = Ratekeeper(sched, self.sequencer, self.storage_servers)
+        self.ratekeeper = Ratekeeper(
+            sched, self.sequencer, self.storage_servers,
+            liveness=self.storage_live,
+        )
         self.grv_proxy = GrvProxy(sched, self.sequencer, ratekeeper=self.ratekeeper)
         # What clients actually talk to (network-wrapped under simulation).
         self.client_storages = [

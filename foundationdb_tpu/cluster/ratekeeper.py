@@ -31,10 +31,12 @@ class Ratekeeper:
         lag_limit: float = 4_500_000,    # near the 5s MVCC window: hard clamp
         max_tps: float = 1e7,
         min_tps: float = 10.0,
+        liveness: list = None,  # shared storage_live list (or None = all live)
     ):
         self.sched = sched
         self.sequencer = sequencer
         self.storage_servers = storage_servers
+        self.liveness = liveness
         self.interval = interval
         self.lag_target = lag_target
         self.lag_limit = lag_limit
@@ -52,9 +54,16 @@ class Ratekeeper:
             self._task.cancel()
 
     def worst_lag(self) -> float:
+        # dead replicas don't count: their frozen versions would throttle
+        # the cluster forever (the reference excludes failed servers from
+        # rate computation the same way)
         head = self.sequencer.live_committed.get()
         return max(
-            (head - ss.version.get() for ss in self.storage_servers),
+            (
+                head - ss.version.get()
+                for i, ss in enumerate(self.storage_servers)
+                if self.liveness is None or self.liveness[i]
+            ),
             default=0.0,
         )
 

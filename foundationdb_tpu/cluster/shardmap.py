@@ -34,6 +34,11 @@ class ShardMap:
              n_servers: int = None) -> "ShardMap":
         n_shards = len(boundaries) + 1
         n_servers = n_servers or n_shards
+        if replication > n_servers:
+            raise ValueError(
+                f"replication {replication} > n_servers {n_servers} would "
+                "put the same server on a team twice"
+            )
         owners = [
             tuple((i + j) % n_servers for j in range(replication))
             for i in range(n_shards)
@@ -98,6 +103,8 @@ class ShardMap:
         """Assign [begin, end) to team new_owner (splitting as needed);
         end=None means to the end of the keyspace."""
         new_owner = _team(new_owner)
+        if not new_owner or len(set(new_owner)) != len(new_owner):
+            raise ValueError(f"invalid team {new_owner!r}")
         if begin:
             self.split(begin)
         if end is not None:

@@ -25,10 +25,12 @@ RETRYABLE = (NotCommitted, TransactionTooOldError, CommitUnknownResult,
              GrvProxyFailedError)
 
 
-def soak(seed: int, *, kill_proxy: bool, rounds: int = 30):
+def soak(seed: int, *, kill_proxy: bool, rounds: int = 30,
+         replication: int = 1, n_storage: int = 2):
     sched, cluster, db = open_cluster(
         ClusterConfig(
-            n_commit_proxies=2, n_resolvers=2, n_storage=2, sim_seed=seed
+            n_commit_proxies=2, n_resolvers=2, n_storage=n_storage,
+            replication_factor=replication, sim_seed=seed,
         )
     )
     rng = np.random.default_rng(seed)
@@ -133,3 +135,8 @@ def test_soak_with_recovery():
 
 def test_soak_rerun_is_identical():
     assert soak(44, kill_proxy=True) == soak(44, kill_proxy=True)
+
+
+def test_soak_replicated():
+    sig = soak(55, kill_proxy=True, replication=2, n_storage=3)
+    assert sig[0] > 0
