@@ -1,0 +1,98 @@
+"""Tenant isolation and management tests."""
+
+import pytest
+
+from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+from foundationdb_tpu.cluster.tenant import (
+    Tenant,
+    TenantExists,
+    TenantNotEmpty,
+    TenantNotFound,
+    create_tenant,
+    delete_tenant,
+    list_tenants,
+)
+
+
+def run(sched, coro):
+    return sched.run_until(sched.spawn(coro).done)
+
+
+@pytest.fixture
+def world():
+    sched, cluster, db = open_cluster(ClusterConfig())
+    yield sched, cluster, db
+    cluster.stop()
+
+
+def test_tenant_isolation(world):
+    sched, cluster, db = world
+
+    async def body():
+        await create_tenant(db, b"alpha")
+        await create_tenant(db, b"beta")
+        a, b = Tenant(db, b"alpha"), Tenant(db, b"beta")
+
+        ta = a.create_transaction()
+        await ta.set(b"k", b"from-alpha")
+        await ta.commit()
+        tb = b.create_transaction()
+        await tb.set(b"k", b"from-beta")
+        await tb.commit()
+
+        ta = a.create_transaction()
+        tb = b.create_transaction()
+        va = await ta.get(b"k")
+        vb = await tb.get(b"k")
+        ra = await ta.get_range(b"", b"\xff")
+        return va, vb, ra
+
+    va, vb, ra = run(sched, body())
+    assert va == b"from-alpha"
+    assert vb == b"from-beta"     # same key name, different keyspaces
+    assert ra == [(b"k", b"from-alpha")]
+
+
+def test_tenant_management_errors(world):
+    sched, cluster, db = world
+
+    async def body():
+        await create_tenant(db, b"t1")
+        with pytest.raises(TenantExists):
+            await create_tenant(db, b"t1")
+        with pytest.raises(TenantNotFound):
+            Tenant(db, b"missing")
+            t = Tenant(db, b"missing")
+            txn = t.create_transaction()
+            await txn.get(b"x")
+        t1 = Tenant(db, b"t1")
+        txn = t1.create_transaction()
+        await txn.set(b"data", b"1")
+        await txn.commit()
+        with pytest.raises(TenantNotEmpty):
+            await delete_tenant(db, b"t1")
+        txn = t1.create_transaction()
+        await txn.clear(b"data")
+        await txn.commit()
+        await delete_tenant(db, b"t1")
+        return await list_tenants(db)
+
+    assert run(sched, body()) == []
+
+
+def test_tenant_retry_loop_and_conflicts(world):
+    sched, cluster, db = world
+
+    async def body():
+        await create_tenant(db, b"rt")
+        t = Tenant(db, b"rt")
+
+        async def w(txn):
+            await txn.atomic_op("add", b"ctr", (1).to_bytes(8, "little"))
+
+        for _ in range(3):
+            await t.run(w)
+        txn = t.create_transaction()
+        return await txn.get(b"ctr")
+
+    assert int.from_bytes(run(sched, body()), "little") == 3
