@@ -37,6 +37,8 @@ class ClusterConfig:
     n_storage: int = 2
     # replicas per shard (storage teams); 1 = no replication
     replication_factor: int = 1
+    # transaction log replicas (LogSystem); 1 = single log
+    n_tlogs: int = 1
     # When set, role-to-role calls go through a SimNetwork with this seed
     # (deterministic latency; clogging/partition fault injection).
     sim_seed: int = None
@@ -101,7 +103,9 @@ class Cluster:
             )
             for i in range(cfg.n_resolvers)
         ]
-        self.tlog = TLog(sched)
+        from foundationdb_tpu.cluster.logsystem import LogSystem
+
+        self.tlog = LogSystem(sched, cfg.n_tlogs)
         self.storage_servers = [
             StorageServer(
                 sched, self.tlog, tag=s, window_versions=cfg.window_versions
@@ -195,6 +199,10 @@ class Cluster:
             )
         if self._started:
             new.start()
+
+    def kill_tlog(self, i: int) -> None:
+        """Mark a log replica dead; commits continue on the survivors."""
+        self.tlog.kill(i)
 
     def kill_storage(self, s: int) -> None:
         """Mark a storage server dead (reads fail over to team peers)."""

@@ -1,0 +1,93 @@
+"""LogSystem: replicated transaction logs.
+
+Behavioral mirror of the reference's TagPartitionedLogSystem
+(fdbserver/TagPartitionedLogSystem.actor.cpp) at its core contract: a
+commit is durable only when EVERY (live) log replica has it (the push
+quorum is all-of-policy in the reference too — lagging/dead logs force
+recovery, they never silently reduce durability); peeks are served by
+any live replica (they hold identical streams); pops forward to all; the
+epoch lock applies to the whole generation.
+
+The LogSystem exposes the same surface as a single TLog (commit / peek /
+pop / version / lock / consumer registration), so storage servers,
+backup workers, and commit proxies use it unchanged.
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.cluster.tlog import TLog, TLogCommitRequest
+from foundationdb_tpu.runtime.flow import Notified, Scheduler, all_of
+
+
+class AllLogsDeadError(Exception):
+    """No live log replica remains — the cluster cannot commit."""
+
+
+class LogSystem:
+    def __init__(self, sched: Scheduler, n_logs: int = 1, *,
+                 recovery_version: int = 0):
+        self.sched = sched
+        self.tlogs = [
+            TLog(sched, recovery_version=recovery_version)
+            for _ in range(n_logs)
+        ]
+        self.live = [True] * n_logs
+        # The system-level durable version: set once every live replica
+        # has acked a push (what proxies/storages chain on).
+        self.version = Notified(recovery_version)
+        self.epoch = 1
+
+    # -- replica selection -------------------------------------------------
+
+    def _live_logs(self) -> list[TLog]:
+        logs = [t for t, alive in zip(self.tlogs, self.live) if alive]
+        if not logs:
+            raise AllLogsDeadError()
+        return logs
+
+    def kill(self, i: int) -> None:
+        """Mark log replica i dead (its state freezes; it no longer
+        participates in pushes, peeks, or pops)."""
+        self.live[i] = False
+        self._live_logs()  # raises if that was the last one
+
+    # -- the TLog-compatible surface --------------------------------------
+
+    async def commit(self, req: TLogCommitRequest) -> int:
+        logs = self._live_logs()
+        results = await all_of(
+            [
+                self.sched.spawn(t.commit(req)).done
+                for t in logs
+            ]
+        )
+        v = max(results)
+        if v > self.version.get():
+            self.version.set(v)
+        return v
+
+    async def peek(self, tag: int, after_version: int):
+        # any live replica serves (identical streams); wait on the
+        # system version so a mid-wait kill cannot strand the waiter on
+        # a frozen replica's Notified
+        await self.version.when_at_least(after_version + 1)
+        return await self._live_logs()[0].peek(tag, after_version)
+
+    def pop(self, tag: int, up_to_version: int, consumer: str = "storage"):
+        for t in self._live_logs():
+            t.pop(tag, up_to_version, consumer)
+
+    def register_consumer(self, name: str) -> None:
+        for t in self.tlogs:
+            t.register_consumer(name)
+
+    def unregister_consumer(self, name: str) -> None:
+        for t in self.tlogs:
+            t.unregister_consumer(name)
+
+    def lock(self, epoch: int, recovery_version: int = None) -> None:
+        self.epoch = max(self.epoch, epoch)
+        for t in self.tlogs:  # dead replicas lock too: no zombie pushes
+            t.lock(epoch, recovery_version)
+        if recovery_version is not None and recovery_version > self.version.get():
+            self.version.set(recovery_version)

@@ -1,0 +1,89 @@
+"""LogSystem (replicated TLogs) tests."""
+
+import pytest
+
+from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+from foundationdb_tpu.cluster.logsystem import AllLogsDeadError
+
+
+def run(sched, coro):
+    return sched.run_until(sched.spawn(coro).done)
+
+
+@pytest.fixture
+def world():
+    sched, cluster, db = open_cluster(ClusterConfig(n_tlogs=3, n_storage=2))
+    yield sched, cluster, db
+    cluster.stop()
+
+
+def test_pushes_replicate_to_every_log(world):
+    sched, cluster, db = world
+
+    async def body():
+        txn = db.create_transaction()
+        txn.set(b"lg", b"v")
+        await txn.commit()
+        await sched.delay(0.05)
+
+    run(sched, body())
+    versions = [t.version.get() for t in cluster.tlog.tlogs]
+    assert len(set(versions)) == 1 and versions[0] > 0
+
+
+def test_log_replica_failure_survivable(world):
+    sched, cluster, db = world
+
+    async def body():
+        txn = db.create_transaction()
+        txn.set(b"pre", b"1")
+        await txn.commit()
+
+        cluster.kill_tlog(0)
+
+        # commits and reads keep working on the survivors
+        txn = db.create_transaction()
+        txn.set(b"post", b"2")
+        await txn.commit()
+        txn = db.create_transaction()
+        return await txn.get(b"pre"), await txn.get(b"post")
+
+    assert run(sched, body()) == (b"1", b"2")
+    # dead replica frozen strictly below the survivors
+    dead_v = cluster.tlog.tlogs[0].version.get()
+    live_v = cluster.tlog.tlogs[1].version.get()
+    assert dead_v < live_v
+
+
+def test_all_logs_dead_raises(world):
+    sched, cluster, db = world
+    cluster.kill_tlog(0)
+    cluster.kill_tlog(1)
+    with pytest.raises(AllLogsDeadError):
+        cluster.kill_tlog(2)
+
+
+def test_recovery_with_replicated_logs(world):
+    sched, cluster, db = world
+
+    async def body():
+        txn = db.create_transaction()
+        txn.set(b"rk", b"1")
+        await txn.commit()
+
+        p = cluster.commit_proxies[0]
+        p.failed = RuntimeError("kill")
+        p.stop()
+        await sched.delay(1.0)
+        assert cluster.controller.epoch == 2
+
+        async def w(txn):
+            txn.set(b"rk2", b"2")
+
+        await db.run(w)
+        txn = db.create_transaction()
+        return await txn.get(b"rk"), await txn.get(b"rk2")
+
+    assert run(sched, body()) == (b"1", b"2")
+    # every live log locked at the new epoch
+    assert all(t.epoch == 2 for t in cluster.tlog.tlogs)
