@@ -1,0 +1,86 @@
+"""Dynamic knob configuration: versioned overrides broadcast to roles.
+
+Behavioral mirror of the reference's dynamic-knobs subsystem
+(design/dynamic-knobs.md; fdbserver/ConfigNode.actor.cpp +
+ConfigBroadcaster.actor.cpp + LocalConfiguration.actor.cpp), using this
+build's own primitives: overrides are committed transactionally into the
+`\\xff/conf/` keyspace (the ConfigNode's versioned store), and each
+process's LocalConfiguration watches the generation key and re-applies
+the full override set to its live Knobs object when it changes — roles
+see knob changes without restarts, in commit order.
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.runtime.flow import ActorCancelled, Scheduler
+from foundationdb_tpu.utils.knobs import Knobs
+
+CONF_PREFIX = b"\xff/conf/"
+CONF_GENERATION = b"\xff/confGeneration"
+
+
+async def set_knob(db, name: str, value) -> None:
+    """Commit one knob override (fdbcli `setknob`)."""
+    txn = db.create_transaction()
+    txn.set(CONF_PREFIX + name.encode(), repr(value).encode())
+    txn.add(CONF_GENERATION, 1)
+    await txn.commit()
+
+
+async def clear_knob(db, name: str) -> None:
+    txn = db.create_transaction()
+    txn.clear(CONF_PREFIX + name.encode())
+    txn.add(CONF_GENERATION, 1)
+    await txn.commit()
+
+
+async def read_overrides(db) -> dict[str, object]:
+    txn = db.create_transaction()
+    items = await txn.get_range(CONF_PREFIX, CONF_PREFIX + b"\xff")
+    import ast
+
+    return {
+        k[len(CONF_PREFIX):].decode(): ast.literal_eval(v.decode())
+        for k, v in items
+    }
+
+
+class LocalConfiguration:
+    """Per-process knob view: defaults + broadcast overrides
+    (LocalConfiguration.actor.cpp)."""
+
+    def __init__(self, db, knobs: Knobs):
+        self.db = db
+        self.knobs = knobs
+        self.generation = 0
+        self._task = None
+
+    def start(self) -> None:
+        self._task = self.db.sched.spawn(self._watch(), name="local-config")
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+    async def refresh(self) -> None:
+        overrides = await read_overrides(self.db)
+        self.knobs.reset()
+        for name, value in overrides.items():
+            try:
+                self.knobs.set(name, value)
+            except KeyError:
+                pass  # unknown knob: ignored, as the reference does
+        txn = self.db.create_transaction()
+        raw = await txn.get(CONF_GENERATION, snapshot=True)
+        self.generation = int.from_bytes(raw or b"\0" * 8, "little")
+
+    async def _watch(self) -> None:
+        try:
+            await self.refresh()
+            while True:
+                txn = self.db.create_transaction()
+                fut = await txn.watch(CONF_GENERATION)
+                await fut
+                await self.refresh()
+        except ActorCancelled:
+            raise

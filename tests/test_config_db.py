@@ -1,0 +1,66 @@
+"""Dynamic knob broadcast tests."""
+
+from foundationdb_tpu.cluster.config_db import (
+    LocalConfiguration,
+    clear_knob,
+    read_overrides,
+    set_knob,
+)
+from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+from foundationdb_tpu.utils.knobs import Knobs
+
+
+def run(sched, coro):
+    return sched.run_until(sched.spawn(coro).done)
+
+
+def make_knobs():
+    k = Knobs("test")
+    k.define("COMMIT_BATCH_INTERVAL", 0.005)
+    k.define("MAX_THING", 100)
+    return k
+
+
+def test_set_and_broadcast():
+    sched, cluster, db = open_cluster(ClusterConfig())
+    knobs = make_knobs()
+    lc = LocalConfiguration(db, knobs)
+    lc.start()
+
+    async def body():
+        await sched.delay(0.05)  # initial refresh
+        assert knobs.MAX_THING == 100
+        await set_knob(db, "MAX_THING", 250)
+        await set_knob(db, "COMMIT_BATCH_INTERVAL", 0.02)
+        await sched.delay(0.1)  # watch fires, overrides apply
+        v1 = (knobs.MAX_THING, knobs.COMMIT_BATCH_INTERVAL)
+        assert await read_overrides(db) == {
+            "MAX_THING": 250, "COMMIT_BATCH_INTERVAL": 0.02
+        }
+        await clear_knob(db, "MAX_THING")
+        await sched.delay(0.1)
+        v2 = knobs.MAX_THING
+        return v1, v2
+
+    (v1, v2) = run(sched, body())
+    assert v1 == (250, 0.02)
+    assert v2 == 100  # cleared override reverts to the default
+    lc.stop()
+    cluster.stop()
+
+
+def test_unknown_knob_ignored():
+    sched, cluster, db = open_cluster(ClusterConfig())
+    knobs = make_knobs()
+    lc = LocalConfiguration(db, knobs)
+    lc.start()
+
+    async def body():
+        await set_knob(db, "NO_SUCH_KNOB", 1)
+        await set_knob(db, "MAX_THING", 7)
+        await sched.delay(0.1)
+        return knobs.MAX_THING
+
+    assert run(sched, body()) == 7
+    lc.stop()
+    cluster.stop()
