@@ -26,11 +26,12 @@ RETRYABLE = (NotCommitted, TransactionTooOldError, CommitUnknownResult,
 
 
 def soak(seed: int, *, kill_proxy: bool, rounds: int = 30,
-         replication: int = 1, n_storage: int = 2):
+         replication: int = 1, n_storage: int = 2, n_tlogs: int = 1,
+         kill_tlog: bool = False):
     sched, cluster, db = open_cluster(
         ClusterConfig(
             n_commit_proxies=2, n_resolvers=2, n_storage=n_storage,
-            replication_factor=replication, sim_seed=seed,
+            replication_factor=replication, n_tlogs=n_tlogs, sim_seed=seed,
         )
     )
     rng = np.random.default_rng(seed)
@@ -92,6 +93,9 @@ def soak(seed: int, *, kill_proxy: bool, rounds: int = 30,
             await cluster.data_distributor.move_shard(b"s05", b"s15", 1)
         except Exception:
             pass
+        if kill_tlog:
+            await sched.delay(0.05)
+            cluster.kill_tlog(0)
         if kill_proxy:
             await sched.delay(0.1)
             p = cluster.commit_proxies[0]
@@ -139,4 +143,14 @@ def test_soak_rerun_is_identical():
 
 def test_soak_replicated():
     sig = soak(55, kill_proxy=True, replication=2, n_storage=3)
+    assert sig[0] > 0
+
+
+def test_soak_everything_at_once():
+    """Replicated storage AND logs, with a log-replica kill, a storage
+    reboot, a shard move, a proxy kill, recovery — one run."""
+    sig = soak(
+        66, kill_proxy=True, kill_tlog=True,
+        replication=2, n_storage=3, n_tlogs=2,
+    )
     assert sig[0] > 0
