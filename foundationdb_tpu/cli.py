@@ -127,3 +127,58 @@ class CliSession:
         agent = BackupAgent(self.db, DirBackupContainer(args[0]))
         version = await agent.restore()
         return f"Restored to version {version}"
+
+    async def _cmd_tenant(self, args) -> str:
+        from foundationdb_tpu.cluster import tenant as T
+
+        sub = args[0]
+        if sub == "create":
+            if err := self._need_write():
+                return err
+            await T.create_tenant(self.db, args[1].encode())
+            return f"The tenant `{args[1]}' has been created"
+        if sub == "delete":
+            if err := self._need_write():
+                return err
+            await T.delete_tenant(self.db, args[1].encode())
+            return f"The tenant `{args[1]}' has been deleted"
+        if sub == "list":
+            names = await T.list_tenants(self.db)
+            return "\n".join(n.decode("latin-1") for n in names) or "No tenants"
+        return "ERROR: tenant [create|delete|list] ..."
+
+    async def _cmd_setknob(self, args) -> str:
+        if err := self._need_write():
+            return err
+        from foundationdb_tpu.cluster.config_db import set_knob
+        import ast
+
+        try:
+            value = ast.literal_eval(args[1])
+        except (ValueError, SyntaxError):
+            value = args[1]
+        await set_knob(self.db, args[0], value)
+        return f"Knob {args[0]} set"
+
+    async def _cmd_getknobs(self, args) -> str:
+        from foundationdb_tpu.cluster.config_db import read_overrides
+
+        ov = await read_overrides(self.db)
+        return "\n".join(f"{k} = {v!r}" for k, v in sorted(ov.items())) or \
+            "No overrides"
+
+    async def _cmd_consistencycheck(self, args) -> str:
+        from foundationdb_tpu.cluster.consistency import check_cluster
+
+        stats = check_cluster(self.cluster)
+        return (f"Consistency check OK: {stats['keys_checked']} keys, "
+                f"{stats['shards_checked']} shards, "
+                f"{stats['replica_compares']} replica comparisons")
+
+    async def _cmd_moveshard(self, args) -> str:
+        if err := self._need_write():
+            return err
+        begin, end = args[0].encode(), args[1].encode()
+        dest = tuple(int(x) for x in args[2].split(","))
+        await self.cluster.data_distributor.move_shard(begin, end, dest)
+        return f"Moved [{args[0]}, {args[1]}) to team {dest}"

@@ -153,3 +153,32 @@ def test_cli_backup_restore(tmp_path, world):
     assert out1.startswith("Snapshot complete")
     assert out2.startswith("Restored")
     assert out3 == "`persist' is `me'"
+
+
+def test_cli_tenant_knob_consistency_move(world):
+    sched, cluster, db = world
+    cli = CliSession(cluster, db)
+
+    async def body():
+        out = []
+        await cli.run_command("writemode on")
+        out.append(await cli.run_command("tenant create projA"))
+        out.append(await cli.run_command("tenant list"))
+        out.append(await cli.run_command("setknob MAX_THING 42"))
+        out.append(await cli.run_command("getknobs"))
+        out.append(await cli.run_command("set mk v"))
+        out.append(await cli.run_command("moveshard mk ml 1"))
+        await sched.delay(0.2)  # let the move's deferred drop settle
+        out.append(await cli.run_command("consistencycheck"))
+        out.append(await cli.run_command("tenant delete projA"))
+        return out
+
+    (created, listed, knob_set, knobs, _set, moved, check,
+     deleted) = run(sched, body())
+    assert "created" in created
+    assert listed == "projA"
+    assert knob_set == "Knob MAX_THING set"
+    assert "MAX_THING = 42" in knobs
+    assert moved.startswith("Moved")
+    assert check.startswith("Consistency check OK")
+    assert "deleted" in deleted
