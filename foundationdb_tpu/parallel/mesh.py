@@ -1,0 +1,112 @@
+"""Device-mesh construction that survives hostile backend environments.
+
+The resolver mesh must be buildable in three very different worlds:
+
+1. CI / unit tests — no accelerator; an 8-virtual-device CPU backend via
+   ``--xla_force_host_platform_device_count``.
+2. The bench environment — ONE real TPU chip behind a tunnel whose
+   backend is force-registered by ``sitecustomize`` *before* any of our
+   code runs, and whose AOT libtpu can be version-skewed (initializing it
+   for a multi-chip dryrun is both wrong and fatal).  The CPU backend
+   coexists: ``jax.devices("cpu")`` works without touching the TPU.
+3. A real multi-chip TPU slice — ``jax.devices()`` has >= n accelerators.
+
+Rule: never call ``jax.devices()`` (which initializes the *default*
+backend) when what we need is a CPU mesh.  Ask for the CPU platform by
+name, and make sure the host-device-count flag is in place before the
+CPU backend's first initialization.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+import numpy as np
+
+AXIS = "resolver"
+
+_FLAG = "xla_force_host_platform_device_count"
+
+
+def ensure_host_device_count(n: int) -> None:
+    """Best-effort: request >= n virtual CPU devices.
+
+    Only effective if the CPU backend has not initialized yet — callers
+    that find fewer devices afterwards must fall back to a subprocess
+    (see `run_in_cpu_subprocess`).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"--{_FLAG}=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (flags + f" --{_FLAG}={n}").strip()
+    elif int(m.group(1)) < n:
+        os.environ["XLA_FLAGS"] = re.sub(
+            rf"--{_FLAG}=\d+", f"--{_FLAG}={n}", flags
+        )
+
+
+def cpu_devices(n: int):
+    """n virtual CPU devices, never touching the default (TPU) backend."""
+    ensure_host_device_count(n)
+    import jax
+
+    devs = jax.devices("cpu")
+    if len(devs) < n:
+        raise RuntimeError(
+            f"need {n} CPU devices but the CPU backend initialized with "
+            f"{len(devs)} before --{_FLAG} could take effect; re-run in a "
+            f"fresh process (see run_in_cpu_subprocess)"
+        )
+    return list(devs[:n])
+
+
+def cpu_mesh(n: int, axis: str = AXIS):
+    import jax
+
+    return jax.sharding.Mesh(np.array(cpu_devices(n)), (axis,))
+
+
+# Set in children of run_in_cpu_subprocess: a child that still can't get
+# its CPU devices must fail loudly, not respawn itself forever.
+_SUBPROCESS_SENTINEL = "_FDBTPU_CPU_SUBPROCESS"
+
+
+def in_cpu_subprocess() -> bool:
+    return bool(os.environ.get(_SUBPROCESS_SENTINEL))
+
+
+def run_in_cpu_subprocess(module: str, func: str, n: int) -> None:
+    """Re-exec `python -c "import module; module.func(n)"` with a clean
+    CPU-only JAX: used when this process's CPU backend already
+    initialized without enough virtual devices."""
+    import subprocess
+
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    flags = re.sub(rf"--{_FLAG}=\d+", "", flags)
+    env["XLA_FLAGS"] = (flags + f" --{_FLAG}={n}").strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env[_SUBPROCESS_SENTINEL] = "1"
+    code = f"import {module}; {module}.{func}({n})"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+    except subprocess.TimeoutExpired as e:
+        for stream, buf in ((sys.stdout, e.stdout), (sys.stderr, e.stderr)):
+            if buf:
+                stream.write(buf if isinstance(buf, str) else buf.decode(errors="replace"))
+        raise
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"{module}.{func}({n}) failed in CPU subprocess (rc={proc.returncode})"
+        )

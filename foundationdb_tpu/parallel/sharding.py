@@ -36,9 +36,8 @@ from foundationdb_tpu.ops import conflict as C
 from foundationdb_tpu.ops import history as H
 from foundationdb_tpu.ops import keys as K
 from foundationdb_tpu.ops.rangemax import INT32_POS
+from foundationdb_tpu.parallel.mesh import AXIS
 from foundationdb_tpu.utils import packing
-
-AXIS = "resolver"
 
 
 class ShardedVerdict(NamedTuple):
