@@ -53,6 +53,11 @@ def main():
 
     import jax
 
+    from foundationdb_tpu.utils import compile_cache
+
+    cache_dir = compile_cache.enable()
+    log(f"compilation cache: {cache_dir}")
+
     from foundationdb_tpu.config import KernelConfig
     from foundationdb_tpu.models.conflict_set import TpuConflictSet
     from foundationdb_tpu.testing.benchgen import skiplist_style_batch

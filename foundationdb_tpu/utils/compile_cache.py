@@ -1,0 +1,33 @@
+"""Persistent XLA compilation cache (VERDICT r1 task 10).
+
+The driver re-runs bench.py in a fresh process every round; without a
+persistent cache each run re-pays the full trace+compile of the resolver
+kernel (137s at 64K-txn shapes in BENCH_r01.json). JAX's persistent
+cache keys on (HLO, compile options, backend version), so a warm cache
+drops that to de/serialization time.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), ".jax_compile_cache")
+
+
+def enable(path: str | None = None) -> str:
+    """Turn on the persistent compilation cache; returns the cache dir.
+
+    Safe to call multiple times and before/after backend init (the cache
+    is consulted at compile time, not backend-init time).
+    """
+    import jax
+
+    path = path or os.environ.get("FDBTPU_COMPILE_CACHE", _DEFAULT)
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # Cache everything: the kernel's many specializations are each well
+    # over the default thresholds anyway, and tiny entries are harmless.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return path
