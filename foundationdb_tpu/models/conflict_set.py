@@ -11,8 +11,11 @@ TPU-motivated:
   call (no separate compaction step).
 * Versions are rebased to int32 offsets of `base_version`; the rebase
   shifts every stored offset on device when the window drifts too far.
-* Capacity overflow is latched on device and checked host-side every
-  OVERFLOW_CHECK_INTERVAL batches (each check is a device sync).
+* Capacity overflow is latched on device and surfaced in every
+  BatchVerdict; `resolve()` checks it on the same sync that reads the
+  verdicts, so no decision computed against a truncated history is ever
+  externalized. The async `resolve_packed` path (bench) checks every
+  OVERFLOW_CHECK_INTERVAL batches to preserve pipelining.
 
 The conflicting-key report follows the reference's recording order:
 history-phase hits record every conflicting read-range index in
@@ -111,8 +114,14 @@ class TpuConflictSet:
             transactions, version, self.base_version, self.config
         )
         self.state, out = self._resolve(self.state, batch.device_args())
-        self._maybe_check_overflow()
         return self._build_result(transactions, batch, out)
+
+    def _raise_overflow(self) -> None:
+        self._batches_since_check = 0
+        raise HistoryOverflowError(
+            f"history_capacity={self.config.history_capacity} exceeded; "
+            "increase it (or lower the MVCC window / write rate)"
+        )
 
     def resolve_packed(self, batch: packing.PackedBatch) -> C.BatchVerdict:
         """Kernel-only path for pre-packed batches (bench / perf tests).
@@ -133,16 +142,18 @@ class TpuConflictSet:
         """Device sync: raise if a merge ever exceeded history_capacity."""
         self._batches_since_check = 0
         if bool(np.asarray(self.state.overflow)):
-            raise HistoryOverflowError(
-                f"history_capacity={self.config.history_capacity} exceeded; "
-                "increase it (or lower the MVCC window / write rate)"
-            )
+            self._raise_overflow()
 
     # -- reply assembly --------------------------------------------------
 
     def _build_result(self, transactions, batch, out: C.BatchVerdict) -> BatchResult:
         n = len(transactions)
         verdict = np.asarray(out.verdict)[:n]
+        # Same device sync the verdict read just paid: refuse to externalize
+        # decisions computed against a truncated history (ADVICE r1 — the
+        # interval-based check is only for the async packed path).
+        if bool(np.asarray(out.overflow)):
+            self._raise_overflow()
         hist_read = np.asarray(out.hist_conflict_read)
         intra_first = np.asarray(out.intra_first_range)[:n]
         verdicts = [TransactionResult(int(v)) for v in verdict]

@@ -12,6 +12,7 @@ fdb_c C ABI, bindings/c/fdb_c.cpp). The native library serves two jobs:
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -20,7 +21,6 @@ import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "conflict_set.cpp")
-_LIB = os.path.join(_DIR, "libconflict.so")
 _lock = threading.Lock()
 _lib = None
 
@@ -29,28 +29,38 @@ class NativeBuildError(RuntimeError):
     pass
 
 
-def _build() -> None:
-    cmd = [
-        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-        "-o", _LIB, _SRC,
-    ]
+def build_shared(src: str, stem: str) -> str:
+    """Compile src into a content-hash-named .so and return its path.
+
+    Hash-named outputs mean a library on disk can never be stale relative
+    to its source OR its build flags — a fresh clone always compiles (no
+    binaries are committed; ADVICE r1: an mtime check let a checked-in
+    .so shadow the source it was supposed to be built from).
+    """
+    flags = ["-O3", "-march=native", "-std=c++17", "-shared", "-fPIC"]
+    with open(src, "rb") as f:
+        hasher = hashlib.sha256(f.read())
+    hasher.update(" ".join(flags).encode())
+    digest = hasher.hexdigest()[:16]
+    out = os.path.join(_DIR, f"{stem}-{digest}.so")
+    if os.path.exists(out):
+        return out
+    tmp = out + f".tmp{os.getpid()}"
+    cmd = ["g++", *flags, "-o", tmp, src]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise NativeBuildError(f"g++ failed:\n{proc.stderr}")
+    os.replace(tmp, out)  # atomic: concurrent builders race safely
+    return out
 
 
 def load() -> ctypes.CDLL:
-    """Build (if stale) and load the native library."""
+    """Build (if not yet built for this source hash) and load."""
     global _lib
     with _lock:
         if _lib is not None:
             return _lib
-        if (
-            not os.path.exists(_LIB)
-            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
-        ):
-            _build()
-        lib = ctypes.CDLL(_LIB)
+        lib = ctypes.CDLL(build_shared(_SRC, "libconflict"))
         lib.cs_create.restype = ctypes.c_void_p
         lib.cs_create.argtypes = [ctypes.c_int64]
         lib.cs_destroy.argtypes = [ctypes.c_void_p]

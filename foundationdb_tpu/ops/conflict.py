@@ -69,6 +69,10 @@ class BatchVerdict(NamedTuple):
     committed_count: jnp.ndarray  # [] int32
     conflict_count: jnp.ndarray   # [] int32
     too_old_count: jnp.ndarray    # [] int32
+    overflow: jnp.ndarray         # [] bool — history capacity exceeded by
+    #   this batch's merge (or latched earlier). Surfaced in the verdict so
+    #   the sync the host already pays to read verdicts also proves the
+    #   history they were computed against didn't truncate (ADVICE r1).
 
 
 def resolve_batch(state: H.VersionHistory, batch: dict):
@@ -222,6 +226,7 @@ def resolve_batch(state: H.VersionHistory, batch: dict):
         committed_count=committed_count,
         conflict_count=conflict_count,
         too_old_count=too_old_count,
+        overflow=state.overflow,
     )
     return state, out
 

@@ -44,6 +44,7 @@ class ShardedVerdict(NamedTuple):
     verdict: jnp.ndarray            # [B] int32 — min-combined across shards
     hist_conflict_read: jnp.ndarray  # [NR] bool — OR across shards
     intra_first_range: jnp.ndarray   # [B] int32 — min non-negative, else -1
+    overflow: jnp.ndarray            # [] bool — any shard's history overflowed
 
 
 def lex_max(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -102,9 +103,10 @@ def _shard_resolve(state: H.VersionHistory, batch: dict, lo, hi):
     first = jnp.where(out.intra_first_range < 0, INT32_POS, out.intra_first_range)
     first = jax.lax.pmin(first, AXIS)
     first = jnp.where(first == INT32_POS, -1, first)
+    overflow = jax.lax.pmax(out.overflow.astype(jnp.int32), AXIS) > 0
 
     state = jax.tree.map(lambda x: x[None], state)
-    return state, ShardedVerdict(verdict, hist_read, first)
+    return state, ShardedVerdict(verdict, hist_read, first, overflow)
 
 
 def make_partition(
@@ -180,16 +182,31 @@ class ShardedConflictSet:
         )
 
     def resolve(self, transactions, version: int) -> ShardedVerdict:
-        """Resolve one batch across all shards; returns combined verdicts."""
+        """Resolve one batch across all shards; returns combined verdicts.
+
+        Like TpuConflictSet.resolve, refuses to externalize verdicts
+        computed against any truncated shard history — the overflow latch
+        rides the same ShardedVerdict the caller is about to sync anyway.
+        """
         batch = packing.pack_batch(
             transactions, version, self.base_version, self.config
         )
         self.state, out = self._resolve(
             self.state, batch.device_args(), self.part_lo, self.part_hi
         )
+        if bool(np.asarray(out.overflow)):
+            self._raise_overflow()
         return out
+
+    def _raise_overflow(self) -> None:
+        from foundationdb_tpu.models.conflict_set import HistoryOverflowError
+
+        raise HistoryOverflowError(
+            f"a shard's history_capacity={self.config.history_capacity} "
+            "overflowed; increase it (or lower the MVCC window / write rate)"
+        )
 
     def check_overflow(self) -> None:
         """Device sync: raise if any shard's history merge overflowed."""
         if bool(np.any(np.asarray(self.state.overflow))):
-            raise RuntimeError("a shard's history_capacity overflowed")
+            self._raise_overflow()
