@@ -129,7 +129,12 @@ class TpuConflictSet:
         Skips the Python packer and reply assembly; the caller owns
         version rebasing (offsets must fit int32).
         """
-        self.state, out = self._resolve(self.state, batch.device_args())
+        return self.resolve_args(batch.device_args())
+
+    def resolve_args(self, args) -> C.BatchVerdict:
+        """Kernel-only path for an already-materialized device_args tree
+        (host numpy or device-resident arrays alike)."""
+        self.state, out = self._resolve(self.state, args)
         self._maybe_check_overflow()
         return out
 

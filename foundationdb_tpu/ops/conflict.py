@@ -166,9 +166,12 @@ def resolve_batch(state: H.VersionHistory, batch: dict):
     committed, _, last_hits = jax.lax.while_loop(
         cond, body, (c1, committed0, hits0)
     )
-    # `last_hits` is intra_hits(prev); recompute at the fixpoint for the
-    # per-range conflict report (cheap relative to the loop).
-    final_hits = intra_hits(committed) & ok[read_txn]
+    # At exit committed == prev and last_hits == intra_hits(prev), so
+    # last_hits IS intra_hits at the fixpoint — including the no-iteration
+    # case (c1 == committed0 implies the fixpoint is committed0 and the
+    # carried hits0 = intra_hits(committed0)). No recompute needed: this
+    # saves one full intra_hits (~17ms at 64K-txn shapes).
+    final_hits = last_hits & ok[read_txn]
 
     # first conflicting read-range index per txn (the reference's intra
     # sweep breaks at the first hit — SkipList.cpp:880-892)
