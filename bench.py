@@ -74,10 +74,14 @@ def main():
         max_txns=cap,
         max_reads=cap,
         max_writes=cap,
-        # hard bound on live boundaries: window/step = 5 live batches x
-        # 2*writes/batch = 10*cap (coalescing only shrinks it; overflow
-        # raises, never lies)
-        history_capacity=10 * cap,
+        # hard bound on live boundaries: a range contributes its begin
+        # (live) plus its end (carrier of the prior value), and the GC
+        # floor trails one batch behind the newest — so
+        # 2*writes/batch x (window/step + 1) = 12*cap live rows worst
+        # case (coalescing only shrinks it; overflow raises, never lies —
+        # 10*cap overflowed at BENCH_TXNS=16384 where uniform ranges
+        # barely coalesce)
+        history_capacity=12 * cap,
         window_versions=window,
     )
 
@@ -94,8 +98,15 @@ def main():
         )
     log(f"generated {n_batches} batches of {n_txns} txns")
 
-    # ---- CPU baseline (native C++ ConflictBatch-equivalent) -------------
-    from foundationdb_tpu.native import NativeConflictSet
+    # ---- CPU baselines (native C++ ConflictBatch-equivalents) -----------
+    # Two independent implementations (VERDICT r1 task 3): the ordered-map
+    # semantic model and the skip-list port of the reference's algorithm
+    # class (pyramid max-versions, radix point sort, bitset intra sweep).
+    # vs_baseline is reported against the FASTER of the two.
+    from foundationdb_tpu.native import (
+        NativeConflictSet,
+        NativeSkipListConflictSet,
+    )
 
     def flat(batch, which):
         begin = batch.read_begin if which == "r" else batch.write_begin
@@ -110,25 +121,35 @@ def main():
         off = np.arange(2 * n + 1, dtype=np.int64) * w
         return blob, off, txn[:n].astype(np.int32)
 
-    cpu = NativeConflictSet(window=window)
-    cpu_times = []
+    flats = [(flat(b, "r"), flat(b, "w")) for b in batches]
+    cpu_rates = {}
     cpu_verdicts = []
-    for i, b in enumerate(batches):
-        rkeys, roff, rtxn = flat(b, "r")
-        wkeys, woff, wtxn = flat(b, "w")
-        snaps = b.snapshot[:n_txns].astype(np.int64)
-        t0 = time.perf_counter()
-        v = cpu.resolve_raw(
-            int(b.version), snaps, rkeys, roff, rtxn, wkeys, woff, wtxn
-        )
-        cpu_times.append(time.perf_counter() - t0)
-        if i < cpu_batches:
-            cpu_verdicts.append(v)
-    # steady-state rate: skip the warm-up batches before the window fills
-    steady = cpu_times[len(cpu_times) // 2 :]
-    cpu_rate = n_txns * len(steady) / sum(steady)
-    log(f"cpu baseline: {cpu_rate:,.0f} txn/s steady "
-        f"(per-batch {[f'{t*1e3:.0f}ms' for t in cpu_times]})")
+    for name, cls in (("map", NativeConflictSet),
+                      ("skiplist", NativeSkipListConflictSet)):
+        cpu = cls(window=window)
+        cpu_times = []
+        for i, b in enumerate(batches):
+            (rkeys, roff, rtxn), (wkeys, woff, wtxn) = flats[i]
+            snaps = b.snapshot[:n_txns].astype(np.int64)
+            t0 = time.perf_counter()
+            v = cpu.resolve_raw(
+                int(b.version), snaps, rkeys, roff, rtxn, wkeys, woff, wtxn
+            )
+            cpu_times.append(time.perf_counter() - t0)
+            if i < cpu_batches:
+                if name == "map":
+                    cpu_verdicts.append(v)
+                else:
+                    # the two baselines must agree before either is a baseline
+                    assert (v == cpu_verdicts[i]).all(), \
+                        f"cpu baseline disagreement at batch {i}"
+        # steady-state rate: skip the warm-up batches before the window fills
+        steady = cpu_times[len(cpu_times) // 2 :]
+        cpu_rates[name] = n_txns * len(steady) / sum(steady)
+        log(f"cpu baseline [{name}]: {cpu_rates[name]:,.0f} txn/s steady "
+            f"(per-batch {[f'{t*1e3:.0f}ms' for t in cpu_times]})")
+    cpu_name, cpu_rate = max(cpu_rates.items(), key=lambda kv: kv[1])
+    log(f"baseline of record: {cpu_name} at {cpu_rate:,.0f} txn/s")
 
     # ---- phase 2: decision parity ---------------------------------------
     cs = TpuConflictSet(config)
@@ -202,6 +223,8 @@ def main():
                 "value": round(dev_rate, 1),
                 "unit": "txn/s",
                 "vs_baseline": round(dev_rate / cpu_rate, 3),
+                "baseline": cpu_name,
+                "baseline_txns_per_sec": round(cpu_rate, 1),
                 "staging": "device",
                 "p50_ms": round(p50 * 1e3, 1),
                 "p99_ms": round(p99 * 1e3, 1),

@@ -21,8 +21,10 @@ import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "conflict_set.cpp")
+_SL_SRC = os.path.join(_DIR, "skiplist.cpp")
 _lock = threading.Lock()
 _lib = None
+_sl_lib = None
 
 
 class NativeBuildError(RuntimeError):
@@ -77,6 +79,32 @@ def load() -> ctypes.CDLL:
         return lib
 
 
+def load_skiplist() -> ctypes.CDLL:
+    """Build/load the skip-list baseline (skiplist.cpp — the reference
+    SkipList.cpp's algorithm class: pyramid max-versions, radix point
+    sort, bitset intra-batch sweep; VERDICT r1 task 3's honest CPU
+    baseline)."""
+    global _sl_lib
+    with _lock:
+        if _sl_lib is not None:
+            return _sl_lib
+        lib = ctypes.CDLL(build_shared(_SL_SRC, "libskiplist"))
+        lib.slcs_create.restype = ctypes.c_void_p
+        lib.slcs_create.argtypes = [ctypes.c_int64]
+        lib.slcs_destroy.argtypes = [ctypes.c_void_p]
+        lib.slcs_resolve.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int32, ctypes.c_void_p,
+        ]
+        lib.slcs_history_size.restype = ctypes.c_int64
+        lib.slcs_history_size.argtypes = [ctypes.c_void_p]
+        _sl_lib = lib
+        return lib
+
+
 def _flatten(ranges_per_txn):
     """[(txn, begin, end)] -> (key blob, offsets[2n+1], txn ids[n])."""
     keys = bytearray()
@@ -100,11 +128,15 @@ class NativeConflictSet:
 
     def __init__(self, window: int = 5_000_000):
         self._lib = load()
-        self._cs = self._lib.cs_create(window)
+        self._create = self._lib.cs_create
+        self._destroy = self._lib.cs_destroy
+        self._resolve = self._lib.cs_resolve
+        self._size = self._lib.cs_history_size
+        self._cs = self._create(window)
 
     def __del__(self):
         if getattr(self, "_cs", None):
-            self._lib.cs_destroy(self._cs)
+            self._destroy(self._cs)
             self._cs = None
 
     def resolve(self, transactions, version: int) -> np.ndarray:
@@ -128,7 +160,7 @@ class NativeConflictSet:
         wkeys, woff, wtxn = _flatten(writes)
         verdict = np.zeros(n, np.int32)
         c = ctypes.c_void_p
-        self._lib.cs_resolve(
+        self._resolve(
             self._cs, version, n,
             snapshots.ctypes.data_as(c),
             rkeys.ctypes.data_as(c), roff.ctypes.data_as(c),
@@ -154,7 +186,7 @@ class NativeConflictSet:
         n = snapshots.shape[0]
         verdict = np.zeros(n, np.int32)
         c = ctypes.c_void_p
-        self._lib.cs_resolve(
+        self._resolve(
             self._cs, version, n,
             np.ascontiguousarray(snapshots, np.int64).ctypes.data_as(c),
             np.ascontiguousarray(rkeys, np.uint8).ctypes.data_as(c),
@@ -169,4 +201,19 @@ class NativeConflictSet:
 
     @property
     def history_size(self) -> int:
-        return self._lib.cs_history_size(self._cs)
+        return self._size(self._cs)
+
+
+class NativeSkipListConflictSet(NativeConflictSet):
+    """The skip-list CPU baseline (skiplist.cpp): same wire contract,
+    same verdicts, the reference's algorithm class instead of the
+    ordered-map semantic model. bench.py reports vs_baseline against the
+    faster of the two (VERDICT r1 task 3)."""
+
+    def __init__(self, window: int = 5_000_000):
+        self._lib = load_skiplist()
+        self._create = self._lib.slcs_create
+        self._destroy = self._lib.slcs_destroy
+        self._resolve = self._lib.slcs_resolve
+        self._size = self._lib.slcs_history_size
+        self._cs = self._create(window)

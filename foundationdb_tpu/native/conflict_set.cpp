@@ -117,6 +117,7 @@ class ConflictSet {
     for (size_t t = 0; t < n; ++t) {
       if (tooOld[t]) continue;
       for (const Range& r : txns[t].reads) {
+        if (r.begin >= r.end) continue;  // empty/inverted: touches nothing
         if (history_.maxOver(r.begin, r.end) > txns[t].snapshot) {
           conflicted[t] = 1;
           break;
@@ -133,6 +134,7 @@ class ConflictSet {
       bool conflict = tooOld[t];
       if (!conflict) {
         for (const Range& r : txns[t].reads) {
+          if (r.begin >= r.end) continue;  // empty/inverted: touches nothing
           if (batchWrites.maxOver(r.begin, r.end) > 0) {
             conflict = true;
             break;

@@ -50,7 +50,7 @@ def main():
     cap = 1 << (N - 1).bit_length()
     config = KernelConfig(
         max_key_bytes=8, max_txns=cap, max_reads=cap, max_writes=cap,
-        history_capacity=10 * cap, window_versions=1_000_000,
+        history_capacity=12 * cap, window_versions=1_000_000,
     )
     rng = np.random.default_rng(0)
     batch = skiplist_style_batch(

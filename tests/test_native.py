@@ -106,3 +106,128 @@ def test_native_oracle_parity_random(lib, seed):
         got = cs.resolve(txns, version).tolist()
         want = oracle.resolve(to_oracle(txns), version).verdicts
         assert got == want
+
+
+# ---------------------------------------------------------------------------
+# Skip-list baseline (native/skiplist.cpp): same contract, the reference's
+# algorithm class (pyramids, radix point sort, bitset intra sweep). Must
+# agree with the oracle AND the ordered-map native model everywhere.
+
+
+@pytest.fixture(scope="module")
+def sl_lib():
+    try:
+        native.load_skiplist()
+    except native.NativeBuildError as e:
+        pytest.skip(f"native build unavailable: {e}")
+    return native
+
+
+def test_skiplist_basic_semantics(sl_lib):
+    from foundationdb_tpu.models.types import CommitTransaction
+
+    cs = native.NativeSkipListConflictSet(window=1000)
+    v = cs.resolve(
+        [CommitTransaction(write_conflict_ranges=[(b"a", b"b")])], 10
+    )
+    assert v.tolist() == [3]
+    v = cs.resolve(
+        [CommitTransaction(read_conflict_ranges=[(b"a", b"b")], read_snapshot=5)],
+        20,
+    )
+    assert v.tolist() == [0]
+    v = cs.resolve(
+        [CommitTransaction(read_conflict_ranges=[(b"a", b"b")], read_snapshot=20)],
+        30,
+    )
+    assert v.tolist() == [3]
+    v = cs.resolve(
+        [CommitTransaction(read_conflict_ranges=[(b"x", b"y")], read_snapshot=-2000)],
+        1500,
+    )
+    assert v.tolist() == [1]
+
+
+def test_skiplist_intra_batch_order(sl_lib):
+    from foundationdb_tpu.models.types import CommitTransaction
+
+    cs = native.NativeSkipListConflictSet(window=1000)
+    batch = [
+        CommitTransaction(write_conflict_ranges=[(b"k", b"l")]),
+        CommitTransaction(read_conflict_ranges=[(b"k", b"l")], read_snapshot=5),
+        CommitTransaction(read_conflict_ranges=[(b"z", b"zz")], read_snapshot=5),
+        CommitTransaction(write_conflict_ranges=[(b"z", b"zz")]),
+    ]
+    v = cs.resolve(batch, 10)
+    assert v.tolist() == [3, 0, 3, 3]
+
+
+def test_skiplist_shorter_key_ordering(sl_lib):
+    """Keys that share a prefix but differ in length (the radix-fallback
+    path) must honor shorter-before-longer ordering."""
+    from foundationdb_tpu.models.types import CommitTransaction
+
+    cs = native.NativeSkipListConflictSet(window=1000)
+    long_a = b"a" * 12  # beyond the 8-byte radix prefix
+    v = cs.resolve(
+        [CommitTransaction(write_conflict_ranges=[(b"a", long_a)])], 10
+    )
+    assert v.tolist() == [3]
+    # read [a*10, a*11) sits inside [a, a*12): stale read conflicts
+    v = cs.resolve(
+        [CommitTransaction(read_conflict_ranges=[(b"a" * 10, b"a" * 11)],
+                           read_snapshot=5)],
+        20,
+    )
+    assert v.tolist() == [0]
+    # read [a*12, a*13) is outside
+    v = cs.resolve(
+        [CommitTransaction(read_conflict_ranges=[(long_a, b"a" * 13)],
+                           read_snapshot=5)],
+        30,
+    )
+    assert v.tolist() == [3]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_skiplist_oracle_parity_random(sl_lib, seed):
+    cfg = WorkloadConfig(
+        n_txns=40, keyspace=64, key_width=6, stale_fraction=0.05, zipf=1.2
+    )
+    window = 500
+    cs = native.NativeSkipListConflictSet(window=window)
+    oracle = ConflictOracle(window=window)
+    rng = np.random.default_rng(seed + 100)
+    version = 0
+    for _ in range(20):
+        version += int(rng.integers(1, 60))
+        txns = make_batch(rng, cfg, version, window)
+        got = cs.resolve(txns, version).tolist()
+        want = oracle.resolve(to_oracle(txns), version).verdicts
+        assert got == want
+
+
+def test_skiplist_gc_windowing(sl_lib):
+    """Long-running stream: history size must stay bounded by the window
+    (the amortized removeBefore budget keeps up with inserts)."""
+    from foundationdb_tpu.models.types import CommitTransaction
+
+    window = 200
+    cs = native.NativeSkipListConflictSet(window=window)
+    rng = np.random.default_rng(7)
+    sizes = []
+    for i in range(200):
+        version = (i + 1) * 10
+        txns = [
+            CommitTransaction(
+                write_conflict_ranges=[
+                    (int(x).to_bytes(4, "big"), int(x + 3).to_bytes(4, "big"))
+                ],
+            )
+            for x in rng.integers(0, 500, size=8)
+        ]
+        cs.resolve(txns, version)
+        sizes.append(cs.history_size)
+    # window covers 20 batches x <=16 boundaries: steady state must not grow
+    assert sizes[-1] < 2000, sizes[-1]
+    assert max(sizes[-50:]) <= max(sizes[50:100]) + 500
