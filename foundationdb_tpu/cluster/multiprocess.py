@@ -1,0 +1,604 @@
+"""Multi-process cluster: roles as OS processes over the serialized wire.
+
+The reference runs every role in its own `fdbserver` process connected by
+FlowTransport (fdbserver/worker.actor.cpp:2305-2811 spawns role actors;
+fdbrpc/FlowTransport.actor.cpp carries the RPCs). This module is that
+deployment shape for this framework: `python -m
+foundationdb_tpu.cluster.multiprocess --role {resolver,tlog,storage}`
+serves one role over wire.transport (UDS by default), and ProxyPipeline
+in the parent process runs the commit pipeline against them:
+
+    client -> GRV (sequencer, in-proxy) -> commit batching -> version
+    allocation -> ResolveTransactionBatchRequest over the wire (version
+    chain: prevVersion ordering, Resolver.actor.cpp:269-290) -> TLog push
+    -> storage apply -> client reply
+
+The deterministic simulator remains the other backend of the same role
+interfaces (sim tests never fork processes) — the reference's
+one-abstraction-two-backends discipline.
+
+Role processes NEVER touch the TPU unless RESOLVER_BACKEND=tpu is set:
+the default resolver backend is the native C++ skip-list conflict set
+(no jax import at all in children).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import dataclasses
+import os
+import subprocess
+import sys
+from typing import Any, Optional
+
+from foundationdb_tpu.models.types import (
+    CommitTransaction,
+    ResolveTransactionBatchReply,
+    ResolveTransactionBatchRequest,
+    TransactionResult,
+)
+from foundationdb_tpu.wire import codec, transport
+
+# ---------------------------------------------------------------------------
+# Well-known endpoint tokens (the WellKnownEndpoints.h analog).
+
+TOKEN_RESOLVE = 0x0101
+TOKEN_TLOG_PUSH = 0x0201
+TOKEN_TLOG_PEEK = 0x0202
+TOKEN_STORAGE_APPLY = 0x0301
+TOKEN_STORAGE_GET = 0x0302
+TOKEN_STORAGE_SNAPSHOT = 0x0303
+TOKEN_PING = 0x0401
+
+
+# ---------------------------------------------------------------------------
+# Small wire messages, declared field-by-field (codec discipline: explicit
+# layouts, stable ids).
+
+_WRITERS = {
+    "u8": codec.w_u8,
+    "u32": codec.w_u32,
+    "i64": codec.w_i64,
+    "bytes": codec.w_bytes,
+    "str": codec.w_str,
+    "bool": codec.w_bool,
+}
+_READERS = {
+    "u8": codec.r_u8,
+    "u32": codec.r_u32,
+    "i64": codec.r_i64,
+    "bytes": codec.r_bytes,
+    "str": codec.r_str,
+    "bool": codec.r_bool,
+}
+
+
+def _w_mutlist(out, ms):
+    codec.w_u32(out, len(ms))
+    for m in ms:
+        codec.w_mutation(out, m)
+
+
+def _r_mutlist(buf, off):
+    n, off = codec.r_u32(buf, off)
+    ms = []
+    for _ in range(n):
+        m, off = codec.r_mutation(buf, off)
+        ms.append(m)
+    return ms, off
+
+
+def _w_optbytes(out, v):
+    codec.w_bool(out, v is not None)
+    codec.w_bytes(out, v or b"")
+
+
+def _r_optbytes(buf, off):
+    present, off = codec.r_bool(buf, off)
+    v, off = codec.r_bytes(buf, off)
+    return (v if present else None), off
+
+
+def _w_kvlist(out, kvs):
+    codec.w_u32(out, len(kvs))
+    for k, v in kvs:
+        codec.w_bytes(out, k)
+        codec.w_bytes(out, v)
+
+
+def _r_kvlist(buf, off):
+    n, off = codec.r_u32(buf, off)
+    kvs = []
+    for _ in range(n):
+        k, off = codec.r_bytes(buf, off)
+        v, off = codec.r_bytes(buf, off)
+        kvs.append((k, v))
+    return kvs, off
+
+
+_WRITERS["mutlist"] = _w_mutlist
+_READERS["mutlist"] = _r_mutlist
+_WRITERS["optbytes"] = _w_optbytes
+_READERS["optbytes"] = _r_optbytes
+_WRITERS["kvlist"] = _w_kvlist
+_READERS["kvlist"] = _r_kvlist
+
+
+def _message(type_id: int, name: str, fields: list[tuple[str, str]]):
+    cls = dataclasses.make_dataclass(name, [f for f, _ in fields])
+
+    def enc(out, m, _fields=fields):
+        for f, kind in _fields:
+            _WRITERS[kind](out, getattr(m, f))
+
+    def dec(buf, off, _fields=fields, _cls=cls):
+        vals = []
+        for _f, kind in _fields:
+            v, off = _READERS[kind](buf, off)
+            vals.append(v)
+        return _cls(*vals), off
+
+    codec.register(type_id, cls, enc, dec)
+    return cls
+
+
+Ping = _message(0x0201, "Ping", [("payload", "bytes")])
+Pong = _message(0x0202, "Pong", [("payload", "bytes")])
+TLogPush = _message(
+    0x0210,
+    "TLogPush",
+    [("version", "i64"), ("prev_version", "i64"), ("mutations", "mutlist")],
+)
+TLogPushReply = _message(0x0211, "TLogPushReply", [("durable_version", "i64")])
+TLogPeek = _message(0x0212, "TLogPeek", [("after_version", "i64")])
+TLogPeekReply = _message(
+    0x0213, "TLogPeekReply", [("version", "i64"), ("mutations", "mutlist")]
+)
+StorageApply = _message(
+    0x0220, "StorageApply", [("version", "i64"), ("mutations", "mutlist")]
+)
+StorageApplyReply = _message(
+    0x0221, "StorageApplyReply", [("durable_version", "i64")]
+)
+StorageGet = _message(
+    0x0222, "StorageGet", [("key", "bytes"), ("version", "i64")]
+)
+StorageGetReply = _message(0x0223, "StorageGetReply", [("value", "optbytes")])
+StorageSnapshotReq = _message(
+    0x0224, "StorageSnapshotReq", [("version", "i64")]
+)
+StorageSnapshotReply = _message(
+    0x0225, "StorageSnapshotReply", [("version", "i64"), ("kvs", "kvlist")]
+)
+
+
+# ---------------------------------------------------------------------------
+# Role servers.
+
+
+class ResolverRole:
+    """Wire-served resolver: version-chained conflict resolution.
+
+    Reproduces the resolveBatch ordering contract
+    (fdbserver/Resolver.actor.cpp:269-290,496): requests wait until the
+    resolver's version reaches req.prev_version, resolve, then advance to
+    req.version — so out-of-order arrivals from concurrent proxies are
+    serialized into the global commit order. Duplicate requests (same
+    version) replay the recorded reply (:515-530).
+    """
+
+    def __init__(self, backend: str = "native", window: int = 5_000_000):
+        self.version = -1
+        self.window = window
+        self._cond: asyncio.Condition | None = None
+        self._replies: dict[int, ResolveTransactionBatchReply] = {}
+        self._backend = backend
+        if backend == "native":
+            from foundationdb_tpu.native import NativeSkipListConflictSet
+
+            self._cs = NativeSkipListConflictSet(window=window)
+        elif backend in ("cpu", "tpu"):
+            from foundationdb_tpu.config import KernelConfig
+            from foundationdb_tpu.models.conflict_set import make_conflict_set
+
+            cfg_env = os.environ.get("RESOLVER_KERNEL", "")
+            kcfg = KernelConfig(
+                max_key_bytes=16,
+                max_txns=1024,
+                max_reads=4096,
+                max_writes=4096,
+                history_capacity=1 << 16,
+                window_versions=window,
+            ) if not cfg_env else eval(cfg_env)  # noqa: S307 (operator-supplied)
+            self._cs = make_conflict_set(kcfg, backend)
+        else:
+            raise ValueError(f"unknown resolver backend {backend!r}")
+
+    def _cond_lazy(self) -> asyncio.Condition:
+        if self._cond is None:
+            self._cond = asyncio.Condition()
+        return self._cond
+
+    async def resolve(self, req: ResolveTransactionBatchRequest):
+        cond = self._cond_lazy()
+        async with cond:
+            await cond.wait_for(
+                lambda: self.version >= req.prev_version
+            )
+            if req.version <= self.version:
+                # duplicate (proxy retry): replay the recorded reply
+                reply = self._replies.get(req.version)
+                if reply is None:
+                    raise transport.RemoteError(
+                        f"version {req.version} already resolved and expired"
+                    )
+                return reply
+            reply = self._resolve_now(req)
+            self._replies[req.version] = reply
+            # retain a bounded replay window
+            floor = req.version - self.window
+            self._replies = {
+                v: r for v, r in self._replies.items() if v >= floor
+            }
+            self.version = req.version
+            cond.notify_all()
+            return reply
+
+    def _resolve_now(self, req) -> ResolveTransactionBatchReply:
+        if self._backend == "native":
+            verdicts = self._cs.resolve(req.transactions, req.version)
+            committed = [TransactionResult(int(v)) for v in verdicts]
+            ckr: dict[int, list[int]] = {}
+        else:
+            res = self._cs.resolve(req.transactions, req.version)
+            committed = res.verdicts
+            ckr = res.conflicting_key_ranges
+        return ResolveTransactionBatchReply(
+            committed=committed,
+            conflicting_key_range_map=ckr,
+            state_mutations=[],
+            debug_id=req.debug_id,
+        )
+
+
+class TLogRole:
+    """Wire-served transaction log: version-ordered append + peek."""
+
+    def __init__(self):
+        self.entries: list[tuple[int, list]] = []  # (version, mutations)
+        self.version = -1
+
+    async def push(self, req: TLogPush) -> TLogPushReply:
+        if req.version <= self.version:
+            # duplicate push: idempotent ack (proxy retry after lost reply)
+            return TLogPushReply(durable_version=self.version)
+        if req.prev_version > self.version:
+            raise transport.RemoteError(
+                f"tlog gap: prev {req.prev_version} > current {self.version}"
+            )
+        self.entries.append((req.version, list(req.mutations)))
+        self.version = req.version
+        return TLogPushReply(durable_version=self.version)
+
+    async def peek(self, req: TLogPeek) -> TLogPeekReply:
+        for v, muts in self.entries:
+            if v > req.after_version:
+                return TLogPeekReply(version=v, mutations=muts)
+        return TLogPeekReply(version=-1, mutations=[])
+
+
+class StorageRole:
+    """Wire-served storage: versioned point store (SET mutations)."""
+
+    MUT_SET = 0
+    MUT_CLEAR_RANGE = 1
+
+    def __init__(self):
+        # key -> list[(version, value|None)] ascending
+        self.history: dict[bytes, list[tuple[int, Optional[bytes]]]] = {}
+        # the empty store is readable at version 0 (a GRV before any commit
+        # must not block behind the first apply)
+        self.version = 0
+        self._cond: asyncio.Condition | None = None
+
+    def _cond_lazy(self) -> asyncio.Condition:
+        if self._cond is None:
+            self._cond = asyncio.Condition()
+        return self._cond
+
+    async def apply(self, req: StorageApply) -> StorageApplyReply:
+        cond = self._cond_lazy()
+        async with cond:
+            if req.version > self.version:
+                for m in req.mutations:
+                    if m.op == self.MUT_SET:
+                        self.history.setdefault(m.param1, []).append(
+                            (req.version, m.param2)
+                        )
+                    elif m.op == self.MUT_CLEAR_RANGE:
+                        for k in list(self.history):
+                            if m.param1 <= k < m.param2:
+                                self.history[k].append((req.version, None))
+                self.version = req.version
+                cond.notify_all()
+            return StorageApplyReply(durable_version=self.version)
+
+    async def get(self, req: StorageGet) -> StorageGetReply:
+        cond = self._cond_lazy()
+        async with cond:
+            await cond.wait_for(lambda: self.version >= req.version)
+        hist = self.history.get(req.key, [])
+        value = None
+        for v, val in hist:
+            if v <= req.version:
+                value = val
+            else:
+                break
+        return StorageGetReply(value=value)
+
+    async def snapshot(self, req: StorageSnapshotReq) -> StorageSnapshotReply:
+        cond = self._cond_lazy()
+        async with cond:
+            await cond.wait_for(lambda: self.version >= req.version)
+        kvs = []
+        for k, hist in sorted(self.history.items()):
+            value = None
+            for v, val in hist:
+                if v <= req.version:
+                    value = val  # leaves the newest value <= version
+            if value is not None:
+                kvs.append((k, value))
+        return StorageSnapshotReply(version=self.version, kvs=kvs)
+
+
+async def _serve_role(role_name: str, address, backend: str) -> None:
+    server = transport.RpcServer(address)
+
+    async def ping(msg: Ping) -> Pong:
+        return Pong(payload=msg.payload)
+
+    server.register(TOKEN_PING, ping)
+    if role_name == "resolver":
+        role = ResolverRole(backend=backend)
+        server.register(TOKEN_RESOLVE, role.resolve)
+    elif role_name == "tlog":
+        role = TLogRole()
+        server.register(TOKEN_TLOG_PUSH, role.push)
+        server.register(TOKEN_TLOG_PEEK, role.peek)
+    elif role_name == "storage":
+        role = StorageRole()
+        server.register(TOKEN_STORAGE_APPLY, role.apply)
+        server.register(TOKEN_STORAGE_GET, role.get)
+        server.register(TOKEN_STORAGE_SNAPSHOT, role.snapshot)
+    else:
+        raise ValueError(f"unknown role {role_name!r}")
+    await server.start()
+    # run until killed
+    await asyncio.Event().wait()
+
+
+# ---------------------------------------------------------------------------
+# Launcher (parent side).
+
+
+@dataclasses.dataclass
+class RoleProcess:
+    name: str
+    address: str
+    proc: subprocess.Popen
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+def spawn_role(
+    name: str, socket_dir: str, *, backend: str = "native", index: int = 0
+) -> RoleProcess:
+    """Start one role as a child OS process serving a UDS in socket_dir.
+
+    Children run with JAX_PLATFORMS=cpu and a clean PYTHONPATH so they can
+    never claim a TPU tunnel (the TPU belongs to the resolver process only
+    when explicitly requested via backend='tpu')."""
+    address = os.path.join(socket_dir, f"{name}{index}.sock")
+    env = dict(os.environ)
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    if backend != "tpu":
+        env["PYTHONPATH"] = repo_root
+        env["JAX_PLATFORMS"] = "cpu"
+    else:
+        # tpu children keep their platform env (the tunnel sitecustomize
+        # stays on PYTHONPATH) but still need the package importable
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "foundationdb_tpu.cluster.multiprocess",
+            "--role",
+            name,
+            "--address",
+            address,
+            "--backend",
+            backend,
+        ],
+        env=env,
+    )
+    return RoleProcess(name=name, address=address, proc=proc)
+
+
+# ---------------------------------------------------------------------------
+# The commit pipeline (parent process: sequencer + proxy + client API).
+
+
+class NotCommittedError(Exception):
+    pass
+
+
+class ProxyPipeline:
+    """Sequencer + commit proxy over wire-connected roles.
+
+    The 5-phase commitBatch pipeline
+    (fdbserver/CommitProxyServer.actor.cpp:2516-2555) against remote
+    resolver/tlog/storage processes: version allocation (master getVersion
+    semantics, monotonic + prevVersion chain), resolution RPC, verdict
+    min-combine, tlog push, storage apply, client replies. GRV serves the
+    last tlog-durable version (commit-before-GRV visibility).
+    """
+
+    def __init__(
+        self,
+        resolvers: list[transport.RpcConnection],
+        tlog: transport.RpcConnection,
+        storage: transport.RpcConnection,
+        *,
+        version_step: int = 1000,
+        batch_interval: float = 0.002,
+        max_batch: int = 512,
+    ):
+        self.resolvers = resolvers
+        self.tlog = tlog
+        self.storage = storage
+        self.version_step = version_step
+        self.batch_interval = batch_interval
+        self.max_batch = max_batch
+        self.committed_version = 0
+        self.prev_version = -1
+        self._last_allocated = 0
+        self._queue: list[tuple[CommitTransaction, asyncio.Future]] = []
+        self._batcher_task: asyncio.Task | None = None
+        self._commit_lock = asyncio.Lock()
+
+    def start(self) -> None:
+        self._batcher_task = asyncio.ensure_future(self._batcher())
+
+    async def stop(self) -> None:
+        if self._batcher_task:
+            self._batcher_task.cancel()
+            try:
+                await self._batcher_task
+            except asyncio.CancelledError:
+                pass
+            self._batcher_task = None
+
+    async def get_read_version(self) -> int:
+        return self.committed_version
+
+    async def commit(self, txn: CommitTransaction) -> int:
+        """Returns the commit version or raises NotCommittedError."""
+        fut = asyncio.get_event_loop().create_future()
+        self._queue.append((txn, fut))
+        return await fut
+
+    async def read(self, key: bytes, version: int) -> Optional[bytes]:
+        reply = await self.storage.call(
+            TOKEN_STORAGE_GET, StorageGet(key=key, version=version)
+        )
+        return reply.value
+
+    async def _batcher(self) -> None:
+        while True:
+            await asyncio.sleep(self.batch_interval)
+            if not self._queue:
+                continue
+            batch, self._queue = (
+                self._queue[: self.max_batch],
+                self._queue[self.max_batch :],
+            )
+            try:
+                await self._commit_batch(batch)
+            except Exception as e:
+                for _txn, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(
+                            transport.RemoteError(f"commit pipeline: {e!r}")
+                        )
+
+    async def _commit_batch(self, batch) -> None:
+        txns = [t for t, _f in batch]
+        async with self._commit_lock:
+            # phase 1: version allocation (sequencer). Monotonic across
+            # FAILED attempts too: a batch that died after resolution
+            # consumed its version (the resolver advanced past it and
+            # recorded its reply); reusing it would replay the dead
+            # batch's verdicts onto different transactions. The reference
+            # master never re-hands a version either — recovery skips
+            # them (masterserver.actor.cpp getVersion monotonicity).
+            version = (
+                max(self.committed_version, self._last_allocated)
+                + self.version_step
+            )
+            self._last_allocated = version
+            # phase 2: resolution (all resolvers see the full batch; each
+            # owns a key partition in multi-resolver configs — here every
+            # resolver sees everything and verdicts min-combine,
+            # CommitProxyServer.actor.cpp:1551-1567)
+            req = ResolveTransactionBatchRequest(
+                prev_version=self.prev_version,
+                version=version,
+                last_received_version=self.prev_version,
+                transactions=txns,
+            )
+            replies = await asyncio.gather(
+                *(r.call(TOKEN_RESOLVE, req) for r in self.resolvers)
+            )
+            verdicts = [
+                min(int(rep.committed[i]) for rep in replies)
+                for i in range(len(txns))
+            ]
+            # phase 3: collect committed mutations
+            mutations = []
+            for t, v in zip(txns, verdicts):
+                if v == TransactionResult.COMMITTED:
+                    mutations.extend(t.mutations)
+            # phase 4: log
+            await self.tlog.call(
+                TOKEN_TLOG_PUSH,
+                TLogPush(
+                    version=version,
+                    prev_version=self.prev_version,
+                    mutations=mutations,
+                ),
+            )
+            # phase 4b: apply to storage (the storage pull loop collapsed
+            # into a push for this pipeline; versioned reads still hold)
+            await self.storage.call(
+                TOKEN_STORAGE_APPLY,
+                StorageApply(version=version, mutations=mutations),
+            )
+            self.prev_version = version
+            self.committed_version = version
+        # phase 5: replies
+        for (txn, fut), v in zip(batch, verdicts):
+            if fut.done():
+                continue
+            if v == TransactionResult.COMMITTED:
+                fut.set_result(version)
+            else:
+                fut.set_exception(NotCommittedError(TransactionResult(v).name))
+
+
+async def connect(address, **kw) -> transport.RpcConnection:
+    conn = transport.RpcConnection(address)
+    await conn.connect(**kw)
+    return conn
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--role", required=True)
+    ap.add_argument("--address", required=True)
+    ap.add_argument("--backend", default="native")
+    args = ap.parse_args()
+    asyncio.run(_serve_role(args.role, args.address, args.backend))
+
+
+if __name__ == "__main__":
+    main()

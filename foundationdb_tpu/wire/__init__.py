@@ -1,0 +1,12 @@
+"""Serialized wire: binary codec + token-addressed RPC transport.
+
+The cross-process seam of the framework (VERDICT r1 task 5). `codec`
+mirrors the reference's protocol-versioned payload serialization
+(flow/serialize.h / flow/flat_buffers.cpp); `transport` mirrors
+FlowTransport's token-addressed, checksummed, version-handshaked framing
+(fdbrpc/FlowTransport.actor.cpp:427,1022,1119-1142). The deterministic
+simulator (sim/network.py) is the other backend of the same one
+abstraction, exactly as Sim2 is for the reference.
+"""
+
+from foundationdb_tpu.wire import codec, transport  # noqa: F401

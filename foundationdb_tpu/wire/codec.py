@@ -1,0 +1,366 @@
+"""Compact binary codec for the wire types.
+
+The reference serializes every RPC payload with a protocol-versioned
+binary format (flow/serialize.h packed-binary + the flatbuffers-compatible
+ObjectSerializer, flow/flat_buffers.cpp) where each type declares a
+`serializer(ar, f1, f2, ...)` field list. This module is the equivalent
+seam for this framework: explicit per-type encode/decode functions over a
+small set of primitives, a u16 type registry (the FileIdentifier analog),
+and a protocol version constant carried in the transport handshake
+(fdbrpc/FlowTransport.actor.cpp:427 ConnectPacket).
+
+Primitives are little-endian fixed-width ints, length-prefixed bytes, and
+count-prefixed lists — no pickling, no reflection on the wire. Mutations
+travel as (op: u8, param1: bytes, param2: bytes) triples, matching the
+shape of the reference's MutationRef.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Callable
+
+from foundationdb_tpu.models.types import (
+    CommitTransaction,
+    ResolveTransactionBatchReply,
+    ResolveTransactionBatchRequest,
+    TransactionResult,
+)
+
+#: Bumped whenever any wire layout changes; checked at connect time.
+PROTOCOL_VERSION = 0x0FDB_7E50_0002
+
+
+class CodecError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Primitive writers/readers. A Writer is a list[bytes] accumulator (joined
+# once at the end); a Reader is (memoryview, offset) threaded explicitly.
+
+_U8 = struct.Struct("<B")
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_I64 = struct.Struct("<q")
+_U64 = struct.Struct("<Q")
+
+
+def w_u8(out: list, v: int) -> None:
+    out.append(_U8.pack(v))
+
+
+def w_u16(out: list, v: int) -> None:
+    out.append(_U16.pack(v))
+
+
+def w_u32(out: list, v: int) -> None:
+    out.append(_U32.pack(v))
+
+
+def w_i64(out: list, v: int) -> None:
+    out.append(_I64.pack(v))
+
+
+def w_u64(out: list, v: int) -> None:
+    out.append(_U64.pack(v))
+
+
+def w_bytes(out: list, b: bytes) -> None:
+    out.append(_U32.pack(len(b)))
+    out.append(b)
+
+
+def w_str(out: list, s: str | None) -> None:
+    w_bytes(out, b"" if s is None else s.encode("utf-8"))
+
+
+def w_bool(out: list, v: bool) -> None:
+    out.append(_U8.pack(1 if v else 0))
+
+
+def r_u8(buf: memoryview, off: int) -> tuple[int, int]:
+    return _U8.unpack_from(buf, off)[0], off + 1
+
+
+def r_u16(buf: memoryview, off: int) -> tuple[int, int]:
+    return _U16.unpack_from(buf, off)[0], off + 2
+
+
+def r_u32(buf: memoryview, off: int) -> tuple[int, int]:
+    return _U32.unpack_from(buf, off)[0], off + 4
+
+
+def r_i64(buf: memoryview, off: int) -> tuple[int, int]:
+    return _I64.unpack_from(buf, off)[0], off + 8
+
+
+def r_u64(buf: memoryview, off: int) -> tuple[int, int]:
+    return _U64.unpack_from(buf, off)[0], off + 8
+
+
+def r_bytes(buf: memoryview, off: int) -> tuple[bytes, int]:
+    n, off = r_u32(buf, off)
+    if off + n > len(buf):
+        raise CodecError("truncated bytes field")
+    return bytes(buf[off : off + n]), off + n
+
+
+def r_str(buf: memoryview, off: int) -> tuple[str | None, int]:
+    b, off = r_bytes(buf, off)
+    return (b.decode("utf-8") if b else None), off
+
+
+def r_bool(buf: memoryview, off: int) -> tuple[bool, int]:
+    v, off = r_u8(buf, off)
+    return bool(v), off
+
+
+# ---------------------------------------------------------------------------
+# Mutations: (op, param1, param2). Anything with .op/.param1/.param2 or a
+# 3-tuple encodes; decodes to a plain Mutation.
+
+
+class Mutation:
+    __slots__ = ("op", "param1", "param2")
+
+    def __init__(self, op: int, param1: bytes, param2: bytes):
+        self.op = op
+        self.param1 = param1
+        self.param2 = param2
+
+    def __eq__(self, other):
+        return (
+            getattr(other, "op", None) == self.op
+            and getattr(other, "param1", None) == self.param1
+            and getattr(other, "param2", None) == self.param2
+        )
+
+    def __repr__(self):
+        return f"Mutation({self.op}, {self.param1!r}, {self.param2!r})"
+
+
+def w_mutation(out: list, m: Any) -> None:
+    if isinstance(m, tuple):
+        op, p1, p2 = m
+    else:
+        op, p1, p2 = m.op, m.param1, m.param2
+    w_u8(out, int(op))
+    w_bytes(out, p1)
+    w_bytes(out, p2)
+
+
+def r_mutation(buf: memoryview, off: int) -> tuple[Mutation, int]:
+    op, off = r_u8(buf, off)
+    p1, off = r_bytes(buf, off)
+    p2, off = r_bytes(buf, off)
+    return Mutation(op, p1, p2), off
+
+
+# ---------------------------------------------------------------------------
+# Wire types.
+
+
+def w_commit_transaction(out: list, t: CommitTransaction) -> None:
+    w_u32(out, len(t.read_conflict_ranges))
+    for b, e in t.read_conflict_ranges:
+        w_bytes(out, b)
+        w_bytes(out, e)
+    w_u32(out, len(t.write_conflict_ranges))
+    for b, e in t.write_conflict_ranges:
+        w_bytes(out, b)
+        w_bytes(out, e)
+    w_i64(out, t.read_snapshot)
+    w_bool(out, t.report_conflicting_keys)
+    w_u32(out, len(t.mutations))
+    for m in t.mutations:
+        w_mutation(out, m)
+
+
+def r_commit_transaction(buf: memoryview, off: int) -> tuple[CommitTransaction, int]:
+    n, off = r_u32(buf, off)
+    reads = []
+    for _ in range(n):
+        b, off = r_bytes(buf, off)
+        e, off = r_bytes(buf, off)
+        reads.append((b, e))
+    n, off = r_u32(buf, off)
+    writes = []
+    for _ in range(n):
+        b, off = r_bytes(buf, off)
+        e, off = r_bytes(buf, off)
+        writes.append((b, e))
+    snap, off = r_i64(buf, off)
+    rck, off = r_bool(buf, off)
+    n, off = r_u32(buf, off)
+    muts = []
+    for _ in range(n):
+        m, off = r_mutation(buf, off)
+        muts.append(m)
+    return (
+        CommitTransaction(
+            read_conflict_ranges=reads,
+            write_conflict_ranges=writes,
+            read_snapshot=snap,
+            report_conflicting_keys=rck,
+            mutations=muts,
+        ),
+        off,
+    )
+
+
+def w_resolve_request(out: list, r: ResolveTransactionBatchRequest) -> None:
+    w_i64(out, r.prev_version)
+    w_i64(out, r.version)
+    w_i64(out, r.last_received_version)
+    w_u32(out, len(r.transactions))
+    for t in r.transactions:
+        w_commit_transaction(out, t)
+    w_u32(out, len(r.txn_state_transactions))
+    for i in r.txn_state_transactions:
+        w_u32(out, i)
+    w_str(out, r.proxy_id)
+    w_str(out, r.debug_id)
+
+
+def r_resolve_request(
+    buf: memoryview, off: int
+) -> tuple[ResolveTransactionBatchRequest, int]:
+    prev, off = r_i64(buf, off)
+    ver, off = r_i64(buf, off)
+    last, off = r_i64(buf, off)
+    n, off = r_u32(buf, off)
+    txns = []
+    for _ in range(n):
+        t, off = r_commit_transaction(buf, off)
+        txns.append(t)
+    n, off = r_u32(buf, off)
+    state_idx = []
+    for _ in range(n):
+        i, off = r_u32(buf, off)
+        state_idx.append(i)
+    proxy_id, off = r_str(buf, off)
+    debug_id, off = r_str(buf, off)
+    return (
+        ResolveTransactionBatchRequest(
+            prev_version=prev,
+            version=ver,
+            last_received_version=last,
+            transactions=txns,
+            txn_state_transactions=state_idx,
+            proxy_id=proxy_id,
+            debug_id=debug_id,
+        ),
+        off,
+    )
+
+
+def w_resolve_reply(out: list, r: ResolveTransactionBatchReply) -> None:
+    w_u32(out, len(r.committed))
+    for v in r.committed:
+        w_u8(out, int(v))
+    w_u32(out, len(r.conflicting_key_range_map))
+    for t, idxs in r.conflicting_key_range_map.items():
+        w_u32(out, t)
+        w_u32(out, len(idxs))
+        for i in idxs:
+            w_u32(out, i)
+    # state mutations travel as (version, [mutations]) groups
+    w_u32(out, len(r.state_mutations))
+    for group in r.state_mutations:
+        version, muts = group
+        w_i64(out, version)
+        w_u32(out, len(muts))
+        for m in muts:
+            w_mutation(out, m)
+    w_str(out, r.debug_id)
+
+
+def r_resolve_reply(
+    buf: memoryview, off: int
+) -> tuple[ResolveTransactionBatchReply, int]:
+    n, off = r_u32(buf, off)
+    committed = []
+    for _ in range(n):
+        v, off = r_u8(buf, off)
+        committed.append(TransactionResult(v))
+    n, off = r_u32(buf, off)
+    ckr = {}
+    for _ in range(n):
+        t, off = r_u32(buf, off)
+        k, off = r_u32(buf, off)
+        idxs = []
+        for _ in range(k):
+            i, off = r_u32(buf, off)
+            idxs.append(i)
+        ckr[t] = idxs
+    n, off = r_u32(buf, off)
+    state = []
+    for _ in range(n):
+        version, off = r_i64(buf, off)
+        k, off = r_u32(buf, off)
+        muts = []
+        for _ in range(k):
+            m, off = r_mutation(buf, off)
+            muts.append(m)
+        state.append((version, muts))
+    debug_id, off = r_str(buf, off)
+    return (
+        ResolveTransactionBatchReply(
+            committed=committed,
+            conflicting_key_range_map=ckr,
+            state_mutations=state,
+            debug_id=debug_id,
+        ),
+        off,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Registry: type id <-> (encoder, decoder). Ids are stable wire contract
+# (the FileIdentifier analog); never reuse an id for a different layout.
+
+_REGISTRY: dict[int, tuple[Callable, Callable]] = {}
+_TYPE_IDS: dict[type, int] = {}
+
+
+def register(type_id: int, cls: type, enc: Callable, dec: Callable) -> None:
+    if type_id in _REGISTRY:
+        raise ValueError(f"duplicate wire type id {type_id}")
+    _REGISTRY[type_id] = (enc, dec)
+    _TYPE_IDS[cls] = type_id
+
+
+register(0x0101, CommitTransaction, w_commit_transaction, r_commit_transaction)
+register(
+    0x0102, ResolveTransactionBatchRequest, w_resolve_request, r_resolve_request
+)
+register(0x0103, ResolveTransactionBatchReply, w_resolve_reply, r_resolve_reply)
+
+
+def encode(msg: Any) -> bytes:
+    """Serialize a registered message to bytes: u16 type id + payload."""
+    tid = _TYPE_IDS.get(type(msg))
+    if tid is None:
+        raise CodecError(f"unregistered wire type {type(msg).__name__}")
+    out: list = [_U16.pack(tid)]
+    _REGISTRY[tid][0](out, msg)
+    return b"".join(out)
+
+
+def decode(data: bytes | memoryview) -> Any:
+    """Inverse of encode. Raises CodecError on unknown type / truncation."""
+    buf = memoryview(data)
+    if len(buf) < 2:
+        raise CodecError("short message")
+    tid = _U16.unpack_from(buf, 0)[0]
+    entry = _REGISTRY.get(tid)
+    if entry is None:
+        raise CodecError(f"unknown wire type id {tid:#06x}")
+    try:
+        msg, off = entry[1](buf, 2)
+    except struct.error as e:
+        raise CodecError(f"truncated message: {e}") from None
+    if off != len(buf):
+        raise CodecError(f"{len(buf) - off} trailing bytes after message")
+    return msg

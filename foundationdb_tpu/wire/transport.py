@@ -1,0 +1,262 @@
+"""Token-addressed RPC over real sockets (asyncio) — the FlowTransport
+analog for multi-process clusters.
+
+The reference's single comm backend is FlowTransport: TCP connections
+carrying token-addressed serialized messages, a version-checked
+ConnectPacket handshake (fdbrpc/FlowTransport.actor.cpp:427), CRC32
+checksums per packet (:1119-1142), and delivery to a local promise keyed
+by the endpoint token (`deliver`, :1022). Simulation swaps the wire for
+in-process Sim2 connections.
+
+This module keeps the same discipline with asyncio streams:
+
+* **Endpoint token** (u64): the server registers async handlers per
+  token; a request frame names the token it targets. Well-known tokens
+  (WellKnownEndpoints.h analog) are small constants in cluster code.
+* **Handshake**: 8-byte magic + u64 PROTOCOL_VERSION both ways before any
+  frame; mismatch closes the connection (the multi-version story lives
+  above this layer, as in the reference).
+* **Frames**: u32 length | u32 crc32(body) | body. A corrupted frame
+  raises and closes the connection rather than delivering garbage.
+* **Request/reply**: u64 request ids correlate replies over a shared
+  connection; handler exceptions travel back as error frames and re-raise
+  client-side as RemoteError.
+
+Unix-domain sockets by default (role processes share a socket dir the
+way fdbmonitor-supervised processes share a cluster file); TCP works by
+passing ("host", port) addresses. The deterministic simulator
+(sim/network.py) remains the other backend of the same abstraction —
+sim tests never touch this module.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import zlib
+from typing import Any, Callable
+
+from foundationdb_tpu.wire import codec
+
+MAGIC = b"FDBTPUv1"
+_HDR = struct.Struct("<II")  # length, crc32
+_REQ = struct.Struct("<BQQ")  # kind, reqid, token
+_REP = struct.Struct("<BQ")  # kind, reqid
+
+KIND_REQUEST = 0
+KIND_REPLY = 1
+KIND_ERROR = 2
+
+MAX_FRAME = 256 * 1024 * 1024
+
+
+class TransportError(ConnectionError):
+    pass
+
+
+class HandshakeError(TransportError):
+    pass
+
+
+class ChecksumError(TransportError):
+    pass
+
+
+class RemoteError(RuntimeError):
+    """The remote handler raised; message carries its repr."""
+
+
+class UnknownEndpointError(RemoteError):
+    pass
+
+
+async def _handshake(reader, writer) -> None:
+    writer.write(MAGIC + struct.pack("<Q", codec.PROTOCOL_VERSION))
+    await writer.drain()
+    peer = await reader.readexactly(len(MAGIC) + 8)
+    if peer[: len(MAGIC)] != MAGIC:
+        raise HandshakeError("bad magic from peer")
+    (version,) = struct.unpack("<Q", peer[len(MAGIC) :])
+    if version != codec.PROTOCOL_VERSION:
+        raise HandshakeError(
+            f"protocol version mismatch: ours {codec.PROTOCOL_VERSION:#x}, "
+            f"peer {version:#x}"
+        )
+
+
+async def _read_frame(reader) -> bytes:
+    hdr = await reader.readexactly(_HDR.size)
+    length, crc = _HDR.unpack(hdr)
+    if length > MAX_FRAME:
+        raise TransportError(f"oversized frame ({length} bytes)")
+    body = await reader.readexactly(length)
+    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+        raise ChecksumError("frame checksum mismatch")
+    return body
+
+
+def _write_frame(writer, body: bytes) -> None:
+    writer.write(_HDR.pack(len(body), zlib.crc32(body) & 0xFFFFFFFF))
+    writer.write(body)
+
+
+Address = "str | tuple[str, int]"  # UDS path or (host, port)
+
+
+class RpcServer:
+    """Serves registered endpoint tokens over UDS or TCP."""
+
+    def __init__(self, address):
+        self.address = address
+        self._handlers: dict[int, Callable] = {}
+        self._server: asyncio.AbstractServer | None = None
+
+    def register(self, token: int, handler: Callable) -> None:
+        """handler: async (msg) -> reply msg (codec-registered types)."""
+        if token in self._handlers:
+            raise ValueError(f"token {token:#x} already registered")
+        self._handlers[token] = handler
+
+    async def start(self) -> None:
+        if isinstance(self.address, str):
+            self._server = await asyncio.start_unix_server(
+                self._serve_conn, path=self.address
+            )
+        else:
+            host, port = self.address
+            self._server = await asyncio.start_server(
+                self._serve_conn, host=host, port=port
+            )
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _serve_conn(self, reader, writer) -> None:
+        try:
+            await _handshake(reader, writer)
+            pending: set[asyncio.Task] = set()
+            while True:
+                body = await _read_frame(reader)
+                kind, reqid, token = _REQ.unpack_from(body, 0)
+                if kind != KIND_REQUEST:
+                    raise TransportError(f"unexpected frame kind {kind}")
+                payload = body[_REQ.size :]
+                t = asyncio.ensure_future(
+                    self._dispatch(writer, reqid, token, payload)
+                )
+                pending.add(t)
+                t.add_done_callback(pending.discard)
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            HandshakeError,
+            ChecksumError,
+        ):
+            pass
+        finally:
+            writer.close()
+
+    async def _dispatch(self, writer, reqid: int, token: int, payload: bytes):
+        try:
+            handler = self._handlers.get(token)
+            if handler is None:
+                raise UnknownEndpointError(f"no endpoint {token:#x}")
+            reply = await handler(codec.decode(payload))
+            body = _REP.pack(KIND_REPLY, reqid) + codec.encode(reply)
+        except Exception as e:  # travels back as an error frame
+            body = _REP.pack(KIND_ERROR, reqid) + repr(e).encode("utf-8")
+        try:
+            _write_frame(writer, body)
+            await writer.drain()
+        except ConnectionError:
+            pass
+
+
+class RpcConnection:
+    """Client side: one connection, correlated request/reply."""
+
+    def __init__(self, address):
+        self.address = address
+        self._reader = None
+        self._writer = None
+        self._next_id = 1
+        self._waiters: dict[int, asyncio.Future] = {}
+        self._reader_task: asyncio.Task | None = None
+
+    async def connect(self, *, retries: int = 50, delay: float = 0.1) -> None:
+        last = None
+        for _ in range(retries):
+            try:
+                if isinstance(self.address, str):
+                    self._reader, self._writer = await asyncio.open_unix_connection(
+                        path=self.address
+                    )
+                else:
+                    host, port = self.address
+                    self._reader, self._writer = await asyncio.open_connection(
+                        host=host, port=port
+                    )
+                break
+            except (ConnectionError, FileNotFoundError, OSError) as e:
+                last = e
+                await asyncio.sleep(delay)
+        else:
+            raise TransportError(f"cannot connect to {self.address}: {last}")
+        await _handshake(self._reader, self._writer)
+        self._reader_task = asyncio.ensure_future(self._read_loop())
+
+    async def close(self) -> None:
+        if self._reader_task:
+            self._reader_task.cancel()
+            try:
+                await self._reader_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._reader_task = None
+        if self._writer:
+            self._writer.close()
+            self._writer = None
+        for f in self._waiters.values():
+            if not f.done():
+                f.set_exception(TransportError("connection closed"))
+        self._waiters.clear()
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                body = await _read_frame(self._reader)
+                kind, reqid = _REP.unpack_from(body, 0)
+                fut = self._waiters.pop(reqid, None)
+                if fut is None or fut.done():
+                    continue
+                payload = body[_REP.size :]
+                if kind == KIND_REPLY:
+                    fut.set_result(codec.decode(payload))
+                elif kind == KIND_ERROR:
+                    fut.set_exception(RemoteError(payload.decode("utf-8")))
+                else:
+                    fut.set_exception(TransportError(f"bad frame kind {kind}"))
+        except (asyncio.IncompleteReadError, ConnectionError, ChecksumError) as e:
+            for f in self._waiters.values():
+                if not f.done():
+                    f.set_exception(TransportError(f"connection lost: {e!r}"))
+            self._waiters.clear()
+        except asyncio.CancelledError:
+            pass
+
+    async def call(self, token: int, msg: Any, *, timeout: float = 30.0) -> Any:
+        reqid = self._next_id
+        self._next_id += 1
+        fut = asyncio.get_event_loop().create_future()
+        self._waiters[reqid] = fut
+        body = _REQ.pack(KIND_REQUEST, reqid, token) + codec.encode(msg)
+        try:
+            _write_frame(self._writer, body)
+            await self._writer.drain()
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            # a timed-out / failed call must not leak its waiter entry
+            self._waiters.pop(reqid, None)

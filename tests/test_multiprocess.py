@@ -1,0 +1,196 @@
+"""Multi-process cluster: real OS processes over the serialized wire.
+
+VERDICT r1 task 5's acceptance shape: client + proxy in this process,
+resolver / tlog / storage as three child processes connected by UDS RPC
+(the FlowTransport-analog), running a contended read-modify-write load
+end-to-end with verdict, durability, and visibility semantics checked.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from foundationdb_tpu.cluster import multiprocess as mp
+from foundationdb_tpu.models.types import CommitTransaction
+from foundationdb_tpu.wire import transport
+from foundationdb_tpu.wire.codec import Mutation
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+@pytest.fixture
+def cluster_procs(tmp_path):
+    procs = [
+        mp.spawn_role("resolver", str(tmp_path)),
+        mp.spawn_role("tlog", str(tmp_path)),
+        mp.spawn_role("storage", str(tmp_path)),
+    ]
+    yield procs
+    for p in procs:
+        p.stop()
+
+
+def test_three_process_pipeline(cluster_procs):
+    resolver_p, tlog_p, storage_p = cluster_procs
+
+    async def scenario():
+        resolver = await mp.connect(resolver_p.address)
+        tlog = await mp.connect(tlog_p.address)
+        storage = await mp.connect(storage_p.address)
+        pipe = mp.ProxyPipeline([resolver], tlog, storage)
+        pipe.start()
+
+        # --- disjoint writes commit; stale read conflicts ---------------
+        v1 = await pipe.commit(
+            CommitTransaction(
+                write_conflict_ranges=[(b"a", b"a\x00")],
+                mutations=[Mutation(0, b"a", b"1")],
+            )
+        )
+        assert v1 > 0
+        # visibility: read-at-commit-version sees the write
+        assert await pipe.read(b"a", v1) == b"1"
+
+        rv = await pipe.get_read_version()
+        assert rv >= v1
+
+        # a second writer on the same key at a stale snapshot conflicts
+        with pytest.raises(mp.NotCommittedError):
+            await pipe.commit(
+                CommitTransaction(
+                    read_conflict_ranges=[(b"a", b"a\x00")],
+                    write_conflict_ranges=[(b"a", b"a\x00")],
+                    read_snapshot=0,  # before v1
+                    mutations=[Mutation(0, b"a", b"2")],
+                )
+            )
+        # at a current snapshot it commits
+        v2 = await pipe.commit(
+            CommitTransaction(
+                read_conflict_ranges=[(b"a", b"a\x00")],
+                write_conflict_ranges=[(b"a", b"a\x00")],
+                read_snapshot=await pipe.get_read_version(),
+                mutations=[Mutation(0, b"a", b"2")],
+            )
+        )
+        assert v2 > v1
+        assert await pipe.read(b"a", v2) == b"2"
+        assert await pipe.read(b"a", v1) == b"1"  # MVCC: old version intact
+
+        await pipe.stop()
+        for c in (resolver, tlog, storage):
+            await c.close()
+
+    run(scenario())
+
+
+def test_contended_counter_workload(cluster_procs):
+    """YCSB-A-flavored: concurrent read-modify-writes on a small hot set;
+    committed increments must equal the final counter values exactly."""
+    resolver_p, tlog_p, storage_p = cluster_procs
+    n_clients, n_ops, n_keys = 8, 15, 4
+
+    async def scenario():
+        resolver = await mp.connect(resolver_p.address)
+        tlog = await mp.connect(tlog_p.address)
+        storage = await mp.connect(storage_p.address)
+        pipe = mp.ProxyPipeline([resolver], tlog, storage,
+                                batch_interval=0.001)
+        pipe.start()
+        committed = [0] * n_keys
+
+        async def client(cid: int):
+            for i in range(n_ops):
+                key = b"ctr%d" % ((cid + i) % n_keys)
+                kr = (key, key + b"\x00")
+                rv = await pipe.get_read_version()
+                cur = await pipe.read(key, rv)
+                n = int.from_bytes(cur or b"\0" * 8, "little")
+                try:
+                    await pipe.commit(
+                        CommitTransaction(
+                            read_conflict_ranges=[kr],
+                            write_conflict_ranges=[kr],
+                            read_snapshot=rv,
+                            mutations=[
+                                Mutation(0, key, (n + 1).to_bytes(8, "little"))
+                            ],
+                        )
+                    )
+                    committed[(cid + i) % n_keys] += 1
+                except mp.NotCommittedError:
+                    pass  # optimistic concurrency: retry-less client
+
+        await asyncio.gather(*(client(c) for c in range(n_clients)))
+
+        # consistency: final counters == exactly the committed increments
+        rv = await pipe.get_read_version()
+        snap = await storage.call(
+            mp.TOKEN_STORAGE_SNAPSHOT, mp.StorageSnapshotReq(version=rv)
+        )
+        got = {k: int.from_bytes(v, "little") for k, v in snap.kvs}
+        total_committed = sum(committed)
+        assert total_committed > 0, "nothing committed — contention too high?"
+        for i in range(n_keys):
+            key = b"ctr%d" % i
+            assert got.get(key, 0) == committed[i], (
+                f"{key}: storage={got.get(key, 0)} committed={committed[i]}"
+            )
+        # under contention some conflicts must actually have happened for
+        # this test to mean anything
+        assert total_committed < n_clients * n_ops
+
+        await pipe.stop()
+        for c in (resolver, tlog, storage):
+            await c.close()
+
+    run(scenario())
+
+
+def test_multi_resolver_min_combine(tmp_path):
+    """Two resolver processes: the proxy min-combines verdicts
+    (CommitProxyServer.actor.cpp:1551-1567) — a conflict on either
+    resolver aborts the txn."""
+    procs = [
+        mp.spawn_role("resolver", str(tmp_path), index=0),
+        mp.spawn_role("resolver", str(tmp_path), index=1),
+        mp.spawn_role("tlog", str(tmp_path)),
+        mp.spawn_role("storage", str(tmp_path)),
+    ]
+    try:
+        async def scenario():
+            r0 = await mp.connect(procs[0].address)
+            r1 = await mp.connect(procs[1].address)
+            tlog = await mp.connect(procs[2].address)
+            storage = await mp.connect(procs[3].address)
+            pipe = mp.ProxyPipeline([r0, r1], tlog, storage)
+            pipe.start()
+            v1 = await pipe.commit(
+                CommitTransaction(
+                    write_conflict_ranges=[(b"k", b"k\x00")],
+                    mutations=[Mutation(0, b"k", b"v")],
+                )
+            )
+            with pytest.raises(mp.NotCommittedError):
+                await pipe.commit(
+                    CommitTransaction(
+                        read_conflict_ranges=[(b"k", b"k\x00")],
+                        read_snapshot=0,
+                    )
+                )
+            assert await pipe.read(b"k", v1) == b"v"
+            await pipe.stop()
+            for c in (r0, r1, tlog, storage):
+                await c.close()
+
+        run(scenario())
+    finally:
+        for p in procs:
+            p.stop()

@@ -1,0 +1,218 @@
+"""Serialized wire: codec roundtrips, frame integrity, RPC transport.
+
+The cross-process seam (wire/codec.py + wire/transport.py — FlowTransport
+discipline: protocol-version handshake, CRC32 frames, token-addressed
+delivery, fdbrpc/FlowTransport.actor.cpp:427,1022,1119-1142)."""
+
+import asyncio
+import struct
+import zlib
+
+import pytest
+
+from foundationdb_tpu.models.types import (
+    CommitTransaction,
+    ResolveTransactionBatchReply,
+    ResolveTransactionBatchRequest,
+    TransactionResult,
+)
+from foundationdb_tpu.wire import codec, transport
+from foundationdb_tpu.wire.codec import Mutation
+
+
+def roundtrip(msg):
+    return codec.decode(codec.encode(msg))
+
+
+def test_codec_commit_transaction_roundtrip():
+    t = CommitTransaction(
+        read_conflict_ranges=[(b"a", b"b"), (b"k\x00", b"k\x01")],
+        write_conflict_ranges=[(b"x", b"y")],
+        read_snapshot=123456789,
+        report_conflicting_keys=True,
+        mutations=[Mutation(0, b"key", b"value"), Mutation(1, b"a", b"z")],
+    )
+    got = roundtrip(t)
+    assert got.read_conflict_ranges == t.read_conflict_ranges
+    assert got.write_conflict_ranges == t.write_conflict_ranges
+    assert got.read_snapshot == t.read_snapshot
+    assert got.report_conflicting_keys is True
+    assert got.mutations == t.mutations
+
+
+def test_codec_resolve_request_roundtrip():
+    req = ResolveTransactionBatchRequest(
+        prev_version=-1,
+        version=1000,
+        last_received_version=-1,
+        transactions=[
+            CommitTransaction(
+                read_conflict_ranges=[(b"a", b"c")], read_snapshot=5
+            ),
+            CommitTransaction(write_conflict_ranges=[(b"d", b"e")]),
+        ],
+        txn_state_transactions=[1],
+        proxy_id="proxy0",
+        debug_id=None,
+    )
+    got = roundtrip(req)
+    assert got.version == 1000 and got.prev_version == -1
+    assert len(got.transactions) == 2
+    assert got.transactions[0].read_conflict_ranges == [(b"a", b"c")]
+    assert got.txn_state_transactions == [1]
+    assert got.proxy_id == "proxy0" and got.debug_id is None
+
+
+def test_codec_resolve_reply_roundtrip():
+    rep = ResolveTransactionBatchReply(
+        committed=[TransactionResult.COMMITTED, TransactionResult.CONFLICT],
+        conflicting_key_range_map={1: [0, 2]},
+        state_mutations=[(500, [Mutation(0, b"\xff/k", b"v")])],
+        debug_id="d1",
+    )
+    got = roundtrip(rep)
+    assert got.committed == rep.committed
+    assert got.conflicting_key_range_map == {1: [0, 2]}
+    assert got.state_mutations[0][0] == 500
+    assert got.state_mutations[0][1] == [Mutation(0, b"\xff/k", b"v")]
+
+
+def test_codec_rejects_garbage():
+    with pytest.raises(codec.CodecError):
+        codec.decode(b"\xff\xff rest")
+    with pytest.raises(codec.CodecError):
+        codec.decode(b"\x01")
+    # trailing junk after a valid message
+    good = codec.encode(CommitTransaction())
+    with pytest.raises(codec.CodecError):
+        codec.decode(good + b"junk")
+    # truncation anywhere in a valid message
+    req = codec.encode(
+        ResolveTransactionBatchRequest(
+            prev_version=0, version=1, last_received_version=0,
+            transactions=[CommitTransaction(read_conflict_ranges=[(b"a", b"b")])],
+        )
+    )
+    with pytest.raises(codec.CodecError):
+        codec.decode(req[: len(req) // 2])
+
+
+# ---------------------------------------------------------------------------
+# Transport.
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+@pytest.fixture
+def sock(tmp_path):
+    return str(tmp_path / "role.sock")
+
+
+def test_rpc_echo_and_concurrency(sock):
+    from foundationdb_tpu.cluster.multiprocess import (
+        TOKEN_PING,
+        Ping,
+        Pong,
+    )
+
+    async def scenario():
+        server = transport.RpcServer(sock)
+
+        async def ping(msg):
+            await asyncio.sleep(0.01 if msg.payload == b"slow" else 0)
+            return Pong(payload=msg.payload)
+
+        server.register(TOKEN_PING, ping)
+        await server.start()
+        conn = transport.RpcConnection(sock)
+        await conn.connect()
+        # concurrent requests over one connection correlate correctly
+        slow = conn.call(TOKEN_PING, Ping(payload=b"slow"))
+        fast = conn.call(TOKEN_PING, Ping(payload=b"fast"))
+        rs, rf = await asyncio.gather(slow, fast)
+        assert rs.payload == b"slow" and rf.payload == b"fast"
+        await conn.close()
+        await server.close()
+
+    run(scenario())
+
+
+def test_rpc_unknown_token_and_handler_error(sock):
+    from foundationdb_tpu.cluster.multiprocess import TOKEN_PING, Ping
+
+    async def scenario():
+        server = transport.RpcServer(sock)
+
+        async def boom(msg):
+            raise ValueError("kaboom")
+
+        server.register(TOKEN_PING, boom)
+        await server.start()
+        conn = transport.RpcConnection(sock)
+        await conn.connect()
+        with pytest.raises(transport.RemoteError, match="kaboom"):
+            await conn.call(TOKEN_PING, Ping(payload=b"x"))
+        with pytest.raises(transport.RemoteError):
+            await conn.call(0xDEAD, Ping(payload=b"x"))
+        await conn.close()
+        await server.close()
+
+    run(scenario())
+
+
+def test_handshake_version_mismatch(sock):
+    async def scenario():
+        server = transport.RpcServer(sock)
+        await server.start()
+        reader, writer = await asyncio.open_unix_connection(path=sock)
+        writer.write(transport.MAGIC + struct.pack("<Q", 0xBAD))
+        await writer.drain()
+        # server sends its handshake then closes on our bad version
+        data = await reader.read(1024)
+        assert data.startswith(transport.MAGIC)
+        more = await reader.read(1024)
+        assert more == b""  # closed
+        writer.close()
+        await server.close()
+
+    run(scenario())
+
+
+def test_corrupt_frame_rejected(sock):
+    from foundationdb_tpu.cluster.multiprocess import TOKEN_PING, Ping, Pong
+
+    async def scenario():
+        server = transport.RpcServer(sock)
+
+        async def ping(msg):
+            return Pong(payload=msg.payload)
+
+        server.register(TOKEN_PING, ping)
+        await server.start()
+        reader, writer = await asyncio.open_unix_connection(path=sock)
+        writer.write(
+            transport.MAGIC + struct.pack("<Q", codec.PROTOCOL_VERSION)
+        )
+        await writer.drain()
+        await reader.readexactly(len(transport.MAGIC) + 8)
+        body = (
+            transport._REQ.pack(transport.KIND_REQUEST, 1, TOKEN_PING)
+            + codec.encode(Ping(payload=b"x"))
+        )
+        # flip a payload bit but keep the stated crc of the original body
+        bad = bytearray(body)
+        bad[-1] ^= 0x40
+        writer.write(
+            transport._HDR.pack(len(bad), zlib.crc32(body) & 0xFFFFFFFF)
+        )
+        writer.write(bytes(bad))
+        await writer.drain()
+        # server must drop the connection, never answer
+        data = await reader.read(1024)
+        assert data == b""
+        writer.close()
+        await server.close()
+
+    run(scenario())
