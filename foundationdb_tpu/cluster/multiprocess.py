@@ -155,6 +155,52 @@ TLogPeek = _message(0x0212, "TLogPeek", [("after_version", "i64")])
 TLogPeekReply = _message(
     0x0213, "TLogPeekReply", [("version", "i64"), ("mutations", "mutlist")]
 )
+
+
+def _w_i64list(out, vs):
+    codec.w_u32(out, len(vs))
+    for v in vs:
+        codec.w_i64(out, v)
+
+
+def _r_i64list(buf, off):
+    n, off = codec.r_u32(buf, off)
+    vs = []
+    for _ in range(n):
+        v, off = codec.r_i64(buf, off)
+        vs.append(v)
+    return vs, off
+
+
+def _w_mutgroups(out, gs):
+    codec.w_u32(out, len(gs))
+    for g in gs:
+        _w_mutlist(out, g)
+
+
+def _r_mutgroups(buf, off):
+    n, off = codec.r_u32(buf, off)
+    gs = []
+    for _ in range(n):
+        g, off = _r_mutlist(buf, off)
+        gs.append(g)
+    return gs, off
+
+
+_WRITERS["i64list"] = _w_i64list
+_READERS["i64list"] = _r_i64list
+_WRITERS["mutgroups"] = _w_mutgroups
+_READERS["mutgroups"] = _r_mutgroups
+
+TLogPeekBatchReq = _message(
+    0x0214, "TLogPeekBatchReq",
+    [("after_version", "i64"), ("max_entries", "u32")],
+)
+TLogPeekBatchReply = _message(
+    0x0215, "TLogPeekBatchReply",
+    [("versions", "i64list"), ("groups", "mutgroups")],
+)
+TOKEN_TLOG_PEEK_BATCH = 0x0204
 StorageApply = _message(
     0x0220, "StorageApply", [("version", "i64"), ("mutations", "mutlist")]
 )
@@ -171,6 +217,12 @@ StorageSnapshotReq = _message(
 StorageSnapshotReply = _message(
     0x0225, "StorageSnapshotReply", [("version", "i64"), ("kvs", "kvlist")]
 )
+RoleVersionReq = _message(0x0230, "RoleVersionReq", [("pad", "u8")])
+RoleVersionReply = _message(0x0231, "RoleVersionReply", [("version", "i64")])
+
+TOKEN_TLOG_VERSION = 0x0203
+TOKEN_STORAGE_VERSION = 0x0304
+TOKEN_RESOLVER_VERSION = 0x0102
 
 
 # ---------------------------------------------------------------------------
@@ -263,29 +315,77 @@ class ResolverRole:
 
 
 class TLogRole:
-    """Wire-served transaction log: version-ordered append + peek."""
+    """Wire-served transaction log: version-ordered append + peek.
 
-    def __init__(self):
+    With a data dir, every push rides the native DiskQueue
+    (native/diskqueue.cpp — the fdbserver/DiskQueue.actor.cpp role):
+    frames are fsynced BEFORE the push is acked (tLogCommit discipline,
+    TLogServer.actor.cpp:2311), and a restart recovers exactly the acked
+    entries via the crc-checked recovery scan.
+    """
+
+    def __init__(self, data_dir: str | None = None):
         self.entries: list[tuple[int, list]] = []  # (version, mutations)
         self.version = -1
+        self._dq = None
+        if data_dir:
+            from foundationdb_tpu.native import DiskQueue
+
+            os.makedirs(data_dir, exist_ok=True)
+            self._dq = DiskQueue(os.path.join(data_dir, "tlog"))
+            for _seq, blob in self._dq.recovered:
+                rec = codec.decode(blob)
+                self.entries.append((rec.version, list(rec.mutations)))
+                self.version = max(self.version, rec.version)
 
     async def push(self, req: TLogPush) -> TLogPushReply:
         if req.version <= self.version:
             # duplicate push: idempotent ack (proxy retry after lost reply)
             return TLogPushReply(durable_version=self.version)
-        if req.prev_version > self.version:
-            raise transport.RemoteError(
-                f"tlog gap: prev {req.prev_version} > current {self.version}"
-            )
+        # Forward version skips are legal: the proxy serializes pushes and
+        # versions are consumed by failed batches and by recovery (a batch
+        # resolved but lost in a crash window leaves prev_version above
+        # our recovered version — the reference's recovery likewise
+        # restarts the chain above lastEpochEnd). Only regressions are
+        # rejected (the <= check above).
+        if self._dq is not None:
+            self._dq.push(codec.encode(req))
+            if self._dq.commit() is None:
+                # fsync/pwrite failed: the data is NOT durable — refuse
+                # the ack rather than lie (tLogCommit discipline)
+                raise transport.RemoteError("tlog disk commit failed")
         self.entries.append((req.version, list(req.mutations)))
         self.version = req.version
         return TLogPushReply(durable_version=self.version)
 
     async def peek(self, req: TLogPeek) -> TLogPeekReply:
-        for v, muts in self.entries:
-            if v > req.after_version:
-                return TLogPeekReply(version=v, mutations=muts)
+        i = self._first_after(req.after_version)
+        if i < len(self.entries):
+            v, muts = self.entries[i]
+            return TLogPeekReply(version=v, mutations=muts)
         return TLogPeekReply(version=-1, mutations=[])
+
+    async def peek_batch(self, req: "TLogPeekBatchReq") -> "TLogPeekBatchReply":
+        """Batched tail read for storage catch-up: all entries above
+        after_version, bounded by max_entries (linear restart, not the
+        one-RPC-per-version quadratic walk)."""
+        i = self._first_after(req.after_version)
+        chunk = self.entries[i : i + req.max_entries]
+        return TLogPeekBatchReply(
+            versions=[v for v, _m in chunk],
+            groups=[m for _v, m in chunk],
+        )
+
+    def _first_after(self, after_version: int) -> int:
+        """Binary search: entries are version-ascending by construction."""
+        import bisect
+
+        return bisect.bisect_right(
+            self.entries, after_version, key=lambda e: e[0]
+        )
+
+    async def get_version(self, req: RoleVersionReq) -> RoleVersionReply:
+        return RoleVersionReply(version=self.version)
 
 
 class StorageRole:
@@ -294,13 +394,84 @@ class StorageRole:
     MUT_SET = 0
     MUT_CLEAR_RANGE = 1
 
-    def __init__(self):
+    #: checkpoint every N applied versions when persistent
+    CHECKPOINT_INTERVAL = 8
+
+    def __init__(self, data_dir: str | None = None):
         # key -> list[(version, value|None)] ascending
         self.history: dict[bytes, list[tuple[int, Optional[bytes]]]] = {}
         # the empty store is readable at version 0 (a GRV before any commit
         # must not block behind the first apply)
         self.version = 0
         self._cond: asyncio.Condition | None = None
+        self._data_dir = data_dir
+        self._applies_since_ckpt = 0
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            self._load_checkpoint()
+
+    # -- durable-version checkpointing (storageserver durableVersion
+    # discipline: persist at a version, replay the tlog tail on restart) --
+
+    def _ckpt_path(self) -> str:
+        return os.path.join(self._data_dir, "storage.ckpt")
+
+    def _serialize_checkpoint(self) -> bytes:
+        out: list = []
+        codec.w_i64(out, self.version)
+        kvs = []
+        for k, hist in self.history.items():
+            value = None
+            for v, val in hist:
+                if v <= self.version:
+                    value = val
+            if value is not None:
+                kvs.append((k, value))
+        _w_kvlist(out, kvs)
+        return b"".join(out)
+
+    def _write_checkpoint_blob(self, blob: bytes) -> None:
+        tmp = self._ckpt_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._ckpt_path())  # atomic install
+
+    def _checkpoint(self) -> None:
+        self._write_checkpoint_blob(self._serialize_checkpoint())
+
+    def _load_checkpoint(self) -> None:
+        try:
+            with open(self._ckpt_path(), "rb") as f:
+                blob = memoryview(f.read())
+        except FileNotFoundError:
+            return
+        version, off = codec.r_i64(blob, 0)
+        kvs, _off = _r_kvlist(blob, off)
+        self.version = version
+        self.history = {k: [(version, v)] for k, v in kvs}
+
+    async def catch_up_from_tlog(self, tlog_address: str) -> None:
+        """Replay the tlog tail above our durable version (the restart
+        path of storageserver.actor.cpp:9117's pull loop) in batched
+        chunks — linear in tail length."""
+        conn = transport.RpcConnection(tlog_address)
+        await conn.connect()
+        try:
+            while True:
+                rep = await conn.call(
+                    TOKEN_TLOG_PEEK_BATCH,
+                    TLogPeekBatchReq(
+                        after_version=self.version, max_entries=256
+                    ),
+                )
+                if not rep.versions:
+                    break
+                for v, muts in zip(rep.versions, rep.groups):
+                    await self.apply(StorageApply(version=v, mutations=muts))
+        finally:
+            await conn.close()
 
     def _cond_lazy(self) -> asyncio.Condition:
         if self._cond is None:
@@ -321,8 +492,22 @@ class StorageRole:
                             if m.param1 <= k < m.param2:
                                 self.history[k].append((req.version, None))
                 self.version = req.version
+                if self._data_dir:
+                    self._applies_since_ckpt += 1
+                    if self._applies_since_ckpt >= self.CHECKPOINT_INTERVAL:
+                        self._applies_since_ckpt = 0
+                        # serialize under the lock (consistent view), but
+                        # keep the fsync off the event loop so concurrent
+                        # reads don't stall behind disk
+                        blob = self._serialize_checkpoint()
+                        await asyncio.get_event_loop().run_in_executor(
+                            None, self._write_checkpoint_blob, blob
+                        )
                 cond.notify_all()
             return StorageApplyReply(durable_version=self.version)
+
+    async def get_version(self, req: RoleVersionReq) -> RoleVersionReply:
+        return RoleVersionReply(version=self.version)
 
     async def get(self, req: StorageGet) -> StorageGetReply:
         cond = self._cond_lazy()
@@ -352,7 +537,13 @@ class StorageRole:
         return StorageSnapshotReply(version=self.version, kvs=kvs)
 
 
-async def _serve_role(role_name: str, address, backend: str) -> None:
+async def _serve_role(
+    role_name: str,
+    address,
+    backend: str,
+    data_dir: str | None = None,
+    tlog_address: str | None = None,
+) -> None:
     server = transport.RpcServer(address)
 
     async def ping(msg: Ping) -> Pong:
@@ -362,15 +553,25 @@ async def _serve_role(role_name: str, address, backend: str) -> None:
     if role_name == "resolver":
         role = ResolverRole(backend=backend)
         server.register(TOKEN_RESOLVE, role.resolve)
+
+        async def rv(req: RoleVersionReq) -> RoleVersionReply:
+            return RoleVersionReply(version=role.version)
+
+        server.register(TOKEN_RESOLVER_VERSION, rv)
     elif role_name == "tlog":
-        role = TLogRole()
+        role = TLogRole(data_dir=data_dir)
         server.register(TOKEN_TLOG_PUSH, role.push)
         server.register(TOKEN_TLOG_PEEK, role.peek)
+        server.register(TOKEN_TLOG_PEEK_BATCH, role.peek_batch)
+        server.register(TOKEN_TLOG_VERSION, role.get_version)
     elif role_name == "storage":
-        role = StorageRole()
+        role = StorageRole(data_dir=data_dir)
+        if tlog_address:
+            await role.catch_up_from_tlog(tlog_address)
         server.register(TOKEN_STORAGE_APPLY, role.apply)
         server.register(TOKEN_STORAGE_GET, role.get)
         server.register(TOKEN_STORAGE_SNAPSHOT, role.snapshot)
+        server.register(TOKEN_STORAGE_VERSION, role.get_version)
     else:
         raise ValueError(f"unknown role {role_name!r}")
     await server.start()
@@ -398,7 +599,13 @@ class RoleProcess:
 
 
 def spawn_role(
-    name: str, socket_dir: str, *, backend: str = "native", index: int = 0
+    name: str,
+    socket_dir: str,
+    *,
+    backend: str = "native",
+    index: int = 0,
+    data_dir: str | None = None,
+    tlog_address: str | None = None,
 ) -> RoleProcess:
     """Start one role as a child OS process serving a UDS in socket_dir.
 
@@ -417,20 +624,22 @@ def spawn_role(
         # tpu children keep their platform env (the tunnel sitecustomize
         # stays on PYTHONPATH) but still need the package importable
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    proc = subprocess.Popen(
-        [
-            sys.executable,
-            "-m",
-            "foundationdb_tpu.cluster.multiprocess",
-            "--role",
-            name,
-            "--address",
-            address,
-            "--backend",
-            backend,
-        ],
-        env=env,
-    )
+    cmd = [
+        sys.executable,
+        "-m",
+        "foundationdb_tpu.cluster.multiprocess",
+        "--role",
+        name,
+        "--address",
+        address,
+        "--backend",
+        backend,
+    ]
+    if data_dir:
+        cmd += ["--data-dir", data_dir]
+    if tlog_address:
+        cmd += ["--tlog-address", tlog_address]
+    proc = subprocess.Popen(cmd, env=env)
     return RoleProcess(name=name, address=address, proc=proc)
 
 
@@ -462,6 +671,7 @@ class ProxyPipeline:
         version_step: int = 1000,
         batch_interval: float = 0.002,
         max_batch: int = 512,
+        start_version: int = 0,
     ):
         self.resolvers = resolvers
         self.tlog = tlog
@@ -469,9 +679,12 @@ class ProxyPipeline:
         self.version_step = version_step
         self.batch_interval = batch_interval
         self.max_batch = max_batch
-        self.committed_version = 0
-        self.prev_version = -1
-        self._last_allocated = 0
+        # a recovering proxy passes start_version = max(tlog version,
+        # resolver version) so allocation resumes strictly above anything
+        # any role has seen (the reference's recovery version semantics)
+        self.committed_version = start_version
+        self.prev_version = -1 if start_version == 0 else start_version
+        self._last_allocated = start_version
         self._queue: list[tuple[CommitTransaction, asyncio.Future]] = []
         self._batcher_task: asyncio.Task | None = None
         self._commit_lock = asyncio.Lock()
@@ -596,8 +809,18 @@ def main() -> None:
     ap.add_argument("--role", required=True)
     ap.add_argument("--address", required=True)
     ap.add_argument("--backend", default="native")
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--tlog-address", default=None)
     args = ap.parse_args()
-    asyncio.run(_serve_role(args.role, args.address, args.backend))
+    asyncio.run(
+        _serve_role(
+            args.role,
+            args.address,
+            args.backend,
+            data_dir=args.data_dir,
+            tlog_address=args.tlog_address,
+        )
+    )
 
 
 if __name__ == "__main__":

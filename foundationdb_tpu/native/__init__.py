@@ -217,3 +217,109 @@ class NativeSkipListConflictSet(NativeConflictSet):
         self._resolve = self._lib.slcs_resolve
         self._size = self._lib.slcs_history_size
         self._cs = self._create(window)
+
+
+# ---------------------------------------------------------------------------
+# DiskQueue (diskqueue.cpp): the TLog's durable log — push/commit(fsync)/
+# pop + crash-recovery scan (role of fdbserver/DiskQueue.actor.cpp).
+
+_dq_lib = None
+
+
+def load_diskqueue() -> ctypes.CDLL:
+    global _dq_lib
+    with _lock:
+        if _dq_lib is not None:
+            return _dq_lib
+        lib = ctypes.CDLL(
+            build_shared(os.path.join(_DIR, "diskqueue.cpp"), "libdiskqueue")
+        )
+        lib.dq_open.restype = ctypes.c_void_p
+        lib.dq_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                ctypes.c_uint64]
+        lib.dq_close.argtypes = [ctypes.c_void_p]
+        lib.dq_push.restype = ctypes.c_uint64
+        lib.dq_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_uint32]
+        lib.dq_pop.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.dq_commit.restype = ctypes.c_uint64
+        lib.dq_commit.argtypes = [ctypes.c_void_p]
+        lib.dq_ok.restype = ctypes.c_int
+        lib.dq_ok.argtypes = [ctypes.c_void_p]
+        lib.dq_next_seq.restype = ctypes.c_uint64
+        lib.dq_next_seq.argtypes = [ctypes.c_void_p]
+        lib.dq_pop_floor.restype = ctypes.c_uint64
+        lib.dq_pop_floor.argtypes = [ctypes.c_void_p]
+        lib.dq_recovered_count.restype = ctypes.c_int64
+        lib.dq_recovered_count.argtypes = [ctypes.c_void_p]
+        lib.dq_recovered_get.restype = ctypes.c_int64
+        lib.dq_recovered_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64),
+        ]
+        _dq_lib = lib
+        return lib
+
+
+class DiskQueue:
+    """Durable append log over a file pair with recovery scan.
+
+    Contract (DiskQueue.actor.cpp): push() buffers, commit() makes
+    everything pushed durable (fsync) — ack callers only after commit;
+    pop(seq) lets the queue discard records below seq; after a crash,
+    `recovered` holds exactly the committed, un-popped records in order.
+    """
+
+    def __init__(self, path_prefix: str, *, rotate_bytes: int = 64 << 20):
+        lib = load_diskqueue()
+        self._lib = lib
+        self._q = lib.dq_open(
+            (path_prefix + "-0.dq").encode(), (path_prefix + "-1.dq").encode(),
+            rotate_bytes,
+        )
+        if not self._q:
+            raise NativeBuildError(f"dq_open failed for {path_prefix}")
+
+    def close(self) -> None:
+        if self._q:
+            self._lib.dq_close(self._q)
+            self._q = None
+
+    def __del__(self):
+        self.close()
+
+    def push(self, data: bytes) -> int:
+        return self._lib.dq_push(self._q, data, len(data))
+
+    def pop(self, up_to_seq: int) -> None:
+        self._lib.dq_pop(self._q, up_to_seq)
+
+    def commit(self):
+        """fsync everything pushed. Returns the last durable seq, or
+        None if the disk write/fsync FAILED — callers must not ack."""
+        r = self._lib.dq_commit(self._q)
+        if not self._lib.dq_ok(self._q):
+            return None
+        return r
+
+    @property
+    def next_seq(self) -> int:
+        return self._lib.dq_next_seq(self._q)
+
+    @property
+    def pop_floor(self) -> int:
+        return self._lib.dq_pop_floor(self._q)
+
+    @property
+    def recovered(self) -> list[tuple[int, bytes]]:
+        n = self._lib.dq_recovered_count(self._q)
+        out = []
+        seq = ctypes.c_uint64()
+        for i in range(n):
+            ln = self._lib.dq_recovered_get(self._q, i, None, 0,
+                                            ctypes.byref(seq))
+            buf = ctypes.create_string_buffer(max(ln, 1))
+            self._lib.dq_recovered_get(self._q, i, buf, ln,
+                                       ctypes.byref(seq))
+            out.append((seq.value, buf.raw[:ln]))
+        return out
