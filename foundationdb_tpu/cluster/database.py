@@ -39,6 +39,9 @@ class ClusterConfig:
     replication_factor: int = 1
     # transaction log replicas (LogSystem); 1 = single log
     n_tlogs: int = 1
+    # coordination quorum size (CoordinatedState/LeaderElection); recovery
+    # requires a majority of these alive
+    n_coordinators: int = 3
     # When set, role-to-role calls go through a SimNetwork with this seed
     # (deterministic latency; clogging/partition fault injection).
     sim_seed: int = None
@@ -123,6 +126,12 @@ class Cluster:
 
             self.net = SimNetwork(sched, seed=cfg.sim_seed)
 
+        from foundationdb_tpu.cluster.coordination import Coordinator
+
+        self.coordinators = [
+            Coordinator(f"coord{i}") for i in range(cfg.n_coordinators)
+        ]
+
         self.build_proxies(epoch=1)
         from foundationdb_tpu.cluster.balancer import ResolutionBalancer
         from foundationdb_tpu.cluster.ratekeeper import Ratekeeper
@@ -199,6 +208,12 @@ class Cluster:
             )
         if self._started:
             new.start()
+
+    def kill_coordinator(self, i: int) -> None:
+        self.coordinators[i].kill()
+
+    def revive_coordinator(self, i: int) -> None:
+        self.coordinators[i].revive()
 
     def kill_tlog(self, i: int) -> None:
         """Mark a log replica dead; commits continue on the survivors."""

@@ -29,6 +29,7 @@ durable); only the stateless roles are rebuilt.
 from __future__ import annotations
 
 from foundationdb_tpu.cluster.commit_proxy import CommitProxy
+from foundationdb_tpu.cluster.coordination import LeaderElection
 from foundationdb_tpu.cluster.grv_proxy import GrvProxy
 from foundationdb_tpu.cluster.sequencer import Sequencer
 from foundationdb_tpu.models.types import ResolveTransactionBatchRequest
@@ -41,13 +42,23 @@ from foundationdb_tpu.utils.trace import TraceEvent
 class ClusterController:
     """Failure watcher + recovery driver (the CC's recovery loop)."""
 
-    def __init__(self, cluster, *, check_interval: float = 0.05):
+    def __init__(self, cluster, *, check_interval: float = 0.05,
+                 cc_id: str = "cc0"):
         self.cluster = cluster
         self.check_interval = check_interval
         self.epoch = 1
         self.counters = CounterCollection("CCMetrics", ["recoveries", "checks"])
         self._task = None
         self._recovering = False
+        # Leadership + epoch locks go through the coordination quorum
+        # (Coordination.actor.cpp / LeaderElection.actor.cpp): recovery is
+        # gated on holding the lease and committing the epoch bump through
+        # a majority of coordinators.
+        self.elector = LeaderElection(
+            cluster.sched, cluster.coordinators, cc_id,
+            lease=50 * check_interval,
+        )
+        self.lease = None
 
     def start(self) -> None:
         self._task = self.cluster.sched.spawn(
@@ -65,6 +76,16 @@ class ClusterController:
                 self.counters.add("checks")
                 if self._recovering:
                     continue
+                # hold (or regain) the leader lease before acting as CC
+                if self.lease is None:
+                    self.lease = await self.elector.try_become_leader()
+                    if self.lease is None:
+                        continue  # quorum down or another leader is live
+                elif self.lease.expires < self.cluster.sched.now() + \
+                        10 * self.check_interval:
+                    self.lease = await self.elector.renew(self.lease)
+                    if self.lease is None:
+                        continue  # deposed; must re-win before recovering
                 if any(p.failed is not None for p in self.cluster.commit_proxies):
                     await self.recover()
         except ActorCancelled:
@@ -76,7 +97,24 @@ class ClusterController:
         try:
             cluster = self.cluster
             sched: Scheduler = cluster.sched
-            self.epoch += 1
+            # 0. Epoch lock through the coordination quorum: commit the
+            #    bumped epoch (riding the leader lease register) through a
+            #    majority BEFORE touching the transaction system. A
+            #    deposed CC fails here and must not recover; a minority of
+            #    dead coordinators does not block this.
+            if self.lease is None:
+                self.lease = await self.elector.try_become_leader()
+            bumped = None
+            if self.lease is not None:
+                bumped = await self.elector.bump_epoch(self.lease)
+            if bumped is None:
+                TraceEvent("RecoveryEpochLockFailed").detail(
+                    "Epoch", self.epoch).log()
+                self.lease = None
+                self._recovering = False
+                return self.epoch
+            self.lease = bumped
+            self.epoch = max(self.epoch + 1, bumped.epoch)
             self.counters.add("recoveries")
             TraceEvent("MasterRecoveryState").detail("Epoch", self.epoch).detail(
                 "StatusCode", "reading_transaction_system_state"
