@@ -72,9 +72,29 @@ def _rebase(state: H.VersionHistory, delta):
     )
 
 
+def _resolve_scan(state, stacked):
+    """Resolve K stacked batches in ONE device program (lax.scan).
+
+    Semantically identical to K sequential resolve_batch calls — the
+    scan carry is the history state, so batch i+1 sees batch i's merged
+    writes. One dispatch instead of K: through this environment's device
+    tunnel a dispatch costs ~30ms, a third of the kernel itself
+    (scripts/profile_serialized.py), and a loaded resolver coalescing
+    its queue is exactly how the reference behaves under backpressure
+    (fdbserver/Resolver.actor.cpp resolveBatch queueing).
+    """
+
+    def body(st, batch):
+        st2, out = C.resolve_batch(st, batch)
+        return st2, out
+
+    return jax.lax.scan(body, state, stacked)
+
+
 # Module-level jitted kernels: shared across all TpuConflictSet instances
 # so N resolvers with the same KernelConfig compile once, not N times.
 _RESOLVE = jax.jit(C.resolve_batch, donate_argnums=0)
+_RESOLVE_SCAN = jax.jit(_resolve_scan, donate_argnums=0)
 _REBASE = jax.jit(_rebase, donate_argnums=0)
 
 #: Overflow is checked host-side every this many batches (each check
@@ -137,6 +157,19 @@ class TpuConflictSet:
         self.state, out = self._resolve(self.state, args)
         self._maybe_check_overflow()
         return out
+
+    def resolve_args_scan(self, stacked_args) -> C.BatchVerdict:
+        """Resolve K batches stacked on a leading axis in one dispatch.
+
+        stacked_args: a device_args tree whose leaves carry a leading
+        [K] axis. Returns a BatchVerdict with [K, ...] leaves, in batch
+        order. State chains across the K batches inside the program.
+        """
+        self.state, outs = _RESOLVE_SCAN(self.state, stacked_args)
+        self._batches_since_check += int(
+            outs.verdict.shape[0]) - 1
+        self._maybe_check_overflow()
+        return outs
 
     def _maybe_check_overflow(self) -> None:
         self._batches_since_check += 1

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Gather/scatter/sort primitive costs on this TPU, serialized-in-jit.
+
+The conflict kernel is gather/scatter/sort bound (profile_serialized):
+rangemax.query pays ~110ns per gathered element. This measures whether
+that is the hardware floor or a formulation artifact: flat vs 2D gathers,
+table sizes, sorted indices, scatter variants, and sort operand scaling.
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from foundationdb_tpu.utils import compile_cache
+
+compile_cache.enable()
+
+REPS = 8
+Q = 1 << 17   # 128K queries
+M = 786_432   # main size
+L = 21
+
+
+def timeit(name, fn, *args):
+    f = jax.jit(fn)
+    t0 = time.perf_counter()
+    out = f(*args)
+    jax.block_until_ready(out)
+    c = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = f(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / REPS
+    per_el = dt / Q * 1e9
+    print(f"{name:46s} {dt * 1e3:8.2f} ms/iter  ({per_el:6.1f} ns/el)"
+          f"  (compile {c:4.1f}s)", flush=True)
+
+
+def chain_gather(getter):
+    def fn(x, idx):
+        def body(i, carry):
+            idx_, acc = carry
+            v = getter(x, idx_)
+            return (idx_ + (v & 1)) % x.shape[-1], acc + jnp.sum(v)
+        return jax.lax.fori_loop(0, REPS, body, (idx, jnp.int32(0)))[1]
+    return fn
+
+
+def main():
+    print("device:", jax.devices()[0], flush=True)
+    rng = np.random.default_rng(0)
+    flat = jnp.asarray(rng.integers(0, 100, size=L * M), jnp.int32)
+    tab2d = flat.reshape(L, M)
+    small = jnp.asarray(rng.integers(0, 100, size=4096), jnp.int32)
+    idx_flat = jnp.asarray(rng.integers(0, L * M, size=Q), jnp.int32)
+    idx_m = jnp.asarray(rng.integers(0, M, size=Q), jnp.int32)
+    idx_sorted = jnp.sort(idx_m)
+    idx_small = jnp.asarray(rng.integers(0, 4096, size=Q), jnp.int32)
+    k_idx = jnp.asarray(rng.integers(0, L, size=Q), jnp.int32)
+
+    timeit("1D gather 128K from 16.5M", chain_gather(lambda x, i: x[i]),
+           flat, idx_flat)
+    timeit("1D gather 128K from 786K", chain_gather(lambda x, i: x[i]),
+           flat[:M], idx_m)
+    timeit("1D gather 128K from 786K (sorted idx)",
+           chain_gather(lambda x, i: x[i]), flat[:M], idx_sorted)
+    timeit("1D gather 128K from 4K", chain_gather(lambda x, i: x[i]),
+           small, idx_small)
+    timeit("take_along_axis 128K from 786K",
+           chain_gather(lambda x, i: jnp.take_along_axis(x, i, 0)),
+           flat[:M], idx_m)
+
+    def g2d(x, i):
+        return tab2d[k_idx, i % M]
+    timeit("2D gather [k,a] 128K from [21,786K]", chain_gather(g2d),
+           flat[:M], idx_m)
+
+    def gflat_emul(x, i):
+        return flat[k_idx * M + (i % M)]
+    timeit("flattened k*M+a 128K (2D-as-1D)", chain_gather(gflat_emul),
+           flat[:M], idx_m)
+
+    # row gather: [Q, 3] rows from [786K, 3] (the searchsorted shape)
+    rows = jnp.stack([flat[:M]] * 3, axis=1)
+
+    def grow(x, i):
+        r = rows[i % M]  # [Q, 3]
+        return r[:, 0] + r[:, 1] + r[:, 2]
+    timeit("row gather [Q,3] from [786K,3]", chain_gather(grow),
+           flat[:M], idx_m)
+
+    # scatter variants
+    val = jnp.asarray(rng.integers(0, 1 << 20, size=Q), jnp.int32)
+
+    def scat_min(x, i):
+        t = jnp.full((L * M + 1,), 2**31 - 1, jnp.int32).at[i].min(val)
+        return t[i]
+    timeit("scatter-min 128K into 16.5M (+re-gather)",
+           chain_gather(scat_min), flat, idx_flat)
+
+    def scat_add_small(x, i):
+        t = jnp.zeros((65536,), jnp.int32).at[i % 65536].add(1)
+        return t[i % 65536]
+    timeit("scatter-add 128K into 64K (+re-gather)",
+           chain_gather(scat_add_small), flat, idx_m)
+
+    def one_hot_set(x, i):
+        t = jnp.zeros((Q,), jnp.int32).at[i % Q].set(val)
+        return t
+    timeit("scatter-set 128K into 128K", chain_gather(one_hot_set),
+           flat, idx_m)
+
+    # sort operand scaling at merge shapes
+    n = M + (1 << 17)
+    cols = [jnp.asarray(rng.integers(0, 2**31, size=n), jnp.uint32)
+            for _ in range(6)]
+
+    def sort_k(num_keys, num_ops):
+        def fn(c0):
+            def body(i, c):
+                ops = [c] + cols[1:num_ops]
+                s = jax.lax.sort(ops, num_keys=num_keys)
+                return s[0]
+            return jax.lax.fori_loop(0, REPS, body, c0)
+        return fn
+    for nk, no in ((1, 2), (2, 3), (3, 4), (4, 6)):
+        t0 = time.perf_counter()
+        f = jax.jit(sort_k(nk, no))
+        out = f(cols[0]); jax.block_until_ready(out)
+        c = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = f(cols[0]); jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / REPS
+        print(f"lax.sort 917K: {nk} keys + {no-nk} payloads        "
+              f"{dt*1e3:8.2f} ms/iter  (compile {c:4.1f}s)", flush=True)
+
+    # scan costs
+    big = jnp.asarray(rng.integers(0, 100, size=1 << 20), jnp.int32)
+
+    def cumsum_chain(x):
+        def body(i, c):
+            return jnp.cumsum(c) % 97
+        return jax.lax.fori_loop(0, REPS, body, x)
+    t0 = time.perf_counter()
+    f = jax.jit(cumsum_chain); out = f(big); jax.block_until_ready(out)
+    c = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = f(big); jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / REPS
+    print(f"{'cumsum over 1M':46s} {dt*1e3:8.2f} ms/iter  (compile {c:4.1f}s)",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

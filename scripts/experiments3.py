@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Gather/scatter formulation costs, operand-origin controlled.
+
+experiments2.py showed 3 orders of magnitude between gather variants but
+mixed argument vs closure-captured operands. Here every operand is a
+function argument and every chain carries real data dependencies, so the
+numbers isolate the formulation: 1D vs 2D indices, computed indices,
+computed operands, scatters without poisoned re-gathers.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from foundationdb_tpu.utils import compile_cache
+
+compile_cache.enable()
+
+REPS = 8
+Q = 1 << 17
+M = 786_432
+L = 21
+
+
+def timeit(name, fn, *args):
+    f = jax.jit(fn)
+    t0 = time.perf_counter()
+    out = f(*args)
+    jax.block_until_ready(out)
+    c = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = f(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / REPS
+    print(f"{name:52s} {dt * 1e3:8.2f} ms/iter ({dt / Q * 1e9:6.1f} ns/el)"
+          f" (compile {c:5.1f}s)", flush=True)
+    return out
+
+
+def main():
+    print("device:", jax.devices()[0], flush=True)
+    rng = np.random.default_rng(0)
+    flat = jnp.asarray(rng.integers(1, 100, size=L * M), jnp.int32)
+    tab2d = jnp.asarray(rng.integers(1, 100, size=(L, M)), jnp.int32)
+    a_idx = jnp.asarray(rng.integers(0, M, size=Q), jnp.int32)
+    k_idx = jnp.asarray(rng.integers(0, L, size=Q), jnp.int32)
+    val = jnp.asarray(rng.integers(1, 1 << 20, size=Q), jnp.int32)
+
+    def chain(fn):
+        def run(*args):
+            def body(i, carry):
+                a, acc = carry
+                v = fn(a, *args[1:])
+                return (a + (v & 1)) % args[-1], acc + jnp.sum(v)
+            return jax.lax.fori_loop(
+                0, REPS, body, (args[0], jnp.int32(0)))[1]
+        return run
+
+    # -- gathers ---------------------------------------------------------
+    timeit("g1: x[a] (arg operand, 1D idx)",
+           chain(lambda a, x, m: x[a]), a_idx, flat[:M], jnp.int32(M))
+
+    timeit("g2: (x*2+1)[a] (computed operand)",
+           chain(lambda a, x, m: (x * 2 + 1)[a]), a_idx, flat[:M],
+           jnp.int32(M))
+
+    timeit("g3: t2d[k, a] (arg operand, 2D idx)",
+           chain(lambda a, t, k, m: t[k, a]), a_idx, tab2d, k_idx,
+           jnp.int32(M))
+
+    r3 = jax.jit(lambda t, k, a: t[k, a])(tab2d, k_idx, a_idx)
+
+    timeit("g4: tflat[k*M+a] (arg operand, computed idx)",
+           chain(lambda a, t, k, m: t[k * M + a]), a_idx, flat, k_idx,
+           jnp.int32(M))
+    r4 = jax.jit(lambda t, k, a: t[k * M + a])(
+        tab2d.reshape(-1), k_idx, a_idx)
+    print("   g4 == g3 (flat gather correctness):",
+          bool(jnp.all(r3 == r4)), flush=True)
+
+    # row gather with arg operand
+    rows = jnp.asarray(rng.integers(1, 100, size=(M, 3)), jnp.int32)
+    timeit("g5: rows[a] -> [Q,3] (arg operand)",
+           chain(lambda a, r, m: r[a].sum(axis=1)), a_idx, rows,
+           jnp.int32(M))
+    timeit("g6: 3x col gather r[:,j][a]",
+           chain(lambda a, r, m: r[:, 0][a] + r[:, 1][a] + r[:, 2][a]),
+           a_idx, rows, jnp.int32(M))
+
+    # -- scatters (chain carries the table, not a poisoned re-gather) ----
+    def s1(t, i, v):
+        def body(j, tt):
+            t2 = tt.at[i].min(v + j)
+            return t2
+        return jax.lax.fori_loop(0, REPS, body, t)
+    timeit("s1: at[i].min into 786K (carried table)",
+           lambda t, i, v: s1(t, i, v), jnp.full((M,), 2**30, jnp.int32),
+           a_idx, val)
+
+    def s2(t, i, v):
+        def body(j, tt):
+            return tt.at[i].set(v + j)
+        return jax.lax.fori_loop(0, REPS, body, t)
+    timeit("s2: at[i].set into 786K (carried table)",
+           lambda t, i, v: s2(t, i, v), jnp.zeros((M,), jnp.int32),
+           a_idx, val)
+
+    def s3(t, i, v):
+        def body(j, tt):
+            return tt.at[i].add(1 + (j & 1))
+        return jax.lax.fori_loop(0, REPS, body, t)
+    timeit("s3: at[i].add into 786K (carried table)",
+           lambda t, i, v: s3(t, i, v), jnp.zeros((M,), jnp.int32),
+           a_idx, val)
+
+    # 2D scatter (the segtree/min_cover shape)
+    def s4(t, i, v):
+        def body(j, tt):
+            return tt.at[i % L, i % M].min(v + j)
+        return jax.lax.fori_loop(0, REPS, body, t)
+    timeit("s4: at[k, a].min into [21, 786K] (2D)",
+           lambda t, i, v: s4(t, i, v),
+           jnp.full((L, M), 2**30, jnp.int32), a_idx, val)
+
+
+if __name__ == "__main__":
+    main()

@@ -127,3 +127,38 @@ def test_intra_batch_chain():
         TransactionResult.COMMITTED,
     ]
     assert got.verdicts == want
+
+
+def test_scan_fused_path_matches_sequential(rng):
+    """resolve_args_scan (K batches, one dispatch) must produce exactly
+    the sequential per-batch decisions — the state chains inside the
+    scan."""
+    import numpy as np
+
+    from foundationdb_tpu.config import TEST_CONFIG
+    from foundationdb_tpu.models.conflict_set import TpuConflictSet
+    from foundationdb_tpu.testing.benchgen import skiplist_style_batch
+
+    config = TEST_CONFIG
+    batches = [
+        skiplist_style_batch(
+            rng, config, 48, version=(i + 1) * 100, keyspace=300,
+            key_bytes=4, snapshot_lag=150,
+        )
+        for i in range(6)
+    ]
+    seq = TpuConflictSet(config)
+    seq_verdicts = [
+        np.asarray(seq.resolve_packed(b).verdict) for b in batches
+    ]
+    fused = TpuConflictSet(config)
+    for gi, g in enumerate((batches[:3], batches[3:])):
+        stacked = {
+            k: np.stack([b.device_args()[k] for b in g])
+            for k in g[0].device_args()
+        }
+        outs = fused.resolve_args_scan(stacked)
+        base = gi * 3
+        for j in range(3):
+            got = np.asarray(outs.verdict[j])
+            assert (got == seq_verdicts[base + j]).all(), (base + j)
