@@ -180,16 +180,12 @@ def main():
     # environment's tunnel; a loaded resolver coalesces its queue the
     # same way). Per-batch latency is still reported un-fused (phase 4).
     fuse = max(1, int(os.environ.get("BENCH_FUSE", 4)))
-    import numpy as _np
+    from foundationdb_tpu.utils.packing import stack_device_args
 
-    dev_groups = []
-    for g in range(0, n_batches, fuse):
-        grp = batches[g : g + fuse]
-        stacked = {
-            k: _np.stack([b.device_args()[k] for b in grp])
-            for k in grp[0].device_args()
-        }
-        dev_groups.append(jax.device_put(stacked))
+    dev_groups = [
+        jax.device_put(stack_device_args(batches[g : g + fuse]))
+        for g in range(0, n_batches, fuse)
+    ]
     jax.block_until_ready(dev_groups)
     # warm the scan program for every group shape (the ragged tail group
     # compiles separately) so compilation stays out of the timed window
@@ -213,6 +209,7 @@ def main():
             f"fused-path decision mismatch at batch {i}"
 
     # ---- phase 4: per-batch latency probe -------------------------------
+    del dev_groups, outs  # release phase-3 staging before re-staging
     dev_batches = [jax.device_put(b.device_args()) for b in batches]
     jax.block_until_ready(dev_batches)
     cs3 = TpuConflictSet(config)

@@ -47,7 +47,10 @@ from foundationdb_tpu.runtime.flow import (
 )
 from foundationdb_tpu.utils.metrics import CounterCollection
 
-SYSTEM_PREFIX = b"\xff"
+from foundationdb_tpu.models.types import (  # noqa: F401 (re-export)
+    SYSTEM_PREFIX,
+    is_metadata_mutation as _is_metadata_shared,
+)
 
 
 class NotCommitted(Exception):
@@ -310,18 +313,38 @@ class CommitProxy:
         )
 
         # State mutations from other proxies' prior versions first, then
-        # this batch's own committed metadata mutations.
+        # this batch's own committed metadata mutations. With the
+        # PROXY_USE_RESOLVER_PRIVATE_MUTATIONS knob on, the batch's own
+        # metadata arrives resolver-generated (reply.private_mutations,
+        # Resolver.actor.cpp:372-441) instead of being re-derived here —
+        # the resolver's materialized txnStateStore is authoritative.
         if self.on_state_mutation is not None:
             for group in replies[0].state_mutations:
                 for st in group:
                     if st.committed:
                         for m in st.mutations:
-                            self.on_state_mutation(m)
-            for t, tr in enumerate(txns):
-                if verdicts[t] == TransactionResult.COMMITTED:
-                    for m in tr.mutations:
-                        if _is_metadata(m):
-                            self.on_state_mutation(m)
+                            # a state txn may mix user mutations in; only
+                            # metadata belongs in the txn-state store
+                            if _is_metadata(m):
+                                self.on_state_mutation(m)
+            if replies[0].private_mutations:
+                # resolver-generated candidates, filtered by the GLOBAL
+                # verdict (a locally-committed state txn may be aborted
+                # by another resolver's shard)
+                for t, tr in enumerate(txns):
+                    if verdicts[t] != TransactionResult.COMMITTED:
+                        continue
+                    local = txn_resolver_map[t].get(0)
+                    if local is None:
+                        continue
+                    for m in replies[0].private_mutations.get(local, []):
+                        self.on_state_mutation(m)
+            else:
+                for t, tr in enumerate(txns):
+                    if verdicts[t] == TransactionResult.COMMITTED:
+                        for m in tr.mutations:
+                            if _is_metadata(m):
+                                self.on_state_mutation(m)
 
         messages = self._assign_mutations(txns, verdicts, version)
 
@@ -482,5 +505,4 @@ def _stamp(version: int, order: int) -> bytes:
 def _is_metadata(m) -> bool:
     """Metadata mutations target the \xff system keyspace
     (the applyMetadataToCommittedTransactions condition)."""
-    key = m[2] if m[0] == "atomic" else m[1]
-    return key.startswith(SYSTEM_PREFIX)
+    return _is_metadata_shared(m)

@@ -225,12 +225,9 @@ class Cluster:
         self.storage_live[s] = False
 
     def _apply_state_mutation(self, m) -> None:
-        kind = m[0]
-        if kind == "set":
-            self.txn_state_store[m[1]] = m[2]
-        elif kind == "clear":
-            for k in [k for k in self.txn_state_store if m[1] <= k < m[2]]:
-                del self.txn_state_store[k]
+        from foundationdb_tpu.models.types import apply_state_mutation
+
+        apply_state_mutation(self.txn_state_store, m)
 
     async def _bootstrap(self) -> None:
         # The master's initial resolver batch (prev_version < 0) — creates

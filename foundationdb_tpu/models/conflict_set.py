@@ -67,7 +67,6 @@ def _rebase(state: H.VersionHistory, delta):
 
     return state._replace(
         main_ver=shift(state.main_ver),
-        main_tab=shift(state.main_tab),
         oldest=shift(state.oldest),
     )
 

@@ -76,4 +76,40 @@ class ResolveTransactionBatchReply:
     # Prior-version state transactions the requesting proxy hasn't seen
     # (ResolverInterface.h:141 stateMutations).
     state_mutations: list[Any] = dataclasses.field(default_factory=list)
+    # Knob-gated (PROXY_USE_RESOLVER_PRIVATE_MUTATIONS): THIS batch's
+    # candidate metadata mutations per LOCAL txn index, generated
+    # resolver-side (ResolverInterface.h:143 privateMutations;
+    # Resolver.actor.cpp:372-441). Candidates carry the resolver-LOCAL
+    # committed verdict; the proxy applies only those whose GLOBAL
+    # (min-combined) verdict is committed — global committed implies
+    # locally committed everywhere, so candidates are complete. Empty
+    # when the knob is off.
+    private_mutations: dict[int, list[Any]] = dataclasses.field(
+        default_factory=dict
+    )
     debug_id: Optional[str] = None
+
+
+#: the \xff system keyspace prefix (fdbclient/SystemData.cpp)
+SYSTEM_PREFIX = b"\xff"
+
+
+def is_metadata_mutation(m) -> bool:
+    """Metadata mutations target the system keyspace — the
+    applyMetadataToCommittedTransactions condition
+    (fdbserver/CommitProxyServer.actor.cpp:1596)."""
+    key = m[2] if m[0] == "atomic" else m[1]
+    return key.startswith(SYSTEM_PREFIX)
+
+
+def apply_state_mutation(store: dict, m) -> None:
+    """Apply one metadata mutation to a txn-state store dict — shared by
+    the cluster-side store (cluster/database.py) and the resolver-side
+    materialization (the private-mutations path), so the two can never
+    drift in semantics."""
+    kind = m[0]
+    if kind == "set":
+        store[m[1]] = m[2]
+    elif kind == "clear":
+        for k in [k for k in store if m[1] <= k < m[2]]:
+            del store[k]

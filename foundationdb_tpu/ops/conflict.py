@@ -96,9 +96,13 @@ def resolve_batch(state: H.VersionHistory, batch: dict):
     write_live = batch["write_valid"] & ~too_old[batch["write_txn"]]
 
     # ---- phase 1: reads vs. persistent history ------------------------
+    # the range-max table is derived state, built here per batch (NOT
+    # carried in VersionHistory — see the NamedTuple note)
+    main_tab = rangemax.build(state.main_ver, op="max")
     read_snap = batch["snapshot"][batch["read_txn"]]
     hist_hit = H.query_reads(
-        state, batch["read_begin"], batch["read_end"], read_snap
+        state, batch["read_begin"], batch["read_end"], read_snap,
+        main_tab=main_tab,
     )
     hist_conflict_read = hist_hit & read_live
     trash = b  # extra slot absorbs masked scatters

@@ -9,15 +9,15 @@ queries, and windowed GC (SkipList::removeBefore :576-608) — is held as
 one sorted boundary array with per-segment versions plus a range-max
 table.
 
-Design note (v2, measured on v5e): gathers/scatters cost ~50ns/element
-on TPU regardless of table size, so the v1 two-tier design (8 fresh runs
-queried by per-run binary search + periodic compaction) spent ~400ms per
-64K batch in searchsorted gathers. v2 is single-tier: each batch's
-combined committed writes merge directly into the main map with ONE
-lax.sort plus associative scans (no searchsorted at all on the merge
-path), and queries pay exactly one binary search (for the begin key)
-plus a bounded geometric probe for the end key. GC is folded into the
-merge (dead segments collapse in the same pass).
+Design note (measured on v5e): the structure is single-tier — one
+sorted boundary array with per-segment versions; the merge is ONE
+4-operand lax.sort + scans, with GC folded in (dead segments collapse
+in the same pass). A sort-free merge via cross searchsorteds was built
+and benchmarked at 8.7x WORSE: random gathers against loop-carried/
+donated state cost ~6-15ns/element on this platform while argument
+gathers are ~free, so search-heavy designs lose to the streaming sort.
+Queries pay one binary search (begin key) + a bounded geometric probe
+for the end key.
 
 All shapes static; all functions pure; state is a NamedTuple pytree that
 callers thread through `jax.jit` with donation.
@@ -41,9 +41,13 @@ class VersionHistory(NamedTuple):
     main_keys: jnp.ndarray   # [M, W] uint32 sorted boundaries (tail sentinel)
     main_ver: jnp.ndarray    # [M] int32 — version of [key_i, key_{i+1});
     #                          NEG from the last real boundary onward
-    main_tab: jnp.ndarray    # [L, M] int32 sparse range-max table of main_ver
     oldest: jnp.ndarray      # [] int32 current oldestVersion offset
     overflow: jnp.ndarray    # [] bool — merge exceeded main capacity
+    # NOTE deliberately NOT carried: the [L, M] range-max table. It is
+    # derived from main_ver at the start of each batch (resolve_batch) —
+    # carrying 66MB of derived data made lax.scan fusion copy it per
+    # iteration (measured: fused dispatch SLOWER than sequential) and
+    # tripled donation traffic.
 
 
 def init(config: KernelConfig) -> VersionHistory:
@@ -52,7 +56,6 @@ def init(config: KernelConfig) -> VersionHistory:
     return VersionHistory(
         main_keys=K.sentinel_like(m, config.key_words),
         main_ver=main_ver,
-        main_tab=rangemax.build(main_ver, op="max"),
         oldest=jnp.int32(VERSION_NEG),
         overflow=jnp.asarray(False),
     )
@@ -63,6 +66,7 @@ def query_reads(
     rb: jnp.ndarray,    # [Q, W] read-range begins
     re: jnp.ndarray,    # [Q, W] read-range ends
     snap: jnp.ndarray,  # [Q] int32 read snapshots
+    main_tab: jnp.ndarray = None,  # [L, M] prebuilt range-max table
 ) -> jnp.ndarray:
     """conflict[q] = (max version over history segments intersecting
     [rb, re)) > snap — the CheckMax contract (SkipList.cpp:695-759).
@@ -88,7 +92,9 @@ def query_reads(
         lambda: K.searchsorted(state.main_keys, re, side="left") - 1,
         lambda: il + cnt,
     )
-    vmax = rangemax.query(state.main_tab, jnp.maximum(il, 0), ir + 1, op="max")
+    if main_tab is None:
+        main_tab = rangemax.build(state.main_ver, op="max")
+    vmax = rangemax.query(main_tab, jnp.maximum(il, 0), ir + 1, op="max")
     return vmax > snap
 
 
@@ -100,7 +106,7 @@ def merge_writes(
     new_oldest: jnp.ndarray,  # [] int32 — MVCC floor (version - window)
 ) -> VersionHistory:
     """Overwrite the union of run intervals with `version`, raise the GC
-    floor, and rebuild the range-max table — one sort + scans.
+    floor, and compact — one packed 4-operand sort + scans.
 
     Equivalent of mergeWriteConflictRanges + removeBefore
     (SkipList.cpp:430-441, 576-608) as a single functional pass:
@@ -111,15 +117,19 @@ def merge_writes(
     m, w = state.main_keys.shape
     mf = run_bounds.shape[0]
 
-    # Sort-operand packing (measured: the sort dominates this function at
-    # bench shapes, and its cost scales with operand count). The tie-kind
-    # (main row before run row at equal keys, so the carry includes the
-    # main value at that key) rides the low bit of the length word —
-    # (len << 1) | kind preserves (key bytes, len, kind) order exactly,
-    # and the parity delta of run rows is re-derived AFTER the sort from
-    # their rank among run rows (runs are disjoint strictly-increasing
-    # boundaries, so sorted order preserves their begin/end alternation).
-    # Net: 4 operands instead of 6.
+    # A sort-free variant of this merge (cross searchsorteds + gathers,
+    # since both inputs are sorted) was built and measured: 469ms vs the
+    # sort's 54ms at bench shapes, because gathers from loop-carried/
+    # donated buffers run ~100x slower than argument gathers on this
+    # platform while lax.sort streams sequentially. The sort stays.
+    #
+    # Sort-operand packing: the tie-kind (main row before run row at
+    # equal keys, so the carry includes the main value at that key) rides
+    # the low bit of the length word — (len << 1) | kind preserves
+    # (key bytes, len, kind) order exactly, and the parity delta of run
+    # rows is re-derived AFTER the sort from their rank among run rows
+    # (runs are disjoint strictly-increasing boundaries, so sorted order
+    # preserves their begin/end alternation). Net: 4 operands.
     main_packed = (state.main_keys[:, w - 1] << 1) | jnp.uint32(0)
     run_packed = (run_bounds[:, w - 1] << 1) | jnp.uint32(1)
     packed = jnp.concatenate([main_packed, run_packed])
@@ -166,7 +176,6 @@ def merge_writes(
     # GC floor: segments that can never conflict again die here.
     new_val = jnp.where(new_val < new_oldest, VERSION_NEG, new_val)
 
-    is_real = ~jnp.all(skeys == K.SENTINEL_WORD, axis=-1)
     prev_val = jnp.concatenate(
         [jnp.full((1,), VERSION_NEG, jnp.int32), new_val[:-1]]
     )
@@ -186,7 +195,6 @@ def merge_writes(
     return VersionHistory(
         main_keys=new_keys,
         main_ver=new_ver,
-        main_tab=rangemax.build(new_ver, op="max"),
         oldest=oldest,
         overflow=overflow,
     )

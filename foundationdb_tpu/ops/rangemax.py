@@ -10,6 +10,7 @@ identical in semantics.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 INT32_NEG = -(2**31) + 1
@@ -41,11 +42,24 @@ def build(values: jnp.ndarray, *, op: str = "max") -> jnp.ndarray:
 
 
 def _floor_log2(n: jnp.ndarray, max_levels: int) -> jnp.ndarray:
-    """Vectorized floor(log2(n)) for n >= 1, exact for all int32."""
-    k = jnp.zeros_like(n)
-    for b in range(max_levels - 1, -1, -1):
-        k = jnp.where((n >> b) > 0, jnp.maximum(k, b), k)
-    return k
+    """Vectorized floor(log2(n)) for n >= 1, exact for all int32.
+
+    Float-exponent trick instead of a 31-step bit loop: the f32 exponent
+    of n is floor(log2(n)) except when mantissa rounding carries into the
+    next power of two (e.g. 2**24 - 1), which one correction step fixes.
+    Small-array op count matters on TPU: each [Q] vector op carries fixed
+    overhead, so 3 ops beat 60 (measured in scripts/experiments3.py era
+    profiling: the bit loop dominated rangemax.query).
+    """
+    f = n.astype(jnp.float32)
+    k = ((jax.lax.bitcast_convert_type(f, jnp.int32) >> 23) & 0xFF) - 127
+    k = jnp.where(_pow2_gt(k, n), k - 1, k)
+    return jnp.clip(k, 0, max_levels - 1)
+
+
+def _pow2_gt(k: jnp.ndarray, n: jnp.ndarray) -> jnp.ndarray:
+    """(1 << k) > n without int64: k <= 31 here."""
+    return (jnp.left_shift(jnp.int32(1), jnp.clip(k, 0, 30)) > n) | (k >= 31)
 
 
 def query(table: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray, *, op: str = "max"):

@@ -42,10 +42,11 @@ def min_cover(
     lo = jnp.clip(lo, 0, leaves)
     hi = jnp.clip(hi, 0, leaves)
     length = hi - lo
-    # k = floor(log2(length)) for length >= 1
-    k = jnp.zeros_like(length)
-    for b in range(log, 0, -1):
-        k = jnp.where((length >> b) > 0, jnp.maximum(k, b), k)
+    # k = floor(log2(length)) for length >= 1 (float-exponent trick —
+    # rangemax._floor_log2 rationale: op count on small arrays)
+    from foundationdb_tpu.ops.rangemax import _floor_log2
+
+    k = _floor_log2(jnp.maximum(length, 1), log + 1)
     valid = length > 0
     # 2D scatter indices (an extra trash level absorbs invalid updates):
     # flattened k*leaves+pos indexing is avoided — XLA:TPU has been seen

@@ -148,6 +148,18 @@ class Resolver:
         self.total_state_bytes = 0
         self.recent_state = _RecentStateTransactionsInfo()
         self.proxy_info: dict[Optional[str], _ProxyRequestsInfo] = {}
+        # Knob-gated private-mutations path (Resolver.actor.cpp:372-441 +
+        # design/transaction-state-store.md): when on, this resolver
+        # materializes committed state-txn mutations into its own
+        # txnStateStore at resolve time and returns them as
+        # reply.private_mutations, so proxies consume resolver-generated
+        # metadata instead of re-deriving it.
+        from foundationdb_tpu.utils.knobs import SERVER_KNOBS
+
+        self.private_mutations_enabled = bool(
+            SERVER_KNOBS.PROXY_USE_RESOLVER_PRIVATE_MUTATIONS
+        )
+        self.txn_state_store: dict[bytes, bytes] = {}
 
         self.counters = CounterCollection(
             "ResolverMetrics",
@@ -302,12 +314,36 @@ class Resolver:
             state_bytes = 0
             for t in req.txn_state_transactions:
                 tr = req.transactions[t]
+                committed = reply.committed[t] == TransactionResult.COMMITTED
                 state_txns.append(
                     StateTransaction(
-                        committed=reply.committed[t] == TransactionResult.COMMITTED,
+                        committed=committed,
                         mutations=list(tr.mutations),
                     )
                 )
+                if committed and self.private_mutations_enabled:
+                    # private-mutations path (:372-441): emit candidate
+                    # metadata for the proxy (which filters by the GLOBAL
+                    # min-combined verdict) and, in single-resolver
+                    # configurations — where the local verdict IS the
+                    # global one — materialize into this resolver's
+                    # txnStateStore. Multi-resolver stores stay passive:
+                    # a resolver cannot know the global verdict at
+                    # resolve time (the reference's knob path shares this
+                    # limitation; it ships default-off,
+                    # ServerKnobs.cpp:549).
+                    from foundationdb_tpu.models.types import (
+                        is_metadata_mutation,
+                    )
+
+                    metas = [
+                        m for m in tr.mutations if is_metadata_mutation(m)
+                    ]
+                    if metas:
+                        reply.private_mutations[t] = metas
+                        if self.resolver_count == 1:
+                            for m in metas:
+                                self._apply_state_mutation(m)
                 state_bytes += sum(_mutation_bytes(m) for m in tr.mutations)
                 self.counters.add("resolvedStateMutations", len(tr.mutations))
             self.counters.add("resolvedStateTransactions", len(req.txn_state_transactions))
@@ -355,6 +391,14 @@ class Resolver:
         return out  # None == the reference's Never()
 
     # -- balancer endpoints (ResolverInterface metrics/split) -------------
+
+    def _apply_state_mutation(self, m) -> None:
+        """Materialize one metadata mutation into the resolver-side
+        txnStateStore (the LogSystemDiskQueueAdapter-materialized store,
+        design/transaction-state-store.md)."""
+        from foundationdb_tpu.models.types import apply_state_mutation
+
+        apply_state_mutation(self.txn_state_store, m)
 
     def _decay_key_sample(self) -> None:
         """Halve all counts, dropping zeros; if the key set itself is too

@@ -1,0 +1,200 @@
+"""Seed-sweeping soak ensemble: Joshua in miniature (VERDICT r1 task 9).
+
+One seed = one deterministic simulated-cluster run with a seed-derived
+cluster shape, seed-randomized knobs (the reference's `randomize &&
+BUGGIFY` discipline, fdbclient/ServerKnobs.cpp), and a seed-derived fault
+mix (clogging, storage reboots, shard moves, tlog kills, coordinator
+kills, proxy kills forcing quorum-gated recovery) running under a
+ConflictRange-style model-checked workload. The signature of a run —
+outcome counts, virtual end time, epoch, final keys — is deterministic
+per seed; `run_seed` executed twice must return identical signatures
+(the unseed-determinism check, contrib/debug_determinism/).
+
+Driven by scripts/soak.py (`--seeds N`), the CI ensemble runner
+(contrib/TestHarness2/test_harness/run.py's role).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class SeedPlan:
+    """Everything a seed decides, derived before the run starts."""
+
+    n_commit_proxies: int
+    n_resolvers: int
+    n_storage: int
+    replication: int
+    n_tlogs: int
+    rounds: int
+    kill_proxy: bool
+    kill_tlog: bool
+    kill_coordinator: bool
+    clog: bool
+    reboot_storage: bool
+    move_shard: bool
+    randomize_knobs: bool
+
+
+def plan_for_seed(seed: int) -> SeedPlan:
+    r = np.random.default_rng(seed ^ 0x5EED)
+    n_storage = int(r.integers(2, 4))
+    replication = int(r.integers(1, min(n_storage, 2) + 1))
+    return SeedPlan(
+        n_commit_proxies=int(r.integers(1, 3)),
+        n_resolvers=int(r.integers(1, 3)),
+        n_storage=n_storage,
+        replication=replication,
+        n_tlogs=int(r.integers(1, 3)),
+        rounds=int(r.integers(20, 45)),
+        kill_proxy=bool(r.random() < 0.5),
+        kill_tlog=bool(r.random() < 0.3),
+        kill_coordinator=bool(r.random() < 0.4),
+        clog=bool(r.random() < 0.6),
+        reboot_storage=bool(r.random() < 0.5),
+        move_shard=bool(r.random() < 0.5),
+        randomize_knobs=bool(r.random() < 0.5),
+    )
+
+
+def run_seed(seed: int) -> tuple:
+    """Run one ensemble seed; returns the deterministic signature."""
+    from foundationdb_tpu.cluster.commit_proxy import (
+        CommitUnknownResult,
+        NotCommitted,
+        TransactionTooOldError,
+    )
+    from foundationdb_tpu.cluster.consistency import check_cluster
+    from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+    from foundationdb_tpu.cluster.grv_proxy import GrvProxyFailedError
+    from foundationdb_tpu.runtime.flow import all_of
+    from foundationdb_tpu.utils.knobs import SERVER_KNOBS
+
+    retryable = (
+        NotCommitted,
+        TransactionTooOldError,
+        CommitUnknownResult,
+        GrvProxyFailedError,
+    )
+    plan = plan_for_seed(seed)
+    SERVER_KNOBS.reset()
+    knob_rng = np.random.default_rng(seed ^ 0xBADC0DE)
+    if plan.randomize_knobs:
+        SERVER_KNOBS.randomize_under_test(knob_rng)
+    # the ensemble always runs the host conflict model: deterministic and
+    # device-free (the TPU kernel has its own parity suites)
+    SERVER_KNOBS.set("RESOLVER_BACKEND", "cpu")
+
+    try:
+        sched, cluster, db = open_cluster(
+            ClusterConfig(
+                n_commit_proxies=plan.n_commit_proxies,
+                n_resolvers=plan.n_resolvers,
+                n_storage=plan.n_storage,
+                replication_factor=plan.replication,
+                n_tlogs=plan.n_tlogs,
+                sim_seed=seed,
+            )
+        )
+        rng = np.random.default_rng(seed)
+        possible: dict[bytes, set] = {}
+        outcome = {"committed": 0, "aborted": 0, "read_checks": 0}
+
+        def check(got: dict, lo: bytes, hi: bytes):
+            keys = set(got) | {k for k in possible if lo <= k < hi}
+            for k in keys:
+                allowed = possible.get(k, {None})
+                assert got.get(k) in allowed, (
+                    f"seed {seed}: key {k!r} = {got.get(k)!r} "
+                    f"not in {allowed}"
+                )
+
+        async def workload():
+            for i in range(plan.rounds):
+                txn = db.create_transaction()
+                writes: dict = {}
+                try:
+                    if rng.random() < 0.6:
+                        a = int(rng.integers(0, 30))
+                        b_ = a + int(rng.integers(1, 8))
+                        lo, hi = b"s%02d" % a, b"s%02d" % b_
+                        got = dict(await txn.get_range(lo, hi))
+                        check(got, lo, hi)
+                        outcome["read_checks"] += 1
+                    for _ in range(int(rng.integers(1, 4))):
+                        k = b"s%02d" % int(rng.integers(0, 30))
+                        v = b"r%d" % i
+                        txn.set(k, v)
+                        writes[k] = v
+                    await txn.commit()
+                    for k, v in writes.items():
+                        possible[k] = {v}
+                    outcome["committed"] += 1
+                except CommitUnknownResult:
+                    for k, v in writes.items():
+                        possible.setdefault(k, {None}).add(v)
+                    outcome["aborted"] += 1
+                    await sched.delay(0.01)
+                except retryable:
+                    outcome["aborted"] += 1
+                    await sched.delay(0.01)
+
+        async def chaos():
+            await sched.delay(0.05)
+            if plan.clog:
+                cluster.net.clog_pair("proxy0", "resolver0", 0.2)
+                await sched.delay(0.05)
+            if plan.kill_coordinator:
+                # a MINORITY: recovery must still go through the quorum
+                cluster.kill_coordinator(int(rng.integers(0, 3)))
+            if plan.reboot_storage:
+                await sched.delay(0.05)
+                cluster.reboot_storage(int(rng.integers(0, plan.n_storage)))
+            if plan.move_shard:
+                await sched.delay(0.05)
+                try:
+                    await cluster.data_distributor.move_shard(
+                        b"s05", b"s15", int(rng.integers(0, plan.n_storage))
+                    )
+                except Exception:
+                    pass
+            if plan.kill_tlog and plan.n_tlogs > 1:
+                await sched.delay(0.05)
+                cluster.kill_tlog(0)
+            if plan.kill_proxy:
+                await sched.delay(0.1)
+                p = cluster.commit_proxies[0]
+                p.failed = RuntimeError("soak kill")
+                p.stop()
+
+        w = sched.spawn(workload(), name="soak-load")
+        c = sched.spawn(chaos(), name="soak-chaos")
+        sched.run_until(all_of([w.done, c.done]))
+        sched.run_for(2.0)  # settle: recovery tail, deferred drops
+
+        async def final_verify():
+            txn = db.create_transaction()
+            return dict(await txn.get_range(b"s", b"t"))
+
+        got = sched.run_until(sched.spawn(final_verify()).done)
+        check(got, b"s", b"t")
+        check_cluster(cluster)
+        if plan.kill_proxy:
+            assert cluster.controller.epoch >= 2, "recovery never happened"
+        sig = (
+            seed,
+            outcome["committed"],
+            outcome["aborted"],
+            outcome["read_checks"],
+            round(sched.now(), 6),
+            cluster.controller.epoch,
+            tuple(sorted(got)),
+        )
+        cluster.stop()
+        return sig
+    finally:
+        SERVER_KNOBS.reset()

@@ -129,6 +129,12 @@ def make_server_knobs() -> Knobs:
         randomize=lambda r: float(r.choice([0.001, 0.005, 0.01])),
     )
     k.define("RESOLVER_BACKEND", "tpu")  # the resolver_backend knob
+    # Resolver-generated private mutations + resolver-side txnStateStore
+    # (fdbclient/ServerKnobs.cpp:549-550 — randomized under test there too)
+    k.define(
+        "PROXY_USE_RESOLVER_PRIVATE_MUTATIONS", False,
+        randomize=lambda r: bool(r.integers(0, 2)),
+    )
     return k
 
 

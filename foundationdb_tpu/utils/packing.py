@@ -218,3 +218,13 @@ def pack_batch(
         write_txn=_col(w_txn, nw),
         write_valid=_col([True] * nwrite, nw, bool),
     )
+
+
+def stack_device_args(batches) -> dict:
+    """Stack PackedBatch device_args along a new leading axis — the
+    input contract of TpuConflictSet.resolve_args_scan. Single place so
+    a new device_args key can never be silently dropped by callers."""
+    import numpy as _np
+
+    args = [b.device_args() for b in batches]
+    return {k: _np.stack([a[k] for a in args]) for k in args[0]}

@@ -273,6 +273,13 @@ def w_resolve_reply(out: list, r: ResolveTransactionBatchReply) -> None:
         w_u32(out, len(muts))
         for m in muts:
             w_mutation(out, m)
+    # private mutations: local txn index -> candidate metadata mutations
+    w_u32(out, len(r.private_mutations))
+    for t, muts in r.private_mutations.items():
+        w_u32(out, t)
+        w_u32(out, len(muts))
+        for m in muts:
+            w_mutation(out, m)
     w_str(out, r.debug_id)
 
 
@@ -304,12 +311,23 @@ def r_resolve_reply(
             m, off = r_mutation(buf, off)
             muts.append(m)
         state.append((version, muts))
+    n, off = r_u32(buf, off)
+    private = {}
+    for _ in range(n):
+        t, off = r_u32(buf, off)
+        k, off = r_u32(buf, off)
+        muts = []
+        for _ in range(k):
+            m, off = r_mutation(buf, off)
+            muts.append(m)
+        private[t] = muts
     debug_id, off = r_str(buf, off)
     return (
         ResolveTransactionBatchReply(
             committed=committed,
             conflicting_key_range_map=ckr,
             state_mutations=state,
+            private_mutations=private,
             debug_id=debug_id,
         ),
         off,

@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Bisect rangemax.query and searchsorted costs piece by piece."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from foundationdb_tpu.utils import compile_cache
+
+compile_cache.enable()
+
+from foundationdb_tpu.ops import keys as K
+from foundationdb_tpu.ops import rangemax
+
+REPS = 8
+Q = 1 << 16
+M = 786_432
+L = 21
+
+
+def timeit(name, fn, *args):
+    f = jax.jit(fn)
+    t0 = time.perf_counter()
+    out = f(*args)
+    jax.block_until_ready(out)
+    c = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = f(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / REPS
+    print(f"{name:56s} {dt * 1e3:8.2f} ms/iter (compile {c:5.1f}s)",
+          flush=True)
+
+
+def chain(fn):
+    def run(a0, *rest):
+        def body(i, carry):
+            a, acc = carry
+            r = fn(a, *rest)
+            return (a ^ (r & 1)) % M, acc + jnp.sum(r)
+        return jax.lax.fori_loop(0, REPS, body, (a0, jnp.int32(0)))[1]
+    return run
+
+
+def main():
+    print("device:", jax.devices()[0], flush=True)
+    rng = np.random.default_rng(0)
+    tab = jnp.asarray(rng.integers(0, 1000, size=(L, M)), jnp.int32)
+    lo = jnp.asarray(rng.integers(0, M - 200, size=Q), jnp.int32)
+    hi_off = jnp.asarray(rng.integers(1, 200, size=Q), jnp.int32)
+    kfix = jnp.asarray(rng.integers(0, L, size=Q), jnp.int32)
+
+    timeit("full rangemax.query (computed k)",
+           chain(lambda a, t, ho: rangemax.query(t, a, a + ho, op="max")),
+           lo, tab, hi_off)
+
+    def q_fixed_k(a, t, k):
+        va = t[k, a]
+        vb = t[k, jnp.clip(a + 37, 0, M - 1)]
+        return jnp.maximum(va, vb)
+    timeit("two gathers only (k passed in)", chain(q_fixed_k),
+           lo, tab, kfix)
+
+    def q_computed_k(a, t, ho):
+        length = jnp.maximum(ho, 1)
+        k = rangemax._floor_log2(length, L)
+        va = t[k, a]
+        vb = t[k, jnp.clip(a + ho - (1 << k), 0, M - 1)]
+        return jnp.maximum(va, vb)
+    timeit("two gathers + computed k (no clips/where)",
+           chain(q_computed_k), lo, tab, hi_off)
+
+    def just_k(a, t, ho):
+        length = jnp.maximum(ho + (a & 1), 1)
+        return rangemax._floor_log2(length, L)
+    timeit("floor_log2 alone", chain(just_k), lo, tab, hi_off)
+
+    # ---- searchsorted variants ----------------------------------------
+    w = 3
+    mk = np.sort(rng.integers(0, 2**31, size=M).astype(np.uint32))
+    main_rows = jnp.stack(
+        [jnp.asarray(mk), jnp.zeros(M, jnp.uint32),
+         jnp.full((M,), 8, jnp.uint32)], axis=1)
+    main_cols = tuple(main_rows[:, i] for i in range(w))
+    qk = rng.integers(0, 2**31, size=Q).astype(np.uint32)
+    q_rows = jnp.stack(
+        [jnp.asarray(qk), jnp.zeros(Q, jnp.uint32),
+         jnp.full((Q,), 8, jnp.uint32)], axis=1)
+    q_cols = tuple(q_rows[:, i] for i in range(w))
+
+    timeit("searchsorted rows (current impl)",
+           chain(lambda a, mr, qr: K.searchsorted(
+               mr, qr.at[:, 0].set(qr[:, 0] ^ (a.astype(jnp.uint32) & 1)),
+               side="right")),
+           lo, main_rows, q_rows)
+
+    def ss_cols(a, mc0, mc1, mc2, qc0, qc1, qc2):
+        qc0 = qc0 ^ (a.astype(jnp.uint32) & 1)
+        loq = jnp.zeros((Q,), jnp.int32)
+        hiq = jnp.full((Q,), M, jnp.int32)
+        for _ in range(21):
+            mid = (loq + hiq) >> 1
+            cm = jnp.clip(mid, 0, M - 1)
+            m0, m1, m2 = mc0[cm], mc1[cm], mc2[cm]
+            # go right iff mid_key <= q  (side='right')
+            le = jnp.where(
+                m0 != qc0, m0 < qc0,
+                jnp.where(m1 != qc1, m1 < qc1, m2 <= qc2),
+            )
+            loq = jnp.where(le, mid + 1, loq)
+            hiq = jnp.where(le, hiq, mid)
+        return loq
+    timeit("searchsorted SoA cols (21 rounds x 3 1D gathers)",
+           chain(ss_cols), lo, *main_cols, *q_cols)
+
+    # correctness of the SoA formulation
+    ref = jax.jit(lambda mr, qr: K.searchsorted(mr, qr, side="right"))(
+        main_rows, q_rows)
+    got = jax.jit(
+        lambda mc0, mc1, mc2, qc0, qc1, qc2: ss_cols(
+            jnp.zeros((Q,), jnp.int32), mc0, mc1, mc2, qc0 ^ 0, qc1, qc2)
+    )(*main_cols, *q_cols)
+    print("   SoA == rows:", bool(jnp.all(ref == got)), flush=True)
+
+    # cumsum variants
+    big = jnp.asarray(rng.integers(0, 2, size=1 << 20), jnp.int32)
+
+    def cs_plain(a, x):
+        return jnp.cumsum(x + (a[0] & 1))[-1:]
+    timeit("cumsum 1M (plain)", chain(cs_plain), lo, big)
+
+    def cs_blocked(a, x):
+        xb = (x + (a[0] & 1)).reshape(-1, 512).astype(jnp.float32)
+        tri = jnp.tril(jnp.ones((512, 512), jnp.float32))
+        within = xb @ tri.T  # within[i, j] = sum of xb[i, :j+1]
+        sums = within[:, -1]
+        offs = jnp.concatenate(
+            [jnp.zeros((1,), jnp.float32), jnp.cumsum(sums)[:-1]])
+        return (within + offs[:, None]).reshape(-1).astype(jnp.int32)[-1:]
+    timeit("cumsum 1M (MXU-blocked f32)", chain(cs_blocked), lo, big)
+    a_ = jnp.cumsum(big)
+    b_ = jax.jit(lambda x: cs_blocked(jnp.zeros((Q,), jnp.int32), x))(big)
+    print("   blocked == plain:", bool(jnp.all(a_ == b_)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Bisect the v3 sort-free merge: which piece costs 450ms?"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from foundationdb_tpu.utils import compile_cache
+
+compile_cache.enable()
+
+from foundationdb_tpu.ops import keys as K
+
+REPS = 6
+M = 786_432
+MF = 131_072
+TOTAL = M + MF
+W = 3
+
+
+def timeit(name, fn, *args):
+    f = jax.jit(fn)
+    t0 = time.perf_counter()
+    out = f(*args)
+    jax.block_until_ready(out)
+    c = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = f(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / REPS
+    print(f"{name:58s} {dt * 1e3:8.2f} ms/iter (compile {c:5.1f}s)",
+          flush=True)
+
+
+def chain1(fn):
+    """Chain on a [TOTAL] int32 carry."""
+    def run(x0, *rest):
+        def body(i, carry):
+            x, acc = carry
+            r = fn(x, *rest)
+            return (x + (r[:1] & 1)).astype(jnp.int32), acc + r[0]
+        return jax.lax.fori_loop(
+            0, REPS, body, (x0, jnp.int32(0)))[1]
+    return run
+
+
+def main():
+    print("device:", jax.devices()[0], flush=True)
+    rng = np.random.default_rng(0)
+    mk = np.sort(rng.integers(0, 2**30, size=M).astype(np.uint32))
+    main_keys = jnp.stack(
+        [jnp.asarray(mk), jnp.zeros(M, jnp.uint32),
+         jnp.full((M,), 8, jnp.uint32)], axis=1)
+    rk = np.sort(rng.integers(0, 2**30, size=MF).astype(np.uint32))
+    run_bounds = jnp.stack(
+        [jnp.asarray(rk), jnp.zeros(MF, jnp.uint32),
+         jnp.full((MF,), 8, jnp.uint32)], axis=1)
+    main_ver = jnp.asarray(rng.integers(0, 1000, size=M), jnp.int32)
+    seed = jnp.zeros((TOTAL,), jnp.int32)
+
+    timeit("A: searchsorted(main[786K], run queries[131K])",
+           chain1(lambda x, mk_, rb: K.searchsorted(
+               mk_, rb.at[:, 0].add(x[0].astype(jnp.uint32) & 1),
+               side="right")),
+           seed, main_keys, run_bounds)
+
+    dest_run = jnp.sort(
+        jnp.asarray(rng.choice(TOTAL, size=MF, replace=False), jnp.int32))
+
+    timeit("B: searchsorted_i32(dest_run[131K], p[917K])",
+           chain1(lambda x, dr: K.searchsorted_i32(
+               dr, jnp.arange(TOTAL, dtype=jnp.int32) + (x[0] & 1),
+               side="right")),
+           seed, dest_run)
+
+    r_right = K.searchsorted_i32(
+        dest_run, jnp.arange(TOTAL, dtype=jnp.int32), side="right")
+    r_right = jax.device_put(r_right)
+
+    def piece_c(x, rr, mv):
+        carry_idx = jnp.arange(TOTAL, dtype=jnp.int32) - rr + (x[0] & 1)
+        return jnp.where(
+            carry_idx >= 0, mv[jnp.clip(carry_idx, 0, M - 1)], -1)
+    timeit("C: carry gather main_ver[917K idx]", chain1(piece_c),
+           seed, r_right, main_ver)
+
+    def piece_d(x, rr, mkk, rbb):
+        is_run = (rr > 0) & (x[:1] >= 0)
+        run_idx = jnp.clip(rr - 1, 0, MF - 1)
+        main_idx = jnp.clip(jnp.arange(TOTAL, dtype=jnp.int32) - rr, 0, M - 1)
+        cols = [
+            jnp.where(is_run, rbb[:, i][run_idx], mkk[:, i][main_idx])
+            for i in range(W)
+        ]
+        return cols[0].astype(jnp.int32)
+    timeit("D: out_cols gathers (strided slices)", chain1(piece_d),
+           seed, r_right, main_keys, run_bounds)
+
+    def piece_d2(x, rr, mkk, rbb):
+        is_run = (rr > 0) & (x[:1] >= 0)
+        run_idx = jnp.clip(rr - 1, 0, MF - 1)
+        main_idx = jnp.clip(jnp.arange(TOTAL, dtype=jnp.int32) - rr, 0, M - 1)
+        mc = jax.lax.optimization_barrier(
+            tuple(mkk[:, i] for i in range(W)))
+        rc = jax.lax.optimization_barrier(
+            tuple(rbb[:, i] for i in range(W)))
+        cols = [
+            jnp.where(is_run, rc[i][run_idx], mc[i][main_idx])
+            for i in range(W)
+        ]
+        return cols[0].astype(jnp.int32)
+    timeit("D2: out_cols gathers (fenced cols)", chain1(piece_d2),
+           seed, r_right, main_keys, run_bounds)
+
+    keep = jnp.asarray(rng.integers(0, 2, size=TOTAL), jnp.int32)
+
+    def piece_e(x, kp):
+        ck = jnp.cumsum(kp + (x[:1] & 1))
+        return ck
+    timeit("E: cumsum[917K]", chain1(piece_e), seed, keep)
+
+    ck = jnp.cumsum(keep)
+
+    def piece_f(x, ckk):
+        src = K.searchsorted_i32(
+            ckk + (x[:1] & 1), jnp.arange(1, M + 1, dtype=jnp.int32),
+            side="left")
+        return src
+    timeit("F: select-kth searchsorted_i32(ck[917K], m q)",
+           chain1(piece_f), seed, ck)
+
+
+if __name__ == "__main__":
+    main()

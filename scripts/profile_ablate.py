@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Ablation profiling of resolve_batch: marginal cost of each stage
+measured by REMOVING it from the real kernel (chained fori_loop, real
+shapes, real fusion context). Isolated-stage microbenches disagree with
+in-kernel costs by 100x on this platform, so deltas against the full
+kernel are the only trustworthy attribution.
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from foundationdb_tpu.utils import compile_cache
+
+compile_cache.enable()
+
+from foundationdb_tpu.config import KernelConfig
+from foundationdb_tpu.ops import history as H
+from foundationdb_tpu.ops import keys as K
+from foundationdb_tpu.ops import rangemax, segtree
+from foundationdb_tpu.ops.rangemax import INT32_POS
+from foundationdb_tpu.testing.benchgen import skiplist_style_batch
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+REPS = 6
+
+
+def resolve_ablated(state, batch, *, query=True, intra=True, combine=True,
+                    merge=True, ranks=True):
+    """resolve_batch with stages optionally stubbed (diagnostic only)."""
+    b = batch["txn_valid"].shape[0]
+    nr = batch["read_valid"].shape[0]
+    nw = batch["write_valid"].shape[0]
+    version = batch["version"]
+    new_oldest = batch["new_oldest"]
+    txn_valid = batch["txn_valid"]
+    too_old = txn_valid & batch["has_reads"] & (batch["snapshot"] < new_oldest)
+    read_live = batch["read_valid"] & ~too_old[batch["read_txn"]]
+    write_live = batch["write_valid"] & ~too_old[batch["write_txn"]]
+
+    if query:
+        main_tab = rangemax.build(state.main_ver, op="max")
+        read_snap = batch["snapshot"][batch["read_txn"]]
+        hist_hit = H.query_reads(
+            state, batch["read_begin"], batch["read_end"], read_snap,
+            main_tab=main_tab,
+        )
+    else:
+        hist_hit = batch["read_valid"] & False
+    hist_conflict_read = hist_hit & read_live
+    trash = b
+    hist_conflict_txn = (
+        jnp.zeros((b + 1,), jnp.int32)
+        .at[jnp.where(read_live, batch["read_txn"], trash)]
+        .max(hist_conflict_read.astype(jnp.int32))[:b]
+    ) > 0
+
+    points = jnp.concatenate(
+        [batch["read_begin"], batch["read_end"],
+         batch["write_begin"], batch["write_end"]], axis=0)
+    if ranks:
+        pt_valid = jnp.concatenate(
+            [read_live, read_live, write_live, write_live])
+        rk, _ukeys, _ucount = K.sort_ranks(points, pt_valid)
+    else:
+        rk = jnp.arange(points.shape[0], dtype=jnp.int32) % (2 * nr)
+        _ukeys = points
+    rb_rank, re_rank = rk[:nr], rk[nr:2 * nr]
+    wb_rank = rk[2 * nr:2 * nr + nw]
+    we_rank = rk[2 * nr + nw:]
+    leaves = 1 << max(0, (points.shape[0] - 1).bit_length())
+
+    ok = txn_valid & ~too_old & ~hist_conflict_txn
+    if intra:
+        wlo = jnp.where(write_live, wb_rank, 0)
+        whi = jnp.where(write_live, we_rank, 0)
+        write_txn = batch["write_txn"]
+        read_txn = batch["read_txn"]
+
+        def intra_hits(committed):
+            writer = jnp.where(
+                committed[write_txn] & write_live, write_txn, INT32_POS)
+            mw = segtree.min_cover(leaves, wlo, whi, writer)
+            mintab = rangemax.build(mw, op="min")
+            min_writer = rangemax.query(mintab, rb_rank, re_rank, op="min")
+            return (min_writer < read_txn) & read_live
+
+        def per_txn_any(read_bits):
+            return (
+                jnp.zeros((b + 1,), jnp.int32)
+                .at[jnp.where(read_live, read_txn, trash)]
+                .max(read_bits.astype(jnp.int32))[:b]) > 0
+
+        def cond(carry):
+            committed, prev, first = carry
+            return jnp.any(committed != prev)
+
+        def body(carry):
+            committed, _prev, _first = carry
+            hits = intra_hits(committed)
+            new_committed = ok & ~per_txn_any(hits & ok[read_txn])
+            return new_committed, committed, hits
+
+        committed0 = ok
+        hits0 = intra_hits(committed0)
+        c1 = ok & ~per_txn_any(hits0 & ok[read_txn])
+        committed, _, last_hits = jax.lax.while_loop(
+            cond, body, (c1, committed0, hits0))
+    else:
+        committed = ok
+
+    verdict = jnp.where(
+        too_old, 1, jnp.where(committed & txn_valid, 3, 0)
+    ).astype(jnp.int32)
+
+    if combine:
+        committed_writes = write_live & committed[batch["write_txn"]]
+        p = points.shape[0]
+        delta = (
+            jnp.zeros((p + 1,), jnp.int32)
+            .at[jnp.where(committed_writes, wb_rank, p)].add(1)
+            .at[jnp.where(committed_writes, we_rank, p)].add(-1)[:p])
+        covered = jnp.cumsum(delta) > 0
+        prev_covered = jnp.concatenate([jnp.zeros((1,), bool), covered[:-1]])
+        is_boundary = covered != prev_covered
+        mf = 2 * nw
+        pos = jnp.cumsum(is_boundary.astype(jnp.int32)) - 1
+        dest = jnp.where(is_boundary & (pos < mf), pos, mf)
+        w = points.shape[1]
+        run_bounds = K.sentinel_like(mf + 1, w).at[dest].set(_ukeys)[:mf]
+    else:
+        run_bounds = K.sentinel_like(2 * nw, points.shape[1])
+
+    if merge:
+        state = H.merge_writes(state, run_bounds, version, new_oldest)
+    return state, verdict
+
+
+def main():
+    print(f"device: {jax.devices()[0]}  N={N}", flush=True)
+    cap = 1 << (N - 1).bit_length()
+    config = KernelConfig(
+        max_key_bytes=8, max_txns=cap, max_reads=cap, max_writes=cap,
+        history_capacity=12 * cap, window_versions=1_000_000,
+    )
+    rng = np.random.default_rng(0)
+    batch = jax.device_put(skiplist_style_batch(
+        rng, config, N, version=1_200_000, keyspace=1_000_000, key_bytes=8,
+        snapshot_lag=400_000,
+    ).device_args())
+    state = jax.device_put(H.init(config))
+    import foundationdb_tpu.ops.conflict as C
+    step = jax.jit(C.resolve_batch)
+    for i in range(5):
+        b2 = skiplist_style_batch(
+            rng, config, N, version=200_000 * (i + 1), keyspace=1_000_000,
+            key_bytes=8, snapshot_lag=400_000).device_args()
+        state, _ = step(state, b2)
+    jax.block_until_ready(state)
+
+    variants = [
+        ("FULL", {}),
+        ("- query", {"query": False}),
+        ("- intra", {"intra": False}),
+        ("- merge", {"merge": False}),
+        ("- combine - merge", {"combine": False, "merge": False}),
+        ("- ranks - intra - combine - merge",
+         {"ranks": False, "intra": False, "combine": False, "merge": False}),
+        ("query only (no ranks/intra/combine/merge)",
+         {"ranks": False, "intra": False, "combine": False, "merge": False}),
+    ]
+    base = None
+    for name, kw in variants:
+        def chain(st, bt, kw=kw):
+            def body(i, cur):
+                s2, verdict = resolve_ablated(cur, bt, **kw)
+                return s2._replace(oldest=s2.oldest | (verdict[0] & 1))
+            return jax.lax.fori_loop(0, REPS, body, st)
+
+        f = jax.jit(chain)
+        t0 = time.perf_counter()
+        out = f(jax.tree.map(jnp.copy, state), batch)
+        jax.block_until_ready(out)
+        comp = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = f(jax.tree.map(jnp.copy, state), batch)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / REPS
+        note = ""
+        if name == "FULL":
+            base = dt
+        elif base is not None:
+            note = f"  (delta {1e3*(base - dt):+7.2f} ms)"
+        print(f"{name:44s} {dt*1e3:8.2f} ms/iter{note}  (compile {comp:4.1f}s)",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""In-kernel bisection of the v3 merge: swap each sub-step for a cheap
+fake (wrong results, right shapes/dtypes) and measure the delta."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from foundationdb_tpu.utils import compile_cache
+
+compile_cache.enable()
+
+from foundationdb_tpu.config import KernelConfig
+from foundationdb_tpu.ops import conflict as C
+from foundationdb_tpu.ops import history as H
+from foundationdb_tpu.ops import keys as K
+from foundationdb_tpu.ops.history import VERSION_NEG, VersionHistory
+from foundationdb_tpu.testing.benchgen import skiplist_style_batch
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+REPS = 6
+
+
+def merge_ablated(state, run_bounds, version, new_oldest, *, f_cnt=True,
+                  f_rr=True, f_vals=True, f_cols=True, f_compact=True):
+    m, w = state.main_keys.shape
+    mf = run_bounds.shape[0]
+    total = m + mf
+
+    if f_cnt:
+        cnt_main = K.searchsorted(state.main_keys, run_bounds, side="right")
+    else:
+        cnt_main = jnp.clip(
+            jnp.arange(mf, dtype=jnp.int32) * (m // mf), 0, m)
+    dest_run = jnp.arange(mf, dtype=jnp.int32) + cnt_main
+
+    p = jnp.arange(total, dtype=jnp.int32)
+    if f_rr:
+        r_right = K.searchsorted_i32(dest_run, p, side="right")
+    else:
+        r_right = jnp.clip(p * mf // total, 0, mf)
+    is_run = (r_right > 0) & (
+        dest_run[jnp.clip(r_right - 1, 0, mf - 1)] == p)
+    run_idx = jnp.clip(r_right - 1, 0, mf - 1)
+    main_idx = jnp.clip(p - r_right, 0, m - 1)
+
+    if f_vals:
+        carry_idx = p - r_right
+        carry_val = jnp.where(
+            carry_idx >= 0,
+            state.main_ver[jnp.clip(carry_idx, 0, m - 1)], VERSION_NEG)
+    else:
+        carry_val = jnp.full((total,), VERSION_NEG, jnp.int32)
+    covered = (r_right & 1) == 1
+    new_val = jnp.where(covered, jnp.maximum(carry_val, version), carry_val)
+    new_val = jnp.where(new_val < new_oldest, VERSION_NEG, new_val)
+
+    if f_cols:
+        out_cols = [
+            jnp.where(is_run, run_bounds[:, i][run_idx],
+                      state.main_keys[:, i][main_idx])
+            for i in range(w)
+        ]
+    else:
+        out_cols = [
+            (p.astype(jnp.uint32) + i) | (is_run.astype(jnp.uint32))
+            for i in range(w)
+        ]
+    is_real = out_cols[w - 1] != K.SENTINEL_WORD
+    prev_val = jnp.concatenate(
+        [jnp.full((1,), VERSION_NEG, jnp.int32), new_val[:-1]])
+    keep = is_real & (new_val != prev_val)
+
+    if f_compact:
+        ck = jnp.cumsum(keep.astype(jnp.int32))
+        new_count = ck[-1]
+        src = K.searchsorted_i32(
+            ck, jnp.arange(1, m + 1, dtype=jnp.int32), side="left")
+        src = jnp.clip(src, 0, total - 1)
+    else:
+        new_count = jnp.int32(m // 2)
+        src = jnp.clip(jnp.arange(m, dtype=jnp.int32), 0, total - 1)
+    overflow = state.overflow | (new_count > m)
+    valid = jnp.arange(m, dtype=jnp.int32) < new_count
+    new_keys = jnp.stack(
+        [jnp.where(valid, c[src], K.SENTINEL_WORD) for c in out_cols],
+        axis=-1)
+    new_ver = jnp.where(valid, new_val[src], VERSION_NEG)
+    return VersionHistory(
+        main_keys=new_keys, main_ver=new_ver,
+        oldest=jnp.maximum(state.oldest, new_oldest), overflow=overflow)
+
+
+def main():
+    print(f"device: {jax.devices()[0]}  N={N}", flush=True)
+    cap = 1 << (N - 1).bit_length()
+    config = KernelConfig(
+        max_key_bytes=8, max_txns=cap, max_reads=cap, max_writes=cap,
+        history_capacity=12 * cap, window_versions=1_000_000)
+    rng = np.random.default_rng(0)
+    batch = jax.device_put(skiplist_style_batch(
+        rng, config, N, version=1_200_000, keyspace=1_000_000, key_bytes=8,
+        snapshot_lag=400_000).device_args())
+    state = jax.device_put(H.init(config))
+    step = jax.jit(C.resolve_batch)
+    for i in range(5):
+        b2 = skiplist_style_batch(
+            rng, config, N, version=200_000 * (i + 1), keyspace=1_000_000,
+            key_bytes=8, snapshot_lag=400_000).device_args()
+        state, _ = step(state, b2)
+    jax.block_until_ready(state)
+    nw = batch["write_valid"].shape[0]
+    run_bounds0 = jnp.concatenate(
+        [batch["write_begin"][: nw], batch["write_end"][: nw]])
+
+    variants = [
+        ("merge FULL (v3)", {}),
+        ("- cnt_main search", {"f_cnt": False}),
+        ("- r_right search", {"f_rr": False}),
+        ("- carry gather", {"f_vals": False}),
+        ("- out_cols gathers", {"f_cols": False}),
+        ("- compact (cumsum+search)", {"f_compact": False}),
+        ("all fakes", {"f_cnt": False, "f_rr": False, "f_vals": False,
+                       "f_cols": False, "f_compact": False}),
+    ]
+    base = None
+    for name, kw in variants:
+        def chain(st, rb, kw=kw):
+            def body(i, cur):
+                s2 = merge_ablated(
+                    cur, rb, jnp.int32(1_200_000) + i,
+                    jnp.int32(200_000) + i, **kw)
+                return s2
+            return jax.lax.fori_loop(0, REPS, body, st)
+
+        f = jax.jit(chain)
+        t0 = time.perf_counter()
+        out = f(jax.tree.map(jnp.copy, state), run_bounds0)
+        jax.block_until_ready(out)
+        comp = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = f(jax.tree.map(jnp.copy, state), run_bounds0)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / REPS
+        note = ""
+        if base is None:
+            base = dt
+        else:
+            note = f"  (delta {1e3*(base - dt):+8.2f} ms)"
+        print(f"{name:38s} {dt*1e3:9.2f} ms/iter{note}  (compile {comp:4.1f}s)",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Seed-sweeping soak runner: `python scripts/soak.py --seeds 100`.
+
+The Joshua-ensemble driver (contrib/TestHarness2/test_harness/run.py's
+role): N seeds, each a deterministic simulated-cluster run with
+seed-randomized knobs + fault mix (foundationdb_tpu/testing/soak.py),
+executed across worker processes. Every K-th seed is run TWICE and the
+signatures compared — the unseed determinism check
+(contrib/debug_determinism/). Any assertion failure reports the seed for
+exact reproduction.
+"""
+
+import argparse
+import os
+import sys
+import time
+from concurrent.futures import ProcessPoolExecutor, as_completed
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force off any device tunnel (sim is CPU-only)
+
+
+def _one(args):
+    seed, check_determinism = args
+    from foundationdb_tpu.testing import soak
+
+    t0 = time.perf_counter()
+    sig = soak.run_seed(seed)
+    if check_determinism:
+        sig2 = soak.run_seed(seed)
+        if sig != sig2:
+            raise AssertionError(
+                f"seed {seed}: NONDETERMINISTIC\n  run1: {sig}\n  run2: {sig2}"
+            )
+    return seed, sig, time.perf_counter() - t0, check_determinism
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seeds", type=int, default=20)
+    ap.add_argument("--start", type=int, default=0)
+    ap.add_argument("--jobs", type=int, default=os.cpu_count() or 4)
+    ap.add_argument(
+        "--determinism-every", type=int, default=5,
+        help="every K-th seed runs twice and must match exactly",
+    )
+    args = ap.parse_args()
+
+    seeds = list(range(args.start, args.start + args.seeds))
+    work = [(s, i % args.determinism_every == 0) for i, s in enumerate(seeds)]
+    t0 = time.perf_counter()
+    failures = []
+    done = 0
+    committed = aborted = rechecks = det_checked = 0
+    with ProcessPoolExecutor(max_workers=args.jobs) as pool:
+        futs = {pool.submit(_one, w): w[0] for w in work}
+        for fut in as_completed(futs):
+            seed = futs[fut]
+            try:
+                s, sig, dt, det = fut.result()
+                done += 1
+                committed += sig[1]
+                aborted += sig[2]
+                rechecks += sig[3]
+                det_checked += int(det)
+                print(
+                    f"seed {s:5d} ok in {dt:5.1f}s  committed={sig[1]:3d} "
+                    f"aborted={sig[2]:3d} epoch={sig[5]}"
+                    + ("  [determinism OK]" if det else ""),
+                    flush=True,
+                )
+            except Exception as e:
+                failures.append((seed, repr(e)))
+                print(f"seed {seed:5d} FAILED: {e!r}", flush=True)
+    wall = time.perf_counter() - t0
+    print(
+        f"\n{done}/{len(seeds)} seeds passed in {wall:.0f}s "
+        f"({args.jobs} jobs); committed={committed} aborted={aborted} "
+        f"read_checks={rechecks} determinism_checked={det_checked}"
+    )
+    if failures:
+        print("FAILURES:")
+        for s, e in failures:
+            print(f"  seed {s}: {e}")
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

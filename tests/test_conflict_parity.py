@@ -151,13 +151,11 @@ def test_scan_fused_path_matches_sequential(rng):
     seq_verdicts = [
         np.asarray(seq.resolve_packed(b).verdict) for b in batches
     ]
+    from foundationdb_tpu.utils.packing import stack_device_args
+
     fused = TpuConflictSet(config)
     for gi, g in enumerate((batches[:3], batches[3:])):
-        stacked = {
-            k: np.stack([b.device_args()[k] for b in g])
-            for k in g[0].device_args()
-        }
-        outs = fused.resolve_args_scan(stacked)
+        outs = fused.resolve_args_scan(stack_device_args(g))
         base = gi * 3
         for j in range(3):
             got = np.asarray(outs.verdict[j])
