@@ -179,7 +179,7 @@ def main():
     # instead of per batch (dispatch costs ~30ms through this
     # environment's tunnel; a loaded resolver coalesces its queue the
     # same way). Per-batch latency is still reported un-fused (phase 4).
-    fuse = max(1, int(os.environ.get("BENCH_FUSE", 4)))
+    fuse = max(1, int(os.environ.get("BENCH_FUSE", 8)))
     from foundationdb_tpu.utils.packing import stack_device_args
 
     dev_groups = [

@@ -46,6 +46,9 @@ from foundationdb_tpu.runtime.flow import (
     all_of,
 )
 from foundationdb_tpu.utils.metrics import CounterCollection
+from foundationdb_tpu.utils.probes import code_probe, declare
+
+declare("proxy.conservative_write_injected", "proxy.min_combine_abort")
 
 from foundationdb_tpu.models.types import (  # noqa: F401 (re-export)
     SYSTEM_PREFIX,
@@ -281,6 +284,7 @@ class CommitProxy:
         # Phase 2: resolution.
         txns = [r.transaction for r in batch]
         if self.conservative_writes:
+            code_probe(True, "proxy.conservative_write_injected")
             moved, self.conservative_writes = self.conservative_writes, []
             # PREPENDED: intra-batch conflicts only see lower-indexed
             # writers, so the synthetic write must come before every user
@@ -447,8 +451,18 @@ class CommitProxy:
         reports: dict[int, list[int]] = {}
         for t in range(len(txns)):
             v = TransactionResult.COMMITTED
+            locals_seen = []
             for s, local in txn_resolver_map[t].items():
+                locals_seen.append(int(replies[s].committed[local]))
                 v = min(v, replies[s].committed[local])
+            # a txn one resolver would commit but another aborts: the
+            # min-combine doing real cross-shard work
+            code_probe(
+                len(locals_seen) > 1
+                and v != TransactionResult.COMMITTED
+                and any(x == TransactionResult.COMMITTED for x in locals_seen),
+                "proxy.min_combine_abort",
+            )
             verdicts.append(TransactionResult(v))
             if v == TransactionResult.CONFLICT and txns[t].report_conflicting_keys:
                 idxs: set[int] = set()

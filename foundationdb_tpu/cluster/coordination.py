@@ -37,7 +37,14 @@ import dataclasses
 from typing import Any, Optional
 
 from foundationdb_tpu.runtime.flow import Scheduler
+from foundationdb_tpu.utils.probes import code_probe, declare
 from foundationdb_tpu.utils.trace import TraceEvent
+
+declare(
+    "coordination.stale_generation",
+    "coordination.quorum_unreachable",
+    "coordination.racing_writer_detected",
+)
 
 
 class CoordinatorDead(Exception):
@@ -151,6 +158,7 @@ class CoordinatedState:
             except StaleGeneration as e:
                 stale.append(e)
         if stale:
+            code_probe(True, "coordination.stale_generation")
             # someone promised higher: this client's generation is dead.
             # Adopt the highest promised count so the next attempt can win.
             top = max(
@@ -161,6 +169,7 @@ class CoordinatedState:
                 self._seen = Generation(top.count, self.client_id)
             raise StaleGeneration(str(stale[0]), top)
         if len(oks) < self.majority:
+            code_probe(True, "coordination.quorum_unreachable")
             raise QuorumUnreachable(
                 f"{len(oks)}/{len(self.coordinators)} answered"
             )
@@ -199,7 +208,8 @@ class CoordinatedState:
         gen = self._next_gen()
         replies = await self._ask_all("lock", gen)
         for wgen, _val in replies:
-            if wgen > self._read_wgen:
+            if code_probe(wgen > self._read_wgen,
+                          "coordination.racing_writer_detected"):
                 raise StaleGeneration(
                     f"value committed at {wgen} since our read at "
                     f"{self._read_wgen}"

@@ -30,6 +30,10 @@ from __future__ import annotations
 
 from foundationdb_tpu.cluster.commit_proxy import CommitProxy
 from foundationdb_tpu.cluster.coordination import LeaderElection
+from foundationdb_tpu.utils.probes import code_probe, declare
+
+declare("recovery.epoch_lock_failed", "recovery.completed",
+        "recovery.leadership_lost")
 from foundationdb_tpu.cluster.grv_proxy import GrvProxy
 from foundationdb_tpu.cluster.sequencer import Sequencer
 from foundationdb_tpu.models.types import ResolveTransactionBatchRequest
@@ -85,6 +89,7 @@ class ClusterController:
                         10 * self.check_interval:
                     self.lease = await self.elector.renew(self.lease)
                     if self.lease is None:
+                        code_probe(True, "recovery.leadership_lost")
                         continue  # deposed; must re-win before recovering
                 if any(p.failed is not None for p in self.cluster.commit_proxies):
                     await self.recover()
@@ -108,6 +113,7 @@ class ClusterController:
             if self.lease is not None:
                 bumped = await self.elector.bump_epoch(self.lease)
             if bumped is None:
+                code_probe(True, "recovery.epoch_lock_failed")
                 TraceEvent("RecoveryEpochLockFailed").detail(
                     "Epoch", self.epoch).log()
                 self.lease = None
@@ -201,6 +207,7 @@ class ClusterController:
 
             await cluster.commit_proxies[0].commit(CommitTransaction()).future
 
+            code_probe(True, "recovery.completed")
             TraceEvent("MasterRecoveryState").detail("Epoch", self.epoch).detail(
                 "StatusCode", "fully_recovered"
             ).log()

@@ -47,6 +47,16 @@ from foundationdb_tpu.models.types import (
 from foundationdb_tpu.runtime.flow import Notified, Scheduler, Trigger, any_of
 from foundationdb_tpu.utils.metrics import CounterCollection, LatencySample
 from foundationdb_tpu.utils import trace
+from foundationdb_tpu.utils.probes import code_probe, declare
+
+declare(
+    "resolver.duplicate_batch_replayed",
+    "resolver.unknown_duplicate_never",
+    "resolver.too_old",
+    "resolver.backpressure_breached",
+    "resolver.state_txn_forwarded",
+    "resolver.first_unseen_is_current",
+)
 
 #: ServerKnobs.RESOLVER_STATE_MEMORY_LIMIT (fdbclient/ServerKnobs.cpp).
 DEFAULT_STATE_MEMORY_LIMIT = 1_000_000
@@ -218,6 +228,10 @@ class Resolver:
 
         # Memory backpressure (Resolver.actor.cpp:254-268): wait for
         # needed_version / total_state_bytes to move.
+        code_probe(
+            self.total_state_bytes > self.state_memory_limit,
+            "resolver.backpressure_breached",
+        )
         while (
             self.total_state_bytes > self.state_memory_limit
             and self.recent_state.size
@@ -303,6 +317,7 @@ class Resolver:
             )
             self.counters.add("transactionsAccepted", n_committed)
             self.counters.add("transactionsTooOld", n_too_old)
+            code_probe(n_too_old > 0, "resolver.too_old")
             self.counters.add(
                 "transactionsConflicted",
                 len(req.transactions) - n_committed - n_too_old,
@@ -350,6 +365,11 @@ class Resolver:
             self.counters.add("resolvedStateBytes", state_bytes)
             self.recent_state.add(req.version, state_txns, state_bytes)
             self.recent_state.apply_to_reply(reply, first_unseen_version, req.version)
+            code_probe(len(state_txns) > 0, "resolver.state_txn_forwarded")
+            code_probe(
+                first_unseen_version == req.version,
+                "resolver.first_unseen_is_current",
+            )
 
             # ---- trim state every proxy has seen (:449-474) ------------
             # The map holds one entry per proxy plus the master's (key None,
@@ -379,7 +399,12 @@ class Resolver:
             if any_popped or breached:
                 self.check_needed_version.trigger()
             self.compute_time.sample(self.sched.now() - begin_compute)
-        # else: duplicate resolve batch request (:513)
+        else:
+            # duplicate resolve batch request (:513)
+            code_probe(
+                req.version in proxy_info.outstanding_batches,
+                "resolver.duplicate_batch_replayed",
+            )
 
         self.counters.add("resolveBatchOut")
         self.resolver_latency.sample(self.sched.now() - request_time)
@@ -388,6 +413,7 @@ class Resolver:
                 "CommitDebug", req.debug_id, "Resolver.resolveBatch.After"
             )
         out = proxy_info.outstanding_batches.get(req.version)
+        code_probe(out is None, "resolver.unknown_duplicate_never")
         return out  # None == the reference's Never()
 
     # -- balancer endpoints (ResolverInterface metrics/split) -------------

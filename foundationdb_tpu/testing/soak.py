@@ -61,8 +61,10 @@ def plan_for_seed(seed: int) -> SeedPlan:
     )
 
 
-def run_seed(seed: int) -> tuple:
-    """Run one ensemble seed; returns the deterministic signature."""
+def run_seed(seed: int, collect_probes: bool = False):
+    """Run one ensemble seed; returns the deterministic signature (and,
+    with collect_probes, the CODE_PROBE hit snapshot for ensemble
+    coverage accounting — the Joshua side of flow/CodeProbe.h)."""
     from foundationdb_tpu.cluster.commit_proxy import (
         CommitUnknownResult,
         NotCommitted,
@@ -81,6 +83,14 @@ def run_seed(seed: int) -> tuple:
         GrvProxyFailedError,
     )
     plan = plan_for_seed(seed)
+    if collect_probes:
+        # per-seed accounting: pooled ensemble workers reuse processes,
+        # so the global counters must start clean for THIS seed (plain
+        # runs leave them accumulating — tests/test_probes.py relies on
+        # cross-run accumulation)
+        from foundationdb_tpu.utils import probes
+
+        probes.reset()
     SERVER_KNOBS.reset()
     knob_rng = np.random.default_rng(seed ^ 0xBADC0DE)
     if plan.randomize_knobs:
@@ -118,6 +128,11 @@ def run_seed(seed: int) -> tuple:
                 txn = db.create_transaction()
                 writes: dict = {}
                 try:
+                    if rng.random() < 0.15:
+                        # metadata write: a state transaction the
+                        # resolvers must forward (and, knob-gated,
+                        # materialize as private mutations)
+                        txn.set(b"\xff/soak/%02d" % (i % 4), b"m%d" % i)
                     if rng.random() < 0.6:
                         a = int(rng.integers(0, 30))
                         b_ = a + int(rng.integers(1, 8))
@@ -195,6 +210,10 @@ def run_seed(seed: int) -> tuple:
             tuple(sorted(got)),
         )
         cluster.stop()
+        if collect_probes:
+            from foundationdb_tpu.utils import probes
+
+            return sig, probes.snapshot()
         return sig
     finally:
         SERVER_KNOBS.reset()

@@ -1,0 +1,66 @@
+"""CODE_PROBE: rare-path coverage assertions collected across ensembles.
+
+The reference marks rare-but-important code paths with
+`CODE_PROBE(cond, "msg")` (flow/include/flow/CodeProbe.h) and CI asserts
+that every probe fires somewhere across a Joshua ensemble — "this branch
+is reachable and our randomization actually reaches it". Same contract
+here:
+
+* `declare(name)` registers a probe statically (module import time), so
+  a probe whose code never even runs still shows up as a MISS.
+* `code_probe(cond, name)` marks a hit when cond is truthy (and
+  auto-registers undeclared names defensively).
+* `snapshot()` / `reset()` let the ensemble runner (scripts/soak.py)
+  aggregate hits across seeds; `tests/test_probes.py` pins the required
+  set — the coveragetool role (flow/coveragetool) collapsed to a module.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_hits: dict[str, int] = {}
+_declared: set[str] = set()
+
+
+def declare(*names: str) -> None:
+    with _lock:
+        _declared.update(names)
+        for n in names:
+            _hits.setdefault(n, 0)
+
+
+def code_probe(cond, name: str) -> bool:
+    """Record a hit when cond is truthy; returns bool(cond) for inlining
+    into existing conditionals."""
+    ok = bool(cond)
+    if ok:
+        with _lock:
+            _declared.add(name)
+            _hits[name] = _hits.get(name, 0) + 1
+    return ok
+
+
+def snapshot() -> dict[str, int]:
+    with _lock:
+        return dict(_hits)
+
+
+def missed() -> list[str]:
+    with _lock:
+        return sorted(n for n in _declared if not _hits.get(n))
+
+
+def reset() -> None:
+    with _lock:
+        for n in list(_hits):
+            _hits[n] = 0
+
+
+def merge(other: dict[str, int]) -> None:
+    """Fold a child run's snapshot into this process's counts."""
+    with _lock:
+        for n, c in other.items():
+            _declared.add(n)
+            _hits[n] = _hits.get(n, 0) + c

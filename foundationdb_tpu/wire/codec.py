@@ -28,7 +28,7 @@ from foundationdb_tpu.models.types import (
 )
 
 #: Bumped whenever any wire layout changes; checked at connect time.
-PROTOCOL_VERSION = 0x0FDB_7E50_0002
+PROTOCOL_VERSION = 0x0FDB_7E50_0003  # +1: private_mutations in resolve reply
 
 
 class CodecError(ValueError):

@@ -24,16 +24,17 @@ os.environ["JAX_PLATFORMS"] = "cpu"  # force off any device tunnel (sim is CPU-o
 def _one(args):
     seed, check_determinism = args
     from foundationdb_tpu.testing import soak
+    from foundationdb_tpu.utils import probes
 
     t0 = time.perf_counter()
-    sig = soak.run_seed(seed)
+    sig, hits = soak.run_seed(seed, collect_probes=True)
     if check_determinism:
         sig2 = soak.run_seed(seed)
         if sig != sig2:
             raise AssertionError(
                 f"seed {seed}: NONDETERMINISTIC\n  run1: {sig}\n  run2: {sig2}"
             )
-    return seed, sig, time.perf_counter() - t0, check_determinism
+    return seed, sig, time.perf_counter() - t0, check_determinism, hits
 
 
 def main():
@@ -47,6 +48,8 @@ def main():
     )
     args = ap.parse_args()
 
+    from foundationdb_tpu.utils import probes as _probes
+
     seeds = list(range(args.start, args.start + args.seeds))
     work = [(s, i % args.determinism_every == 0) for i, s in enumerate(seeds)]
     t0 = time.perf_counter()
@@ -58,7 +61,8 @@ def main():
         for fut in as_completed(futs):
             seed = futs[fut]
             try:
-                s, sig, dt, det = fut.result()
+                s, sig, dt, det, hits = fut.result()
+                _probes.merge(hits)
                 done += 1
                 committed += sig[1]
                 aborted += sig[2]
@@ -79,6 +83,16 @@ def main():
         f"({args.jobs} jobs); committed={committed} aborted={aborted} "
         f"read_checks={rechecks} determinism_checked={det_checked}"
     )
+    # ensemble CODE_PROBE coverage (the Joshua probe-accounting role):
+    # a declared probe no seed hit means our randomization never reaches
+    # that rare path — widen the ensemble or fix the path.
+    fired = {k: v for k, v in _probes.snapshot().items() if v}
+    print(f"CODE_PROBEs fired ({len(fired)}):")
+    for k in sorted(fired):
+        print(f"  {k}: {fired[k]}")
+    missed = _probes.missed()
+    if missed:
+        print(f"CODE_PROBEs NEVER HIT ({len(missed)}): {missed}")
     if failures:
         print("FAILURES:")
         for s, e in failures:
