@@ -273,6 +273,21 @@ class CommitProxy:
         self, batch: list[CommitRequest], batch_num: int
     ) -> None:
         self.counters.add("commitBatchIn")
+        # span per commit batch (the reference's commitBatch span,
+        # Tracing.actor.cpp); children: the resolution requests
+        from foundationdb_tpu.utils.spans import Span
+
+        batch_span = Span(
+            f"{self.proxy_id}.commitBatch", clock=self.sched.now
+        ).attribute("txns", len(batch))
+        try:
+            await self._commit_batch_spanned(batch, batch_num, batch_span)
+        finally:
+            # failure paths (dead resolver, recovery kill) still export
+            batch_span.finish()
+
+    async def _commit_batch_spanned(self, batch, batch_num, batch_span):
+        txns = [r.transaction for r in batch]
         # Phase 1: order batches, get the version pair.
         await self.latest_batch_resolving.when_at_least(batch_num - 1)
         self._request_num += 1
@@ -282,7 +297,6 @@ class CommitProxy:
         prev_version, version = vreply.prev_version, vreply.version
 
         # Phase 2: resolution.
-        txns = [r.transaction for r in batch]
         if self.conservative_writes:
             code_probe(True, "proxy.conservative_write_injected")
             moved, self.conservative_writes = self.conservative_writes, []
@@ -301,6 +315,8 @@ class CommitProxy:
         reqs, txn_resolver_map, range_maps = self._build_resolution_requests(
             txns, prev_version, version
         )
+        for rq in reqs:
+            rq.span = batch_span.context.as_tuple()
         self.latest_batch_resolving.set(batch_num)
         replies = await all_of(
             [
@@ -364,6 +380,7 @@ class CommitProxy:
         self.latest_batch_logging.set(batch_num)
 
         # Phase 5: reply.
+        batch_span.attribute("version", version)
         self.sequencer.report_live_committed_version(version)
         self.committed_version.set(version)
         for t, req in enumerate(batch):

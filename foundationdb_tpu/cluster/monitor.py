@@ -1,0 +1,187 @@
+"""fdbmonitor analog: supervise role processes, restart them on death.
+
+The reference ships `fdbmonitor` (fdbmonitor/fdbmonitor.cpp, 1,944 LoC):
+a small non-Flow supervisor that reads `foundationdb.conf`, launches the
+configured fdbserver processes, restarts them with backoff when they die,
+and re-reads the conf on SIGHUP. Same contract here for the multiprocess
+roles:
+
+* conf: an INI-like file with one `[role.<name>]` section per process —
+  role kind, socket address, optional data dir / backend / tlog address
+  (for storage catch-up on restart).
+* supervision loop: poll children; a dead child is restarted after an
+  exponential backoff (reset once it stays up), exactly fdbmonitor's
+  delay discipline.
+* SIGHUP (or `reload()`): re-read the conf — new sections launch,
+  removed sections are stopped.
+
+Used programmatically (`Monitor(conf_path).run_forever()`) or as
+`python -m foundationdb_tpu.cluster.monitor <conf>`.
+"""
+
+from __future__ import annotations
+
+import configparser
+import dataclasses
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Optional
+
+from foundationdb_tpu.cluster.multiprocess import spawn_role
+
+
+@dataclasses.dataclass
+class RoleSpec:
+    name: str
+    kind: str                      # resolver | tlog | storage
+    socket_dir: str
+    index: int = 0
+    backend: str = "native"
+    data_dir: Optional[str] = None
+    tlog_address: Optional[str] = None
+
+    @property
+    def address(self) -> str:
+        return os.path.join(self.socket_dir, f"{self.kind}{self.index}.sock")
+
+
+def parse_conf(path: str) -> dict[str, RoleSpec]:
+    """Parse the foundationdb.conf-style role file."""
+    cp = configparser.ConfigParser()
+    with open(path) as f:
+        cp.read_file(f)
+    specs: dict[str, RoleSpec] = {}
+    for section in cp.sections():
+        if not section.startswith("role."):
+            continue
+        name = section[len("role."):]
+        sec = cp[section]
+        specs[name] = RoleSpec(
+            name=name,
+            kind=sec["kind"],
+            socket_dir=sec["socket_dir"],
+            index=sec.getint("index", 0),
+            backend=sec.get("backend", "native"),
+            data_dir=sec.get("data_dir", None),
+            tlog_address=sec.get("tlog_address", None),
+        )
+    return specs
+
+
+@dataclasses.dataclass
+class _Child:
+    spec: RoleSpec
+    proc: object  # RoleProcess
+    started_at: float
+    backoff: float
+    restart_at: Optional[float] = None  # set while waiting out a backoff
+
+
+class Monitor:
+    """Supervises one conf's role processes (fdbmonitor's loop)."""
+
+    INITIAL_BACKOFF = 0.2
+    MAX_BACKOFF = 30.0
+    #: uptime after which the backoff resets (fdbmonitor's restart delay
+    #: resets once the child proves stable)
+    STABLE_AFTER = 5.0
+
+    def __init__(self, conf_path: str, *, log=print):
+        self.conf_path = conf_path
+        self.log = log
+        self.children: dict[str, _Child] = {}
+        self.restarts: dict[str, int] = {}
+        self._stop = False
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start_all(self) -> None:
+        for name, spec in parse_conf(self.conf_path).items():
+            if name not in self.children:
+                self._launch(spec)
+
+    def _launch(self, spec: RoleSpec) -> None:
+        # a stale socket from a dead child blocks rebinding
+        try:
+            os.unlink(spec.address)
+        except FileNotFoundError:
+            pass
+        proc = spawn_role(
+            spec.kind,
+            spec.socket_dir,
+            backend=spec.backend,
+            index=spec.index,
+            data_dir=spec.data_dir,
+            tlog_address=spec.tlog_address,
+        )
+        self.children[spec.name] = _Child(
+            spec=spec, proc=proc, started_at=time.monotonic(),
+            backoff=self.INITIAL_BACKOFF,
+        )
+        self.log(f"[monitor] launched {spec.name} ({spec.kind}) "
+                 f"pid={proc.proc.pid}")
+
+    def poll_once(self) -> None:
+        """One supervision pass: restart whatever died (with backoff).
+
+        Never blocks: a dead child gets a restart DEADLINE and is
+        relaunched on a later pass once its backoff elapses, so one
+        crash-looping role cannot stall supervision of the others (or
+        signal handling) — fdbmonitor's per-process delay discipline.
+        """
+        now = time.monotonic()
+        for name, child in list(self.children.items()):
+            if child.restart_at is not None:
+                if now >= child.restart_at:
+                    self.restarts[name] = self.restarts.get(name, 0) + 1
+                    backoff = min(child.backoff * 2, self.MAX_BACKOFF)
+                    self._launch(child.spec)
+                    self.children[name].backoff = backoff
+                continue
+            rc = child.proc.proc.poll()
+            if rc is None:
+                if now - child.started_at > self.STABLE_AFTER:
+                    child.backoff = self.INITIAL_BACKOFF
+                continue
+            self.log(f"[monitor] {name} died rc={rc}; restarting in "
+                     f"{child.backoff:.1f}s")
+            child.restart_at = now + child.backoff
+
+    def reload(self) -> None:
+        """Re-read the conf: launch new sections, stop removed ones."""
+        specs = parse_conf(self.conf_path)
+        for name in [n for n in self.children if n not in specs]:
+            self.log(f"[monitor] {name} removed from conf; stopping")
+            self.children.pop(name).proc.stop()
+        for name, spec in specs.items():
+            if name not in self.children:
+                self._launch(spec)
+
+    def stop_all(self) -> None:
+        self._stop = True
+        for child in self.children.values():
+            child.proc.stop()
+        self.children.clear()
+
+    def run_forever(self, *, poll_interval: float = 0.25) -> None:
+        self.start_all()
+        signal.signal(signal.SIGHUP, lambda *_: self.reload())
+        signal.signal(signal.SIGTERM, lambda *_: self.stop_all())
+        while not self._stop:
+            self.poll_once()
+            time.sleep(poll_interval)
+
+
+def main() -> None:
+    if len(sys.argv) != 2:
+        print("usage: python -m foundationdb_tpu.cluster.monitor <conf>",
+              file=sys.stderr)
+        sys.exit(2)
+    Monitor(sys.argv[1]).run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -64,6 +64,9 @@ class ResolveTransactionBatchRequest:
     txn_state_transactions: list[int] = dataclasses.field(default_factory=list)
     proxy_id: Optional[str] = None  # stands in for the reply endpoint address
     debug_id: Optional[str] = None
+    # OTEL-style span context (trace_id, span_id) — the reference threads
+    # a SpanContext on every request (ResolverInterface.h:129)
+    span: Optional[tuple] = None
 
 
 @dataclasses.dataclass

@@ -216,6 +216,19 @@ class Resolver:
         retry elsewhere or die).
         """
         request_time = self.sched.now()
+        from foundationdb_tpu.utils.spans import Span, SpanContext
+
+        span = Span(
+            f"resolver{self.resolver_id}.resolveBatch",
+            parent=SpanContext(*req.span) if req.span else None,
+            clock=self.sched.now,
+        ).attribute("version", req.version)
+        try:
+            return await self._resolve_spanned(req, span, request_time)
+        finally:
+            span.finish()  # failure/cancellation paths still export
+
+    async def _resolve_spanned(self, req, span, request_time):
         proxy_key = req.proxy_id if req.prev_version >= 0 else None
         proxy_info = self.proxy_info.setdefault(proxy_key, _ProxyRequestsInfo())
         self.counters.add("resolveBatchIn")
@@ -414,6 +427,7 @@ class Resolver:
             )
         out = proxy_info.outstanding_batches.get(req.version)
         code_probe(out is None, "resolver.unknown_duplicate_never")
+        span.attribute("txns", len(req.transactions))
         return out  # None == the reference's Never()
 
     # -- balancer endpoints (ResolverInterface metrics/split) -------------

@@ -28,7 +28,7 @@ from foundationdb_tpu.models.types import (
 )
 
 #: Bumped whenever any wire layout changes; checked at connect time.
-PROTOCOL_VERSION = 0x0FDB_7E50_0003  # +1: private_mutations in resolve reply
+PROTOCOL_VERSION = 0x0FDB_7E50_0004  # +1: private_mutations reply field; +1: span context on resolve requests
 
 
 class CodecError(ValueError):
@@ -221,6 +221,10 @@ def w_resolve_request(out: list, r: ResolveTransactionBatchRequest) -> None:
         w_u32(out, i)
     w_str(out, r.proxy_id)
     w_str(out, r.debug_id)
+    # span context: (trace_id, span_id), zeros = absent
+    tid, sid = r.span if r.span else (0, 0)
+    w_u64(out, tid)
+    w_u64(out, sid)
 
 
 def r_resolve_request(
@@ -241,6 +245,8 @@ def r_resolve_request(
         state_idx.append(i)
     proxy_id, off = r_str(buf, off)
     debug_id, off = r_str(buf, off)
+    tid, off = r_u64(buf, off)
+    sid, off = r_u64(buf, off)
     return (
         ResolveTransactionBatchRequest(
             prev_version=prev,
@@ -250,6 +256,7 @@ def r_resolve_request(
             txn_state_transactions=state_idx,
             proxy_id=proxy_id,
             debug_id=debug_id,
+            span=(tid, sid) if (tid or sid) else None,
         ),
         off,
     )

@@ -1,0 +1,130 @@
+"""fdbmonitor analog: conf-driven supervision, restart-on-death, reload.
+
+The supervisor must relaunch a SIGKILLed role (with its data dir, so a
+persistent tlog recovers), pick up conf changes on reload, and keep the
+cluster usable across the restart (fdbmonitor/fdbmonitor.cpp's contract).
+"""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from foundationdb_tpu.cluster import multiprocess as mp
+from foundationdb_tpu.cluster.monitor import Monitor, parse_conf
+from foundationdb_tpu.models.types import CommitTransaction
+from foundationdb_tpu.wire.codec import Mutation
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def write_conf(path, socket_dir, tlog_dir, extra=""):
+    with open(path, "w") as f:
+        f.write(f"""
+[role.r0]
+kind = resolver
+socket_dir = {socket_dir}
+
+[role.t0]
+kind = tlog
+socket_dir = {socket_dir}
+data_dir = {tlog_dir}
+{extra}
+""")
+
+
+def test_parse_conf(tmp_path):
+    conf = tmp_path / "cluster.conf"
+    write_conf(conf, str(tmp_path), str(tmp_path / "td"))
+    specs = parse_conf(str(conf))
+    assert set(specs) == {"r0", "t0"}
+    assert specs["t0"].kind == "tlog"
+    assert specs["t0"].data_dir == str(tmp_path / "td")
+    assert specs["r0"].data_dir is None
+
+
+def test_restart_on_death_and_reload(tmp_path):
+    conf = tmp_path / "cluster.conf"
+    sock_dir = str(tmp_path / "socks")
+    os.makedirs(sock_dir)
+    tlog_dir = str(tmp_path / "tlog-data")
+    write_conf(conf, sock_dir, tlog_dir)
+    mon = Monitor(str(conf), log=lambda *a: None)
+    mon.start_all()
+    try:
+        tlog_addr = mon.children["t0"].spec.address
+
+        async def push_one(version, prev):
+            c = await mp.connect(tlog_addr)
+            try:
+                rep = await c.call(
+                    mp.TOKEN_TLOG_PUSH,
+                    mp.TLogPush(version=version, prev_version=prev,
+                                mutations=[Mutation(0, b"k", b"v")]),
+                )
+                return rep.durable_version
+            finally:
+                await c.close()
+
+        assert run(push_one(10, -1)) == 10
+
+        # SIGKILL the tlog; the monitor must relaunch it with the same
+        # data dir, and the DiskQueue recovery must restore version 10
+        pid = mon.children["t0"].proc.proc.pid
+        mon.children["t0"].proc.proc.kill()
+        mon.children["t0"].proc.proc.wait()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            mon.poll_once()
+            if mon.children["t0"].proc.proc.poll() is None and \
+                    mon.children["t0"].proc.proc.pid != pid:
+                break
+            time.sleep(0.1)
+        assert mon.restarts.get("t0") == 1
+
+        async def get_version():
+            c = await mp.connect(tlog_addr)
+            try:
+                rep = await c.call(
+                    mp.TOKEN_TLOG_VERSION, mp.RoleVersionReq(pad=0))
+                return rep.version
+            finally:
+                await c.close()
+
+        assert run(get_version()) == 10  # recovered from disk
+        assert run(push_one(20, 10)) == 20  # and accepting new pushes
+
+        # conf reload: add a storage role, drop the resolver
+        with open(conf, "w") as f:
+            f.write(f"""
+[role.t0]
+kind = tlog
+socket_dir = {sock_dir}
+data_dir = {tlog_dir}
+
+[role.s0]
+kind = storage
+socket_dir = {sock_dir}
+""")
+        mon.reload()
+        assert set(mon.children) == {"t0", "s0"}
+
+        async def storage_up():
+            c = await mp.connect(mon.children["s0"].spec.address)
+            try:
+                rep = await c.call(
+                    mp.TOKEN_STORAGE_VERSION, mp.RoleVersionReq(pad=0))
+                return rep.version
+            finally:
+                await c.close()
+
+        assert run(storage_up()) == 0
+    finally:
+        mon.stop_all()

@@ -1,0 +1,61 @@
+"""OTEL-style spans: contexts propagate client->proxy->resolver.
+
+The reference threads SpanContexts on every RPC and exports finished
+spans (fdbclient/Tracing.actor.cpp; ResolverInterface.h:129 spanContext).
+A commit through the sim cluster must yield a proxy commitBatch span
+with resolver child spans in the same trace, timed in virtual time.
+"""
+
+from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+from foundationdb_tpu.utils import spans
+
+
+def test_commit_produces_span_tree():
+    exporter = spans.SpanExporter()
+    prev = spans.set_exporter(exporter)
+    sched, cluster, db = open_cluster(
+        ClusterConfig(n_commit_proxies=1, n_resolvers=2, n_storage=2)
+    )
+
+    async def go():
+        t = db.create_transaction()
+        t.set(b"k", b"v")
+        await t.commit()
+        return True
+
+    task = sched.spawn(go(), name="drive")
+    sched.run_until(task.done)
+    assert task.done.get()
+    cluster.stop()
+    spans.set_exporter(prev)
+
+    proxy_spans = [s for s in exporter.finished
+                   if s["location"].endswith("commitBatch")]
+    assert proxy_spans, exporter.finished
+    batch = next(s for s in proxy_spans if s["attributes"].get("txns"))
+    children = [
+        s for s in exporter.finished
+        if s["parent_id"] == batch["span_id"]
+        and s["trace_id"] == batch["trace_id"]
+    ]
+    # both resolver shards resolved under this batch span
+    locs = {s["location"] for s in children}
+    assert {"resolver0.resolveBatch", "resolver1.resolveBatch"} <= locs
+    # spans are timed in virtual time: children nest inside the parent
+    for c in children:
+        assert batch["begin"] <= c["begin"] <= c["end"] <= batch["end"]
+
+
+def test_span_codec_roundtrip():
+    from foundationdb_tpu.models.types import ResolveTransactionBatchRequest
+    from foundationdb_tpu.wire import codec
+
+    req = ResolveTransactionBatchRequest(
+        prev_version=0, version=10, last_received_version=0,
+        span=(12345, 678),
+    )
+    got = codec.decode(codec.encode(req))
+    assert got.span == (12345, 678)
+    req2 = ResolveTransactionBatchRequest(
+        prev_version=0, version=10, last_received_version=0)
+    assert codec.decode(codec.encode(req2)).span is None
