@@ -179,6 +179,20 @@ class DataDistributor:
                 s for s in range(len(cluster.storage_servers))
                 if cluster.storage_live[s] and s not in team
             ]
+            # locality-aware repair: prefer replacements that keep the
+            # team satisfying the replication policy (PolicyAcross zones)
+            policy = getattr(cluster.config, "replication_policy", None)
+            localities = getattr(cluster.config, "storage_localities", None)
+            if policy is not None and localities is not None:
+                from foundationdb_tpu.cluster.locality import validate_team
+
+                keep = tuple(s for s in team if s != dead)
+                good = [
+                    c for c in candidates
+                    if validate_team(keep + (c,), localities, policy)
+                ]
+                if good:
+                    candidates = good
             if replacement in candidates:
                 pick = replacement
             elif candidates:

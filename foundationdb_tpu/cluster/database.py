@@ -42,6 +42,11 @@ class ClusterConfig:
     # coordination quorum size (CoordinatedState/LeaderElection); recovery
     # requires a majority of these alive
     n_coordinators: int = 3
+    # optional failure-domain topology: server id -> LocalityData, plus a
+    # replication policy (cluster/locality.py) that storage teams must
+    # satisfy (PolicyAcross zones/DCs — fdbrpc/ReplicationPolicy.cpp)
+    storage_localities: dict = None
+    replication_policy: object = None
     # When set, role-to-role calls go through a SimNetwork with this seed
     # (deterministic latency; clogging/partition fault injection).
     sim_seed: int = None
@@ -62,6 +67,23 @@ class ClusterConfig:
     window_versions: int = None      # default: kernel_config.window_versions
 
     def __post_init__(self):
+        if self.replication_policy is not None:
+            if self.storage_localities is None:
+                raise ValueError("replication_policy requires storage_localities")
+            bad = [s for s in self.storage_localities if not (
+                isinstance(s, int) and 0 <= s < self.n_storage)]
+            if bad:
+                raise ValueError(
+                    f"storage_localities ids {bad} out of range for "
+                    f"n_storage={self.n_storage}"
+                )
+            if self.replication_policy.min_replicas != self.replication_factor:
+                raise ValueError(
+                    f"replication_factor={self.replication_factor} != "
+                    f"policy.min_replicas="
+                    f"{self.replication_policy.min_replicas}: team size is "
+                    "the policy's — make them agree explicitly"
+                )
         if self.replication_factor > self.n_storage:
             raise ValueError(
                 f"replication_factor {self.replication_factor} > "
@@ -94,6 +116,8 @@ class Cluster:
             list(cfg.storage_boundaries),
             replication=cfg.replication_factor,
             n_servers=cfg.n_storage,
+            localities=cfg.storage_localities,
+            policy=cfg.replication_policy,
         )
         self.resolvers = [
             Resolver(

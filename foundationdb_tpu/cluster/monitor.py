@@ -54,12 +54,13 @@ def parse_conf(path: str) -> dict[str, RoleSpec]:
     with open(path) as f:
         cp.read_file(f)
     specs: dict[str, RoleSpec] = {}
+    addresses: dict[str, str] = {}
     for section in cp.sections():
         if not section.startswith("role."):
             continue
         name = section[len("role."):]
         sec = cp[section]
-        specs[name] = RoleSpec(
+        spec = RoleSpec(
             name=name,
             kind=sec["kind"],
             socket_dir=sec["socket_dir"],
@@ -68,6 +69,13 @@ def parse_conf(path: str) -> dict[str, RoleSpec]:
             data_dir=sec.get("data_dir", None),
             tlog_address=sec.get("tlog_address", None),
         )
+        if spec.address in addresses:
+            raise ValueError(
+                f"[role.{name}] and [role.{addresses[spec.address]}] share "
+                f"socket {spec.address}: give them distinct index values"
+            )
+        addresses[spec.address] = name
+        specs[name] = spec
     return specs
 
 
@@ -95,6 +103,7 @@ class Monitor:
         self.children: dict[str, _Child] = {}
         self.restarts: dict[str, int] = {}
         self._stop = False
+        self._want_reload = False
 
     # -- lifecycle -------------------------------------------------------
 
@@ -151,13 +160,19 @@ class Monitor:
             child.restart_at = now + child.backoff
 
     def reload(self) -> None:
-        """Re-read the conf: launch new sections, stop removed ones."""
+        """Re-read the conf: launch new sections, stop removed ones, and
+        RESTART sections whose spec changed (fdbmonitor restarts changed
+        processes; a crash-restart must never resurrect a stale spec)."""
         specs = parse_conf(self.conf_path)
         for name in [n for n in self.children if n not in specs]:
             self.log(f"[monitor] {name} removed from conf; stopping")
             self.children.pop(name).proc.stop()
         for name, spec in specs.items():
             if name not in self.children:
+                self._launch(spec)
+            elif self.children[name].spec != spec:
+                self.log(f"[monitor] {name} conf changed; restarting")
+                self.children.pop(name).proc.stop()
                 self._launch(spec)
 
     def stop_all(self) -> None:
@@ -167,12 +182,26 @@ class Monitor:
         self.children.clear()
 
     def run_forever(self, *, poll_interval: float = 0.25) -> None:
+        """Supervision loop. Signal handlers only SET FLAGS; the loop acts
+        on them between passes — mutating children from a handler mid-pass
+        could leak an orphan child or resurrect a removed role
+        (fdbmonitor serializes signals into its main loop the same way).
+        """
         self.start_all()
-        signal.signal(signal.SIGHUP, lambda *_: self.reload())
-        signal.signal(signal.SIGTERM, lambda *_: self.stop_all())
+        signal.signal(
+            signal.SIGHUP,
+            lambda *_: setattr(self, "_want_reload", True),
+        )
+        signal.signal(
+            signal.SIGTERM, lambda *_: setattr(self, "_stop", True)
+        )
         while not self._stop:
+            if self._want_reload:
+                self._want_reload = False
+                self.reload()
             self.poll_once()
             time.sleep(poll_interval)
+        self.stop_all()
 
 
 def main() -> None:

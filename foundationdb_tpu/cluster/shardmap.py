@@ -31,7 +31,14 @@ class ShardMap:
 
     @classmethod
     def even(cls, boundaries: list[bytes], *, replication: int = 1,
-             n_servers: int = None) -> "ShardMap":
+             n_servers: int = None, localities: dict = None,
+             policy=None) -> "ShardMap":
+        """Even key split. With `localities` (server id -> LocalityData)
+        and a replication `policy` (cluster/locality.py), every team is
+        built to satisfy the policy — replicas across distinct failure
+        domains, DDTeamCollection-style — rotating the preference so load
+        spreads. Without a policy: simple rotation (legacy behavior).
+        """
         n_shards = len(boundaries) + 1
         n_servers = n_servers or n_shards
         if replication > n_servers:
@@ -39,10 +46,26 @@ class ShardMap:
                 f"replication {replication} > n_servers {n_servers} would "
                 "put the same server on a team twice"
             )
-        owners = [
-            tuple((i + j) % n_servers for j in range(replication))
-            for i in range(n_shards)
-        ]
+        if policy is not None:
+            from foundationdb_tpu.cluster.locality import build_team
+
+            assert localities is not None, "policy needs localities"
+            server_ids = sorted(localities)
+            owners = [
+                build_team(
+                    localities, policy,
+                    prefer=tuple(
+                        server_ids[(i + j) % len(server_ids)]
+                        for j in range(len(server_ids))
+                    ),
+                )
+                for i in range(n_shards)
+            ]
+        else:
+            owners = [
+                tuple((i + j) % n_servers for j in range(replication))
+                for i in range(n_shards)
+            ]
         return cls(boundaries, owners)
 
     # -- lookup (keyServers reads) ----------------------------------------

@@ -13,21 +13,23 @@ from foundationdb_tpu.utils import spans
 def test_commit_produces_span_tree():
     exporter = spans.SpanExporter()
     prev = spans.set_exporter(exporter)
-    sched, cluster, db = open_cluster(
-        ClusterConfig(n_commit_proxies=1, n_resolvers=2, n_storage=2)
-    )
+    try:
+        sched, cluster, db = open_cluster(
+            ClusterConfig(n_commit_proxies=1, n_resolvers=2, n_storage=2)
+        )
 
-    async def go():
-        t = db.create_transaction()
-        t.set(b"k", b"v")
-        await t.commit()
-        return True
+        async def go():
+            t = db.create_transaction()
+            t.set(b"k", b"v")
+            await t.commit()
+            return True
 
-    task = sched.spawn(go(), name="drive")
-    sched.run_until(task.done)
-    assert task.done.get()
-    cluster.stop()
-    spans.set_exporter(prev)
+        task = sched.spawn(go(), name="drive")
+        sched.run_until(task.done)
+        assert task.done.get()
+        cluster.stop()
+    finally:
+        spans.set_exporter(prev)
 
     proxy_spans = [s for s in exporter.finished
                    if s["location"].endswith("commitBatch")]
