@@ -332,6 +332,34 @@ class Database:
             return str(self.cluster.controller.epoch).encode()
         if key == b"\xff\xff/cluster/live_committed_version":
             return str(self.cluster.sequencer.live_committed.get()).encode()
+        if key == b"\xff\xff/worker_interfaces":
+            # the recruited role inventory (worker_interfaces module of
+            # SpecialKeySpace: who is serving what)
+            return json.dumps({
+                "commit_proxies": [p.proxy_id for p in
+                                   self.cluster.commit_proxies],
+                "resolvers": [f"resolver{r.resolver_id}"
+                              for r in self.cluster.resolvers],
+                "storage": [f"storage{i}" for i, live in
+                            enumerate(self.cluster.storage_live) if live],
+                "coordinators": [c.name for c in self.cluster.coordinators
+                                 if c.alive],
+            }).encode()
+        if key == b"\xff\xff/metrics/resolver":
+            # resolver counter rollup (the metrics module surface)
+            out = []
+            for r in self.cluster.resolvers:
+                out.append(r.counters.as_dict())
+            return json.dumps(out).encode()
+        if key == b"\xff\xff/coordinators":
+            return json.dumps({
+                "quorum": len(self.cluster.coordinators) // 2 + 1,
+                "alive": sum(c.alive for c in self.cluster.coordinators),
+                "total": len(self.cluster.coordinators),
+            }).encode()
+        if key == b"\xff\xff/data_distribution/key_counts":
+            return json.dumps(
+                self.cluster.data_distributor.key_counts()).encode()
         return None
 
     async def run(self, fn, *, max_retries: int = 50, idempotent: bool = False):

@@ -77,6 +77,15 @@ class ClusterConfig:
                     f"storage_localities ids {bad} out of range for "
                     f"n_storage={self.n_storage}"
                 )
+            missing = [s for s in range(self.n_storage)
+                       if s not in self.storage_localities]
+            if missing:
+                # teams are built from localities keys; an uncovered
+                # server would silently own zero shards forever
+                raise ValueError(
+                    f"storage_localities missing ids {missing}: every "
+                    f"server needs a declared failure domain"
+                )
             if self.replication_policy.min_replicas != self.replication_factor:
                 raise ValueError(
                     f"replication_factor={self.replication_factor} != "

@@ -92,11 +92,13 @@ def build_team(
 ) -> tuple:
     """Pick a minimal team of server ids satisfying `policy`.
 
-    Greedy with exhaustive fallback: try preferred servers first, then
-    search minimal-size combinations. Raises PolicyUnsatisfiableError if
-    no subset of the live topology can satisfy the policy — recruitment
-    must fail loudly, not silently under-replicate (the reference's
-    recruitment error paths).
+    Exhaustive minimal-size search in preference order: the first
+    satisfying combination of exactly policy.min_replicas servers wins
+    (complete — any satisfying superset contains a min-size satisfying
+    subset). Worst case O(C(n, r)) validate calls; topologies here are
+    small. Raises PolicyUnsatisfiableError if no subset of the live
+    topology can satisfy the policy — recruitment must fail loudly,
+    never silently under-replicate.
     """
     candidates = [s for s in localities if s not in exclude]
     ordered = [s for s in prefer if s in candidates] + [
@@ -104,7 +106,6 @@ def build_team(
     ]
     size = policy.min_replicas
     if size <= len(ordered):
-        # greedy pass: extend by the first server that adds a new group
         for combo in itertools.combinations(ordered, size):
             if policy.validate([localities[s] for s in combo]):
                 return tuple(sorted(combo))

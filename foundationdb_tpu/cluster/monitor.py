@@ -195,13 +195,22 @@ class Monitor:
         signal.signal(
             signal.SIGTERM, lambda *_: setattr(self, "_stop", True)
         )
-        while not self._stop:
-            if self._want_reload:
-                self._want_reload = False
-                self.reload()
-            self.poll_once()
-            time.sleep(poll_interval)
-        self.stop_all()
+        try:
+            while not self._stop:
+                if self._want_reload:
+                    self._want_reload = False
+                    try:
+                        self.reload()
+                    except Exception as e:
+                        # a bad conf must not kill the monitor: keep
+                        # supervising with the old one (fdbmonitor's
+                        # behavior on an unparseable reload)
+                        self.log(f"[monitor] reload failed, keeping old "
+                                 f"conf: {e}")
+                self.poll_once()
+                time.sleep(poll_interval)
+        finally:
+            self.stop_all()  # never orphan children, even on a crash
 
 
 def main() -> None:

@@ -186,3 +186,34 @@ def test_cycle_workload_invariant(world):
         seen.add(at)
         at = ptrs[at]
     assert at == 0 and len(seen) == n
+
+
+def test_special_key_space_modules():
+    """SpecialKeySpace surface: worker inventory, resolver metrics,
+    coordinators, DD key counts (SpecialKeySpace.actor.cpp modules)."""
+    import json
+
+    sched, cluster, db = open_cluster(
+        ClusterConfig(n_commit_proxies=1, n_resolvers=1, n_storage=2)
+    )
+
+    async def go():
+        t = db.create_transaction()
+        t.set(b"k", b"v")
+        await t.commit()
+        t = db.create_transaction()
+        w = json.loads(await t.get(b"\xff\xff/worker_interfaces"))
+        assert w["resolvers"] and w["storage"] and w["coordinators"]
+        m = json.loads(await t.get(b"\xff\xff/metrics/resolver"))
+        assert m[0]["resolveBatchIn"] > 0
+        c = json.loads(await t.get(b"\xff\xff/coordinators"))
+        assert c["alive"] == c["total"] == 3 and c["quorum"] == 2
+        kc = json.loads(await t.get(b"\xff\xff/data_distribution/key_counts"))
+        assert isinstance(kc, list)
+        assert await t.get(b"\xff\xff/definitely/not/a/module") is None
+        return True
+
+    task = sched.spawn(go(), name="drive")
+    sched.run_until(task.done)
+    assert task.done.get()
+    cluster.stop()

@@ -82,9 +82,8 @@ def test_cluster_teams_honor_policy_and_repair():
         t = db.create_transaction()
         t.set(b"k1", b"v1")
         await t.commit()
-        # kill server 2 (the only z2 member besides... z2={2,3? no:
-        # 2 is z2, 3 is z3}); repair must pick a replacement that keeps
-        # each repaired team cross-zone where possible
+        # kill server 2 (the sole z2 member): repair must rebuild each
+        # affected team cross-zone from the z1/z3 survivors
         cluster.kill_storage(2)
         await cluster.data_distributor.repair(2)
         for team in cluster.key_servers.owners:
