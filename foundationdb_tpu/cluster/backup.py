@@ -94,7 +94,18 @@ class BackupAgent:
 
     # -- snapshot (range files; FileBackupAgent range tasks) ---------------
 
+    def register_log_consumer(self, cluster) -> None:
+        """Must precede (or coincide with) the snapshot: proxies emit the
+        full-stream tag only while a log consumer is registered, so a
+        mutation between the snapshot's read version and registration
+        would otherwise be on neither the snapshot nor the stream."""
+        cluster.tlog.register_consumer("backup")
+        self._tlog = cluster.tlog
+
     async def snapshot(self, *, chunk: int = 1000) -> int:
+        cluster = getattr(self.db, "cluster", None)
+        if cluster is not None:
+            self.register_log_consumer(cluster)
         """Full range snapshot at one read version; returns that version."""
         txn = self.db.create_transaction()
         version = await txn.get_read_version()
@@ -116,20 +127,20 @@ class BackupAgent:
     def start_log_backup(self, cluster) -> None:
         sched = self.db.sched
         tlog = cluster.tlog
-        n_tags = len(cluster.storage_servers)
-        tlog.register_consumer("backup")
-        self._tlog = tlog
+        from foundationdb_tpu.cluster.tlog import LOG_STREAM_TAG
+
+        self.register_log_consumer(cluster)
 
         async def pull():
             try:
+                # the full-stream tag: every committed mutation exactly
+                # once, in commit order — per-storage tags would replay a
+                # mutation once per team replica (atomics would double-
+                # apply on restore in replicated configs)
                 after = self.log_version
                 while True:
-                    entries: dict[int, list] = {}
-                    log_version = after
-                    for tag in range(n_tags):
-                        got, log_version = await tlog.peek(tag, after)
-                        for v, msgs in got:
-                            entries.setdefault(v, []).extend(msgs)
+                    got, log_version = await tlog.peek(LOG_STREAM_TAG, after)
+                    entries = {v: msgs for v, msgs in got if msgs}
                     if entries:
                         # zero-padded version keys: restore sorts these
                         # strings, so unpadded digits would replay out of
@@ -140,8 +151,7 @@ class BackupAgent:
                         )
                     after = max(log_version, max(entries, default=0))
                     self.log_version = after
-                    for tag in range(n_tags):
-                        tlog.pop(tag, after, consumer="backup")
+                    tlog.pop(LOG_STREAM_TAG, after, consumer="backup")
                     await tlog.version.when_at_least(after + 1)
             except ActorCancelled:
                 raise

@@ -105,6 +105,9 @@ class Transaction:
         self.committed_version: Optional[int] = None
         self._versionstamp: Optional[bytes] = None
         self.idempotency_id: Optional[bytes] = None
+        # set by the DR agent: its own applies may write while the
+        # database is DR-locked (cluster/dr.py)
+        self.dr_bypass = False
 
     # -- reads ------------------------------------------------------------
 
@@ -244,6 +247,14 @@ class Transaction:
             # (Transaction::commit fast path).
             self.committed_version = await self.get_read_version()
             return self.committed_version
+        if getattr(self.db, "dr_locked", False) and not self.dr_bypass:
+            # databaseLocked: a DR destination refuses ordinary commits
+            # (the reference checks \xff/dbLocked on every commit)
+            from foundationdb_tpu.cluster.dr import DestinationLockedError
+
+            raise DestinationLockedError(
+                "database is a DR destination; writes are locked"
+            )
         rv = await self.get_read_version()
         mutations = list(self.mutations)
         if self.idempotency_id is not None:
@@ -256,6 +267,7 @@ class Transaction:
             read_snapshot=rv,
             report_conflicting_keys=self.report_conflicting_keys,
             mutations=mutations,
+            lock_aware=self.dr_bypass,
         )
         ctr.validate()
         commit_id = await self.db.commit_proxy().commit(ctr).future
@@ -279,6 +291,7 @@ class Database:
         self.sched = cluster.sched
         self._next_proxy = 0
         self._read_rr = 0  # replica rotation (loadBalance's next-replica)
+        self.dr_locked = False  # set while this db is a DR destination
 
     @property
     def grv_proxy(self):

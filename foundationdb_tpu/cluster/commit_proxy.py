@@ -56,6 +56,16 @@ from foundationdb_tpu.models.types import (  # noqa: F401 (re-export)
 )
 
 
+#: the databaseLocked key (cluster/dr.py writes it; the reference's
+#: analog is \xff/dbLocked consulted by proxies via the txnStateStore)
+DB_LOCK_KEY = b"\xff/dr/locked"
+
+
+class DatabaseLockedError(Exception):
+    """error_code_database_locked: commits refused while the database is
+    locked (DR destination / retired DR source)."""
+
+
 class NotCommitted(Exception):
     """error_code_not_committed; carries the conflicting read-range report."""
 
@@ -141,6 +151,7 @@ class CommitProxy:
         batch_interval: float = 0.005,
         max_batch_txns: int = 512,
         on_state_mutation: Optional[Callable[[Any], None]] = None,
+        txn_state_view: Optional[dict] = None,
     ):
         self.sched = sched
         self.epoch = epoch
@@ -153,6 +164,9 @@ class CommitProxy:
         self.batch_interval = batch_interval
         self.max_batch_txns = max_batch_txns
         self.on_state_mutation = on_state_mutation
+        # read-only view of the materialized txn-state store: the
+        # dbLocked check consults it so EVERY client handle is covered
+        self.txn_state_view = txn_state_view if txn_state_view is not None else {}
 
         self.requests = PromiseStream()
         self._batch_num = 0
@@ -287,6 +301,27 @@ class CommitProxy:
             batch_span.finish()
 
     async def _commit_batch_spanned(self, batch, batch_num, batch_span):
+        # databaseLocked (NativeAPI's commit check against \xff/dbLocked,
+        # here proxy-side via the materialized txn-state store so no
+        # client handle can bypass it): non-lock-aware txns fail fast.
+        if self.txn_state_view.get(DB_LOCK_KEY) is not None:
+            passing = []
+            for r in batch:
+                if getattr(r.transaction, "lock_aware", False):
+                    passing.append(r)
+                else:
+                    r.reply.send_error(DatabaseLockedError())
+            batch = passing
+            if not batch:
+                # the batch-ordering chains must still advance — IN ORDER
+                # (set() without awaiting the predecessor would violate
+                # the monotonic Notified contract when an earlier batch
+                # is still mid-flight)
+                await self.latest_batch_resolving.when_at_least(batch_num - 1)
+                self.latest_batch_resolving.set(batch_num)
+                await self.latest_batch_logging.when_at_least(batch_num - 1)
+                self.latest_batch_logging.set(batch_num)
+                return
         txns = [r.transaction for r in batch]
         # Phase 1: order batches, get the version pair.
         await self.latest_batch_resolving.when_at_least(batch_num - 1)
@@ -494,6 +529,14 @@ class CommitProxy:
 
     def _assign_mutations(self, txns, verdicts, version: int) -> dict[int, list[Any]]:
         messages: dict[int, list[Any]] = {}
+        # full-stream tag for log-consuming workers (backup/DR): each
+        # committed mutation EXACTLY ONCE, in commit order — per-storage
+        # tags duplicate a mutation per team replica, which would
+        # double-apply atomics on replay (BackupWorker's dedicated tags
+        # exist for the same reason)
+        from foundationdb_tpu.cluster.tlog import LOG_STREAM_TAG
+
+        emit_stream = self.tlog.has_log_consumers()
         for t, tr in enumerate(txns):
             if verdicts[t] != TransactionResult.COMMITTED:
                 continue
@@ -525,6 +568,8 @@ class CommitProxy:
                         shards.append(tag)
                 for s in shards:
                     messages.setdefault(s, []).append(m)
+                if emit_stream:
+                    messages.setdefault(LOG_STREAM_TAG, []).append(m)
         return messages
 
 

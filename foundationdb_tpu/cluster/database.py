@@ -216,6 +216,7 @@ class Cluster:
                 # a batch must fit the kernel's static txn capacity
                 max_batch_txns=cfg.kernel_config.max_txns,
                 on_state_mutation=self._apply_state_mutation,
+                txn_state_view=self.txn_state_store,
             )
             for p in range(cfg.n_commit_proxies)
         ]

@@ -77,6 +77,9 @@ class LogSystem:
         for t in self._live_logs():
             t.pop(tag, up_to_version, consumer)
 
+    def has_log_consumers(self) -> bool:
+        return any(t.has_log_consumers() for t in self._live_logs())
+
     def register_consumer(self, name: str) -> None:
         for t in self.tlogs:
             t.register_consumer(name)

@@ -36,6 +36,14 @@ class TLogCommitRequest:
     epoch: int = 1  # generation of the pushing proxy
 
 
+#: The full-stream tag: carries each version's COMPLETE ordered mutation
+#: list for log-consuming workers (backup/DR) — the role of the
+#: reference's dedicated backup mutation tags (BackupWorker.actor.cpp).
+#: Emitted by proxies only while such a consumer is registered; retained
+#: only for non-storage consumers (storage never reads it).
+LOG_STREAM_TAG: Tag = -1
+
+
 class TLogStoppedError(Exception):
     """error_code_tlog_stopped: a previous-generation push after the log
     was locked by recovery (TagPartitionedLogSystem epoch locking)."""
@@ -95,6 +103,11 @@ class TLog:
         """Retain messages for an extra consumer from this point on."""
         self._popped.setdefault(name, {})
 
+    def has_log_consumers(self) -> bool:
+        """Any non-storage consumer registered (proxies emit the
+        full-stream tag only when someone will read it)?"""
+        return any(name != "storage" for name in self._popped)
+
     def unregister_consumer(self, name: str) -> None:
         if name != "storage":
             self._popped.pop(name, None)
@@ -109,9 +122,20 @@ class TLog:
         self._trim(tag)
 
     def _trim(self, tag: Tag) -> None:
-        floor = min(
-            (marks.get(tag, 0) for marks in self._popped.values()), default=0
-        )
+        if tag == LOG_STREAM_TAG:
+            # storage never pops the full stream; only backup/DR
+            # consumers constrain it — none registered = drop everything
+            extras = [m for n, m in self._popped.items() if n != "storage"]
+            if not extras:
+                self._messages[tag] = []
+                return
+            floor = min(m.get(tag, 0) for m in extras)
+        else:
+            # per-storage tags are governed by storage ALONE: stream
+            # consumers read only LOG_STREAM_TAG, and letting their
+            # never-popped marks pin storage tags would leak the whole
+            # log for the lifetime of a backup/DR relationship
+            floor = self._popped["storage"].get(tag, 0)
         self._messages[tag] = [
             (v, m) for v, m in self._messages.get(tag, []) if v > floor
         ]

@@ -43,6 +43,9 @@ class CommitTransaction:
     read_snapshot: int = 0
     report_conflicting_keys: bool = False
     mutations: list[Any] = dataclasses.field(default_factory=list)
+    # commit is allowed while the database is locked (the reference's
+    # lock_aware transaction option; DR agents use it)
+    lock_aware: bool = False
 
     def validate(self) -> None:
         for b, e in self.read_conflict_ranges + self.write_conflict_ranges:

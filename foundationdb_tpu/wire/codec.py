@@ -28,7 +28,7 @@ from foundationdb_tpu.models.types import (
 )
 
 #: Bumped whenever any wire layout changes; checked at connect time.
-PROTOCOL_VERSION = 0x0FDB_7E50_0004  # +1: private_mutations reply field; +1: span context on resolve requests
+PROTOCOL_VERSION = 0x0FDB_7E50_0005  # 0003: private_mutations; 0004: span context; 0005: lock_aware txn flag
 
 
 class CodecError(ValueError):
@@ -172,6 +172,7 @@ def w_commit_transaction(out: list, t: CommitTransaction) -> None:
         w_bytes(out, e)
     w_i64(out, t.read_snapshot)
     w_bool(out, t.report_conflicting_keys)
+    w_bool(out, t.lock_aware)
     w_u32(out, len(t.mutations))
     for m in t.mutations:
         w_mutation(out, m)
@@ -192,6 +193,7 @@ def r_commit_transaction(buf: memoryview, off: int) -> tuple[CommitTransaction, 
         writes.append((b, e))
     snap, off = r_i64(buf, off)
     rck, off = r_bool(buf, off)
+    lock_aware, off = r_bool(buf, off)
     n, off = r_u32(buf, off)
     muts = []
     for _ in range(n):
@@ -203,6 +205,7 @@ def r_commit_transaction(buf: memoryview, off: int) -> tuple[CommitTransaction, 
             write_conflict_ranges=writes,
             read_snapshot=snap,
             report_conflicting_keys=rck,
+            lock_aware=lock_aware,
             mutations=muts,
         ),
         off,
