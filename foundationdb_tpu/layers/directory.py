@@ -7,11 +7,13 @@ node subtree under `\\xfe`; contents live under the allocated prefix via
 a Subspace. create/open/move/remove/list compose transactionally with
 ordinary operations.
 
-The prefix allocator is a simplified monotonic counter (the reference
-uses the HCA — high-contention allocator — for parallel allocation;
-the counter lives in the same keyspace and is allocated through the
-same transaction, so allocation is still transactional and conflict-
-checked, just not contention-optimized).
+Prefix allocation uses the HCA (high-contention allocator — the
+bindings' HighContentionAllocator): a windowed candidate scheme where
+concurrent allocators pick RANDOM candidates in the current window and
+conflict only when they pick the same one — the window's usage counter
+advances via atomic adds (conflict-free) and the window slides forward
+once half-used. A transactional fallback counter remains available via
+use_hca=False.
 """
 
 from __future__ import annotations
@@ -23,6 +25,104 @@ from foundationdb_tpu.layers.tuple import Subspace
 
 NODE_PREFIX = b"\xfe"
 COUNTER_KEY = NODE_PREFIX + b"hca"
+HCA_COUNTERS = NODE_PREFIX + b"hca/c/"   # window start -> usage count
+HCA_RECENT = NODE_PREFIX + b"hca/r/"     # candidate -> taken marker
+
+
+class HighContentionAllocator:
+    """The bindings' HCA: windowed random-candidate allocation.
+
+    * The current window [start, start+size) has a usage counter at
+      HCA_COUNTERS+start bumped by ATOMIC add — no read conflict, so
+      concurrent allocators never conflict on the counter.
+    * Each allocator picks a RANDOM free candidate in the window and
+      claims it with a write conflict on that single key: two
+      allocations conflict only if they picked the same candidate.
+    * When the window is half-used, it slides forward (old counters and
+      claims cleared); window sizes grow with the keyspace exactly like
+      the reference (64 / 1024 / 8192).
+    """
+
+    def __init__(self, rng=None):
+        import numpy as np
+
+        # seeded by default: candidate picking must be reproducible under
+        # the deterministic simulator (unseeded randomness would break
+        # seed-identical reruns and the soak determinism check)
+        self.rng = rng if rng is not None else np.random.default_rng(0)
+
+    @staticmethod
+    def _window_size(start: int) -> int:
+        if start < 255:
+            return 64
+        if start < 65535:
+            return 1024
+        return 8192
+
+    @staticmethod
+    def _slide(txn, new_start: int) -> None:
+        """Advance the window: clear only BELOW the new start — a
+        concurrent allocator may already hold a claim in the new window,
+        and wiping it would let its candidate be handed out twice (the
+        bindings clear [_, start) the same way)."""
+        txn.clear_range(
+            HCA_COUNTERS, HCA_COUNTERS + fdbtuple.pack((new_start,))
+        )
+        txn.clear_range(
+            HCA_RECENT, HCA_RECENT + fdbtuple.pack((new_start,))
+        )
+        txn.atomic_op(
+            "add",
+            HCA_COUNTERS + fdbtuple.pack((new_start,)),
+            (0).to_bytes(8, "little"),
+        )
+
+    async def allocate(self, txn) -> int:
+        # migration guard: values the legacy transactional counter
+        # already handed out (the pre-HCA allocator) are consumed —
+        # never open a window below them
+        legacy_raw = await txn.get(COUNTER_KEY, snapshot=True)
+        legacy = int.from_bytes(legacy_raw, "little") if legacy_raw else 0
+        while True:
+            start, count = await self._current_window(txn)
+            if start < legacy:
+                self._slide(txn, legacy)
+                continue
+            size = self._window_size(start)
+            if (count + 1) * 2 >= size:
+                self._slide(txn, start + size)
+                continue
+            txn.atomic_op(
+                "add",
+                HCA_COUNTERS + fdbtuple.pack((start,)),
+                (1).to_bytes(8, "little"),
+            )
+            for _ in range(size):
+                candidate = start + int(self.rng.integers(0, size))
+                ck = HCA_RECENT + fdbtuple.pack((candidate,))
+                # CONFLICT-ADDING read on just this candidate key: two
+                # transactions claiming the same candidate collide via
+                # the read-write conflict (write-write alone would NOT
+                # conflict under OCC and both would commit — the
+                # bindings' HCA reads the candidate non-snapshot for
+                # exactly this reason); different candidates never touch
+                taken = await txn.get(ck)
+                if taken is None:
+                    txn.set(ck, b"")
+                    return candidate
+            # window exhausted under contention: slide and retry
+            self._slide(txn, start + size)
+
+    async def _current_window(self, txn):
+        """Newest counter key (snapshot read: windows are shared state)."""
+        rows = await txn.get_range(
+            HCA_COUNTERS, HCA_COUNTERS + b"\xff", snapshot=True
+        )
+        if not rows:
+            return 0, 0
+        key, val = rows[-1]
+        (start,) = fdbtuple.unpack(key[len(HCA_COUNTERS):])
+        return int(start), int.from_bytes(val or b"", "little") if val else 0
 
 
 class DirectoryAlreadyExists(Exception):
@@ -49,16 +149,23 @@ class DirectorySubspace(Subspace):
 
 
 class DirectoryLayer:
-    def __init__(self):
+    def __init__(self, *, use_hca: bool = True, rng=None):
+        self.use_hca = use_hca
+        self._hca = HighContentionAllocator(rng) if use_hca else None
         self._nodes = Subspace((), NODE_PREFIX)
 
     def _node_key(self, path: tuple) -> bytes:
         return self._nodes.pack(("node",) + tuple(path))
 
     async def _allocate_prefix(self, txn) -> bytes:
-        raw = await txn.get(COUNTER_KEY)
-        n = int.from_bytes(raw, "little") if raw else 0
-        txn.set(COUNTER_KEY, (n + 1).to_bytes(8, "little"))
+        if self._hca is not None:
+            n = await self._hca.allocate(txn)
+        else:
+            # fallback: transactional monotonic counter (serializes all
+            # concurrent allocations through one conflict key)
+            raw = await txn.get(COUNTER_KEY)
+            n = int.from_bytes(raw, "little") if raw else 0
+            txn.set(COUNTER_KEY, (n + 1).to_bytes(8, "little"))
         # short prefixes under \x15... (tuple-int region), like the HCA's
         return b"\x15" + fdbtuple.pack((n,))
 

@@ -139,3 +139,70 @@ def test_consistency_check_detects_corruption(world):
         check_cluster(cluster)
     ss._live_count -= 1
     check_cluster(cluster)  # clean again
+
+
+def test_hca_concurrent_allocations_unique():
+    """The high-contention allocator: concurrent transactions allocate
+    DISTINCT prefixes, conflicting only on same-candidate collisions
+    (the bindings' HighContentionAllocator semantics)."""
+    import numpy as np
+
+    from foundationdb_tpu.cluster.commit_proxy import NotCommitted
+    from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+    from foundationdb_tpu.layers.directory import HighContentionAllocator
+
+    sched, cluster, db = open_cluster(
+        ClusterConfig(n_commit_proxies=2, n_storage=2)
+    )
+    hca = HighContentionAllocator(np.random.default_rng(0))
+    allocated = []
+    conflicts = [0]
+
+    async def worker(wid):
+        for _ in range(15):
+            while True:
+                txn = db.create_transaction()
+                n = await hca.allocate(txn)
+                try:
+                    await txn.commit()
+                    allocated.append(n)
+                    break
+                except NotCommitted:
+                    conflicts[0] += 1
+
+    from foundationdb_tpu.runtime.flow import all_of
+
+    tasks = [sched.spawn(worker(w), name=f"hca{w}") for w in range(6)]
+    sched.run_until(all_of([t.done for t in tasks]))
+    for t in tasks:
+        t.done.get()
+    assert len(allocated) == 90
+    assert len(set(allocated)) == 90, "HCA handed out a duplicate"
+    cluster.stop()
+
+
+def test_hca_window_advances():
+    import numpy as np
+
+    from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+    from foundationdb_tpu.layers.directory import HighContentionAllocator
+
+    sched, cluster, db = open_cluster(
+        ClusterConfig(n_commit_proxies=1, n_storage=2)
+    )
+    hca = HighContentionAllocator(np.random.default_rng(1))
+
+    async def go():
+        got = []
+        for _ in range(100):  # > half of the initial 64-window
+            txn = db.create_transaction()
+            got.append(await hca.allocate(txn))
+            await txn.commit()
+        return got
+
+    t = sched.spawn(go(), name="drive")
+    sched.run_until(t.done)
+    got = t.done.get()
+    assert len(set(got)) == 100
+    assert max(got) >= 64, "window never advanced"
+    cluster.stop()
