@@ -72,6 +72,13 @@ def cpu_mesh(n: int, axis: str = AXIS):
 # its CPU devices must fail loudly, not respawn itself forever.
 _SUBPROCESS_SENTINEL = "_FDBTPU_CPU_SUBPROCESS"
 
+# The tunnel environment's sitecustomize force-registers its TPU PJRT
+# plugin (and jax.config.update()s jax_platforms, which BEATS the
+# JAX_PLATFORMS env var) whenever this trigger variable is set. Any
+# process that must stay CPU-only has to strip it (also used by
+# tests/conftest.py).
+TPU_PLUGIN_TRIGGER = "PALLAS_AXON_POOL_IPS"
+
 
 def in_cpu_subprocess() -> bool:
     return bool(os.environ.get(_SUBPROCESS_SENTINEL))
@@ -88,6 +95,8 @@ def run_in_cpu_subprocess(module: str, func: str, n: int) -> None:
     flags = re.sub(rf"--{_FLAG}=\d+", "", flags)
     env["XLA_FLAGS"] = (flags + f" --{_FLAG}={n}").strip()
     env["JAX_PLATFORMS"] = "cpu"
+    # A hermetic CPU child must never load the tunnel's TPU plugin.
+    env.pop(TPU_PLUGIN_TRIGGER, None)
     env[_SUBPROCESS_SENTINEL] = "1"
     code = f"import {module}; {module}.{func}({n})"
     try:

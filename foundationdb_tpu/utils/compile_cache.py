@@ -11,8 +11,39 @@ from __future__ import annotations
 
 import os
 
-_DEFAULT = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+_BASE = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))), ".jax_compile_cache")
+
+
+def _machine_tag() -> str:
+    """Short hash of the host's CPU feature set.
+
+    XLA:CPU cache entries embed AOT machine code; loading an entry
+    compiled on a host with different ISA features risks SIGILL (the
+    loader only warns). The container this repo lives in migrates
+    between hosts across rounds, so the cache dir is keyed per-machine.
+    """
+    import hashlib
+    import platform
+
+    # ISA feature lines only ("flags" on x86, "Features" on arm) — the
+    # rest of cpuinfo has per-boot noise (MHz, bogomips) that would
+    # invalidate the cache on every restart of the same host.
+    feature_lines = set()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feature_lines.add(line.strip())
+    except OSError:
+        pass
+    seed = "|".join(sorted(feature_lines)) or platform.processor()
+    return hashlib.md5(
+        (platform.machine() + ":" + seed).encode()
+    ).hexdigest()[:8]
+
+
+_DEFAULT = _BASE + "." + _machine_tag()
 
 
 def enable(path: str | None = None) -> str:

@@ -14,6 +14,20 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+from foundationdb_tpu.parallel.mesh import TPU_PLUGIN_TRIGGER  # noqa: E402
+
+# Subprocesses spawned by tests (multiprocess roles, the hermetic dryrun
+# child) must not have the tunnel sitecustomize claim a TPU at their
+# interpreter start either.
+os.environ.pop(TPU_PLUGIN_TRIGGER, None)
+
+import jax  # noqa: E402
+
+# In the bench environment the sitecustomize already ran jax.config.update
+# ("jax_platforms", "axon,cpu") at interpreter start, which BEATS the env
+# var above; re-pin by the same mechanism before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

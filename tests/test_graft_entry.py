@@ -1,0 +1,82 @@
+"""Hermeticity contract for the graded multichip dryrun.
+
+Rounds 1-2 both failed `dryrun_multichip` the same way: the parent
+process's default backend is a tunneled TPU with a version-skewed AOT
+libtpu, and some eager jnp op (state init, batch packing) escaped to it.
+The contract now is: the dryrun body NEVER runs in a process whose
+default backend could be anything but CPU — it unconditionally re-execs
+into a child with ``JAX_PLATFORMS=cpu`` and the tunnel sitecustomize's
+trigger variable stripped, and the child asserts its default backend.
+
+Reference analog: the multi-resolver split these shardings implement is
+`fdbserver/CommitProxyServer.actor.cpp:1551-1567`.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+import pytest
+
+
+def _graft():
+    sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+    import __graft_entry__ as G
+
+    return G
+
+
+def test_dryrun_parent_never_runs_body_in_process(monkeypatch):
+    """Without the sentinel, the parent must delegate — not build a mesh."""
+    G = _graft()
+    from foundationdb_tpu.parallel import mesh as M
+
+    calls = []
+    monkeypatch.delenv(M._SUBPROCESS_SENTINEL, raising=False)
+    monkeypatch.setattr(
+        M, "run_in_cpu_subprocess", lambda m, f, n: calls.append((m, f, n))
+    )
+    G.dryrun_multichip(8)
+    assert calls == [("__graft_entry__", "dryrun_multichip", 8)]
+
+
+def test_cpu_subprocess_env_is_hermetic(monkeypatch):
+    """The child env pins CPU, strips the TPU-plugin trigger, sets the
+    sentinel, and requests the right virtual device count."""
+    from foundationdb_tpu.parallel import mesh as M
+
+    captured = {}
+
+    def fake_run(cmd, env=None, **kw):
+        captured["cmd"], captured["env"] = cmd, env
+
+        class P:
+            returncode = 0
+            stdout = ""
+            stderr = ""
+
+        return P()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    M.run_in_cpu_subprocess("somemod", "somefunc", 4)
+
+    env = captured["env"]
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "PALLAS_AXON_POOL_IPS" not in env
+    assert env[M._SUBPROCESS_SENTINEL] == "1"
+    assert "--xla_force_host_platform_device_count=4" in env["XLA_FLAGS"]
+    assert captured["cmd"][0] == sys.executable
+
+
+@pytest.mark.kernel
+def test_dryrun_end_to_end():
+    """The real thing: exactly what the driver runs, asserting rc=0.
+
+    Cheap because the child's tiny-shape compiles hit the persistent
+    per-machine compile cache after the first run.
+    """
+    G = _graft()
+    G.dryrun_multichip(8)
