@@ -105,6 +105,12 @@ class DrAgent:
             data = await ts.get_range(b"", b"\xff")
             td = self.dst.create_transaction()
             td.dr_bypass = True
+            # The copy must start from an empty destination: any
+            # pre-existing destination key absent on the source would
+            # survive a bare set-loop and silently diverge the replica
+            # (the reference verifies an empty destination before
+            # priming).
+            td.clear_range(b"", b"\xff")
             for k, v in data:
                 td.set(k, v)
             td.set(APPLIED_KEY, str(rv).encode())

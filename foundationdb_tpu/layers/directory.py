@@ -44,12 +44,19 @@ class HighContentionAllocator:
     """
 
     def __init__(self, rng=None):
+        import os
+
         import numpy as np
 
-        # seeded by default: candidate picking must be reproducible under
-        # the deterministic simulator (unseeded randomness would break
-        # seed-identical reruns and the soak determinism check)
-        self.rng = rng if rng is not None else np.random.default_rng(0)
+        # Per-instance entropy by default: concurrent allocators (separate
+        # clients/processes) must draw DIFFERENT candidate sequences or
+        # they always collide on the same candidate and the random-probe
+        # contention avoidance — the HCA's whole point — degenerates to a
+        # serial counter (the reference bindings use random.randrange).
+        # The deterministic simulator/soak injects a seeded rng explicitly.
+        self.rng = rng if rng is not None else np.random.default_rng(
+            int.from_bytes(os.urandom(8), "little")
+        )
 
     @staticmethod
     def _window_size(start: int) -> int:
@@ -162,7 +169,20 @@ class DirectoryLayer:
             n = await self._hca.allocate(txn)
         else:
             # fallback: transactional monotonic counter (serializes all
-            # concurrent allocations through one conflict key)
+            # concurrent allocations through one conflict key). Unsafe on
+            # a database the HCA already touched: the counter never
+            # advances past HCA claims, so it would re-hand-out prefixes
+            # the HCA allocated — silent data corruption. Refuse loudly.
+            hca_rows = await txn.get_range(
+                HCA_COUNTERS, HCA_COUNTERS + b"\xff", limit=1
+            )
+            if hca_rows:
+                raise RuntimeError(
+                    "DirectoryLayer(use_hca=False) on a database already "
+                    "allocated by the HCA: the legacy counter could hand "
+                    "out prefixes the HCA has claimed. Open with "
+                    "use_hca=True."
+                )
             raw = await txn.get(COUNTER_KEY)
             n = int.from_bytes(raw, "little") if raw else 0
             txn.set(COUNTER_KEY, (n + 1).to_bytes(8, "little"))

@@ -182,21 +182,71 @@ class DiskQueue {
         if (maxSeqInFile_[idx] == UINT64_MAX || h.seq > maxSeqInFile_[idx])
           maxSeqInFile_[idx] = h.seq;
       }
+      if (maxAnySeqInFile_[idx] == UINT64_MAX ||
+          h.seq > maxAnySeqInFile_[idx])
+        maxAnySeqInFile_[idx] = h.seq;
       off += sizeof(h) + h.len;
       validEnd = off;
     }
-    // drop any torn tail so future appends start at a clean boundary
-    if (validEnd < (size_t)size) {
-      if (::ftruncate(fds_[idx], validEnd) != 0) ok_ = false;
-    }
+    // Truncation policy is decided in recover() once both files are
+    // scanned: only a PLAUSIBLE torn tail may be dropped. Blindly
+    // truncating here would let a single mid-file bit flip in the older
+    // file destroy every acked record after it — destructive recovery
+    // on corruption. Resync probe: a frame that still validates past
+    // the invalid region proves the damage is interior, not a tail.
+    torn_[idx] = validEnd < (size_t)size;
+    laterValid_[idx] =
+        torn_[idx] && anyValidFrameAfter(content, validEnd + 1);
+    validEnd_[idx] = validEnd;
     fileSize_[idx] = validEnd;
+  }
+
+  static bool anyValidFrameAfter(const std::vector<uint8_t>& content,
+                                 size_t from) {
+    size_t size = content.size();
+    for (size_t p = from; p + sizeof(FrameHeader) <= size; ++p) {
+      FrameHeader h;
+      std::memcpy(&h, content.data() + p, sizeof(h));
+      if (h.magic != kMagicData && h.magic != kMagicPop) continue;
+      if (p + sizeof(h) + h.len > size) continue;
+      if (crc32(content.data() + p + sizeof(h), h.len,
+                h.magic ^ (uint32_t)h.seq) == h.crc)
+        return true;
+    }
+    return false;
   }
 
   void recover() {
     std::vector<Record> all;
     maxSeqInFile_[0] = maxSeqInFile_[1] = UINT64_MAX;
+    maxAnySeqInFile_[0] = maxAnySeqInFile_[1] = UINT64_MAX;
     scanFile(0, all);
     scanFile(1, all);
+    // Which file holds the newest data? Only ITS trailing invalid bytes
+    // are a plausible torn tail: tears (interrupted, never-acked,
+    // possibly block-reordered commits) happen only in the file that was
+    // active at the crash, which is the one with the newest sequence
+    // numbers. Invalid bytes in the OLDER file are corruption of acked
+    // data -> refuse to open rather than silently truncate it away —
+    // with one exception: a file with NO valid frames, no revalidating
+    // frame past the damage (resync probe), and a clean sibling is a
+    // crash tearing the first write to a freshly rotated file.
+    int newest = (maxAnySeqInFile_[1] != UINT64_MAX &&
+                  (maxAnySeqInFile_[0] == UINT64_MAX ||
+                   maxAnySeqInFile_[1] > maxAnySeqInFile_[0]))
+                     ? 1
+                     : 0;
+    for (int idx = 0; idx < 2; ++idx) {
+      if (!torn_[idx]) continue;
+      bool noValidFrames = maxAnySeqInFile_[idx] == UINT64_MAX;
+      bool freshRotationTear =
+          noValidFrames && !laterValid_[idx] && !torn_[1 - idx];
+      if (idx != newest && !freshRotationTear) {
+        ok_ = false;  // corruption of acked data: fail loudly
+        return;
+      }
+      if (::ftruncate(fds_[idx], validEnd_[idx]) != 0) ok_ = false;
+    }
     std::sort(all.begin(), all.end(),
               [](const Record& a, const Record& b) { return a.seq < b.seq; });
     // longest contiguous run ending at the max seq... records committed
@@ -236,6 +286,10 @@ class DiskQueue {
   uint64_t popFloor_ = 0;
   uint64_t fileSize_[2] = {0, 0};
   uint64_t maxSeqInFile_[2] = {UINT64_MAX, UINT64_MAX};
+  uint64_t maxAnySeqInFile_[2] = {UINT64_MAX, UINT64_MAX};
+  bool torn_[2] = {false, false};
+  bool laterValid_[2] = {false, false};
+  size_t validEnd_[2] = {0, 0};
   std::vector<uint8_t> buffer_;
   std::vector<Record> recovered_;
 };

@@ -105,6 +105,28 @@ def test_diskqueue_corrupt_record_ends_recovery(tmp_path):
     assert [d for _s, d in q2.recovered] == [b"aaaa", b"bbbb"]
 
 
+def test_diskqueue_midfile_corruption_refuses_open(tmp_path):
+    """A bit flip in the OLDER file's interior is not a torn tail: acked
+    records live after it, and truncating would destroy them. Recovery
+    must fail loudly instead of silently dropping data (ADVICE r2)."""
+    q = native.DiskQueue(str(tmp_path / "log"), rotate_bytes=4096)
+    for i in range(8):
+        q.push(b"x" * 700)
+        q.commit()  # rotation happens at commit: file 0 fills, then 1
+    q.close()
+    p0 = str(tmp_path / "log") + "-0.dq"
+    p1 = str(tmp_path / "log") + "-1.dq"
+    assert os.path.getsize(p0) > 0 and os.path.getsize(p1) > 0
+    # Corrupt the interior of the OLDER file (writes start in -0 and
+    # rotate to -1, so -0 holds the older records); both files hold
+    # live, unpopped records, and valid frames survive past the damage.
+    with open(p0, "r+b") as f:
+        f.seek(100)
+        f.write(b"\xff\xff")
+    with pytest.raises(native.NativeBuildError):
+        native.DiskQueue(str(tmp_path / "log"))
+
+
 def test_diskqueue_rotation_bounds_disk(tmp_path):
     q = native.DiskQueue(str(tmp_path / "log"), rotate_bytes=4096)
     payload = b"x" * 256
