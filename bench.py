@@ -173,12 +173,14 @@ def main():
     # baseline's inputs are likewise in RAM before its timer starts.
     # Phase 4 reports the tunnel-inclusive latency separately so the
     # staging effect is visible, and the JSON marks the methodology.
-    # Batches are dispatched in fused groups of BENCH_FUSE (default 4):
-    # one lax.scan program resolves the group with the history state
-    # chaining inside — identical decisions, one dispatch per group
-    # instead of per batch (dispatch costs ~30ms through this
-    # environment's tunnel; a loaded resolver coalesces its queue the
-    # same way). Per-batch latency is still reported un-fused (phase 4).
+    # Batches are dispatched in groups of BENCH_FUSE (default 8) through
+    # the GROUP kernel (ops/group.py): one mega-sort program resolves the
+    # whole group — identical decisions (tests/test_group_parity.py), one
+    # dispatch per group (~76ms through this environment's tunnel), and
+    # the history merge amortized across the group. A loaded resolver
+    # coalescing its queue is exactly how the reference behaves under
+    # backpressure (fdbserver/Resolver.actor.cpp resolveBatch queueing).
+    # Per-batch latency is still reported un-fused (phase 4).
     fuse = max(1, int(os.environ.get("BENCH_FUSE", 8)))
     from foundationdb_tpu.utils.packing import stack_device_args
 
@@ -187,18 +189,18 @@ def main():
         for g in range(0, n_batches, fuse)
     ]
     jax.block_until_ready(dev_groups)
-    # warm the scan program for every group shape (the ragged tail group
+    # warm the group program for every group shape (the ragged tail group
     # compiles separately) so compilation stays out of the timed window
     warm = TpuConflictSet(config)
     for dg in {g["version"].shape[0]: g for g in dev_groups}.values():
-        warm.resolve_args_scan(dg)
+        warm.resolve_group_args(dg)
     jax.block_until_ready(warm.state)
     cs2 = TpuConflictSet(config)
     outs = []
     t0 = time.perf_counter()
     for dg in dev_groups:
-        outs.append(cs2.resolve_args_scan(dg))  # async dispatch; chains
-    jax.block_until_ready(outs[-1].verdict)
+        outs.append(cs2.resolve_group_args(dg))  # async dispatch; chains
+    np.asarray(outs[-1].verdict)  # honest fence: device->host transfer
     total = time.perf_counter() - t0
     dev_rate = n_txns * n_batches / total
     cs2.check_overflow()
@@ -217,7 +219,8 @@ def main():
     for db in dev_batches:
         t0 = time.perf_counter()
         out = cs3.resolve_args(db)
-        out.verdict.block_until_ready()
+        np.asarray(out.verdict)  # honest fence (block_until_ready lies
+        #                          through the tunnel — see memory/r3)
         lat.append(time.perf_counter() - t0)
     lat_s = sorted(lat[1:])
     p50 = lat_s[len(lat_s) // 2]
@@ -230,7 +233,7 @@ def main():
     for b in batches:
         t0 = time.perf_counter()
         out = cs4.resolve_packed(b)
-        out.verdict.block_until_ready()
+        np.asarray(out.verdict)
         lat_h.append(time.perf_counter() - t0)
     lat_hs = sorted(lat_h[1:])
     p50_h = lat_hs[len(lat_hs) // 2]

@@ -92,8 +92,15 @@ def _resolve_scan(state, stacked):
 
 # Module-level jitted kernels: shared across all TpuConflictSet instances
 # so N resolvers with the same KernelConfig compile once, not N times.
-_RESOLVE = jax.jit(C.resolve_batch, donate_argnums=0)
+# State is deliberately NOT donated to the group kernel: the mega-sort
+# gathers against the history buffers, and gathers from donated/carried
+# buffers measure ~2x slower than from plain arguments on v5e
+# (scripts/price_primitives.py); the un-donated copy is 2 x ~12MB.
+from foundationdb_tpu.ops import group as _G
+
+_RESOLVE = jax.jit(C.resolve_batch)
 _RESOLVE_SCAN = jax.jit(_resolve_scan, donate_argnums=0)
+_RESOLVE_GROUP = jax.jit(_G.resolve_group)
 _REBASE = jax.jit(_rebase, donate_argnums=0)
 
 #: Overflow is checked host-side every this many batches (each check
@@ -167,6 +174,19 @@ class TpuConflictSet:
         self.state, outs = _RESOLVE_SCAN(self.state, stacked_args)
         self._batches_since_check += int(
             outs.verdict.shape[0]) - 1
+        self._maybe_check_overflow()
+        return outs
+
+    def resolve_group_args(self, stacked_args):
+        """Resolve K stacked batches via the GROUP kernel (ops/group.py):
+        one mega-sort program instead of a lax.scan of per-batch
+        kernels — same decisions (tests/test_group_parity.py), one
+        dispatch, and the per-batch history merge amortized across the
+        group. Versions must ascend across the stack (sequencer
+        contract); a stale host-side check guards the bench path.
+        """
+        self.state, outs = _RESOLVE_GROUP(self.state, stacked_args)
+        self._batches_since_check += int(outs.verdict.shape[0]) - 1
         self._maybe_check_overflow()
         return outs
 

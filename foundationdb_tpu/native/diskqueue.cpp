@@ -239,9 +239,15 @@ class DiskQueue {
     for (int idx = 0; idx < 2; ++idx) {
       if (!torn_[idx]) continue;
       bool noValidFrames = maxAnySeqInFile_[idx] == UINT64_MAX;
-      bool freshRotationTear =
-          noValidFrames && !laterValid_[idx] && !torn_[1 - idx];
-      if (idx != newest && !freshRotationTear) {
+      bool freshRotationTear = noValidFrames && !torn_[1 - idx];
+      // A frame that still validates PAST the damage means the invalid
+      // region sits between recoverable records — interior corruption,
+      // never a tail — regardless of which file it is. (A torn
+      // multi-frame flush can in principle leave stray valid frames via
+      // out-of-order block persistence, but none of those bytes were
+      // acked either way; refusing loudly beats silently discarding
+      // what may be acked data.)
+      if (laterValid_[idx] || (idx != newest && !freshRotationTear)) {
         ok_ = false;  // corruption of acked data: fail loudly
         return;
       }

@@ -1,44 +1,20 @@
 """The batch conflict-resolution kernel: one pure JAX function per batch.
 
 This is the TPU replacement for ConflictBatch::detectConflicts
-(fdbserver/SkipList.cpp:909-956). The reference pipeline is
+(fdbserver/SkipList.cpp:909-956). Since round 3 the implementation is
+the G=1 specialization of the group kernel (ops/group.py — one
+mega-sort co-sorting history boundaries with the batch's points, so no
+binary searches remain on the hot path; see that module's docstring for
+the design and the measured cost model that drove it).
 
-    sortPoints -> checkReadConflictRanges -> checkIntraBatchConflicts
-    -> combineWriteConflictRanges -> mergeWriteConflictRanges -> GC
+The public contract is unchanged from the round-2 kernel:
 
-and every stage has an exact tensor equivalent here:
-
-* sortPoints            -> one `lax.sort` building a dense rank space over
-                           all batch boundary keys (ops.keys.sort_ranks).
-* checkReadConflictRanges -> vectorized range-max queries against the
-                           two-tier version history (ops.history).
-* checkIntraBatchConflicts -> an *alternating fixpoint*: the reference's
-                           sequential MiniConflictSet sweep (:874-899)
-                           decides txns in order, each seeing earlier
-                           committed writes. We compute the same unique
-                           solution of the recurrence
-                             committed[t] = ok[t] and not exists s < t:
-                                 committed[s] and writes(s) ∩ reads(t)
-                           by iterating committed -> F(committed) from the
-                           all-ok start. F is antitone, and correctness
-                           propagates up the dependency ranks: after k
-                           iterations every txn whose longest conflict
-                           chain is < k is exact and stable, so the loop
-                           reaches the exact sequential answer in
-                           (max chain length + 1) iterations — typically
-                           2-3, never more than the batch size. Each
-                           iteration is one segment-tree min-cover (the
-                           smallest committed writer index covering each
-                           rank segment) plus one range-min query per read.
-* combineWriteConflictRanges -> coverage-parity prefix sum over the rank
-                           space (:996-1011's sweep, vectorized).
-* mergeWriteConflictRanges + removeBefore GC -> history.merge_writes:
-                           one sort + associative scans folds the batch's
-                           combined writes into the single-tier map and
-                           drops segments below the MVCC floor.
-
-Decisions are bit-identical to the reference by construction; the parity
-tests drive randomized batches against the Python oracle.
+* resolve_batch(state, batch) -> (state', BatchVerdict), pure, jittable,
+  decisions bit-identical to the reference pipeline
+  (sortPoints -> checkReadConflictRanges -> checkIntraBatchConflicts ->
+  combineWriteConflictRanges -> mergeWriteConflictRanges -> removeBefore)
+  as driven by the parity suites against the Python oracle and the two
+  native C++ baselines.
 """
 
 from __future__ import annotations
@@ -48,17 +24,14 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from foundationdb_tpu.config import KernelConfig
+from foundationdb_tpu.ops import group as G
 from foundationdb_tpu.ops import history as H
-from foundationdb_tpu.ops import keys as K
-from foundationdb_tpu.ops import rangemax, segtree
-from foundationdb_tpu.ops.rangemax import INT32_POS
 
 # Verdict codes — ConflictBatch::TransactionCommitResult
 # (fdbserver/include/fdbserver/ConflictSet.h:41-46).
-CONFLICT = 0
-TOO_OLD = 1
-COMMITTED = 3
+CONFLICT = G.CONFLICT
+TOO_OLD = G.TOO_OLD
+COMMITTED = G.COMMITTED
 
 
 class BatchVerdict(NamedTuple):
@@ -80,163 +53,14 @@ def resolve_batch(state: H.VersionHistory, batch: dict):
 
     `batch` is PackedBatch.device_args(). Pure; jit with donated state.
     """
-    b = batch["txn_valid"].shape[0]
-    nr = batch["read_valid"].shape[0]
-    nw = batch["write_valid"].shape[0]
-
-    version = batch["version"]
-    new_oldest = batch["new_oldest"]
-    txn_valid = batch["txn_valid"]
-
-    # ---- tooOld classification (ConflictBatch::addTransaction,
-    # SkipList.cpp:819-828: snapshot below the window AND has read ranges).
-    too_old = txn_valid & batch["has_reads"] & (batch["snapshot"] < new_oldest)
-
-    read_live = batch["read_valid"] & ~too_old[batch["read_txn"]]
-    write_live = batch["write_valid"] & ~too_old[batch["write_txn"]]
-
-    # ---- phase 1: reads vs. persistent history ------------------------
-    # the range-max table is derived state, built here per batch (NOT
-    # carried in VersionHistory — see the NamedTuple note)
-    main_tab = rangemax.build(state.main_ver, op="max")
-    read_snap = batch["snapshot"][batch["read_txn"]]
-    hist_hit = H.query_reads(
-        state, batch["read_begin"], batch["read_end"], read_snap,
-        main_tab=main_tab,
+    stacked = {k: jnp.asarray(v)[None] for k, v in batch.items()}
+    state, out = G.resolve_group(state, stacked)
+    return state, BatchVerdict(
+        verdict=out.verdict[0],
+        hist_conflict_read=out.hist_conflict_read[0],
+        intra_first_range=out.intra_first_range[0],
+        committed_count=out.committed_count[0],
+        conflict_count=out.conflict_count[0],
+        too_old_count=out.too_old_count[0],
+        overflow=out.overflow[0],
     )
-    hist_conflict_read = hist_hit & read_live
-    trash = b  # extra slot absorbs masked scatters
-    hist_conflict_txn = (
-        jnp.zeros((b + 1,), jnp.int32)
-        .at[jnp.where(read_live, batch["read_txn"], trash)]
-        .max(hist_conflict_read.astype(jnp.int32))[:b]
-    ) > 0
-
-    # ---- rank space over all live boundary points ----------------------
-    points = jnp.concatenate(
-        [
-            batch["read_begin"],
-            batch["read_end"],
-            batch["write_begin"],
-            batch["write_end"],
-        ],
-        axis=0,
-    )
-    pt_valid = jnp.concatenate([read_live, read_live, write_live, write_live])
-    ranks, _ukeys, _ucount = K.sort_ranks(points, pt_valid)
-    rb_rank, re_rank = ranks[:nr], ranks[nr : 2 * nr]
-    wb_rank = ranks[2 * nr : 2 * nr + nw]
-    we_rank = ranks[2 * nr + nw :]
-
-    leaves = _next_pow2(points.shape[0])
-
-    # ---- phase 2: intra-batch alternating fixpoint ---------------------
-    ok = txn_valid & ~too_old & ~hist_conflict_txn
-    wlo = jnp.where(write_live, wb_rank, 0)
-    whi = jnp.where(write_live, we_rank, 0)
-    write_txn = batch["write_txn"]
-    read_txn = batch["read_txn"]
-
-    def intra_hits(committed):
-        """Per-read: does an earlier committed txn write into this read?"""
-        writer = jnp.where(
-            committed[write_txn] & write_live, write_txn, INT32_POS
-        )
-        mw = segtree.min_cover(leaves, wlo, whi, writer)
-        mintab = rangemax.build(mw, op="min")
-        min_writer = rangemax.query(mintab, rb_rank, re_rank, op="min")
-        return (min_writer < read_txn) & read_live
-
-    def per_txn_any(read_bits):
-        return (
-            jnp.zeros((b + 1,), jnp.int32)
-            .at[jnp.where(read_live, read_txn, trash)]
-            .max(read_bits.astype(jnp.int32))[:b]
-        ) > 0
-
-    def cond(carry):
-        committed, prev, first = carry
-        return jnp.any(committed != prev)
-
-    def body(carry):
-        committed, _prev, _first = carry
-        hits = intra_hits(committed)
-        new_committed = ok & ~per_txn_any(hits & ok[read_txn])
-        return new_committed, committed, hits
-
-    committed0 = ok
-    hits0 = intra_hits(committed0)
-    c1 = ok & ~per_txn_any(hits0 & ok[read_txn])
-    committed, _, last_hits = jax.lax.while_loop(
-        cond, body, (c1, committed0, hits0)
-    )
-    # At exit committed == prev and last_hits == intra_hits(prev), so
-    # last_hits IS intra_hits at the fixpoint — including the no-iteration
-    # case (c1 == committed0 implies the fixpoint is committed0 and the
-    # carried hits0 = intra_hits(committed0)). No recompute needed: this
-    # saves one full intra_hits (~17ms at 64K-txn shapes).
-    final_hits = last_hits & ok[read_txn]
-
-    # first conflicting read-range index per txn (the reference's intra
-    # sweep breaks at the first hit — SkipList.cpp:880-892)
-    first_idx = (
-        jnp.full((b + 1,), INT32_POS, jnp.int32)
-        .at[jnp.where(final_hits, read_txn, trash)]
-        .min(jnp.where(final_hits, batch["read_index"], INT32_POS))[:b]
-    )
-    intra_first_range = jnp.where(
-        committed | ~txn_valid | too_old | hist_conflict_txn,
-        -1,
-        jnp.where(first_idx == INT32_POS, -1, first_idx),
-    )
-
-    # ---- verdicts ------------------------------------------------------
-    verdict = jnp.where(
-        too_old,
-        TOO_OLD,
-        jnp.where(committed & txn_valid, COMMITTED, CONFLICT),
-    ).astype(jnp.int32)
-    committed_count = jnp.sum((committed & txn_valid).astype(jnp.int32))
-    too_old_count = jnp.sum(too_old.astype(jnp.int32))
-    conflict_count = (
-        jnp.sum(txn_valid.astype(jnp.int32)) - committed_count - too_old_count
-    )
-
-    # ---- phase 3: combine committed writes (coverage parity) -----------
-    committed_writes = write_live & committed[write_txn]
-    p = points.shape[0]
-    delta = (
-        jnp.zeros((p + 1,), jnp.int32)
-        .at[jnp.where(committed_writes, wb_rank, p)]
-        .add(1)
-        .at[jnp.where(committed_writes, we_rank, p)]
-        .add(-1)[:p]
-    )
-    covered = jnp.cumsum(delta) > 0  # covered[v]: segment [u_v, u_{v+1})
-    prev_covered = jnp.concatenate([jnp.zeros((1,), bool), covered[:-1]])
-    is_boundary = covered != prev_covered
-    # Coverage can only flip at write begin/end keys, so the combined run
-    # has at most 2*NW boundaries.
-    mf = 2 * nw
-    pos = jnp.cumsum(is_boundary.astype(jnp.int32)) - 1
-    dest = jnp.where(is_boundary & (pos < mf), pos, mf)  # mf = trash row
-    w = points.shape[1]
-    run_bounds = K.sentinel_like(mf + 1, w).at[dest].set(_ukeys)[:mf]
-
-    # ---- phase 4: merge + GC (one sort + scans, history.merge_writes) --
-    state = H.merge_writes(state, run_bounds, version, new_oldest)
-
-    out = BatchVerdict(
-        verdict=verdict,
-        hist_conflict_read=hist_conflict_read,
-        intra_first_range=intra_first_range,
-        committed_count=committed_count,
-        conflict_count=conflict_count,
-        too_old_count=too_old_count,
-        overflow=state.overflow,
-    )
-    return state, out
-
-
-def _next_pow2(n: int) -> int:
-    return 1 << max(0, (n - 1).bit_length())

@@ -21,6 +21,15 @@ def _num_levels(m: int) -> int:
     return max(1, (m - 1).bit_length() + 1)
 
 
+_OPS = {
+    "max": (jnp.maximum, INT32_NEG),
+    "min": (jnp.minimum, INT32_POS),
+    # bitwise union over a range — used for the group kernel's per-batch
+    # coverage bitmasks (ops/group.py cross-batch visibility)
+    "or": (jnp.bitwise_or, 0),
+}
+
+
 def build(values: jnp.ndarray, *, op: str = "max") -> jnp.ndarray:
     """Build the doubling table. values: [M] -> table [L, M].
 
@@ -29,7 +38,7 @@ def build(values: jnp.ndarray, *, op: str = "max") -> jnp.ndarray:
     512K on v5e; slices+concat compile to cheap vector shifts.
     """
     m = values.shape[0]
-    fn = jnp.maximum if op == "max" else jnp.minimum
+    fn = _OPS[op][0]
     levels = [values]
     for k in range(1, _num_levels(m)):
         prev = levels[-1]
@@ -69,8 +78,8 @@ def query(table: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray, *, op: str = "ma
     op identity (-inf for max, +inf for min).
     """
     levels, m = table.shape
-    ident = jnp.int32(INT32_NEG if op == "max" else INT32_POS)
-    fn = jnp.maximum if op == "max" else jnp.minimum
+    fn, ident_v = _OPS[op]
+    ident = jnp.int32(ident_v)
     loc = jnp.clip(lo, 0, m)
     hic = jnp.clip(hi, 0, m)
     length = jnp.maximum(hic - loc, 1)

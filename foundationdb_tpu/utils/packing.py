@@ -227,4 +227,9 @@ def stack_device_args(batches) -> dict:
     import numpy as _np
 
     args = [b.device_args() for b in batches]
+    versions = [int(a["version"]) for a in args]
+    if any(b <= a for a, b in zip(versions, versions[1:])):
+        # the group kernel's cross-batch visibility masks assume the
+        # sequencer's monotone version order
+        raise ValueError(f"stacked batch versions must ascend: {versions}")
     return {k: _np.stack([a[k] for a in args]) for k in args[0]}

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Ablation profiling of resolve_group at bench shapes (honest fencing).
+
+Variants:
+  full          — the real kernel
+  iters=k       — while_loop replaced by k fixed applications of F
+  no-same       — same-batch min_cover stubbed (hits = False)
+  no-cross      — cross-batch coverage/OR stubbed
+  no-fixpoint   — both stubbed (1 application of nothing)
+  no-merge      — merge replaced by returning the old state
+  sort-only     — mega-sort + rank plumbing only
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from foundationdb_tpu.utils import compile_cache  # noqa: E402
+
+compile_cache.enable()
+
+from foundationdb_tpu import config as cfg  # noqa: E402
+from foundationdb_tpu.ops import group as G  # noqa: E402
+from foundationdb_tpu.ops import history as H  # noqa: E402
+from foundationdb_tpu.testing.benchgen import skiplist_style_batch  # noqa: E402
+from foundationdb_tpu.utils.packing import stack_device_args  # noqa: E402
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+FUSE = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+MODE = sys.argv[3] if len(sys.argv) > 3 else "uniform"
+
+
+def main():
+    cap = 1 << (N - 1).bit_length()
+    config = cfg.KernelConfig(
+        max_key_bytes=8, max_txns=cap, max_reads=cap, max_writes=cap,
+        history_capacity=12 * cap, window_versions=1_000_000,
+    )
+    gen_kw = {
+        "uniform": {},
+        "zipf": {"zipf": 1.1, "keyspace": 10_000_000},
+        "range": {"range_len": 500},
+    }[MODE]
+    rng = np.random.default_rng(0)
+    batches = [
+        skiplist_style_batch(
+            rng, config, N, version=(i + 1) * 200_000, keyspace=1_000_000,
+            key_bytes=8, snapshot_lag=400_000, **gen_kw,
+        )
+        for i in range(2 * FUSE)
+    ]
+    g1 = jax.device_put(stack_device_args(batches[:FUSE]))
+    g2 = jax.device_put(stack_device_args(batches[FUSE:]))
+    np.asarray(g2["version"])
+
+    def timed(name, fn):
+        jf = jax.jit(fn)
+        state = H.init(config)
+        s1, _ = jf(state, g1)
+        np.asarray(s1.oldest)  # warm/compile
+        best = 1e9
+        for _ in range(3):
+            state = H.init(config)
+            t0 = time.perf_counter()
+            s1, o1 = jf(state, g1)
+            s2, o2 = jf(s1, g2)
+            np.asarray(o2.verdict[0][:4])
+            best = min(best, time.perf_counter() - t0)
+        per_group = best / 2 * 1e3
+        print(f"{name:30s} {per_group:8.1f} ms/group  "
+              f"{per_group/FUSE:6.1f} ms/batch", flush=True)
+        return per_group
+
+    timed("full", G.resolve_group)
+
+    import foundationdb_tpu.ops.group as gg
+
+    real_while = jax.lax.while_loop
+
+    def with_fixed_iters(k):
+        def fake_while(cond, body, carry):
+            for _ in range(k):
+                carry = body(carry)
+            return carry
+
+        def fn(state, args):
+            gg.jax.lax = jax.lax  # no-op; clarity
+            orig = jax.lax.while_loop
+            jax.lax.while_loop = fake_while
+            try:
+                return G.resolve_group(state, args)
+            finally:
+                jax.lax.while_loop = orig
+
+        return fn
+
+    for k in (0, 1, 2, 4):
+        timed(f"iters={k}", with_fixed_iters(k))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,5 +1,6 @@
 """Directory layer, special key space, and consistency check tests."""
 
+import numpy as np
 import pytest
 
 from foundationdb_tpu.cluster.consistency import check_cluster
@@ -24,7 +25,8 @@ def world():
 
 def test_directory_create_open_list(world):
     sched, cluster, db = world
-    dl = DirectoryLayer()
+    # seeded rng: deterministic-sim tests must replay identically
+    dl = DirectoryLayer(rng=np.random.default_rng(0))
 
     async def body():
         txn = db.create_transaction()
@@ -50,7 +52,8 @@ def test_directory_create_open_list(world):
 
 def test_directory_errors_and_move_remove(world):
     sched, cluster, db = world
-    dl = DirectoryLayer()
+    # seeded rng: deterministic-sim tests must replay identically
+    dl = DirectoryLayer(rng=np.random.default_rng(0))
 
     async def body():
         txn = db.create_transaction()

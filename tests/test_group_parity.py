@@ -1,0 +1,309 @@
+"""Group kernel parity: resolve_group(G batches) == G x resolve_batch.
+
+The group kernel (ops/group.py) must be decision-identical to resolving
+the same batches sequentially — including the hard part: a read's
+snapshot can land BETWEEN the group's commit versions, so its conflicts
+with earlier in-group batches are version-dependent, exactly as if
+those batches had already merged into history.
+
+Also asserts the final history STATE is semantically identical (same
+piecewise key->version map; boundary arrays may differ in redundant
+rows, so maps are compared by evaluation).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from foundationdb_tpu.config import KernelConfig
+from foundationdb_tpu.models.types import CommitTransaction
+from foundationdb_tpu.ops import conflict as C
+from foundationdb_tpu.ops import group as G
+from foundationdb_tpu.ops import history as H
+from foundationdb_tpu.utils import packing
+
+from conftest import random_key, random_range
+
+
+def small_config(**kw):
+    defaults = dict(
+        max_key_bytes=8,
+        max_txns=16,
+        max_reads=32,
+        max_writes=32,
+        history_capacity=512,
+        window_versions=1000,
+    )
+    defaults.update(kw)
+    return KernelConfig(**defaults)
+
+
+def random_txn(rng, *, n_ranges=2, snap_lo, snap_hi, blind_prob=0.15):
+    reads = [] if rng.random() < blind_prob else [
+        random_range(rng) for _ in range(1 + int(rng.integers(0, n_ranges)))
+    ]
+    writes = [random_range(rng) for _ in range(1 + int(rng.integers(0, n_ranges)))]
+    return CommitTransaction(
+        read_conflict_ranges=reads,
+        write_conflict_ranges=writes,
+        read_snapshot=int(rng.integers(snap_lo, snap_hi)),
+    )
+
+
+def gen_group(rng, config, g, base_version=1000, step=100, n_txns=12):
+    """G batches whose snapshots deliberately straddle the group's
+    commit versions (the cross-batch visibility trap)."""
+    batches = []
+    for i in range(g):
+        version = base_version + (i + 1) * step
+        txns = [
+            random_txn(
+                rng,
+                snap_lo=max(0, base_version - 2 * step),
+                snap_hi=version,  # exclusive: snap < own commit version
+            )
+            for _ in range(n_txns)
+        ]
+        batches.append(
+            packing.pack_batch(txns, version, 0, config)
+        )
+    return batches
+
+
+def eval_map(state, probe_keys):
+    """Evaluate the piecewise key->version map at packed probe keys."""
+    mk = np.asarray(state.main_keys)
+    mv = np.asarray(state.main_ver)
+    out = []
+    for pk in probe_keys:
+        # value in force = last boundary <= key
+        idx = -1
+        for j in range(mk.shape[0]):
+            row = tuple(mk[j])
+            if row == tuple([0xFFFFFFFF] * mk.shape[1]):
+                continue
+            if tuple(pk) >= row_key(mk[j]):
+                idx = j
+        out.append(int(mv[idx]) if idx >= 0 else H.VERSION_NEG)
+    return out
+
+
+def row_key(row):
+    return tuple(row)
+
+
+def canonical_map(state, config):
+    """(boundary bytes, version) pairs with redundant rows collapsed."""
+    mk = np.asarray(state.main_keys)
+    mv = np.asarray(state.main_ver)
+    rows = []
+    for j in range(mk.shape[0]):
+        if all(x == 0xFFFFFFFF for x in mk[j]):
+            continue
+        rows.append((tuple(mk[j]), int(mv[j])))
+    rows.sort()
+    # collapse equal-key rows (keep last = value in force) and
+    # value-repeats (redundant boundaries)
+    dedup = {}
+    for k, v in rows:
+        dedup[k] = v  # later rows (same key) overwrite: sorted order keeps last
+    out = []
+    for k in sorted(dedup):
+        if not out or out[-1][1] != dedup[k]:
+            out.append((k, dedup[k]))
+    return out
+
+
+def run_sequential(config, batches):
+    state = H.init(config)
+    step = jax.jit(C.resolve_batch)
+    outs = []
+    for pb in batches:
+        state, out = step(state, pb.device_args())
+        outs.append(jax.tree_util.tree_map(np.asarray, out))
+    return state, outs
+
+
+def run_group(config, batches):
+    state = H.init(config)
+    stacked = packing.stack_device_args(batches)
+    state, out = jax.jit(G.resolve_group)(state, stacked)
+    return state, jax.tree_util.tree_map(np.asarray, out)
+
+
+def assert_group_matches(config, batches):
+    s_seq, seq_outs = run_sequential(config, batches)
+    s_grp, grp_out = run_group(config, batches)
+    for i, so in enumerate(seq_outs):
+        np.testing.assert_array_equal(
+            grp_out.verdict[i], so.verdict, err_msg=f"verdict batch {i}"
+        )
+        np.testing.assert_array_equal(
+            grp_out.hist_conflict_read[i],
+            so.hist_conflict_read,
+            err_msg=f"hist_conflict_read batch {i}",
+        )
+        np.testing.assert_array_equal(
+            grp_out.intra_first_range[i],
+            so.intra_first_range,
+            err_msg=f"intra_first_range batch {i}",
+        )
+        assert grp_out.committed_count[i] == so.committed_count
+        assert grp_out.too_old_count[i] == so.too_old_count
+    assert canonical_map(s_grp, config) == canonical_map(s_seq, config), (
+        "final history maps diverge"
+    )
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_group_matches_sequential_random(seed):
+    rng = np.random.default_rng(seed)
+    config = small_config()
+    batches = gen_group(rng, config, g=4)
+    assert_group_matches(config, batches)
+
+
+def test_group_snapshot_straddles_versions():
+    """A read whose snapshot >= an earlier group batch's version must NOT
+    conflict with that batch's writes (it already saw them)."""
+    config = small_config()
+    k = lambda i: bytes([i])
+    t_writer = CommitTransaction(
+        read_conflict_ranges=[],
+        write_conflict_ranges=[(k(5), k(6))],
+        read_snapshot=50,
+    )
+    # snapshot 150 >= batch-0 version 100: writer already visible
+    t_reader_new = CommitTransaction(
+        read_conflict_ranges=[(k(5), k(6))],
+        write_conflict_ranges=[(k(9), k(10))],
+        read_snapshot=150,
+    )
+    # snapshot 90 < 100: conflict
+    t_reader_old = CommitTransaction(
+        read_conflict_ranges=[(k(5), k(6))],
+        write_conflict_ranges=[(k(11), k(12))],
+        read_snapshot=90,
+    )
+    b0 = packing.pack_batch([t_writer], 100, 0, config)
+    b1 = packing.pack_batch([t_reader_new, t_reader_old], 200, 0, config)
+    assert_group_matches(config, [b0, b1])
+    _, out = run_group(config, [b0, b1])
+    assert out.verdict[1][0] == C.COMMITTED  # saw the write already
+    assert out.verdict[1][1] == C.CONFLICT   # stale snapshot
+
+
+def test_group_too_old_and_blind_writes():
+    config = small_config(window_versions=100)
+    k = lambda i: bytes([i])
+    stale = CommitTransaction(
+        read_conflict_ranges=[(k(1), k(2))],
+        write_conflict_ranges=[(k(1), k(2))],
+        read_snapshot=5,
+    )
+    blind = CommitTransaction(
+        read_conflict_ranges=[],
+        write_conflict_ranges=[(k(3), k(4))],
+        read_snapshot=5,  # stale snapshot but NO reads: never too old
+    )
+    b0 = packing.pack_batch([stale, blind], 200, 0, config)
+    b1 = packing.pack_batch([stale], 300, 0, config)
+    assert_group_matches(config, [b0, b1])
+    _, out = run_group(config, [b0, b1])
+    assert out.verdict[0][0] == C.TOO_OLD
+    assert out.verdict[0][1] == C.COMMITTED
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_group_hot_key_contention(seed):
+    """Zipf-style: every batch reads+writes one hot range — long
+    cross-batch conflict chains exercise the fixpoint depth."""
+    rng = np.random.default_rng(100 + seed)
+    config = small_config()
+    hot = (b"\x10", b"\x11")
+    batches = []
+    base, step = 1000, 100
+    for i in range(4):
+        version = base + (i + 1) * step
+        txns = []
+        for _t in range(8):
+            txns.append(CommitTransaction(
+                read_conflict_ranges=[hot] if rng.random() < 0.7 else [random_range(rng)],
+                write_conflict_ranges=[hot] if rng.random() < 0.7 else [random_range(rng)],
+                read_snapshot=int(rng.integers(base - step, version)),
+            ))
+        batches.append(packing.pack_batch(txns, version, 0, config))
+    assert_group_matches(config, batches)
+
+
+def test_group_continuation_across_groups():
+    """State threads between groups: group 2 must see group 1's writes
+    as ordinary history."""
+    rng = np.random.default_rng(7)
+    config = small_config()
+    all_batches = gen_group(rng, config, g=6, n_txns=10)
+    s_seq, seq_outs = run_sequential(config, all_batches)
+
+    state = H.init(config)
+    jg = jax.jit(G.resolve_group)
+    outs = []
+    for lo in (0, 3):
+        stacked = packing.stack_device_args(all_batches[lo : lo + 3])
+        state, out = jg(state, stacked)
+        outs.append(jax.tree_util.tree_map(np.asarray, out))
+    for i in range(6):
+        np.testing.assert_array_equal(
+            outs[i // 3].verdict[i % 3],
+            seq_outs[i].verdict,
+            err_msg=f"batch {i}",
+        )
+    assert canonical_map(state, config) == canonical_map(s_seq, config)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_group_parity_with_prestate(seed):
+    """Parity — including the per-read hist_conflict_read report — when
+    history is NON-empty before the group (a txn condemned by pre-group
+    history must still report its cross-batch conflicting reads)."""
+    rng = np.random.default_rng(200 + seed)
+    config = small_config()
+    pre = gen_group(rng, config, g=2, base_version=500)
+    batches = gen_group(rng, config, g=4, base_version=1000)
+
+    state_a = H.init(config)
+    step = jax.jit(C.resolve_batch)
+    for pb in pre:
+        state_a, _ = step(state_a, pb.device_args())
+    seq_outs = []
+    state_s = state_a
+    for pb in batches:
+        state_s, out = step(state_s, pb.device_args())
+        seq_outs.append(jax.tree_util.tree_map(np.asarray, out))
+
+    state_b = H.init(config)
+    for pb in pre:
+        state_b, _ = step(state_b, pb.device_args())
+    stacked = packing.stack_device_args(batches)
+    state_g, grp = jax.jit(G.resolve_group)(state_b, stacked)
+    grp = jax.tree_util.tree_map(np.asarray, grp)
+
+    for i, so in enumerate(seq_outs):
+        np.testing.assert_array_equal(grp.verdict[i], so.verdict)
+        np.testing.assert_array_equal(
+            grp.hist_conflict_read[i], so.hist_conflict_read,
+            err_msg=f"hist_conflict_read batch {i}",
+        )
+        np.testing.assert_array_equal(
+            grp.intra_first_range[i], so.intra_first_range
+        )
+    assert canonical_map(state_g, config) == canonical_map(state_s, config)
+
+
+def test_group_of_one_equals_resolve_batch():
+    rng = np.random.default_rng(3)
+    config = small_config()
+    batches = gen_group(rng, config, g=1)
+    assert_group_matches(config, batches)

@@ -127,6 +127,23 @@ def test_diskqueue_midfile_corruption_refuses_open(tmp_path):
         native.DiskQueue(str(tmp_path / "log"))
 
 
+def test_diskqueue_newest_file_interior_corruption_refuses(tmp_path):
+    """Interior damage in the NEWEST file with acked frames still valid
+    past it is corruption, not a torn tail — refuse, don't truncate
+    away the surviving acked records."""
+    q = native.DiskQueue(str(tmp_path / "log"))
+    for i in range(6):
+        q.push(b"rec%d" % i + b"y" * 200)
+        q.commit()  # each record fsync-acked
+    q.close()
+    p0 = str(tmp_path / "log") + "-0.dq"
+    with open(p0, "r+b") as f:
+        f.seek(260)  # inside record 1's payload; records 2..5 intact
+        f.write(b"\xff\xff")
+    with pytest.raises(native.NativeBuildError):
+        native.DiskQueue(str(tmp_path / "log"))
+
+
 def test_diskqueue_rotation_bounds_disk(tmp_path):
     q = native.DiskQueue(str(tmp_path / "log"), rotate_bytes=4096)
     payload = b"x" * 256
