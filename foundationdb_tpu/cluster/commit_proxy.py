@@ -193,6 +193,12 @@ class CommitProxy:
         self._task = None
         self._inflight: set = set()
         self._collecting: list[CommitRequest] = []
+        # BUGGIFY_DUPLICATE_RESOLVE: recent resolve requests kept for
+        # replay (a proxy retry after a lost reply). Old entries replay
+        # as requests the resolver has pruned from its reply window.
+        self._replay_ring: list = []
+        # armed stream waiter carried across idle batcher rounds
+        self._pending_next = None
 
     def start(self) -> None:
         self._task = self.sched.spawn(self._batcher(), name=f"{self.proxy_id}-batcher")
@@ -214,6 +220,13 @@ class CommitProxy:
             if not req.reply.is_set:
                 req.reply.send_error(CommitUnknownResult())
         self._collecting = []
+        # a request captured by the armed idle waiter must not dangle
+        if self._pending_next is not None:
+            if self._pending_next.is_ready and not self._pending_next.is_error:
+                req = self._pending_next.get()
+                if not req.reply.is_set:
+                    req.reply.send_error(CommitUnknownResult())
+            self._pending_next = None
         queue = self.requests.stream._queue
         while queue:
             req = queue.pop(0)
@@ -237,35 +250,67 @@ class CommitProxy:
     # -- phase 0: batching (commitBatcher :361) ----------------------------
 
     async def _batcher(self) -> None:
+        from foundationdb_tpu.runtime.flow import any_of
+
         while True:
-            first = await self.requests.stream.next()
+            # Wait for traffic, but never idle past the forced-batch
+            # interval: an idle proxy still emits EMPTY batches so its
+            # lastVersion keeps advancing at every resolver — otherwise
+            # retained state transactions (consumed only once every proxy
+            # has passed them) pin resolver memory and the backpressure
+            # loop can wedge the whole pipeline on one quiet proxy
+            # (the reference's commitBatcher forced-batch behavior,
+            # CommitProxyServer.actor.cpp commitBatcher's
+            # MAX_COMMIT_BATCH_INTERVAL).
+            # The head request always comes through the tracked armed
+            # waiter: send() delivers values INTO waiter futures, so a
+            # stop() between delivery and resumption would orphan an
+            # untracked one (stop recovers self._pending_next).
+            ok, first = self.requests.stream.try_next()
+            if not ok:
+                if self._pending_next is None:
+                    self._pending_next = self.requests.stream.next()
+                idx, val = await any_of(
+                    [
+                        self._pending_next,
+                        self.sched.delay(10 * self.batch_interval),
+                    ]
+                )
+                if idx == 1:
+                    self._spawn_batch([])  # idle forced empty batch
+                    continue
+                self._pending_next = None
+                first = val
             # self._collecting is visible to stop(): requests gathered but
             # not yet dispatched must not die silently with the batcher.
             batch = self._collecting = [first]
             deadline = self.sched.now() + self.batch_interval
-            while (
-                len(batch) < self.max_batch_txns
-                and not self.requests.stream.is_empty()
-            ):
-                batch.append(await self.requests.stream.next())
+
+            def drain():
+                while len(batch) < self.max_batch_txns:
+                    ok, req = self.requests.stream.try_next()
+                    if not ok:
+                        return
+                    batch.append(req)
+
+            drain()
             # allow a short accumulation window
             while len(batch) < self.max_batch_txns and self.sched.now() < deadline:
                 await self.sched.delay(self.batch_interval / 4)
-                while (
-                    len(batch) < self.max_batch_txns
-                    and not self.requests.stream.is_empty()
-                ):
-                    batch.append(await self.requests.stream.next())
+                drain()
             self._collecting = []
-            self._batch_num += 1
-            task = self.sched.spawn(
-                self._commit_batch(batch, self._batch_num),
-                name=f"{self.proxy_id}-batch{self._batch_num}",
-            )
-            self._inflight.add(task)
-            task.done.add_done_callback(
-                lambda _f, t=task: self._inflight.discard(t)
-            )
+            self._spawn_batch(batch)
+
+    def _spawn_batch(self, batch: list) -> None:
+        self._batch_num += 1
+        task = self.sched.spawn(
+            self._commit_batch(batch, self._batch_num),
+            name=f"{self.proxy_id}-batch{self._batch_num}",
+        )
+        self._inflight.add(task)
+        task.done.add_done_callback(
+            lambda _f, t=task: self._inflight.discard(t)
+        )
 
     # -- phases 1-5 (commitBatch :2516) ------------------------------------
 
@@ -360,6 +405,26 @@ class CommitProxy:
             ]
         )
         self.last_received_version = version
+        from foundationdb_tpu.utils.knobs import SERVER_KNOBS
+
+        if SERVER_KNOBS.BUGGIFY_DUPLICATE_RESOLVE:
+            # Re-send resolve requests the resolver has already answered —
+            # the retry-after-lost-reply path (Resolver.actor.cpp:513
+            # returns the cached reply; requests pruned from the reply
+            # window return Never(), so replays are fire-and-forget).
+            async def _replay(res, req):
+                try:
+                    await res.resolve(req)
+                except Exception:
+                    pass
+
+            self._replay_ring.append((self.resolvers[0], reqs[0]))
+            if version % 2 == 0:
+                self.sched.spawn(_replay(self.resolvers[0], reqs[0]))
+            if len(self._replay_ring) > 6 and version % 3 == 0:
+                res_old, req_old = self._replay_ring.pop(0)
+                self.sched.spawn(_replay(res_old, req_old))
+            del self._replay_ring[:-8]
 
         # Phase 3: post-resolution (order by logging chain).
         await self.latest_batch_logging.when_at_least(batch_num - 1)

@@ -43,6 +43,7 @@ class GrvProxy:
         )
         self._pending: list[Promise] = []
         self._task = None
+        self._armed = None  # the starter's in-flight stream waiter
 
     def start(self) -> None:
         self._task = self.sched.spawn(self._starter(), name="grv-starter")
@@ -57,6 +58,16 @@ class GrvProxy:
             if not p.is_set:
                 p.send_error(GrvProxyFailedError())
         self._pending = []
+        # A request delivered into the starter's armed stream waiter but
+        # not yet consumed (the cancel landed between send() and the
+        # task's resumption) is invisible to both _pending and the
+        # queue — recover it from the tracked waiter.
+        if self._armed is not None:
+            if self._armed.is_ready and not self._armed.is_error:
+                p = self._armed.get()
+                if not p.is_set:
+                    p.send_error(GrvProxyFailedError())
+            self._armed = None
         queue = self.requests.stream._queue
         while queue:
             p = queue.pop(0)
@@ -66,6 +77,13 @@ class GrvProxy:
     def get_read_version(self) -> Promise:
         p = Promise()
         self.counters.add("txnRequestIn")
+        if self._task is None:
+            # Stopped proxy (the recovery window between the old
+            # generation stopping and the new one starting): a request
+            # queued into the dead stream would strand its client
+            # forever — fail fast with the retryable error instead.
+            p.send_error(GrvProxyFailedError())
+            return p
         self.requests.send(p)
         return p
 
@@ -77,10 +95,15 @@ class GrvProxy:
         last = self.sched.now()
         while True:
             if not pending:
-                pending.append(await self.requests.stream.next())
+                self._armed = self.requests.stream.next()
+                pending.append(await self._armed)
+                self._armed = None
             await self.sched.delay(self.batch_interval)
-            while not self.requests.stream.is_empty():
-                pending.append(await self.requests.stream.next())
+            while True:
+                ok, p = self.requests.stream.try_next()
+                if not ok:
+                    break
+                pending.append(p)
 
             now = self.sched.now()
             if self.ratekeeper is not None:

@@ -135,17 +135,22 @@ class Resolver:
         resolver_id: int = 0,
         resolver_count: int = 1,
         commit_proxy_count: int = 1,
-        state_memory_limit: int = DEFAULT_STATE_MEMORY_LIMIT,
+        state_memory_limit: int = None,  # None -> the server knob
         init_version: int = -1,  # reference: Resolver() : version(-1)
         backend: str = None,  # resolver_backend knob: "tpu" | "cpu"
     ):
         from foundationdb_tpu.models.conflict_set import make_conflict_set
+        from foundationdb_tpu.utils.knobs import SERVER_KNOBS
 
         self.sched = sched
         self.resolver_id = resolver_id
         self.resolver_count = resolver_count
         self.commit_proxy_count = commit_proxy_count
-        self.state_memory_limit = state_memory_limit
+        self.state_memory_limit = (
+            SERVER_KNOBS.RESOLVER_STATE_MEMORY_LIMIT
+            if state_memory_limit is None
+            else state_memory_limit
+        )
 
         self.conflict_set = make_conflict_set(config, backend)
         self.version = Notified(init_version)

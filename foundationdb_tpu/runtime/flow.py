@@ -147,6 +147,16 @@ class FutureStream:
             self._waiters.append(f)
         return f
 
+    def try_next(self):
+        """(True, value) if an item is queued, else (False, None) — no
+        future, no suspension. Drain loops use this so a value can never
+        sit inside a waiter future orphaned by task cancellation (the
+        send()-delivers-into-waiter model means a consumer cancelled
+        between delivery and resumption silently loses the item)."""
+        if self._queue:
+            return True, self._queue.pop(0)
+        return False, None
+
     def is_empty(self) -> bool:
         return not self._queue
 

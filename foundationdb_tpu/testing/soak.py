@@ -38,6 +38,14 @@ class SeedPlan:
     reboot_storage: bool
     move_shard: bool
     randomize_knobs: bool
+    # round-3 fault classes (VERDICT r2 task 4): the rare paths the
+    # ensemble previously never reached
+    duplicate_resolve: bool    # proxy replays resolve requests
+    coordinator_outage: bool   # majority down transiently mid-recovery
+    usurper: bool              # rogue candidate steals leadership
+    laggard_txn: bool          # snapshot ages past the MVCC window
+    state_squeeze: bool        # resolver state-memory backpressure
+    small_window: bool         # 1s MVCC window (makes laggard cheap)
 
 
 def plan_for_seed(seed: int) -> SeedPlan:
@@ -58,6 +66,12 @@ def plan_for_seed(seed: int) -> SeedPlan:
         reboot_storage=bool(r.random() < 0.5),
         move_shard=bool(r.random() < 0.5),
         randomize_knobs=bool(r.random() < 0.5),
+        duplicate_resolve=bool(r.random() < 0.45),
+        coordinator_outage=bool(r.random() < 0.3),
+        usurper=bool(r.random() < 0.35),
+        laggard_txn=bool(r.random() < 0.4),
+        state_squeeze=bool(r.random() < 0.3),
+        small_window=bool(r.random() < 0.5),
     )
 
 
@@ -98,7 +112,17 @@ def run_seed(seed: int, collect_probes: bool = False):
     # the ensemble always runs the host conflict model: deterministic and
     # device-free (the TPU kernel has its own parity suites)
     SERVER_KNOBS.set("RESOLVER_BACKEND", "cpu")
+    if plan.duplicate_resolve:
+        SERVER_KNOBS.set("BUGGIFY_DUPLICATE_RESOLVE", True)
+    if plan.state_squeeze:
+        # tiny resolver memory limit: metadata bursts breach it and the
+        # backpressure loop must drain via the version chain
+        SERVER_KNOBS.set("RESOLVER_STATE_MEMORY_LIMIT", 600)
 
+    window = 1_000_000 if plan.small_window else 5_000_000
+    from foundationdb_tpu.cluster.database import ClusterConfig as _CC
+
+    kernel_config = _CC.kernel_config.scaled(window_versions=window)
     try:
         sched, cluster, db = open_cluster(
             ClusterConfig(
@@ -108,6 +132,7 @@ def run_seed(seed: int, collect_probes: bool = False):
                 replication_factor=plan.replication,
                 n_tlogs=plan.n_tlogs,
                 sim_seed=seed,
+                kernel_config=kernel_config,
             )
         )
         rng = np.random.default_rng(seed)
@@ -128,11 +153,17 @@ def run_seed(seed: int, collect_probes: bool = False):
                 txn = db.create_transaction()
                 writes: dict = {}
                 try:
-                    if rng.random() < 0.15:
+                    if rng.random() < 0.15 or plan.state_squeeze:
                         # metadata write: a state transaction the
                         # resolvers must forward (and, knob-gated,
-                        # materialize as private mutations)
+                        # materialize as private mutations). Squeeze
+                        # seeds write them every round so the resolver's
+                        # tiny state-memory limit is breached and the
+                        # backpressure loop must drain via the chain.
                         txn.set(b"\xff/soak/%02d" % (i % 4), b"m%d" % i)
+                        if plan.state_squeeze:
+                            txn.set(b"\xff/soak/big%02d" % (i % 8),
+                                    b"x" * 40)
                     if rng.random() < 0.6:
                         a = int(rng.integers(0, 30))
                         b_ = a + int(rng.integers(1, 8))
@@ -157,6 +188,86 @@ def run_seed(seed: int, collect_probes: bool = False):
                 except retryable:
                     outcome["aborted"] += 1
                     await sched.delay(0.01)
+
+        async def laggard():
+            """A transaction whose snapshot ages past the MVCC window:
+            the resolver must classify it TOO_OLD (resolver.too_old).
+            NO check() here: it runs concurrently with the workload, so
+            its (old) snapshot legitimately misses commits the model has
+            already recorded — snapshot isolation, not a lost write."""
+            await sched.delay(0.25)
+            txn = db.create_transaction()
+            try:
+                await txn.get_range(b"s00", b"s05")
+                await sched.delay(window / 1e6 + 1.2)
+                txn.set(b"s29", b"laggard")
+                await txn.commit()
+                outcome["committed"] += 1
+                # s29 is also a workload key and reply order across
+                # proxies need not match version order — widen the
+                # allowed set instead of overwriting it
+                possible.setdefault(b"s29", {None}).add(b"laggard")
+            except CommitUnknownResult:
+                # may or may not have landed
+                possible.setdefault(b"s29", {None}).add(b"laggard")
+                outcome["aborted"] += 1
+            except retryable:
+                outcome["aborted"] += 1
+
+        async def coordination_chaos():
+            """Quorum outage + a usurping candidate during live operation:
+            the coordination/recovery rare paths (quorum_unreachable,
+            stale_generation, racing_writer, epoch_lock_failed,
+            leadership_lost)."""
+            from foundationdb_tpu.cluster.coordination import (
+                LeaderElection,
+                QuorumUnreachable,
+                StaleGeneration,
+            )
+
+            if plan.coordinator_outage:
+                await sched.delay(0.12)
+                cluster.kill_coordinator(0)
+                cluster.kill_coordinator(1)
+                await sched.delay(0.8)
+                cluster.revive_coordinator(0)
+                cluster.revive_coordinator(1)
+            if plan.usurper:
+                from foundationdb_tpu.cluster.coordination import LeaderLease
+
+                await sched.delay(0.1)
+                rogues = [
+                    LeaderElection(
+                        sched, cluster.coordinators, f"rogue-cc{i}",
+                        lease=0.4,
+                    )
+                    for i in (0, 1)
+                ]
+                for _ in range(3):
+                    # Two candidates race the register read-modify-write:
+                    # both read, both write — the loser's lock replies
+                    # carry the winner's newer write generation
+                    # (racing_writer_detected), and the real CC's next
+                    # renew/bump fails deposed (leadership_lost /
+                    # epoch_lock_failed / stale_generation).
+                    views = []
+                    for r in rogues:
+                        try:
+                            views.append((r, await r.cs.read()))
+                        except (QuorumUnreachable, StaleGeneration):
+                            pass
+                    for i, (r, cur) in enumerate(views):
+                        if cur is None:
+                            continue
+                        try:
+                            await r.cs.write(LeaderLease(
+                                leader=r.candidate_id,
+                                epoch=cur.epoch + 1,
+                                expires=sched.now() + 0.4,
+                            ))
+                        except (QuorumUnreachable, StaleGeneration):
+                            pass
+                    await sched.delay(0.45)
 
         async def chaos():
             await sched.delay(0.05)
@@ -188,7 +299,11 @@ def run_seed(seed: int, collect_probes: bool = False):
 
         w = sched.spawn(workload(), name="soak-load")
         c = sched.spawn(chaos(), name="soak-chaos")
-        sched.run_until(all_of([w.done, c.done]))
+        cc = sched.spawn(coordination_chaos(), name="soak-coord-chaos")
+        tasks = [w.done, c.done, cc.done]
+        if plan.laggard_txn:
+            tasks.append(sched.spawn(laggard(), name="soak-laggard").done)
+        sched.run_until(all_of(tasks))
         sched.run_for(2.0)  # settle: recovery tail, deferred drops
 
         async def final_verify():

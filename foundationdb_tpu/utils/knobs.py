@@ -129,6 +129,11 @@ def make_server_knobs() -> Knobs:
         randomize=lambda r: float(r.choice([0.001, 0.005, 0.01])),
     )
     k.define("RESOLVER_BACKEND", "tpu")  # the resolver_backend knob
+    # BUGGIFY: proxies re-send resolve requests (a retry after a lost
+    # reply) so the resolver's duplicate-reply window is exercised —
+    # Resolver.actor.cpp:513's cached-reply path and the Never() path
+    # for requests pruned from the window.
+    k.define("BUGGIFY_DUPLICATE_RESOLVE", False)
     # Resolver-generated private mutations + resolver-side txnStateStore
     # (fdbclient/ServerKnobs.cpp:549-550 — randomized under test there too)
     k.define(

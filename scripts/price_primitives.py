@@ -40,8 +40,8 @@ def _force(out):
     return np.asarray(jax.tree_util.tree_leaves(out)[0])
 
 
-def timed(name, fn, *args, donate=()):
-    jfn = jax.jit(fn, donate_argnums=donate)
+def timed(name, fn, *args):
+    jfn = jax.jit(fn)
     _force(jfn(*args))  # compile + warm
     best = float("inf")
     for _ in range(3):

@@ -73,28 +73,6 @@ def gen_group(rng, config, g, base_version=1000, step=100, n_txns=12):
     return batches
 
 
-def eval_map(state, probe_keys):
-    """Evaluate the piecewise key->version map at packed probe keys."""
-    mk = np.asarray(state.main_keys)
-    mv = np.asarray(state.main_ver)
-    out = []
-    for pk in probe_keys:
-        # value in force = last boundary <= key
-        idx = -1
-        for j in range(mk.shape[0]):
-            row = tuple(mk[j])
-            if row == tuple([0xFFFFFFFF] * mk.shape[1]):
-                continue
-            if tuple(pk) >= row_key(mk[j]):
-                idx = j
-        out.append(int(mv[idx]) if idx >= 0 else H.VERSION_NEG)
-    return out
-
-
-def row_key(row):
-    return tuple(row)
-
-
 def canonical_map(state, config):
     """(boundary bytes, version) pairs with redundant rows collapsed."""
     mk = np.asarray(state.main_keys)
