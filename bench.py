@@ -74,6 +74,10 @@ def main():
         max_txns=cap,
         max_reads=cap,
         max_writes=cap,
+        # short_span_limit stays 0: the direct short-span range ops
+        # measured SLOWER than the doubling tables at these shapes
+        # (scripts/profile_group.py ablations) — the option remains for
+        # other shapes/platforms, latched and parity-tested.
         # hard bound on live boundaries: a range contributes its begin
         # (live) plus its end (carrier of the prior value), and the GC
         # floor trails one batch behind the newest — so
@@ -151,6 +155,34 @@ def main():
     cpu_name, cpu_rate = max(cpu_rates.items(), key=lambda kv: kv[1])
     log(f"baseline of record: {cpu_name} at {cpu_rate:,.0f} txn/s")
 
+    # ---- phase 1.5: rangemax flat-gather selftest on THIS device --------
+    # The doubling-table query uses a flattened data-dependent gather; an
+    # older XLA:TPU was seen miscompiling that pattern at large m (gather
+    # landing on the wrong level). This randomized large-m check runs on
+    # the real device every bench run so a regression trips loudly here,
+    # before any throughput number is produced.
+    from foundationdb_tpu.ops import rangemax as _rm
+
+    mm = config.history_capacity
+    vals = rng.integers(0, 2**30, size=mm).astype(np.int32)
+    qlo = rng.integers(0, mm - 1, size=8192).astype(np.int32)
+    qlen = rng.integers(1, mm // 2, size=8192).astype(np.int32)
+    qhi = np.minimum(qlo + qlen, mm).astype(np.int32)
+    tab = jax.jit(lambda v: _rm.build(v, op="max"))(vals)
+    got = np.asarray(jax.jit(
+        lambda t, lo, hi: _rm.query(t, lo, hi, op="max")
+    )(tab, qlo, qhi))
+    # numpy reference via running maximum on a suffix trick is O(n*q);
+    # spot-check a sample exactly
+    idx = rng.integers(0, 8192, size=256)
+    for i in idx:
+        want = int(vals[qlo[i]:qhi[i]].max())
+        assert got[i] == want, (
+            f"rangemax flat-gather MISCOMPILE at m={mm}: query "
+            f"[{qlo[i]},{qhi[i]}) got {got[i]} want {want}"
+        )
+    log(f"rangemax large-m selftest: OK (m={mm}, 8192 queries)")
+
     # ---- phase 2: decision parity ---------------------------------------
     cs = TpuConflictSet(config)
     t0 = time.perf_counter()
@@ -181,7 +213,7 @@ def main():
     # coalescing its queue is exactly how the reference behaves under
     # backpressure (fdbserver/Resolver.actor.cpp resolveBatch queueing).
     # Per-batch latency is still reported un-fused (phase 4).
-    fuse = max(1, int(os.environ.get("BENCH_FUSE", 8)))
+    fuse = max(1, int(os.environ.get("BENCH_FUSE", 16)))
     from foundationdb_tpu.utils.packing import stack_device_args
 
     dev_groups = [

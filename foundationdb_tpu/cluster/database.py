@@ -253,6 +253,11 @@ class Cluster:
         """Mark a log replica dead; commits continue on the survivors."""
         self.tlog.kill(i)
 
+    def crash_reboot_tlog(self, i: int, rng=None) -> None:
+        """Power-loss + DiskQueue recovery scan + peer catch-up for one
+        log replica (sim disk stack — AsyncFileNonDurable semantics)."""
+        self.tlog.crash_and_reboot(i, rng)
+
     def kill_storage(self, s: int) -> None:
         """Mark a storage server dead (reads fail over to team peers)."""
         self.storage_servers[s].stop()

@@ -25,10 +25,20 @@ class AllLogsDeadError(Exception):
 
 class LogSystem:
     def __init__(self, sched: Scheduler, n_logs: int = 1, *,
-                 recovery_version: int = 0):
+                 recovery_version: int = 0, durable: bool = True):
+        from foundationdb_tpu.sim.diskqueue import SimDiskQueue
+
         self.sched = sched
+        # Every sim replica writes through a SimDiskQueue so simulation
+        # seeds exercise the DiskQueue recovery-scan path (the
+        # one-abstraction-two-backends discipline; the multiprocess
+        # deployment uses the native queue, native/diskqueue.cpp).
         self.tlogs = [
-            TLog(sched, recovery_version=recovery_version)
+            TLog(
+                sched,
+                recovery_version=recovery_version,
+                durable=SimDiskQueue() if durable else None,
+            )
             for _ in range(n_logs)
         ]
         self.live = [True] * n_logs
@@ -50,6 +60,31 @@ class LogSystem:
         participates in pushes, peeks, or pops)."""
         self.live[i] = False
         self._live_logs()  # raises if that was the last one
+
+    def crash_and_reboot(self, i: int, rng=None) -> None:
+        """Power-loss the replica's simulated disk (un-fsynced data may
+        tear — AsyncFileNonDurable semantics), run the DiskQueue
+        recovery scan, then catch the replica up from a live peer and
+        return it to service. The sim analog of a tlog process reboot."""
+        t = self.tlogs[i]
+        # find the peer BEFORE marking dead: if none exists, refuse
+        # without corrupting the live set (the replica is still healthy)
+        peer = next(
+            (
+                tl
+                for j, (tl, alive) in enumerate(zip(self.tlogs, self.live))
+                if alive and j != i
+            ),
+            None,
+        )
+        if peer is None:
+            raise AllLogsDeadError("no live peer to catch up from")
+        self.live[i] = False
+        if t.dq is not None:
+            t.dq.crash(rng)
+            t.restore_from_disk()
+        t.catch_up_from(peer)
+        self.live[i] = True
 
     # -- the TLog-compatible surface --------------------------------------
 

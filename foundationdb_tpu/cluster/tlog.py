@@ -22,6 +22,9 @@ import dataclasses
 from typing import Any
 
 from foundationdb_tpu.runtime.flow import Notified, Scheduler
+from foundationdb_tpu.utils.probes import declare
+
+declare("tlog.diskqueue_recovery", "simdisk.torn_tail")
 
 Tag = int  # storage tag (the reference's Tag{locality, id})
 
@@ -50,12 +53,25 @@ class TLogStoppedError(Exception):
 
 
 class TLog:
-    """One in-memory tlog instance."""
+    """One tlog instance.
 
-    def __init__(self, sched: Scheduler, *, recovery_version: int = 0):
+    With `durable` set (a sim.diskqueue.SimDiskQueue), every commit is
+    written-ahead to the queue and "fsynced" before the in-memory state
+    updates — the native DiskQueue discipline (native/diskqueue.cpp) on
+    the simulated disk, so simulation seeds exercise the recovery scan
+    (crash -> restore_from_disk -> peer catch-up) exactly like the
+    reference's simulated files reach its DiskQueue code
+    (fdbrpc/sim2.actor.cpp simulated disk + AsyncFileNonDurable).
+    """
+
+    def __init__(self, sched: Scheduler, *, recovery_version: int = 0,
+                 durable=None):
         self.sched = sched
         self.epoch = 1
         self.version = Notified(recovery_version)
+        self.dq = durable
+        # version -> dq seq of its record (for physical pops)
+        self._seq_of_version: list[tuple[int, int]] = []
         # tag -> list of (version, mutations)
         self._messages: dict[Tag, list[tuple[int, list[Any]]]] = {}
         # consumer -> tag -> popped-through version. Messages are retained
@@ -83,6 +99,17 @@ class TLog:
             raise TLogStoppedError(f"epoch {req.epoch} < locked {self.epoch}")
         if self.version.get() >= req.version:
             return self.version.get()  # duplicate (already durable)
+        if self.dq is not None:
+            # write-ahead + "fsync" BEFORE the in-memory apply: the ack
+            # this commit produces must imply durability (the DiskQueue
+            # commit-before-ack contract)
+            import pickle
+
+            seq = self.dq.push(
+                pickle.dumps((req.prev_version, req.version, req.messages))
+            )
+            self.dq.commit()
+            self._seq_of_version.append((req.version, seq))
         for tag, msgs in req.messages.items():
             self._messages.setdefault(tag, []).append((req.version, msgs))
         self.version.set(req.version)
@@ -120,6 +147,95 @@ class TLog:
         marks = self._popped.setdefault(consumer, {})
         marks[tag] = max(marks.get(tag, 0), up_to_version)
         self._trim(tag)
+        self._physical_pop()
+
+    def _physical_pop(self) -> None:
+        """Discard disk records every consumer is done with: translate
+        the min per-tag version floor to a queue sequence number."""
+        if self.dq is None or not self._seq_of_version:
+            return
+        floors = [
+            self._popped["storage"].get(tag, 0)
+            for tag in self._messages
+            if tag != LOG_STREAM_TAG
+        ]
+        for name, marks in self._popped.items():
+            if name != "storage":
+                floors.append(min(marks.values()) if marks else 0)
+        if not floors:
+            return
+        floor_v = min(floors)
+        last_seq = None
+        for v, seq in self._seq_of_version:
+            if v <= floor_v:
+                last_seq = seq
+            else:
+                break
+        if last_seq is not None:
+            # pops are advisory and ride un-fsynced (the reference
+            # piggybacks pop locations on the push stream): a crash may
+            # lose them, and recovery then replays already-popped
+            # records — storage dedups by version, so this is safe AND
+            # it gives the ensemble a real lost-unsynced-write path
+            self.dq.pop(last_seq + 1)
+            self._seq_of_version = [
+                (v, s) for v, s in self._seq_of_version if v > floor_v
+            ]
+
+    def restore_from_disk(self) -> None:
+        """The recovery scan: rebuild state from the durable queue after
+        a crash (records above the popped floor, version-ascending)."""
+        import pickle
+
+        from foundationdb_tpu.utils.probes import code_probe
+
+        code_probe(True, "tlog.diskqueue_recovery")
+        assert self.dq is not None
+        self._messages = {}
+        self._seq_of_version = []
+        last_version = 0
+        for seq, blob in self.dq.recovered:
+            _prev, v, messages = pickle.loads(blob)
+            if v <= last_version:
+                continue  # duplicate record
+            for tag, msgs in messages.items():
+                self._messages.setdefault(tag, []).append((v, msgs))
+            self._seq_of_version.append((v, seq))
+            last_version = v
+        if last_version > self.version.get():
+            self.version.set(last_version)
+
+    def catch_up_from(self, peer: "TLog") -> None:
+        """Copy versions the peer has above ours (the rebooted replica
+        missed pushes while dead; in the reference the new generation's
+        logs recover the old generation's tail the same way). The copied
+        versions are written through OUR durable queue too — otherwise a
+        second crash would lose acked versions the first recovery only
+        held in memory."""
+        import pickle
+
+        my_v = self.version.get()
+        copied: dict[int, dict] = {}
+        for tag, entries in peer._messages.items():
+            for v, msgs in entries:
+                if v > my_v:
+                    self._messages.setdefault(tag, []).append((v, msgs))
+                    copied.setdefault(v, {})[tag] = msgs
+        for tag in self._messages:
+            self._messages[tag].sort(key=lambda e: e[0])
+        if self.dq is not None:
+            for v in sorted(copied):
+                seq = self.dq.push(pickle.dumps((my_v, v, copied[v])))
+                self._seq_of_version.append((v, seq))
+            self._seq_of_version.sort(key=lambda e: e[0])
+            self.dq.commit()
+        if peer.version.get() > self.version.get():
+            self.version.set(peer.version.get())
+        self.epoch = peer.epoch
+        # adopt the peer's pop bookkeeping (ours died with the process)
+        self._popped = {
+            n: dict(m) for n, m in peer._popped.items()
+        }
 
     def _trim(self, tag: Tag) -> None:
         if tag == LOG_STREAM_TAG:

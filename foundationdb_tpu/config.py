@@ -45,6 +45,12 @@ class KernelConfig:
     max_writes: int = 4096
     history_capacity: int = 1 << 15
     window_versions: int = 5_000_000
+    #: 0 = fully general range structures. A positive S compiles the
+    #: group kernel's range ops as direct S-wide gathers/scatters —
+    #: much faster for point-ish conflict ranges — with a loud latch
+    #: (overflow) if any live range ever spans more than S rank blocks.
+    #: See ops/group.resolve_group.
+    short_span_limit: int = 0
 
     def __post_init__(self):
         if self.max_key_bytes % 4 != 0:

@@ -100,8 +100,23 @@ from foundationdb_tpu.ops import group as _G
 
 _RESOLVE = jax.jit(C.resolve_batch)
 _RESOLVE_SCAN = jax.jit(_resolve_scan, donate_argnums=0)
-_RESOLVE_GROUP = jax.jit(_G.resolve_group)
 _REBASE = jax.jit(_rebase, donate_argnums=0)
+
+_GROUP_JITS: dict = {}
+
+
+def _resolve_group_jit(short_span_limit: int):
+    """One compiled group kernel per short_span_limit value (a static
+    compile-time switch — see ops/group.resolve_group)."""
+    fn = _GROUP_JITS.get(short_span_limit)
+    if fn is None:
+        import functools
+
+        fn = jax.jit(functools.partial(
+            _G.resolve_group, short_span_limit=short_span_limit
+        ))
+        _GROUP_JITS[short_span_limit] = fn
+    return fn
 
 #: Overflow is checked host-side every this many batches (each check
 #: forces a device sync; the merge itself is async).
@@ -185,7 +200,9 @@ class TpuConflictSet:
         group. Versions must ascend across the stack (sequencer
         contract); a stale host-side check guards the bench path.
         """
-        self.state, outs = _RESOLVE_GROUP(self.state, stacked_args)
+        self.state, outs = _resolve_group_jit(
+            getattr(self.config, "short_span_limit", 0)
+        )(self.state, stacked_args)
         self._batches_since_check += int(outs.verdict.shape[0]) - 1
         self._maybe_check_overflow()
         return outs

@@ -92,13 +92,30 @@ def _shift_down(x, fill):
     return jnp.concatenate([jnp.full((1,), fill, x.dtype), x[:-1]])
 
 
-def resolve_group(state: H.VersionHistory, g: dict):
+def resolve_group(state: H.VersionHistory, g: dict, *,
+                  short_span_limit: int = 0,
+                  _ablate: frozenset = frozenset()):
     """Resolve G stacked batches in one program.
 
     `g` is a stacked device_args tree (leaves [G, ...]); versions must be
     strictly increasing across the group (the caller asserts — the
     sequencer hands out monotone batch versions by construction).
     Returns (new_state, GroupVerdict).
+
+    `short_span_limit` (static): 0 compiles the fully general doubling
+    structures. A positive S compiles DIRECT S-wide gather/scatter range
+    ops instead — the doubling cover + two table builds per fixpoint
+    application cost ~40 small latency-bound passes on v5e, while point
+    workloads (conflict ranges a few keys wide, e.g. the reference's own
+    skipListTest shapes) span only a handful of rank blocks. Exactness
+    is preserved by a latch: if any live range spans more than S blocks,
+    the overflow flag trips and the host refuses the results (the same
+    static-capacity discipline as history overflow) — never a silent
+    wrong answer. Leave 0 for arbitrary workloads (range scans).
+
+    `_ablate` (static, diagnostic only — scripts/profile_group.py):
+    stage names whose work is stubbed out to attribute in-kernel cost;
+    results are WRONG with any stage ablated.
     """
     gn, b = g["txn_valid"].shape
     nr = g["read_valid"].shape[1]
@@ -208,22 +225,25 @@ def resolve_group(state: H.VersionHistory, g: dict):
     ir_row = mains_before_block - 1    # searchsorted-left(key) - 1 vs main
 
     # per-batch local ranks: dense block count within each batch's rows
-    onehot = (
-        s_is_point[:, None]
-        & (s_batch[:, None] == jnp.arange(gn, dtype=jnp.int32)[None, :])
-    )
-    prev_onehot = jnp.concatenate(
-        [jnp.zeros((1, gn), bool), onehot[:-1]], axis=0
-    )
-    same_block = ~key_new
-    first_in_block = onehot & ~(prev_onehot & same_block[:, None])
-    lcum = jnp.cumsum(first_in_block.astype(jnp.int32), axis=0)  # [R, G]
-    lrank_row = (
-        jnp.take_along_axis(
-            lcum, jnp.clip(s_batch, 0, gn - 1)[:, None], axis=1
-        )[:, 0]
-        - 1
-    )
+    if "lcum" in _ablate:
+        lrank_row = jnp.zeros((r_rows,), jnp.int32)
+    else:
+        onehot = (
+            s_is_point[:, None]
+            & (s_batch[:, None] == jnp.arange(gn, dtype=jnp.int32)[None, :])
+        )
+        prev_onehot = jnp.concatenate(
+            [jnp.zeros((1, gn), bool), onehot[:-1]], axis=0
+        )
+        same_block = ~key_new
+        first_in_block = onehot & ~(prev_onehot & same_block[:, None])
+        lcum = jnp.cumsum(first_in_block.astype(jnp.int32), axis=0)  # [R, G]
+        lrank_row = (
+            jnp.take_along_axis(
+                lcum, jnp.clip(s_batch, 0, gn - 1)[:, None], axis=1
+            )[:, 0]
+            - 1
+        )
 
     # ---- scatter per-point data back to input order --------------------
     p_pts = 2 * rn + 2 * wn
@@ -251,10 +271,37 @@ def resolve_group(state: H.VersionHistory, g: dict):
     lw_lo = lrank_pt[2 * rn : 2 * rn + wn].reshape(gn, nw)
     lw_hi = lrank_pt[2 * rn + wn :].reshape(gn, nw)
 
+    # span-violation latch for the short_span_limit fast paths
+    span_ok = jnp.asarray(True)
+
+    def direct_range_op(values, lo, hi, *, op, span):
+        """op over values[lo:hi] per query via `span` direct gathers —
+        exact when hi-lo <= span (the caller latches violations)."""
+        fn, ident = rangemax._OPS[op]
+        n = values.shape[0]
+        acc = jnp.full(lo.shape, ident, values.dtype)
+        for d in range(span):
+            pos = lo + d
+            v = values[jnp.clip(pos, 0, n - 1)]
+            acc = fn(acc, jnp.where(pos < hi, v, ident))
+        return acc
+
     # ---- phase 1: reads vs. persistent (pre-group) history -------------
-    main_tab = rangemax.build(state.main_ver, op="max")
-    vmax = rangemax.query(main_tab, jnp.maximum(il, 0), ir + 1, op="max")
-    stale_hit = (vmax > read_snap) & read_live
+    if "mainq" in _ablate:
+        stale_hit = jnp.zeros((rn,), bool)
+    elif short_span_limit:
+        ss = short_span_limit
+        span_ok &= jnp.max(
+            jnp.where(read_live, (ir + 1) - jnp.maximum(il, 0), 0)
+        ) <= ss
+        vmax = direct_range_op(
+            state.main_ver, jnp.maximum(il, 0), ir + 1, op="max", span=ss
+        )
+        stale_hit = (vmax > read_snap) & read_live
+    else:
+        main_tab = rangemax.build(state.main_ver, op="max")
+        vmax = rangemax.query(main_tab, jnp.maximum(il, 0), ir + 1, op="max")
+        stale_hit = (vmax > read_snap) & read_live
 
     trash = gn * b
     def per_txn_any(read_bits):
@@ -266,100 +313,167 @@ def resolve_group(state: H.VersionHistory, g: dict):
 
     hist_conflict_txn0 = per_txn_any(stale_hit)
 
-    # ---- phase 2: the group fixpoint -----------------------------------
-    ok = txn_valid & ~too_old & ~hist_conflict_txn0
+    # ---- phase 2: per-batch fixpoints over a running coverage map ------
+    # Batches resolve IN ORDER inside the trace, exactly like the
+    # sequential pipeline: batch i's reads first query `seg_ver` — the
+    # running piecewise map of the group's committed-write versions so
+    # far — with the exact version-vs-snapshot comparison (a snapshot
+    # between two group versions sees precisely the earlier writes), then
+    # run the round-2 alternating fixpoint against their OWN batch's
+    # writers only. Chains therefore stay within one batch (2-3
+    # iterations); the earlier whole-group fixpoint paid G-deep
+    # cross-batch chains and a full coverage rebuild per iteration.
     leaves_local = _next_pow2(2 * nr + 2 * nw)
     r_txn2 = r_txn.reshape(gn, nr)
     read_live2 = read_live.reshape(gn, nr)
-
+    snap2 = read_snap.reshape(gn, nr)
+    stale2 = stale_hit.reshape(gn, nr)
+    w_txn2 = w_txn.reshape(gn, nw)
     w_live2 = write_live.reshape(gn, nw)
     wlo2 = jnp.where(w_live2, lw_lo, 0)
     whi2 = jnp.where(w_live2, lw_hi, 0)
+    rank_rb2 = rank_rb.reshape(gn, nr)
+    rank_re2 = rank_re.reshape(gn, nr)
+    rank_wb2 = rank_wb.reshape(gn, nw)
+    rank_we2 = rank_we.reshape(gn, nw)
+    too_old2 = too_old.reshape(gn, b)
+    txn_valid2 = txn_valid.reshape(gn, b)
+    read_index2 = fl(g["read_index"]).reshape(gn, nr)
 
-    # visibility mask per read: batches j with version_j > snap and j < i
-    lbr = jnp.sum(
-        (versions[None, :] <= read_snap[:, None]).astype(jnp.int32), axis=1
-    )
-    def bits_below(k):
-        return (jnp.int32(1) << jnp.clip(k, 0, 31)) - 1
-    vis_mask = bits_below(r_batch) & ~bits_below(lbr)
+    def per_txn_g(gi, read_bits):
+        return (
+            jnp.zeros((b + 1,), jnp.int32)
+            .at[jnp.where(read_live2[gi], r_txn2[gi], b)]
+            .max(read_bits.astype(jnp.int32))[:b]
+        ) > 0
 
-    pow2 = (jnp.int32(1) << jnp.arange(gn, dtype=jnp.int32))[None, :]
-
-    def coverage_bits(committed):
-        """[R]-block int32 bitmask: bit j = batch j's committed writes
-        cover this block's key segment."""
-        cw = committed[w_gid] & write_live
-        idx_b = jnp.where(cw, rank_wb, r_rows)
-        idx_e = jnp.where(cw, rank_we, r_rows)
-        dd = (
-            jnp.zeros((r_rows + 1, gn), jnp.int32)
-            .at[idx_b, w_batch].add(1)
-            .at[idx_e, w_batch].add(-1)[:r_rows]
+    seg_ver = jnp.full((r_rows,), VERSION_NEG, jnp.int32)
+    committed_parts, same_parts, cross_parts, first_parts = [], [], [], []
+    for gi in range(gn):
+        if short_span_limit:
+            # the cross-batch query walks GLOBAL block ranks — its span
+            # must be latched too, or wide reads would silently miss
+            # earlier in-group writes
+            span_ok &= jnp.max(
+                jnp.where(
+                    read_live2[gi], rank_re2[gi] - rank_rb2[gi], 0
+                )
+            ) <= short_span_limit
+        if gi == 0 or "cross" in _ablate:
+            cross_g = jnp.zeros((nr,), bool)
+        elif short_span_limit:
+            gmax = direct_range_op(
+                seg_ver, rank_rb2[gi], rank_re2[gi], op="max",
+                span=short_span_limit,
+            )
+            cross_g = (gmax > snap2[gi]) & read_live2[gi]
+        else:
+            gtab = rangemax.build(seg_ver, op="max")
+            gmax = rangemax.query(
+                gtab, rank_rb2[gi], rank_re2[gi], op="max"
+            )
+            cross_g = (gmax > snap2[gi]) & read_live2[gi]
+        ok_g = (
+            txn_valid2[gi]
+            & ~too_old2[gi]
+            & ~per_txn_g(gi, stale2[gi] | cross_g)
         )
-        cov = jnp.cumsum(dd, axis=0) > 0
-        return jnp.sum(jnp.where(cov, pow2, 0), axis=1)
 
-    def same_hits(committed):
-        val = jnp.where(
-            (committed[w_gid] & write_live).reshape(gn, nw),
-            w_txn.reshape(gn, nw),
-            INT32_POS,
+        if short_span_limit:
+            span_ok &= jnp.max(
+                jnp.where(w_live2[gi], whi2[gi] - wlo2[gi], 0)
+            ) <= short_span_limit
+            span_ok &= jnp.max(
+                jnp.where(read_live2[gi], lq_hi[gi] - lq_lo[gi], 0)
+            ) <= short_span_limit
+
+        def same_hits_g(committed_g, gi=gi):
+            val = jnp.where(
+                committed_g[w_txn2[gi]] & w_live2[gi],
+                w_txn2[gi],
+                INT32_POS,
+            )
+            if short_span_limit:
+                # direct S-wide cover: scatter-min val at every covered
+                # leaf (exact under the span latch)
+                flat = jnp.full((leaves_local + 1,), INT32_POS, jnp.int32)
+                for d in range(short_span_limit):
+                    pos = wlo2[gi] + d
+                    idx = jnp.where(pos < whi2[gi], pos, leaves_local)
+                    flat = flat.at[idx].min(val)
+                mw = flat[:leaves_local]
+                minw = direct_range_op(
+                    mw, lq_lo[gi], lq_hi[gi], op="min",
+                    span=short_span_limit,
+                )
+            else:
+                mw = segtree.min_cover(
+                    leaves_local, wlo2[gi], whi2[gi], val
+                )
+                mtab = rangemax.build(mw, op="min")
+                minw = rangemax.query(mtab, lq_lo[gi], lq_hi[gi], op="min")
+            return (minw < r_txn2[gi]) & read_live2[gi]
+
+        def cond(carry):
+            committed_g, prev, _h = carry
+            return jnp.any(committed_g != prev)
+
+        def body(carry, gi=gi, ok_g=ok_g):
+            committed_g, _prev, _h = carry
+            h = same_hits_g(committed_g)
+            return ok_g & ~per_txn_g(gi, h & ok_g[r_txn2[gi]]), committed_g, h
+
+        if "fixpoint" in _ablate:
+            committed_g = ok_g
+            final_same_g = jnp.zeros((nr,), bool)
+        elif "fix1" in _ablate:  # diagnostic: exactly one application
+            h0 = same_hits_g(ok_g)
+            committed_g = ok_g & ~per_txn_g(gi, h0 & ok_g[r_txn2[gi]])
+            final_same_g = h0 & ok_g[r_txn2[gi]]
+        else:
+            h0 = same_hits_g(ok_g)
+            c1 = ok_g & ~per_txn_g(gi, h0 & ok_g[r_txn2[gi]])
+            committed_g, _, last_h = jax.lax.while_loop(
+                cond, body, (c1, ok_g, h0)
+            )
+            # last_h is the hits AT the fixpoint (carried from prev ==
+            # fixpoint — the round-2 kernel's argument).
+            final_same_g = last_h & ok_g[r_txn2[gi]]
+
+        if "seg" not in _ablate:
+            # fold batch gi's committed writes into the running map
+            cw = committed_g[w_txn2[gi]] & w_live2[gi]
+            dd = (
+                jnp.zeros((r_rows + 1,), jnp.int32)
+                .at[jnp.where(cw, rank_wb2[gi], r_rows)].add(1)
+                .at[jnp.where(cw, rank_we2[gi], r_rows)].add(-1)[:r_rows]
+            )
+            covered = jnp.cumsum(dd) > 0
+            seg_ver = jnp.where(covered, versions[gi], seg_ver)
+
+        committed_parts.append(committed_g)
+        same_parts.append(final_same_g)
+        cross_parts.append(cross_g)
+        first_parts.append(
+            jnp.full((b + 1,), INT32_POS, jnp.int32)
+            .at[jnp.where(final_same_g, r_txn2[gi], b)]
+            .min(jnp.where(final_same_g, read_index2[gi], INT32_POS))[:b]
         )
-        mw = jax.vmap(lambda lo, hi, v: segtree.min_cover(
-            leaves_local, lo, hi, v))(wlo2, whi2, val)
-        mtab = jax.vmap(lambda v: rangemax.build(v, op="min"))(mw)
-        minw = jax.vmap(lambda t, lo, hi: rangemax.query(
-            t, lo, hi, op="min"))(mtab, lq_lo, lq_hi)
-        return (minw < r_txn2) & read_live2
 
-    def cross_hits(committed):
-        bits = coverage_bits(committed)
-        otab = rangemax.build(bits, op="or")
-        rbits = rangemax.query(otab, rank_rb, rank_re, op="or")
-        return (rbits & vis_mask) != 0
-
-    def apply_f(committed):
-        sh = same_hits(committed)
-        ch = cross_hits(committed) & read_live
-        hits = sh.reshape(-1) | ch
-        return ok & ~per_txn_any(hits), sh, ch
-
-    committed0 = ok
-    c1, sh0, ch0 = apply_f(committed0)
-
-    def cond(carry):
-        committed, prev, _sh, _ch = carry
-        return jnp.any(committed != prev)
-
-    def body(carry):
-        committed, _prev, _sh, _ch = carry
-        nxt, sh, ch = apply_f(committed)
-        return nxt, committed, sh, ch
-
-    committed, _, last_sh, last_ch = jax.lax.while_loop(
-        cond, body, (c1, committed0, sh0, ch0)
-    )
-    # At exit committed == prev, so last_sh/last_ch are the hits AT the
-    # fixpoint (same argument as the round-2 kernel: the carried hits
-    # were computed from prev == the fixpoint).
-    final_same = last_sh.reshape(-1) & ok[r_gid]
+    committed = jnp.concatenate(committed_parts)
+    final_same = jnp.concatenate(same_parts)
     # The cross-batch report is NOT masked by `ok`: sequentially these
     # writes sit in history when batch i resolves, and the round-2
     # kernel reports hist_conflict_read masked only by read_live — a
     # txn condemned by pre-group history still reports its other
     # conflicting reads (tests/test_group_parity.py prestate case).
-    final_cross = last_ch
+    final_cross = jnp.concatenate(cross_parts)
 
     # ---- verdicts ------------------------------------------------------
     hist_conflict_read = stale_hit | final_cross
     hist_conflict_txn = hist_conflict_txn0 | per_txn_any(final_cross)
 
-    first_idx = (
-        jnp.full((gn * b + 1,), INT32_POS, jnp.int32)
-        .at[jnp.where(final_same, r_gid, trash)]
-        .min(jnp.where(final_same, fl(g["read_index"]), INT32_POS))[: gn * b]
-    )
+    first_idx = jnp.concatenate(first_parts)
     intra_first_range = jnp.where(
         committed | ~txn_valid | too_old | hist_conflict_txn,
         -1,
@@ -384,14 +498,8 @@ def resolve_group(state: H.VersionHistory, g: dict):
     )
 
     # ---- phase 3: merge committed writes into history ------------------
-    # Final per-block version: the highest committed batch covering the
-    # block (versions ascend with batch index, so highest bit = last
-    # writer = the version the sequential merges would leave).
-    bits = coverage_bits(committed)
-    hb = _highest_bit(bits)
-    seg_ver = jnp.where(
-        bits != 0, versions[jnp.clip(hb, 0, gn - 1)], VERSION_NEG
-    )
+    # `seg_ver` after the batch loop IS the group's committed-write map
+    # (last writer's version per block — what sequential merges leave).
     gval = seg_ver[jnp.clip(bi, 0, r_rows - 1)]
 
     mval = jnp.where(
@@ -405,31 +513,55 @@ def resolve_group(state: H.VersionHistory, g: dict):
         bv, bm = bb
         return jnp.where(bm, bv, av), am | bm
 
-    carry_val, _ = jax.lax.associative_scan(last_valid, (mval, s_is_main))
+    if "merge" in _ablate:
+        new_state = state._replace(
+            overflow=state.overflow | (seg_ver[0] > jnp.int32(2**30))
+        )
+        overflow = new_state.overflow
+    else:
+        carry_val, _ = jax.lax.associative_scan(
+            last_valid, (mval, s_is_main)
+        )
 
-    new_val = jnp.maximum(carry_val, gval)
-    new_val = jnp.where(new_val < final_floor, VERSION_NEG, new_val)
-    prev_val = _shift_down(new_val, jnp.int32(VERSION_NEG))
-    keep = key_new & ~is_sent & (new_val != prev_val)
+        new_val = jnp.maximum(carry_val, gval)
+        new_val = jnp.where(new_val < final_floor, VERSION_NEG, new_val)
+        prev_val = _shift_down(new_val, jnp.int32(VERSION_NEG))
+        keep = key_new & ~is_sent & (new_val != prev_val)
 
-    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
-    new_count = jnp.sum(keep.astype(jnp.int32))
-    overflow = state.overflow | (new_count > m)
-    dest = jnp.where(keep & (pos < m), pos, m)
+        new_count = jnp.sum(keep.astype(jnp.int32))
+        # ~span_ok: a short_span_limit build saw a wider range than
+        # configured — same loud-refusal discipline as capacity overflow
+        overflow = state.overflow | (new_count > m) | ~span_ok
 
-    len_word = jnp.where(is_sent, K.SENTINEL_WORD, s_len)
-    srows = jnp.stack(list(skw) + [len_word], axis=-1)
-    new_keys = K.sentinel_like(m + 1, w).at[dest].set(srows)[:m]
-    new_ver = (
-        jnp.full((m + 1,), VERSION_NEG, jnp.int32).at[dest].set(new_val)[:m]
-    )
+        # Compact kept rows by SORT, not scatter: a 2.9M-row scatter
+        # measured ~200ms while lax.sort streams the same rows in ~7ms
+        # (the platform cost model). One packed key — dropped rows to
+        # the back, kept rows in original (already key-sorted) order —
+        # makes it a single 5-operand sort; rows past new_count are
+        # masked back to sentinel/NEG after the slice.
+        ckey = ((~keep).astype(jnp.uint32) << 31) | (
+            iota.astype(jnp.uint32) & 0x7FFFFFFF
+        )
+        len_word = jnp.where(is_sent, K.SENTINEL_WORD, s_len)
+        s2 = jax.lax.sort(
+            [ckey] + list(skw) + [len_word, new_val], num_keys=1
+        )
+        live = jnp.arange(m, dtype=jnp.int32) < new_count
+        new_keys = jnp.stack(
+            [
+                jnp.where(live, c[:m], K.SENTINEL_WORD)
+                for c in list(s2[1:w]) + [s2[w]]
+            ],
+            axis=-1,
+        )
+        new_ver = jnp.where(live, s2[w + 1][:m], VERSION_NEG)
 
-    new_state = H.VersionHistory(
-        main_keys=new_keys,
-        main_ver=new_ver,
-        oldest=jnp.maximum(state.oldest, final_floor),
-        overflow=overflow,
-    )
+        new_state = H.VersionHistory(
+            main_keys=new_keys,
+            main_ver=new_ver,
+            oldest=jnp.maximum(state.oldest, final_floor),
+            overflow=overflow,
+        )
     out = GroupVerdict(
         verdict=v2,
         hist_conflict_read=hist_conflict_read.reshape(gn, nr),
@@ -440,12 +572,3 @@ def resolve_group(state: H.VersionHistory, g: dict):
         overflow=jnp.broadcast_to(overflow, (gn,)),
     )
     return new_state, out
-
-
-def _highest_bit(x: jnp.ndarray) -> jnp.ndarray:
-    """floor(log2(x)) for x >= 1 via the f32 exponent trick (0 -> 0)."""
-    f = x.astype(jnp.float32)
-    k = ((jax.lax.bitcast_convert_type(f, jnp.int32) >> 23) & 0xFF) - 127
-    # mantissa rounding can overshoot by one (e.g. 2**24 - 1)
-    k = jnp.where((jnp.int32(1) << jnp.clip(k, 0, 30)) > x, k - 1, k)
-    return jnp.clip(k, 0, 31)

@@ -86,9 +86,16 @@ def query(table: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray, *, op: str = "ma
     k = _floor_log2(length, levels)
     a = jnp.clip(loc, 0, m - 1)
     b = jnp.clip(hic - (1 << k), 0, m - 1)
-    # 2D indexing, NOT table.reshape(-1)[k*m+a]: XLA:TPU miscompiles the
-    # flattened data-dependent index at large m (observed on v5e: the
-    # gather lands on the wrong level), while the 2D gather is correct.
-    va = table[k, a]
-    vb = table[k, b]
+    # Gather shape matters enormously on v5e (measured, round 3):
+    # 2D data-dependent table[k, a] ~140ns/element (150ms per bench
+    # group for the history query alone); a per-level 1D-gather select
+    # chain pays levels x the gathers and is no better; the FLATTENED
+    # 1D gather runs at the ~5ns/element class of searchsorted's row
+    # gathers. An older XLA:TPU was seen miscompiling large flattened
+    # data-dependent gathers (landing on the wrong level); bench.py's
+    # per-run decision-parity assertion against the CPU baselines and
+    # the TPU parity suites guard against a regression of that bug.
+    flat = table.reshape(-1)
+    va = flat[k * m + a]
+    vb = flat[k * m + b]
     return jnp.where(hic > loc, fn(va, vb), ident)

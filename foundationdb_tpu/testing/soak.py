@@ -46,6 +46,7 @@ class SeedPlan:
     laggard_txn: bool          # snapshot ages past the MVCC window
     state_squeeze: bool        # resolver state-memory backpressure
     small_window: bool         # 1s MVCC window (makes laggard cheap)
+    crash_tlog: bool           # power-loss + DiskQueue recovery of a log
 
 
 def plan_for_seed(seed: int) -> SeedPlan:
@@ -72,6 +73,7 @@ def plan_for_seed(seed: int) -> SeedPlan:
         laggard_txn=bool(r.random() < 0.4),
         state_squeeze=bool(r.random() < 0.3),
         small_window=bool(r.random() < 0.5),
+        crash_tlog=bool(r.random() < 0.4),
     )
 
 
@@ -288,6 +290,15 @@ def run_seed(seed: int, collect_probes: bool = False):
                     )
                 except Exception:
                     pass
+            if plan.crash_tlog and plan.n_tlogs > 1:
+                # power-loss one log replica mid-traffic: un-fsynced data
+                # tears, the DiskQueue recovery scan rebuilds, the peer
+                # catch-up restores parity — acked commits must survive
+                await sched.delay(0.07)
+                cluster.crash_reboot_tlog(
+                    plan.n_tlogs - 1,
+                    np.random.default_rng(seed ^ 0xD15C),
+                )
             if plan.kill_tlog and plan.n_tlogs > 1:
                 await sched.delay(0.05)
                 cluster.kill_tlog(0)

@@ -1,21 +1,16 @@
 #!/usr/bin/env python
 """Ablation profiling of resolve_group at bench shapes (honest fencing).
 
-Variants:
-  full          — the real kernel
-  iters=k       — while_loop replaced by k fixed applications of F
-  no-same       — same-batch min_cover stubbed (hits = False)
-  no-cross      — cross-batch coverage/OR stubbed
-  no-fixpoint   — both stubbed (1 application of nothing)
-  no-merge      — merge replaced by returning the old state
-  sort-only     — mega-sort + rank plumbing only
+Each variant stubs one stage via resolve_group(_ablate=...); the delta
+against `full` attributes that stage's in-kernel cost (isolated-stage
+microbenches lie on this platform — see memory/v5e cost model).
 """
 
+import functools
 import sys
 import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 sys.path.insert(0, "/root/repo")
@@ -32,6 +27,25 @@ from foundationdb_tpu.utils.packing import stack_device_args  # noqa: E402
 N = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
 FUSE = int(sys.argv[2]) if len(sys.argv) > 2 else 8
 MODE = sys.argv[3] if len(sys.argv) > 3 else "uniform"
+
+import os
+
+_ALL = [
+    ("full", frozenset()),
+    ("fix1", frozenset({"fix1"})),
+    ("-fixpoint", frozenset({"fixpoint"})),
+    ("-cross", frozenset({"cross"})),
+    ("-merge", frozenset({"merge"})),
+    ("-mainq", frozenset({"mainq"})),
+    ("-seg", frozenset({"seg", "cross"})),
+    ("-lcum-fix", frozenset({"lcum", "fixpoint"})),
+    ("skeleton", frozenset(
+        {"fixpoint", "cross", "merge", "mainq", "seg", "lcum"})),
+]
+_sel = os.environ.get("VARIANTS")
+VARIANTS = (
+    [(n, a) for n, a in _ALL if n in _sel.split(",")] if _sel else _ALL
+)
 
 
 def main():
@@ -56,12 +70,16 @@ def main():
     g1 = jax.device_put(stack_device_args(batches[:FUSE]))
     g2 = jax.device_put(stack_device_args(batches[FUSE:]))
     np.asarray(g2["version"])
+    print(f"N={N} FUSE={FUSE} MODE={MODE}", flush=True)
 
-    def timed(name, fn):
-        jf = jax.jit(fn)
+    span = int(os.environ.get("SPAN", "0"))
+    base = None
+    for name, ab in VARIANTS:
+        jf = jax.jit(functools.partial(
+            G.resolve_group, _ablate=ab, short_span_limit=span))
         state = H.init(config)
-        s1, _ = jf(state, g1)
-        np.asarray(s1.oldest)  # warm/compile
+        s1, o = jf(state, g1)
+        np.asarray(o.verdict[0][:4])  # compile+warm
         best = 1e9
         for _ in range(3):
             state = H.init(config)
@@ -71,35 +89,11 @@ def main():
             np.asarray(o2.verdict[0][:4])
             best = min(best, time.perf_counter() - t0)
         per_group = best / 2 * 1e3
-        print(f"{name:30s} {per_group:8.1f} ms/group  "
-              f"{per_group/FUSE:6.1f} ms/batch", flush=True)
-        return per_group
-
-    timed("full", G.resolve_group)
-
-    import foundationdb_tpu.ops.group as gg
-
-    real_while = jax.lax.while_loop
-
-    def with_fixed_iters(k):
-        def fake_while(cond, body, carry):
-            for _ in range(k):
-                carry = body(carry)
-            return carry
-
-        def fn(state, args):
-            gg.jax.lax = jax.lax  # no-op; clarity
-            orig = jax.lax.while_loop
-            jax.lax.while_loop = fake_while
-            try:
-                return G.resolve_group(state, args)
-            finally:
-                jax.lax.while_loop = orig
-
-        return fn
-
-    for k in (0, 1, 2, 4):
-        timed(f"iters={k}", with_fixed_iters(k))
+        delta = "" if base is None else f"  (delta {base - per_group:+7.1f})"
+        if base is None:
+            base = per_group
+        print(f"{name:12s} {per_group:8.1f} ms/group "
+              f"{per_group/FUSE:6.1f} ms/batch{delta}", flush=True)
 
 
 if __name__ == "__main__":

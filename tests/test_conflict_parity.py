@@ -14,6 +14,9 @@ from foundationdb_tpu.models.types import CommitTransaction, TransactionResult
 from foundationdb_tpu.testing.oracle import ConflictOracle, OracleTxn
 from foundationdb_tpu.testing import workloads
 
+# compile-heavy kernel tests: run with -m kernel (fast lane: -m 'not kernel')
+pytestmark = pytest.mark.kernel
+
 CFG = KernelConfig(
     max_key_bytes=12,
     max_txns=64,

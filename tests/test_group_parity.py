@@ -27,6 +27,9 @@ from foundationdb_tpu.utils import packing
 
 from conftest import random_key, random_range
 
+# compile-heavy kernel tests: run with -m kernel (fast lane: -m 'not kernel')
+pytestmark = pytest.mark.kernel
+
 
 def small_config(**kw):
     defaults = dict(
@@ -278,6 +281,65 @@ def test_group_parity_with_prestate(seed):
             grp.intra_first_range[i], so.intra_first_range
         )
     assert canonical_map(state_g, config) == canonical_map(state_s, config)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_short_span_path_matches_general(seed):
+    """short_span_limit=S compiles the direct range ops; on workloads
+    within the span bound it must be decision-identical to the general
+    path, with no latch trip."""
+    import functools
+
+    rng = np.random.default_rng(300 + seed)
+    config = small_config()
+    # point-ish ranges: single-byte keys, [k, k+1) style
+    def point_txn():
+        k = bytes([int(rng.integers(0, 40))])
+        k2 = bytes([int(rng.integers(0, 40))])
+        return CommitTransaction(
+            read_conflict_ranges=[(k, k + b"\x01")],
+            write_conflict_ranges=[(k2, k2 + b"\x01")],
+            read_snapshot=int(rng.integers(900, 1100 + 100 * rng.integers(1, 3))),
+        )
+
+    batches = [
+        packing.pack_batch(
+            [point_txn() for _ in range(10)], 1000 + (i + 1) * 100, 0, config
+        )
+        for i in range(3)
+    ]
+    stacked = packing.stack_device_args(batches)
+
+    s0, out0 = jax.jit(G.resolve_group)(H.init(config), stacked)
+    jf = jax.jit(functools.partial(G.resolve_group, short_span_limit=8))
+    s1, out1 = jf(H.init(config), stacked)
+    np.testing.assert_array_equal(
+        np.asarray(out1.verdict), np.asarray(out0.verdict)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out1.hist_conflict_read), np.asarray(out0.hist_conflict_read)
+    )
+    assert not bool(np.asarray(out1.overflow).any()), "latch must not trip"
+    assert canonical_map(s1, config) == canonical_map(s0, config)
+
+
+def test_short_span_latch_trips_on_wide_ranges():
+    """A range wider than the limit must trip the loud latch (overflow),
+    never silently resolve."""
+    import functools
+
+    config = small_config()
+    wide = CommitTransaction(
+        read_conflict_ranges=[(b"\x00", b"\x30")],  # spans many keys
+        write_conflict_ranges=[
+            (bytes([i]), bytes([i]) + b"\x01") for i in range(12)
+        ],
+        read_snapshot=1000,
+    )
+    b0 = packing.pack_batch([wide], 1100, 0, config)
+    jf = jax.jit(functools.partial(G.resolve_group, short_span_limit=2))
+    _s, out = jf(H.init(config), packing.stack_device_args([b0]))
+    assert bool(np.asarray(out.overflow).any())
 
 
 def test_group_of_one_equals_resolve_batch():

@@ -6,12 +6,16 @@ ones — the kernel must still catch every true conflict (safety), and for
 keys within the width it stays exact.
 """
 
+import pytest
 import numpy as np
 
 from foundationdb_tpu.config import TEST_CONFIG
 from foundationdb_tpu.models.conflict_set import TpuConflictSet
 from foundationdb_tpu.models.types import CommitTransaction, TransactionResult
 from foundationdb_tpu.testing.oracle import ConflictOracle, OracleTxn
+
+# compile-heavy kernel tests: run with -m kernel (fast lane: -m 'not kernel')
+pytestmark = pytest.mark.kernel
 
 CFG = TEST_CONFIG  # max_key_bytes = 8
 

@@ -18,6 +18,9 @@ from foundationdb_tpu.models.conflict_set import (
 )
 from foundationdb_tpu.models.types import CommitTransaction
 
+# compile-heavy kernel tests: run with -m kernel (fast lane: -m 'not kernel')
+pytestmark = pytest.mark.kernel
+
 
 def k(i: int) -> bytes:
     return int(i).to_bytes(4, "big")

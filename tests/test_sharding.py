@@ -18,6 +18,9 @@ from foundationdb_tpu.parallel.sharding import ShardedConflictSet
 from foundationdb_tpu.testing.oracle import MultiResolverOracle, OracleTxn
 from foundationdb_tpu.testing.workloads import WorkloadConfig, int_key, make_batch
 
+# compile-heavy kernel tests: run with -m kernel (fast lane: -m 'not kernel')
+pytestmark = pytest.mark.kernel
+
 
 def make_mesh(n: int):
     # jax.devices("cpu"), never jax.devices(): the bench environment
