@@ -213,7 +213,10 @@ def main():
     # coalescing its queue is exactly how the reference behaves under
     # backpressure (fdbserver/Resolver.actor.cpp resolveBatch queueing).
     # Per-batch latency is still reported un-fused (phase 4).
-    fuse = max(1, int(os.environ.get("BENCH_FUSE", 16)))
+    # 8 batches per group: G=16 amortizes fixed costs further but its
+    # XLA compile exceeds 35 minutes on a single-core host — not worth
+    # the cold-start risk for ~10% throughput.
+    fuse = max(1, int(os.environ.get("BENCH_FUSE", 8)))
     from foundationdb_tpu.utils.packing import stack_device_args
 
     dev_groups = [

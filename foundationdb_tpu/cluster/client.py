@@ -94,8 +94,11 @@ class WriteMap:
 
 
 class Transaction:
-    def __init__(self, db: "Database"):
+    def __init__(self, db: "Database", tag: str = None):
         self.db = db
+        #: optional transaction tag: GRV requests carrying it are metered
+        #: against the Ratekeeper's per-tag quota (tag throttling)
+        self.tag = tag
         self._read_version: Optional[int] = None
         self.writes = WriteMap()
         self.mutations: list = []
@@ -113,7 +116,9 @@ class Transaction:
 
     async def get_read_version(self) -> int:
         if self._read_version is None:
-            self._read_version = await self.db.grv_proxy.get_read_version().future
+            self._read_version = await self.db.grv_proxy.get_read_version(
+                self.tag
+            ).future
         return self._read_version
 
     async def get(self, key: bytes, *, snapshot: bool = False) -> Optional[bytes]:
@@ -276,7 +281,10 @@ class Transaction:
         return commit_id.version
 
     def reset(self) -> None:
-        self.__init__(self.db)
+        # the tag survives reset: retried transactions must stay metered
+        # (the overload-retry loop is exactly what tag throttling exists
+        # to contain)
+        self.__init__(self.db, tag=self.tag)
 
 
 def _dedup(ranges):
@@ -329,8 +337,8 @@ class Database:
             for b, e, team in self.cluster.key_servers.segments_in(begin, end)
         ]
 
-    def create_transaction(self) -> Transaction:
-        return Transaction(self)
+    def create_transaction(self, tag: str = None) -> Transaction:
+        return Transaction(self, tag=tag)
 
     def special_key(self, key: bytes):
         """The \\xff\\xff special key space (SpecialKeySpace.actor.cpp):

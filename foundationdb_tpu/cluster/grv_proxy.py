@@ -17,6 +17,9 @@ from __future__ import annotations
 
 from foundationdb_tpu.runtime.flow import Promise, PromiseStream, Scheduler
 from foundationdb_tpu.utils.metrics import CounterCollection
+from foundationdb_tpu.utils.probes import declare
+
+declare("ratekeeper.tag_throttled")
 
 
 class GrvProxyFailedError(Exception):
@@ -44,6 +47,7 @@ class GrvProxy:
         self._pending: list[Promise] = []
         self._task = None
         self._armed = None  # the starter's in-flight stream waiter
+        self._tag_tokens: dict[str, float] = {}  # per-tag throttle buckets
 
     def start(self) -> None:
         self._task = self.sched.spawn(self._starter(), name="grv-starter")
@@ -74,8 +78,12 @@ class GrvProxy:
             if not p.is_set:
                 p.send_error(GrvProxyFailedError())
 
-    def get_read_version(self) -> Promise:
+    def get_read_version(self, tag: str = None) -> Promise:
+        """tag: optional transaction tag; tagged requests are metered
+        against the Ratekeeper's per-tag quota (GlobalTagThrottler's
+        enforcement point) on top of the global budget."""
         p = Promise()
+        p.tag = tag
         self.counters.add("txnRequestIn")
         if self._task is None:
             # Stopped proxy (the recovery window between the old
@@ -113,6 +121,7 @@ class GrvProxy:
                 )
             else:
                 tokens = float(len(pending))
+            dt = now - last
             last = now
             n = min(len(pending), int(tokens))
             if n == 0:
@@ -120,6 +129,45 @@ class GrvProxy:
             tokens -= n
             batch = pending[:n]
             del pending[:n]
+            # per-tag metering: requests over their tag's quota are
+            # deferred back to the queue (the tag throttle delays, never
+            # drops — GlobalTagThrottler semantics)
+            if self.ratekeeper is not None and any(
+                getattr(p, "tag", None) for p in batch
+            ):
+                from foundationdb_tpu.utils.probes import code_probe
+
+                # refill each tag's bucket ONCE per interval (not per
+                # request — that would scale the quota by queue depth)
+                tags = {p.tag for p in batch if getattr(p, "tag", None)}
+                for tag in tags:
+                    quota = self.ratekeeper.get_tag_quota(tag)
+                    if quota == float("inf"):
+                        self._tag_tokens[tag] = float("inf")
+                        continue
+                    self._tag_tokens[tag] = min(
+                        self._tag_tokens.get(tag, 0.0)
+                        + quota * max(dt, 1e-9),
+                        max(quota * 0.5, 1.0),
+                    )
+                admit, defer = [], []
+                for p in batch:
+                    tag = getattr(p, "tag", None)
+                    if tag is None or self._tag_tokens[tag] >= 1.0:
+                        if tag is not None:
+                            self._tag_tokens[tag] -= 1.0
+                        admit.append(p)
+                    else:
+                        code_probe(True, "ratekeeper.tag_throttled")
+                        defer.append(p)
+                # deferred requests were never started: refund their
+                # global tokens so a throttled tag flood cannot starve
+                # untagged traffic
+                tokens += len(defer)
+                pending.extend(defer)
+                batch = admit
+                if not batch:
+                    continue
             version = self.sequencer.get_live_committed_version()
             self.counters.add("grvBatches")
             for p in batch:

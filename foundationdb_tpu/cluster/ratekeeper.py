@@ -17,6 +17,9 @@ from __future__ import annotations
 
 from foundationdb_tpu.runtime.flow import ActorCancelled, Scheduler
 from foundationdb_tpu.utils.metrics import CounterCollection
+from foundationdb_tpu.utils.probes import code_probe, declare
+
+declare("ratekeeper.throttled")
 
 
 class Ratekeeper:
@@ -44,6 +47,12 @@ class Ratekeeper:
         self.min_tps = min_tps
         self.tps_budget = max_tps
         self.counters = CounterCollection("RkMetrics", ["loops", "throttled"])
+        # GlobalTagThrottler (minimal): per-transaction-tag TPS quotas
+        # (fdbserver/GlobalTagThrottler.actor.cpp's enforcement point —
+        # quotas here are set by management rather than derived from
+        # storage busyness). GRV proxies meter tagged requests against
+        # these on top of the global budget.
+        self.tag_quotas: dict[str, float] = {}
         self._task = None
 
     def start(self) -> None:
@@ -71,6 +80,13 @@ class Ratekeeper:
         """GetRateInfoRequest: the current per-second txn budget."""
         return self.tps_budget
 
+    def set_tag_quota(self, tag: str, tps: float) -> None:
+        """Management surface: cap a transaction tag's start rate."""
+        self.tag_quotas[tag] = tps
+
+    def get_tag_quota(self, tag: str) -> float:
+        return self.tag_quotas.get(tag, float("inf"))
+
     async def _loop(self) -> None:
         try:
             while True:
@@ -82,11 +98,13 @@ class Ratekeeper:
                 elif lag >= self.lag_limit:
                     self.tps_budget = self.min_tps
                     self.counters.add("throttled")
+                    code_probe(True, "ratekeeper.throttled")
                 else:
                     frac = (self.lag_limit - lag) / (
                         self.lag_limit - self.lag_target
                     )
                     self.tps_budget = max(self.min_tps, self.max_tps * frac)
                     self.counters.add("throttled")
+                    code_probe(True, "ratekeeper.throttled")
         except ActorCancelled:
             raise

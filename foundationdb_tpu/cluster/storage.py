@@ -69,6 +69,10 @@ class StorageServer:
         self._live_count = 0
         self._last_gc = recovery_version
         self._update_task = None
+        #: fault injection: extra seconds per pull iteration (a slow
+        #: disk/IO path; the Ratekeeper must observe the growing lag and
+        #: throttle admission — Ratekeeper.actor.cpp's control input)
+        self.slowdown = 0.0
 
     def start(self) -> None:
         self._update_task = self.sched.spawn(self._update_loop(), name="ss-update")
@@ -82,6 +86,8 @@ class StorageServer:
     async def _update_loop(self) -> None:
         try:
             while True:
+                if self.slowdown:
+                    await self.sched.delay(self.slowdown)
                 entries, log_version = await self.tlog.peek(
                     self.tag, self.version.get()
                 )

@@ -114,10 +114,11 @@ class Future:
 class Promise:
     """Producer handle for a Future (reference Promise<T>)."""
 
-    __slots__ = ("future",)
+    __slots__ = ("future", "tag")
 
     def __init__(self):
         self.future = Future()
+        self.tag = None  # optional transaction tag (GRV throttling)
 
     def send(self, value: Any = None) -> None:
         self.future._set(value)

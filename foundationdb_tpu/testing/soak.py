@@ -47,6 +47,8 @@ class SeedPlan:
     state_squeeze: bool        # resolver state-memory backpressure
     small_window: bool         # 1s MVCC window (makes laggard cheap)
     crash_tlog: bool           # power-loss + DiskQueue recovery of a log
+    slow_storage: bool         # IO slowdown -> ratekeeper must throttle
+    tag_quota: bool            # per-tag GRV throttling exercised
 
 
 def plan_for_seed(seed: int) -> SeedPlan:
@@ -74,6 +76,8 @@ def plan_for_seed(seed: int) -> SeedPlan:
         state_squeeze=bool(r.random() < 0.3),
         small_window=bool(r.random() < 0.5),
         crash_tlog=bool(r.random() < 0.4),
+        slow_storage=bool(r.random() < 0.3),
+        tag_quota=bool(r.random() < 0.3),
     )
 
 
@@ -140,6 +144,9 @@ def run_seed(seed: int, collect_probes: bool = False):
         rng = np.random.default_rng(seed)
         possible: dict[bytes, set] = {}
         outcome = {"committed": 0, "aborted": 0, "read_checks": 0}
+        if plan.tag_quota:
+            # a "batch"-tagged workload slice throttled at the front door
+            cluster.ratekeeper.set_tag_quota("batch", 12.0)
 
         def check(got: dict, lo: bytes, hi: bytes):
             keys = set(got) | {k for k in possible if lo <= k < hi}
@@ -152,7 +159,9 @@ def run_seed(seed: int, collect_probes: bool = False):
 
         async def workload():
             for i in range(plan.rounds):
-                txn = db.create_transaction()
+                txn = db.create_transaction(
+                    tag="batch" if plan.tag_quota and i % 3 == 0 else None
+                )
                 writes: dict = {}
                 try:
                     if rng.random() < 0.15 or plan.state_squeeze:
@@ -290,6 +299,22 @@ def run_seed(seed: int, collect_probes: bool = False):
                     )
                 except Exception:
                     pass
+            if plan.slow_storage:
+                # a slow storage pull loop: lag grows, the ratekeeper's
+                # control law must throttle admission and the cluster
+                # must stay inside the MVCC window (no unbounded queue).
+                # The law's thresholds are tightened for the fault window
+                # (the production 2s lag target would need seconds of
+                # virtual saturation per seed).
+                rk = cluster.ratekeeper
+                ss = cluster.storage_servers[0]
+                old = (rk.lag_target, rk.lag_limit, rk.interval)
+                rk.lag_target, rk.lag_limit, rk.interval = 40_000, 300_000, 0.05
+                ss.slowdown = 0.1
+                await sched.delay(0.6)
+                ss.slowdown = 0.0
+                await sched.delay(0.4)  # drain under throttle
+                rk.lag_target, rk.lag_limit, rk.interval = old
             if plan.crash_tlog and plan.n_tlogs > 1:
                 # power-loss one log replica mid-traffic: un-fsynced data
                 # tears, the DiskQueue recovery scan rebuilds, the peer

@@ -1,0 +1,111 @@
+"""Multi-region skeleton: log-router replication + remote-DC failover.
+
+VERDICT r2 task 8. The remote region trails the primary by a bounded
+version lag via the LogRouter's pull stream; failover promotes the
+remote with data parity at the takeover version
+(fdbserver/LogRouter.actor.cpp + TagPartitionedLogSystem multi-region,
+ha-write-path.rst).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+from foundationdb_tpu.cluster.multiregion import RemoteDC
+
+
+def _run(sched, coro):
+    t = sched.spawn(coro)
+    sched.run_until(t.done)
+    return t.done.get()
+
+
+@pytest.fixture
+def world():
+    sched, cluster, db = open_cluster(ClusterConfig(n_storage=2))
+    yield sched, cluster, db
+    cluster.stop()
+
+
+def test_remote_dc_replicates_and_fails_over(world):
+    sched, cluster, db = world
+    remote = RemoteDC(
+        sched, cluster.tlog, n_tlogs=2, n_storage=2,
+        storage_boundaries=[b"m"],
+    )
+    remote.start()
+
+    committed: dict[bytes, tuple[int, bytes]] = {}
+
+    async def workload():
+        for i in range(25):
+            txn = db.create_transaction()
+            k = b"mr%02d" % (i % 12)
+            v = b"v%d" % i
+            txn.set(k, v)
+            cid = await txn.commit()
+            committed[k] = (txn.committed_version, v)
+
+    _run(sched, workload())
+    _run(sched, remote.wait_caught_up())
+    assert remote.lag() == 0
+
+    # graceful failover: nothing acked may be lost
+    takeover = _run(sched, remote.failover())
+    for k, (v_committed, v) in committed.items():
+        assert v_committed <= takeover
+        got = _run(sched, remote.read_at(k, takeover))
+        assert got == v, f"{k!r}: {got!r} != {v!r}"
+
+
+def test_remote_dc_bounded_lag_during_load(world):
+    sched, cluster, db = world
+    remote = RemoteDC(sched, cluster.tlog, n_tlogs=1, n_storage=1)
+    remote.start()
+
+    async def workload():
+        for i in range(30):
+            txn = db.create_transaction()
+            txn.set(b"lag%02d" % (i % 8), b"x%d" % i)
+            await txn.commit()
+
+    _run(sched, workload())
+    # the router keeps pulling while load flows; shortly after the last
+    # commit the remote must be fully caught up (lag -> 0)
+    _run(sched, remote.wait_caught_up())
+    assert remote.lag() == 0
+    remote.stop()
+
+
+def test_remote_dc_primary_death_serves_watermark_prefix(world):
+    sched, cluster, db = world
+    remote = RemoteDC(sched, cluster.tlog, n_tlogs=1, n_storage=2,
+                      storage_boundaries=[b"m"])
+    remote.start()
+
+    committed: dict[bytes, tuple[int, bytes]] = {}
+
+    async def workload():
+        for i in range(20):
+            txn = db.create_transaction()
+            k = b"pd%02d" % (i % 10)
+            v = b"w%d" % i
+            txn.set(k, v)
+            await txn.commit()
+            committed[k] = (txn.committed_version, v)
+
+    _run(sched, workload())
+    _run(sched, remote.wait_caught_up())
+
+    # primary dies hard: every log replica gone
+    cluster.tlog.live = [False] * len(cluster.tlog.live)
+
+    takeover = _run(sched, remote.failover())
+    # the remote serves a consistent prefix at its watermark: everything
+    # acked at or below the takeover version is present and correct
+    for k, (v_committed, v) in committed.items():
+        if v_committed <= takeover:
+            got = _run(sched, remote.read_at(k, takeover))
+            assert got == v

@@ -191,6 +191,51 @@ def test_every_declared_probe_fires():
     assert t.done.get()
     cluster3.stop()
 
+    # -- disk stack: torn tail + recovery scan ---------------------------
+    import numpy as np
+
+    from foundationdb_tpu.sim.diskqueue import SimDiskQueue
+
+    for s in range(32):  # the tear branch is coin-flipped per crash
+        q = SimDiskQueue()
+        q.push(b"durable")
+        q.commit()
+        for i in range(4):
+            q.push(b"unsynced%d" % i)
+        q.crash(np.random.default_rng(s))
+    sched4, cluster4, db4 = open_cluster(
+        ClusterConfig(n_storage=2, n_tlogs=2)
+    )
+
+    from foundationdb_tpu.cluster.multiregion import RemoteDC
+
+    remote = RemoteDC(sched4, cluster4.tlog, n_storage=1)
+    remote.start()
+
+    async def disk_and_rates():
+        for i in range(4):
+            txn = db4.create_transaction()
+            txn.set(b"dq%d" % i, b"v")
+            await txn.commit()
+        cluster4.crash_reboot_tlog(1, np.random.default_rng(0))
+        await remote.wait_caught_up()
+        await remote.failover()
+        # ratekeeper law: tighten + slow storage
+        rk = cluster4.ratekeeper
+        rk.lag_target, rk.lag_limit, rk.interval = 30_000, 200_000, 0.05
+        cluster4.storage_servers[0].slowdown = 0.1
+        for i in range(8):
+            txn = db4.create_transaction(tag="batch")
+            await txn.get_read_version()
+        cluster4.storage_servers[0].slowdown = 0.0
+        return True
+
+    cluster4.ratekeeper.set_tag_quota("batch", 3.0)
+    t = sched4.spawn(disk_and_rates(), name="drive")
+    sched4.run_until(t.done)
+    assert t.done.get()
+    cluster4.stop()
+
     assert probes.missed() == [], (
         f"declared CODE_PROBEs never fired: {probes.missed()}\n"
         f"fired: { {k: v for k, v in probes.snapshot().items() if v} }"
