@@ -406,9 +406,23 @@ class StorageRole:
         self._cond: asyncio.Condition | None = None
         self._data_dir = data_dir
         self._applies_since_ckpt = 0
+        # Incremental durability (KeyValueStoreMemory's discipline,
+        # fdbserver/KeyValueStoreMemory.actor.cpp): every apply streams
+        # its mutations to a local DiskQueue and fsyncs BEFORE acking
+        # durable_version (the tlog pops on that ack — without the log,
+        # acked-but-not-yet-checkpointed data died with the process).
+        # Checkpoints become periodic compactions that pop the log
+        # prefix; restart = load checkpoint + replay only the log tail.
+        self._dq = None
+        self._seq_by_version: list[tuple[int, int]] = []
+        self.replayed_on_restart = 0
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
+            from foundationdb_tpu import native
+
+            self._dq = native.DiskQueue(os.path.join(data_dir, "mutlog"))
             self._load_checkpoint()
+            self._replay_local_log()
 
     # -- durable-version checkpointing (storageserver durableVersion
     # discipline: persist at a version, replay the tlog tail on restart) --
@@ -452,6 +466,64 @@ class StorageRole:
         self.version = version
         self.history = {k: [(version, v)] for k, v in kvs}
 
+    # -- the mutation log (incremental durability) -----------------------
+    # Records are codec-encoded StorageApply messages — the same
+    # registered wire codec the RPC layer uses (TLogRole logs its
+    # DiskQueue records the same way; no second serialization path).
+
+    def _replay_local_log(self) -> None:
+        """Restart: replay the log tail above the checkpoint — cost
+        proportional to the tail, not the dataset."""
+        for seq, blob in self._dq.recovered:
+            rec = codec.decode(blob)
+            if rec.version > self.version:
+                self._apply_mutations(rec.version, rec.mutations)
+                self.version = rec.version
+                self.replayed_on_restart += 1
+            self._seq_by_version.append((rec.version, seq))
+
+    def _log_apply_durably(self, reqs: list) -> None:
+        """Write-ahead + fsync a group of versions' mutations (one
+        fsync per group — catch-up batches amortize it). Runs in the
+        executor, BEFORE the in-memory apply and the ack."""
+        seqs = [
+            (req.version, self._dq.push(codec.encode(req)))
+            for req in reqs
+        ]
+        if self._dq.commit() is None:
+            # fsync/pwrite failed: the data is NOT durable — refuse the
+            # ack rather than lie (the tLogCommit discipline; the tlog
+            # pops on our durable_version ack)
+            raise transport.RemoteError("storage mutation-log commit failed")
+        self._seq_by_version.extend(seqs)
+
+    def _compact_log(self, ckpt_version: int) -> None:
+        """After a checkpoint at ckpt_version is durably installed, the
+        log prefix at or below it is dead: pop it (the restart replay
+        shrinks back to the new tail)."""
+        last_seq = None
+        kept = []
+        for v, s in self._seq_by_version:
+            if v <= ckpt_version:
+                last_seq = s
+            else:
+                kept.append((v, s))
+        if last_seq is not None:
+            self._dq.pop(last_seq + 1)
+            self._dq.commit()
+            self._seq_by_version = kept
+
+    def _apply_mutations(self, version: int, mutations) -> None:
+        for m in mutations:
+            if m.op == self.MUT_SET:
+                self.history.setdefault(m.param1, []).append(
+                    (version, m.param2)
+                )
+            elif m.op == self.MUT_CLEAR_RANGE:
+                for k in list(self.history):
+                    if m.param1 <= k < m.param2:
+                        self.history[k].append((version, None))
+
     async def catch_up_from_tlog(self, tlog_address: str) -> None:
         """Replay the tlog tail above our durable version (the restart
         path of storageserver.actor.cpp:9117's pull loop) in batched
@@ -468,8 +540,19 @@ class StorageRole:
                 )
                 if not rep.versions:
                     break
-                for v, muts in zip(rep.versions, rep.groups):
-                    await self.apply(StorageApply(version=v, mutations=muts))
+                reqs = [
+                    StorageApply(version=v, mutations=muts)
+                    for v, muts in zip(rep.versions, rep.groups)
+                    if v > self.version
+                ]
+                if reqs and self._dq is not None:
+                    # group commit: ONE fsync per peek chunk, not per
+                    # version — restart catch-up stays O(chunks) fsyncs
+                    await asyncio.get_event_loop().run_in_executor(
+                        None, self._log_apply_durably, reqs
+                    )
+                for req in reqs:
+                    await self._apply_logged(req)
         finally:
             await conn.close()
 
@@ -479,29 +562,40 @@ class StorageRole:
         return self._cond
 
     async def apply(self, req: StorageApply) -> StorageApplyReply:
+        # WRITE-AHEAD: fsync the mutations to the local log BEFORE the
+        # in-memory apply and the ack — durable_version must imply
+        # durability (the tlog pops on it). The fsync runs OUTSIDE the
+        # condition lock so reads at already-applied versions never
+        # stall behind the disk; a stale/duplicate record logged by a
+        # lost race is skipped idempotently on replay.
+        if self._dq is not None and req.version > self.version:
+            await asyncio.get_event_loop().run_in_executor(
+                None, self._log_apply_durably, [req]
+            )
+        return await self._apply_logged(req)
+
+    async def _apply_logged(self, req: StorageApply) -> StorageApplyReply:
         cond = self._cond_lazy()
         async with cond:
             if req.version > self.version:
-                for m in req.mutations:
-                    if m.op == self.MUT_SET:
-                        self.history.setdefault(m.param1, []).append(
-                            (req.version, m.param2)
-                        )
-                    elif m.op == self.MUT_CLEAR_RANGE:
-                        for k in list(self.history):
-                            if m.param1 <= k < m.param2:
-                                self.history[k].append((req.version, None))
+                self._apply_mutations(req.version, req.mutations)
                 self.version = req.version
                 if self._data_dir:
                     self._applies_since_ckpt += 1
                     if self._applies_since_ckpt >= self.CHECKPOINT_INTERVAL:
                         self._applies_since_ckpt = 0
-                        # serialize under the lock (consistent view), but
-                        # keep the fsync off the event loop so concurrent
-                        # reads don't stall behind disk
+                        # checkpoint = compaction: serialize under the
+                        # lock (consistent view), install + pop the log
+                        # prefix off the event loop
                         blob = self._serialize_checkpoint()
+                        ckpt_version = self.version
+
+                        def install():
+                            self._write_checkpoint_blob(blob)
+                            self._compact_log(ckpt_version)
+
                         await asyncio.get_event_loop().run_in_executor(
-                            None, self._write_checkpoint_blob, blob
+                            None, install
                         )
                 cond.notify_all()
             return StorageApplyReply(durable_version=self.version)
