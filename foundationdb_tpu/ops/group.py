@@ -340,140 +340,149 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
     txn_valid2 = txn_valid.reshape(gn, b)
     read_index2 = fl(g["read_index"]).reshape(gn, nr)
 
-    def per_txn_g(gi, read_bits):
-        return (
-            jnp.zeros((b + 1,), jnp.int32)
-            .at[jnp.where(read_live2[gi], r_txn2[gi], b)]
-            .max(read_bits.astype(jnp.int32))[:b]
-        ) > 0
+    # The per-batch step runs under lax.scan: ONE traced/compiled body
+    # regardless of G (the unrolled loop's compile time grew ~linearly
+    # with G and exceeded 35 minutes at G=16 on this host). The carry is
+    # the running coverage map (+ the span latch); everything else rides
+    # the scan's per-batch xs slices. Batch 0 needs no special case: the
+    # initial all-NEG seg_ver answers every cross query with "no
+    # earlier write".
+    def batch_step(carry, xs):
+        seg_ver, span_ok = carry
+        (lqlo, lqhi, wlo, whi, rrb, rre, rwb, rwe, rtxn, rlive, wlive,
+         wtxn, snap, stale, toold, tvalid, ridx, ver) = xs
 
-    seg_ver = jnp.full((r_rows,), VERSION_NEG, jnp.int32)
-    committed_parts, same_parts, cross_parts, first_parts = [], [], [], []
-    for gi in range(gn):
+        def per_txn(read_bits):
+            return (
+                jnp.zeros((b + 1,), jnp.int32)
+                .at[jnp.where(rlive, rtxn, b)]
+                .max(read_bits.astype(jnp.int32))[:b]
+            ) > 0
+
         if short_span_limit:
             # the cross-batch query walks GLOBAL block ranks — its span
             # must be latched too, or wide reads would silently miss
             # earlier in-group writes
             span_ok &= jnp.max(
-                jnp.where(
-                    read_live2[gi], rank_re2[gi] - rank_rb2[gi], 0
-                )
+                jnp.where(rlive, rre - rrb, 0)
             ) <= short_span_limit
-        if gi == 0 or "cross" in _ablate:
+            span_ok &= jnp.max(
+                jnp.where(wlive, whi - wlo, 0)
+            ) <= short_span_limit
+            span_ok &= jnp.max(
+                jnp.where(rlive, lqhi - lqlo, 0)
+            ) <= short_span_limit
+
+        if "cross" in _ablate:
             cross_g = jnp.zeros((nr,), bool)
         elif short_span_limit:
             gmax = direct_range_op(
-                seg_ver, rank_rb2[gi], rank_re2[gi], op="max",
-                span=short_span_limit,
+                seg_ver, rrb, rre, op="max", span=short_span_limit
             )
-            cross_g = (gmax > snap2[gi]) & read_live2[gi]
+            cross_g = (gmax > snap) & rlive
         else:
             gtab = rangemax.build(seg_ver, op="max")
-            gmax = rangemax.query(
-                gtab, rank_rb2[gi], rank_re2[gi], op="max"
-            )
-            cross_g = (gmax > snap2[gi]) & read_live2[gi]
-        ok_g = (
-            txn_valid2[gi]
-            & ~too_old2[gi]
-            & ~per_txn_g(gi, stale2[gi] | cross_g)
-        )
+            gmax = rangemax.query(gtab, rrb, rre, op="max")
+            cross_g = (gmax > snap) & rlive
+        ok_g = tvalid & ~toold & ~per_txn(stale | cross_g)
 
-        if short_span_limit:
-            span_ok &= jnp.max(
-                jnp.where(w_live2[gi], whi2[gi] - wlo2[gi], 0)
-            ) <= short_span_limit
-            span_ok &= jnp.max(
-                jnp.where(read_live2[gi], lq_hi[gi] - lq_lo[gi], 0)
-            ) <= short_span_limit
-
-        def same_hits_g(committed_g, gi=gi):
+        def same_hits_g(committed_g):
             val = jnp.where(
-                committed_g[w_txn2[gi]] & w_live2[gi],
-                w_txn2[gi],
-                INT32_POS,
+                committed_g[wtxn] & wlive, wtxn, INT32_POS
             )
             if short_span_limit:
                 # direct S-wide cover: scatter-min val at every covered
                 # leaf (exact under the span latch)
                 flat = jnp.full((leaves_local + 1,), INT32_POS, jnp.int32)
                 for d in range(short_span_limit):
-                    pos = wlo2[gi] + d
-                    idx = jnp.where(pos < whi2[gi], pos, leaves_local)
+                    pos = wlo + d
+                    idx = jnp.where(pos < whi, pos, leaves_local)
                     flat = flat.at[idx].min(val)
                 mw = flat[:leaves_local]
                 minw = direct_range_op(
-                    mw, lq_lo[gi], lq_hi[gi], op="min",
-                    span=short_span_limit,
+                    mw, lqlo, lqhi, op="min", span=short_span_limit
                 )
             else:
-                mw = segtree.min_cover(
-                    leaves_local, wlo2[gi], whi2[gi], val
-                )
+                mw = segtree.min_cover(leaves_local, wlo, whi, val)
                 mtab = rangemax.build(mw, op="min")
-                minw = rangemax.query(mtab, lq_lo[gi], lq_hi[gi], op="min")
-            return (minw < r_txn2[gi]) & read_live2[gi]
+                minw = rangemax.query(mtab, lqlo, lqhi, op="min")
+            return (minw < rtxn) & rlive
 
-        def cond(carry):
-            committed_g, prev, _h = carry
+        def cond(c):
+            committed_g, prev, _h = c
             return jnp.any(committed_g != prev)
 
-        def body(carry, gi=gi, ok_g=ok_g):
-            committed_g, _prev, _h = carry
+        def body(c):
+            committed_g, _prev, _h = c
             h = same_hits_g(committed_g)
-            return ok_g & ~per_txn_g(gi, h & ok_g[r_txn2[gi]]), committed_g, h
+            return ok_g & ~per_txn(h & ok_g[rtxn]), committed_g, h
 
         if "fixpoint" in _ablate:
             committed_g = ok_g
             final_same_g = jnp.zeros((nr,), bool)
         elif "fix1" in _ablate:  # diagnostic: exactly one application
             h0 = same_hits_g(ok_g)
-            committed_g = ok_g & ~per_txn_g(gi, h0 & ok_g[r_txn2[gi]])
-            final_same_g = h0 & ok_g[r_txn2[gi]]
+            committed_g = ok_g & ~per_txn(h0 & ok_g[rtxn])
+            final_same_g = h0 & ok_g[rtxn]
         else:
             h0 = same_hits_g(ok_g)
-            c1 = ok_g & ~per_txn_g(gi, h0 & ok_g[r_txn2[gi]])
+            c1 = ok_g & ~per_txn(h0 & ok_g[rtxn])
             committed_g, _, last_h = jax.lax.while_loop(
                 cond, body, (c1, ok_g, h0)
             )
             # last_h is the hits AT the fixpoint (carried from prev ==
             # fixpoint — the round-2 kernel's argument).
-            final_same_g = last_h & ok_g[r_txn2[gi]]
+            final_same_g = last_h & ok_g[rtxn]
 
         if "seg" not in _ablate:
-            # fold batch gi's committed writes into the running map
-            cw = committed_g[w_txn2[gi]] & w_live2[gi]
+            # fold this batch's committed writes into the running map
+            cw = committed_g[wtxn] & wlive
             dd = (
                 jnp.zeros((r_rows + 1,), jnp.int32)
-                .at[jnp.where(cw, rank_wb2[gi], r_rows)].add(1)
-                .at[jnp.where(cw, rank_we2[gi], r_rows)].add(-1)[:r_rows]
+                .at[jnp.where(cw, rwb, r_rows)].add(1)
+                .at[jnp.where(cw, rwe, r_rows)].add(-1)[:r_rows]
             )
             covered = jnp.cumsum(dd) > 0
-            seg_ver = jnp.where(covered, versions[gi], seg_ver)
+            seg_ver = jnp.where(covered, ver, seg_ver)
 
-        committed_parts.append(committed_g)
-        same_parts.append(final_same_g)
-        cross_parts.append(cross_g)
-        first_parts.append(
+        first_g = (
             jnp.full((b + 1,), INT32_POS, jnp.int32)
-            .at[jnp.where(final_same_g, r_txn2[gi], b)]
-            .min(jnp.where(final_same_g, read_index2[gi], INT32_POS))[:b]
+            .at[jnp.where(final_same_g, rtxn, b)]
+            .min(jnp.where(final_same_g, ridx, INT32_POS))[:b]
+        )
+        return (seg_ver, span_ok), (
+            committed_g, final_same_g, cross_g, first_g
         )
 
-    committed = jnp.concatenate(committed_parts)
-    final_same = jnp.concatenate(same_parts)
+    # The initial carry must inherit the axis-varying type of the traced
+    # inputs, or lax.scan rejects the carry under shard_map (the sharded
+    # multi-resolver path). `bi` derives from the co-sort of the SHARDED
+    # history state, so it carries the manual-axis varyingness exactly
+    # when anything does; adding 0*bi[0] is numerically a no-op.
+    seg_ver0 = jnp.full((r_rows,), VERSION_NEG, jnp.int32) + 0 * bi[0]
+    span_ok = span_ok & (bi[0] == bi[0])
+    xs = (
+        lq_lo, lq_hi, wlo2, whi2, rank_rb2, rank_re2, rank_wb2,
+        rank_we2, r_txn2, read_live2, w_live2, w_txn2, snap2, stale2,
+        too_old2, txn_valid2, read_index2, versions,
+    )
+    (seg_ver, span_ok), (committed2, same2, cross2, first2) = jax.lax.scan(
+        batch_step, (seg_ver0, span_ok), xs
+    )
+    committed = committed2.reshape(-1)
+    final_same = same2.reshape(-1)
     # The cross-batch report is NOT masked by `ok`: sequentially these
     # writes sit in history when batch i resolves, and the round-2
     # kernel reports hist_conflict_read masked only by read_live — a
     # txn condemned by pre-group history still reports its other
     # conflicting reads (tests/test_group_parity.py prestate case).
-    final_cross = jnp.concatenate(cross_parts)
+    final_cross = cross2.reshape(-1)
 
     # ---- verdicts ------------------------------------------------------
     hist_conflict_read = stale_hit | final_cross
     hist_conflict_txn = hist_conflict_txn0 | per_txn_any(final_cross)
 
-    first_idx = jnp.concatenate(first_parts)
+    first_idx = first2.reshape(-1)
     intra_first_range = jnp.where(
         committed | ~txn_valid | too_old | hist_conflict_txn,
         -1,
