@@ -164,23 +164,7 @@ def main():
     from foundationdb_tpu.ops import rangemax as _rm
 
     mm = config.history_capacity
-    vals = rng.integers(0, 2**30, size=mm).astype(np.int32)
-    qlo = rng.integers(0, mm - 1, size=8192).astype(np.int32)
-    qlen = rng.integers(1, mm // 2, size=8192).astype(np.int32)
-    qhi = np.minimum(qlo + qlen, mm).astype(np.int32)
-    tab = jax.jit(lambda v: _rm.build(v, op="max"))(vals)
-    got = np.asarray(jax.jit(
-        lambda t, lo, hi: _rm.query(t, lo, hi, op="max")
-    )(tab, qlo, qhi))
-    # numpy reference via running maximum on a suffix trick is O(n*q);
-    # spot-check a sample exactly
-    idx = rng.integers(0, 8192, size=256)
-    for i in idx:
-        want = int(vals[qlo[i]:qhi[i]].max())
-        assert got[i] == want, (
-            f"rangemax flat-gather MISCOMPILE at m={mm}: query "
-            f"[{qlo[i]},{qhi[i]}) got {got[i]} want {want}"
-        )
+    _rm.flat_gather_selftest(mm, force=True)
     log(f"rangemax large-m selftest: OK (m={mm}, 8192 queries)")
 
     # ---- phase 2: decision parity ---------------------------------------

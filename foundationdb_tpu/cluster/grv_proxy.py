@@ -83,7 +83,10 @@ class GrvProxy:
         against the Ratekeeper's per-tag quota (GlobalTagThrottler's
         enforcement point) on top of the global budget."""
         p = Promise()
-        p.tag = tag
+        # normalize falsy tags (e.g. "") to None: the admit loop and the
+        # refill set must agree on what counts as "tagged", or an
+        # empty-string tag reaches the bucket dict without a bucket
+        p.tag = tag or None
         self.counters.add("txnRequestIn")
         if self._task is None:
             # Stopped proxy (the recovery window between the old

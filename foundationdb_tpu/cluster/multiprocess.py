@@ -415,6 +415,12 @@ class StorageRole:
         # prefix; restart = load checkpoint + replay only the log tail.
         self._dq = None
         self._seq_by_version: list[tuple[int, int]] = []
+        # Serializes write-ahead logging: the fsync runs in an executor
+        # OUTSIDE the read condition lock (reads must not stall behind
+        # the disk), so without this lock two concurrent apply() calls
+        # could persist log records out of version order and replay
+        # would skip the lower version (ADVICE r3).
+        self._log_lock: asyncio.Lock | None = None
         self.replayed_on_restart = 0
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
@@ -548,9 +554,7 @@ class StorageRole:
                 if reqs and self._dq is not None:
                     # group commit: ONE fsync per peek chunk, not per
                     # version — restart catch-up stays O(chunks) fsyncs
-                    await asyncio.get_event_loop().run_in_executor(
-                        None, self._log_apply_durably, reqs
-                    )
+                    await self._log_durably(reqs)
                 for req in reqs:
                     await self._apply_logged(req)
         finally:
@@ -569,10 +573,20 @@ class StorageRole:
         # stall behind the disk; a stale/duplicate record logged by a
         # lost race is skipped idempotently on replay.
         if self._dq is not None and req.version > self.version:
-            await asyncio.get_event_loop().run_in_executor(
-                None, self._log_apply_durably, [req]
-            )
+            await self._log_durably([req])
         return await self._apply_logged(req)
+
+    async def _log_durably(self, reqs: list) -> None:
+        """Run the write-ahead fsync in the executor under a per-store
+        lock: log records must hit the disk in version order (replay
+        skips any version at or below the restart cursor, so an
+        out-of-order pair would silently drop the lower one)."""
+        if self._log_lock is None:
+            self._log_lock = asyncio.Lock()
+        async with self._log_lock:
+            await asyncio.get_event_loop().run_in_executor(
+                None, self._log_apply_durably, reqs
+            )
 
     async def _apply_logged(self, req: StorageApply) -> StorageApplyReply:
         cond = self._cond_lazy()

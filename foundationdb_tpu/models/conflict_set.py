@@ -129,6 +129,15 @@ class TpuConflictSet:
     def __init__(self, config: KernelConfig, base_version: int = 0):
         self.config = config
         self.base_version = base_version
+        # Guard the production path against the known large-m flattened
+        # gather miscompile class before the first decision is served
+        # (ADVICE r3). Once per (platform, m) per process; XLA:CPU never
+        # exhibited the bug and the sim/test lanes run there, so the
+        # check is accelerator-only.
+        from foundationdb_tpu.ops import rangemax as _rm
+
+        if jax.default_backend() != "cpu":
+            _rm.flat_gather_selftest(config.history_capacity)
         self.state = H.init(config)
         self._batches_since_check = 0
         self._resolve = _RESOLVE

@@ -27,21 +27,26 @@ sums over the sorted order:
   - the merge of committed writes into history is a carry scan + dedup
     over the SAME sorted order — the mega-sort IS the merge sort.
 
-Cross-batch semantics (the part a naive fused scan got for free): a
-read in batch i conflicts with batch j<i's committed writes only if
-version_j > read_snapshot — snapshots may land between group commit
-versions, so visibility is per-(read, writer-batch). Each fixpoint
-iteration computes per-batch committed-write coverage (parity-delta
-lane cumsum over the block space), packs it into per-block G-bit masks,
-builds a range-OR doubling table, and tests each read's mask window
-[first-visible-batch, own-batch) — exact version semantics, one table.
+Cross-batch semantics: a read in batch i conflicts with batch j<i's
+committed writes only if version_j > read_snapshot — snapshots may land
+between group commit versions, so visibility is per-(read,
+writer-batch). The kernel resolves batches IN ORDER inside one trace
+(a lax.scan whose carry is `seg_ver`, the running piecewise map of the
+group's committed-write versions over the sorted block space): batch
+i's reads first range-max `seg_ver` against their snapshot — exactly
+the writes of earlier batches whose version exceeds the snapshot, i.e.
+what sequential resolution would find in history — then run the
+alternating fixpoint against their OWN batch's writers only. After the
+verdicts, the batch's committed writes fold into `seg_ver` via a
+parity-delta cumsum. Chains therefore stay within one batch (2-3
+fixpoint iterations); cross-batch ordering is exact by construction.
 
 The alternating fixpoint recurrence (see ops/conflict.py's original
-derivation) is unchanged, just over global txn ids: committed[t] =
-ok[t] and no visible committed earlier writer intersects t's reads.
-F is antitone, the dependency order is a DAG by (batch, txn index), so
-iteration from the all-ok start converges to the unique sequential
-answer in (max conflict-chain length + 1) rounds.
+derivation) is unchanged, per batch: committed[t] = ok[t] and no
+committed earlier writer in the same batch intersects t's reads. F is
+antitone and the dependency order is a DAG by txn index, so iteration
+from the all-ok start converges to the unique sequential answer in
+(max conflict-chain length + 1) rounds.
 
 Decisions are bit-identical to resolving the G batches sequentially
 (tests/test_group_parity.py drives both paths plus the Python oracle).
@@ -67,7 +72,12 @@ CONFLICT = 0
 TOO_OLD = 1
 COMMITTED = 3
 
-MAX_GROUP = 16  # visibility masks ride int32 bit positions
+# G's ceiling is compile cost, not correctness: the batch index rides
+# `bits_b` bits of the packed sort key (stealing them from the length
+# word) and the scan body compiles once for any G, but the skeleton's
+# r_rows = M + 2G(NR+NW) arrays make XLA compile time grow with G
+# (G=16 at bench shapes exceeded 35 minutes on this host).
+MAX_GROUP = 16
 
 
 class GroupVerdict(NamedTuple):

@@ -24,9 +24,6 @@ def _num_levels(m: int) -> int:
 _OPS = {
     "max": (jnp.maximum, INT32_NEG),
     "min": (jnp.minimum, INT32_POS),
-    # bitwise union over a range — used for the group kernel's per-batch
-    # coverage bitmasks (ops/group.py cross-batch visibility)
-    "or": (jnp.bitwise_or, 0),
 }
 
 
@@ -99,3 +96,47 @@ def query(table: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray, *, op: str = "ma
     va = flat[k * m + a]
     vb = flat[k * m + b]
     return jnp.where(hic > loc, fn(va, vb), ident)
+
+
+_SELFTEST_OK: set = set()
+
+
+def flat_gather_selftest(m: int, *, queries: int = 8192, sample: int = 256,
+                         force: bool = False) -> None:
+    """Run the large-m flattened-gather miscompile check on the current
+    default device, once per (platform, m) per process.
+
+    An older XLA:TPU was seen miscompiling the flattened data-dependent
+    gather in query() at large m (the gather landed on the wrong level
+    => silently wrong conflict decisions). TpuConflictSet calls this at
+    init (ADVICE r3 medium) so the production resolver path refuses to
+    start on an affected libtpu; bench.py runs it too. XLA:CPU never
+    exhibited the bug — callers gate on the backend.
+
+    Raises RuntimeError on mismatch.
+    """
+    import numpy as np
+
+    key = (jax.default_backend(), int(m))
+    if key in _SELFTEST_OK and not force:
+        return
+    rng = np.random.default_rng(0xC0FFEE)
+    vals = rng.integers(0, 2**30, size=m).astype(np.int32)
+    qlo = rng.integers(0, max(m - 1, 1), size=queries).astype(np.int32)
+    qlen = rng.integers(1, max(m // 2, 2), size=queries).astype(np.int32)
+    qhi = np.minimum(qlo + qlen, m).astype(np.int32)
+    tab = jax.jit(lambda v: build(v, op="max"))(vals)
+    got = np.asarray(
+        jax.jit(lambda t, lo, hi: query(t, lo, hi, op="max"))(tab, qlo, qhi)
+    )
+    idx = rng.integers(0, queries, size=sample)
+    for i in idx:
+        want = int(vals[qlo[i]:qhi[i]].max())
+        if got[i] != want:
+            raise RuntimeError(
+                f"rangemax flat-gather MISCOMPILE at m={m}: query "
+                f"[{qlo[i]},{qhi[i]}) got {got[i]} want {want} — "
+                "this libtpu/XLA miscompiles large flattened gathers; "
+                "refusing to serve conflict decisions"
+            )
+    _SELFTEST_OK.add(key)
