@@ -126,12 +126,13 @@ def main():
         return blob, off, txn[:n].astype(np.int32)
 
     flats = [(flat(b, "r"), flat(b, "w")) for b in batches]
-    cpu_rates = {}
-    cpu_verdicts = []
-    for name, cls in (("map", NativeConflictSet),
-                      ("skiplist", NativeSkipListConflictSet)):
+
+    def cpu_pass(cls, collect_verdicts=False):
+        """One full stream through a fresh CPU conflict set; returns the
+        steady-state rate (and optionally the first batches' verdicts)."""
         cpu = cls(window=window)
         cpu_times = []
+        verdicts = []
         for i, b in enumerate(batches):
             (rkeys, roff, rtxn), (wkeys, woff, wtxn) = flats[i]
             snaps = b.snapshot[:n_txns].astype(np.int64)
@@ -140,20 +141,20 @@ def main():
                 int(b.version), snaps, rkeys, roff, rtxn, wkeys, woff, wtxn
             )
             cpu_times.append(time.perf_counter() - t0)
-            if i < cpu_batches:
-                if name == "map":
-                    cpu_verdicts.append(v)
-                else:
-                    # the two baselines must agree before either is a baseline
-                    assert (v == cpu_verdicts[i]).all(), \
-                        f"cpu baseline disagreement at batch {i}"
+            if collect_verdicts and i < cpu_batches:
+                verdicts.append(v)
         # steady-state rate: skip the warm-up batches before the window fills
         steady = cpu_times[len(cpu_times) // 2 :]
-        cpu_rates[name] = n_txns * len(steady) / sum(steady)
-        log(f"cpu baseline [{name}]: {cpu_rates[name]:,.0f} txn/s steady "
-            f"(per-batch {[f'{t*1e3:.0f}ms' for t in cpu_times]})")
-    cpu_name, cpu_rate = max(cpu_rates.items(), key=lambda kv: kv[1])
-    log(f"baseline of record: {cpu_name} at {cpu_rate:,.0f} txn/s")
+        return n_txns * len(steady) / sum(steady), verdicts
+
+    # one verdict-collecting pass per impl up front: the two baselines
+    # must agree before either is a baseline (timing comes later,
+    # interleaved with the device passes — see the measurement phase)
+    _, cpu_verdicts = cpu_pass(NativeConflictSet, collect_verdicts=True)
+    _, sk_verdicts = cpu_pass(NativeSkipListConflictSet, collect_verdicts=True)
+    for i in range(cpu_batches):
+        assert (cpu_verdicts[i] == sk_verdicts[i]).all(), \
+            f"cpu baseline disagreement at batch {i}"
 
     # ---- phase 1.5: rangemax flat-gather selftest on THIS device --------
     # The doubling-table query uses a flattened data-dependent gather; an
@@ -214,23 +215,57 @@ def main():
     for dg in {g["version"].shape[0]: g for g in dev_groups}.values():
         warm.resolve_group_args(dg)
     jax.block_until_ready(warm.state)
-    cs2 = TpuConflictSet(config)
-    outs = []
-    t0 = time.perf_counter()
-    for dg in dev_groups:
-        outs.append(cs2.resolve_group_args(dg))  # async dispatch; chains
-    np.asarray(outs[-1].verdict)  # honest fence: device->host transfer
-    total = time.perf_counter() - t0
-    dev_rate = n_txns * n_batches / total
-    cs2.check_overflow()
-    # decision parity of the fused path against the CPU verdicts
-    for i in range(cpu_batches):
-        dv = np.asarray(outs[i // fuse].verdict[i % fuse])[:n_txns]
-        assert (dv == cpu_verdicts[i]).all(), \
-            f"fused-path decision mismatch at batch {i}"
+
+    def device_pass(check_parity=False):
+        cs2 = TpuConflictSet(config)
+        outs = []
+        t0 = time.perf_counter()
+        for dg in dev_groups:
+            outs.append(cs2.resolve_group_args(dg))  # async dispatch; chains
+        np.asarray(outs[-1].verdict)  # honest fence: device->host transfer
+        total = time.perf_counter() - t0
+        cs2.check_overflow()
+        if check_parity:
+            # decision parity of the fused path against the CPU verdicts
+            for i in range(cpu_batches):
+                dv = np.asarray(outs[i // fuse].verdict[i % fuse])[:n_txns]
+                assert (dv == cpu_verdicts[i]).all(), \
+                    f"fused-path decision mismatch at batch {i}"
+        return n_txns * n_batches / total
+
+    device_pass(check_parity=True)  # warm + parity, untimed
+
+    # INTERLEAVED median-of-N measurement (VERDICT r3 weak #4): the
+    # shared-host CPU baseline swings >2x run-to-run, so a single draw of
+    # each side makes the graded ratio a dice roll. Alternating
+    # cpu/device passes sample the same noise environment; medians of
+    # each side are the numbers of record and the spreads ship in the
+    # JSON. (Core pinning is moot here: the host has ONE core.)
+    reps = max(1, int(os.environ.get("BENCH_REPS", 5)))
+    cpu_samples = {"map": [], "skiplist": []}
+    dev_samples = []
+    for rep in range(reps):
+        cpu_samples["map"].append(cpu_pass(NativeConflictSet)[0])
+        dev_samples.append(device_pass())
+        cpu_samples["skiplist"].append(
+            cpu_pass(NativeSkipListConflictSet)[0]
+        )
+        log(f"rep {rep}: cpu map {cpu_samples['map'][-1]:,.0f} | "
+            f"skiplist {cpu_samples['skiplist'][-1]:,.0f} | "
+            f"device {dev_samples[-1]:,.0f} txn/s")
+
+    med = lambda xs: sorted(xs)[len(xs) // 2]
+    cpu_medians = {k: med(v) for k, v in cpu_samples.items()}
+    cpu_name, cpu_rate = max(cpu_medians.items(), key=lambda kv: kv[1])
+    dev_rate = med(dev_samples)
+    log(f"baseline of record: {cpu_name} median {cpu_rate:,.0f} txn/s "
+        f"(spread {min(cpu_samples[cpu_name]):,.0f}-"
+        f"{max(cpu_samples[cpu_name]):,.0f}); device median "
+        f"{dev_rate:,.0f} (spread {min(dev_samples):,.0f}-"
+        f"{max(dev_samples):,.0f})")
 
     # ---- phase 4: per-batch latency probe -------------------------------
-    del dev_groups, outs  # release phase-3 staging before re-staging
+    del dev_groups  # release phase-3 staging before re-staging
     dev_batches = [jax.device_put(b.device_args()) for b in batches]
     jax.block_until_ready(dev_batches)
     cs3 = TpuConflictSet(config)
@@ -273,6 +308,15 @@ def main():
                 "vs_baseline": round(dev_rate / cpu_rate, 3),
                 "baseline": cpu_name,
                 "baseline_txns_per_sec": round(cpu_rate, 1),
+                "reps": reps,
+                "baseline_spread": [
+                    round(min(cpu_samples[cpu_name]), 1),
+                    round(max(cpu_samples[cpu_name]), 1),
+                ],
+                "device_spread": [
+                    round(min(dev_samples), 1),
+                    round(max(dev_samples), 1),
+                ],
                 "staging": "device",
                 "fused_dispatch": fuse,
                 "p50_ms": round(p50 * 1e3, 1),

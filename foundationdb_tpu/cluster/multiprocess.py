@@ -397,8 +397,12 @@ class StorageRole:
     #: checkpoint every N applied versions when persistent
     CHECKPOINT_INTERVAL = 8
 
-    def __init__(self, data_dir: str | None = None):
-        # key -> list[(version, value|None)] ascending
+    #: memtable budget before the LSM engine flushes (bytes)
+    LSM_FLUSH_BYTES = 4 << 20
+
+    def __init__(self, data_dir: str | None = None, engine: str = "memory",
+                 window: int = 5_000_000):
+        # key -> list[(version, value|None)] ascending  (memory engine)
         self.history: dict[bytes, list[tuple[int, Optional[bytes]]]] = {}
         # the empty store is readable at version 0 (a GRV before any commit
         # must not block behind the first apply)
@@ -422,13 +426,29 @@ class StorageRole:
         # would skip the lower version (ADVICE r3).
         self._log_lock: asyncio.Lock | None = None
         self.replayed_on_restart = 0
+        # Persistent engine selection (the reference's storage-engine
+        # knob, fdbserver/worker.actor.cpp openKVStore): "memory" =
+        # KeyValueStoreMemory-class (RAM dict + WAL + checkpoint blob);
+        # "lsm" = the native versioned LSM (native/vlsm.cpp — data >
+        # RAM, restart ∝ WAL tail, at-version reads off disk runs).
+        self.engine = engine
+        self._lsm = None
+        self.window = window
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
             from foundationdb_tpu import native
 
             self._dq = native.DiskQueue(os.path.join(data_dir, "mutlog"))
-            self._load_checkpoint()
+            if engine == "lsm":
+                self._lsm = native.VersionedLsm(
+                    os.path.join(data_dir, "kvstore"), window=window
+                )
+                self.version = self._lsm.durable_version
+            else:
+                self._load_checkpoint()
             self._replay_local_log()
+        elif engine == "lsm":
+            raise ValueError("engine='lsm' requires a data_dir")
 
     # -- durable-version checkpointing (storageserver durableVersion
     # discipline: persist at a version, replay the tlog tail on restart) --
@@ -520,6 +540,11 @@ class StorageRole:
             self._seq_by_version = kept
 
     def _apply_mutations(self, version: int, mutations) -> None:
+        if self._lsm is not None:
+            self._lsm.apply(
+                version, [(m.op, m.param1, m.param2) for m in mutations]
+            )
+            return
         for m in mutations:
             if m.op == self.MUT_SET:
                 self.history.setdefault(m.param1, []).append(
@@ -594,7 +619,27 @@ class StorageRole:
             if req.version > self.version:
                 self._apply_mutations(req.version, req.mutations)
                 self.version = req.version
-                if self._data_dir:
+                if self._data_dir and self._lsm is not None:
+                    self._applies_since_ckpt += 1
+                    if (
+                        self._applies_since_ckpt >= self.CHECKPOINT_INTERVAL
+                        or self._lsm.mem_bytes > self.LSM_FLUSH_BYTES
+                    ):
+                        self._applies_since_ckpt = 0
+                        # LSM checkpoint: flush the memtable to a durable
+                        # run (fsync off the loop), advance the MVCC GC
+                        # floor, pop the WAL prefix the run now covers
+                        lsm = self._lsm
+
+                        def lsm_flush():
+                            durable = lsm.flush()
+                            lsm.set_floor(durable - self.window)
+                            self._compact_log(durable)
+
+                        await asyncio.get_event_loop().run_in_executor(
+                            None, lsm_flush
+                        )
+                elif self._data_dir:
                     self._applies_since_ckpt += 1
                     if self._applies_since_ckpt >= self.CHECKPOINT_INTERVAL:
                         self._applies_since_ckpt = 0
@@ -621,6 +666,13 @@ class StorageRole:
         cond = self._cond_lazy()
         async with cond:
             await cond.wait_for(lambda: self.version >= req.version)
+        if self._lsm is not None:
+            # disk preads off the event loop: a cold read must not stall
+            # unrelated requests
+            value = await asyncio.get_event_loop().run_in_executor(
+                None, self._lsm.get, req.key, req.version
+            )
+            return StorageGetReply(value=value)
         hist = self.history.get(req.key, [])
         value = None
         for v, val in hist:
@@ -634,6 +686,11 @@ class StorageRole:
         cond = self._cond_lazy()
         async with cond:
             await cond.wait_for(lambda: self.version >= req.version)
+        if self._lsm is not None:
+            kvs = await asyncio.get_event_loop().run_in_executor(
+                None, self._lsm.range, b"", b"", req.version
+            )
+            return StorageSnapshotReply(version=self.version, kvs=kvs)
         kvs = []
         for k, hist in sorted(self.history.items()):
             value = None
@@ -651,6 +708,7 @@ async def _serve_role(
     backend: str,
     data_dir: str | None = None,
     tlog_address: str | None = None,
+    storage_engine: str = "memory",
 ) -> None:
     server = transport.RpcServer(address)
 
@@ -673,7 +731,7 @@ async def _serve_role(
         server.register(TOKEN_TLOG_PEEK_BATCH, role.peek_batch)
         server.register(TOKEN_TLOG_VERSION, role.get_version)
     elif role_name == "storage":
-        role = StorageRole(data_dir=data_dir)
+        role = StorageRole(data_dir=data_dir, engine=storage_engine)
         if tlog_address:
             await role.catch_up_from_tlog(tlog_address)
         server.register(TOKEN_STORAGE_APPLY, role.apply)
@@ -714,6 +772,7 @@ def spawn_role(
     index: int = 0,
     data_dir: str | None = None,
     tlog_address: str | None = None,
+    storage_engine: str = "memory",
 ) -> RoleProcess:
     """Start one role as a child OS process serving a UDS in socket_dir.
 
@@ -747,6 +806,8 @@ def spawn_role(
         cmd += ["--data-dir", data_dir]
     if tlog_address:
         cmd += ["--tlog-address", tlog_address]
+    if storage_engine != "memory":
+        cmd += ["--storage-engine", storage_engine]
     proc = subprocess.Popen(cmd, env=env)
     return RoleProcess(name=name, address=address, proc=proc)
 
@@ -919,6 +980,8 @@ def main() -> None:
     ap.add_argument("--backend", default="native")
     ap.add_argument("--data-dir", default=None)
     ap.add_argument("--tlog-address", default=None)
+    ap.add_argument("--storage-engine", default="memory",
+                    choices=("memory", "lsm"))
     args = ap.parse_args()
     asyncio.run(
         _serve_role(
@@ -927,6 +990,7 @@ def main() -> None:
             args.backend,
             data_dir=args.data_dir,
             tlog_address=args.tlog_address,
+            storage_engine=args.storage_engine,
         )
     )
 

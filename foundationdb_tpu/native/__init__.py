@@ -323,3 +323,198 @@ class DiskQueue:
                                        ctypes.byref(seq))
             out.append((seq.value, buf.raw[:ln]))
         return out
+
+
+# ---------------------------------------------------------------------------
+# VersionedLsm (vlsm.cpp): the persistent storage engine behind StorageRole
+# (role of the reference's Redwood/sqlite engines — data > RAM, restart
+# cost proportional to the WAL tail, MVCC at-version reads).
+
+_VLSM_SRC = os.path.join(_DIR, "vlsm.cpp")
+_vlsm_lib = None
+
+
+def load_vlsm() -> ctypes.CDLL:
+    global _vlsm_lib
+    with _lock:
+        if _vlsm_lib is not None:
+            return _vlsm_lib
+        lib = ctypes.CDLL(build_shared(_VLSM_SRC, "libvlsm"))
+        lib.vlsm_open.restype = ctypes.c_void_p
+        lib.vlsm_open.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
+        lib.vlsm_ok.argtypes = [ctypes.c_void_p]
+        lib.vlsm_close.argtypes = [ctypes.c_void_p]
+        for name in ("vlsm_durable_version", "vlsm_applied_version",
+                     "vlsm_mem_bytes", "vlsm_floor"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_longlong
+            fn.argtypes = [ctypes.c_void_p]
+        lib.vlsm_num_runs.argtypes = [ctypes.c_void_p]
+        lib.vlsm_last_error.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        lib.vlsm_apply.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_char_p,
+            ctypes.c_longlong]
+        lib.vlsm_get.restype = ctypes.c_longlong
+        lib.vlsm_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_longlong, ctypes.c_void_p, ctypes.c_longlong]
+        lib.vlsm_flush.restype = ctypes.c_longlong
+        lib.vlsm_flush.argtypes = [ctypes.c_void_p]
+        lib.vlsm_compact.argtypes = [ctypes.c_void_p]
+        lib.vlsm_set_floor.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+        lib.vlsm_range.restype = ctypes.c_longlong
+        lib.vlsm_range.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_longlong,
+            ctypes.c_longlong, ctypes.c_void_p, ctypes.c_longlong,
+            ctypes.POINTER(ctypes.c_longlong)]
+        _vlsm_lib = lib
+        return lib
+
+
+class VlsmError(RuntimeError):
+    pass
+
+
+class VersionedLsm:
+    """Versioned LSM storage engine (vlsm.cpp).
+
+    apply() buffers into the memtable (NOT durable by itself — pair it
+    with a write-ahead log, as StorageRole does); flush() makes every
+    applied version durable and returns the durable version; reads are
+    at-version within the MVCC window above the GC floor.
+    """
+
+    MUT_SET = 0
+    MUT_CLEAR_RANGE = 1
+
+    def __init__(self, directory: str, window: int = 5_000_000):
+        self._lib = load_vlsm()
+        # vlsm.cpp does NO locking, and ctypes calls release the GIL:
+        # this lock serializes every native call so the role may run
+        # reads in executor threads while applies stay on the event loop
+        self._tl = threading.Lock()
+        self._h = self._lib.vlsm_open(
+            directory.encode(), ctypes.c_longlong(window))
+        if not self._lib.vlsm_ok(self._h):
+            raise VlsmError(f"vlsm open failed: {self._error()}")
+
+    def _error(self) -> str:
+        buf = ctypes.create_string_buffer(1024)
+        self._lib.vlsm_last_error(self._h, buf, 1024)
+        return buf.value.decode(errors="replace")
+
+    def close(self) -> None:
+        with self._tl:
+            if self._h:
+                self._lib.vlsm_close(self._h)
+                self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- writes ----------------------------------------------------------
+
+    def apply(self, version: int, mutations) -> None:
+        """mutations: [(op, key, value_or_end)] with op in
+        {MUT_SET, MUT_CLEAR_RANGE}."""
+        blob = bytearray(len(mutations).to_bytes(4, "little"))
+        for op, key, second in mutations:
+            blob.append(op)
+            blob += len(key).to_bytes(4, "little")
+            blob += key
+            blob += len(second).to_bytes(4, "little")
+            blob += second
+        b = bytes(blob)
+        with self._tl:
+            rc = self._lib.vlsm_apply(
+                self._h, ctypes.c_longlong(version), b, len(b)
+            )
+        if rc != 0:
+            raise VlsmError("malformed mutation blob")
+
+    def flush(self) -> int:
+        """Flush the memtable into a durable run; returns the durable
+        version (auto-compacts when the run count passes the trigger)."""
+        with self._tl:
+            v = self._lib.vlsm_flush(self._h)
+        if v < 0:
+            raise VlsmError(f"flush failed: {self._error()}")
+        return v
+
+    def compact(self) -> None:
+        with self._tl:
+            rc = self._lib.vlsm_compact(self._h)
+        if rc != 0:
+            raise VlsmError(f"compact failed: {self._error()}")
+
+    def set_floor(self, floor: int) -> None:
+        with self._tl:
+            self._lib.vlsm_set_floor(self._h, ctypes.c_longlong(floor))
+
+    # -- reads -----------------------------------------------------------
+
+    def get(self, key: bytes, version: int) -> bytes | None:
+        cap = 4096
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            with self._tl:
+                n = self._lib.vlsm_get(
+                    self._h, key, len(key), ctypes.c_longlong(version),
+                    buf, cap)
+            if n == -1:
+                return None
+            if n < -1:
+                cap = -(n + 2) + 1
+                continue
+            return buf.raw[:n]
+
+    def range(
+        self, begin: bytes, end: bytes, version: int,
+        max_items: int = 1 << 62,
+    ) -> list[tuple[bytes, bytes]]:
+        """Merged scan of [begin, end) at `version`; end=b"" scans to
+        the last key."""
+        cap = 1 << 20
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            nbytes = ctypes.c_longlong()
+            with self._tl:
+                n = self._lib.vlsm_range(
+                    self._h, begin, len(begin), end, len(end),
+                    ctypes.c_longlong(version), ctypes.c_longlong(max_items),
+                    buf, cap, ctypes.byref(nbytes))
+            if n == -1:
+                cap = nbytes.value + 1
+                continue
+            out = []
+            raw = memoryview(buf.raw)
+            p = 0
+            for _ in range(n):
+                kl = int.from_bytes(raw[p:p + 4], "little"); p += 4
+                k = bytes(raw[p:p + kl]); p += kl
+                vl = int.from_bytes(raw[p:p + 4], "little"); p += 4
+                v = bytes(raw[p:p + vl]); p += vl
+                out.append((k, v))
+            return out
+
+    # -- introspection ---------------------------------------------------
+
+    @property
+    def durable_version(self) -> int:
+        with self._tl:
+            return self._lib.vlsm_durable_version(self._h)
+
+    @property
+    def mem_bytes(self) -> int:
+        with self._tl:
+            return self._lib.vlsm_mem_bytes(self._h)
+
+    @property
+    def num_runs(self) -> int:
+        with self._tl:
+            return self._lib.vlsm_num_runs(self._h)

@@ -1,0 +1,206 @@
+"""VersionedLsm engine tests: versioned reads, durability, compaction,
+GC floor, restart cost, and bounded memory with data >> memtable.
+
+The engine is the StorageRole's persistent store (native/vlsm.cpp) —
+the build's answer to the reference's on-disk engines
+(fdbserver/VersionedBTree.actor.cpp Redwood / KeyValueStoreSQLite):
+data > RAM via sorted runs + pread, restart ∝ WAL tail, at-version MVCC
+reads with floor GC.
+"""
+
+import os
+
+import pytest
+
+from foundationdb_tpu.native import VersionedLsm
+
+S = VersionedLsm.MUT_SET
+C = VersionedLsm.MUT_CLEAR_RANGE
+
+
+def test_versioned_point_reads(tmp_path):
+    db = VersionedLsm(str(tmp_path / "db"))
+    db.apply(10, [(S, b"a", b"v10")])
+    db.apply(20, [(S, b"a", b"v20"), (S, b"b", b"bee")])
+    assert db.get(b"a", 9) is None
+    assert db.get(b"a", 10) == b"v10"
+    assert db.get(b"a", 19) == b"v10"
+    assert db.get(b"a", 20) == b"v20"
+    assert db.get(b"b", 15) is None
+    assert db.get(b"b", 25) == b"bee"
+    # same answers after a flush (run-resident)
+    db.flush()
+    assert db.get(b"a", 19) == b"v10"
+    assert db.get(b"a", 20) == b"v20"
+
+
+def test_clear_range_versions(tmp_path):
+    db = VersionedLsm(str(tmp_path / "db"))
+    db.apply(10, [(S, b"k1", b"a"), (S, b"k2", b"b"), (S, b"k3", b"c")])
+    db.apply(20, [(C, b"k1", b"k3")])  # clears k1, k2; k3 survives
+    db.apply(30, [(S, b"k2", b"reborn")])
+    for probe in (lambda: None, db.flush):
+        probe()
+        assert db.get(b"k1", 15) == b"a"
+        assert db.get(b"k1", 25) is None
+        assert db.get(b"k2", 25) is None
+        assert db.get(b"k2", 30) == b"reborn"
+        assert db.get(b"k3", 25) == b"c"
+
+
+def test_within_version_order(tmp_path):
+    """Mutation order inside one version is authoritative: set after
+    clear survives, clear after set kills."""
+    db = VersionedLsm(str(tmp_path / "db"))
+    db.apply(5, [(S, b"x", b"old"), (S, b"y", b"old")])
+    db.apply(10, [(C, b"a", b"z"), (S, b"x", b"new")])
+    assert db.get(b"x", 10) == b"new"
+    assert db.get(b"y", 10) is None
+    db.flush()
+    assert db.get(b"x", 10) == b"new"
+    assert db.get(b"y", 10) is None
+
+
+def test_within_version_set_then_clear(tmp_path):
+    """The mirror case: a clear AFTER a set at the same version kills
+    the key (the memory engine's apply-order semantics — code-review r4
+    found the original tie-break inverted this)."""
+    db = VersionedLsm(str(tmp_path / "db"))
+    db.apply(10, [(S, b"k", b"val"), (C, b"a", b"z")])
+    assert db.get(b"k", 10) is None
+    db.flush()
+    assert db.get(b"k", 10) is None
+    # and after compaction with the floor above it, the key is gone
+    db.set_floor(20)
+    db.compact()
+    assert db.get(b"k", 20) is None
+    assert db.range(b"", b"", 20) == []
+
+
+def test_key_versions_straddle_index_boundary(tmp_path):
+    """Older versions of a key sitting at the tail of the previous
+    sparse-index block must still be found (code-review r4: seek_block
+    landed ON the equal index key and skipped them)."""
+    db = VersionedLsm(str(tmp_path / "db"))
+    muts = [(S, b"fill%04d" % i, b"x") for i in range(15)]
+    db.apply(100, muts + [(S, b"kk", b"v0")])
+    for i in range(1, 6):
+        db.apply(100 + i, [(S, b"kk", b"v%d" % i)])
+    db.flush()
+    for i in range(6):
+        assert db.get(b"kk", 100 + i) == b"v%d" % i, i
+
+
+def test_restart_recovers_runs_not_memtable(tmp_path):
+    d = str(tmp_path / "db")
+    db = VersionedLsm(d)
+    db.apply(10, [(S, b"durable", b"yes")])
+    assert db.flush() == 10
+    db.apply(20, [(S, b"volatile", b"lost")])  # never flushed
+    db.close()
+
+    db2 = VersionedLsm(d)
+    assert db2.durable_version == 10
+    assert db2.get(b"durable", 10) == b"yes"
+    # the memtable died with the process — the caller's WAL replays it
+    assert db2.get(b"volatile", 20) is None
+
+
+def test_range_scan_merges_sources(tmp_path):
+    db = VersionedLsm(str(tmp_path / "db"))
+    db.apply(10, [(S, b"a", b"1"), (S, b"c", b"3")])
+    db.flush()
+    db.apply(20, [(S, b"b", b"2"), (C, b"c", b"d")])
+    # at v=10: a, c; at v=20: a, b (c cleared)
+    assert db.range(b"", b"\xff", 10) == [(b"a", b"1"), (b"c", b"3")]
+    assert db.range(b"", b"\xff", 20) == [(b"a", b"1"), (b"b", b"2")]
+    db.flush()
+    assert db.range(b"a", b"c", 20) == [(b"a", b"1"), (b"b", b"2")]
+    assert db.range(b"b", b"\xff", 10) == [(b"c", b"3")]
+
+
+def test_floor_gc_compacts_but_keeps_window(tmp_path):
+    db = VersionedLsm(str(tmp_path / "db"))
+    for v in range(1, 11):
+        db.apply(v, [(S, b"k", b"v%d" % v)])
+        db.flush()
+    db.set_floor(5)
+    db.compact()
+    assert db.num_runs == 1
+    # at the floor: collapsed to the floor winner. (Below the floor is
+    # out of contract — the role raises transaction_too_old there, the
+    # reference's VersionedMap::forgetVersionsBefore discipline.)
+    assert db.get(b"k", 5) == b"v5"
+    # above the floor: exact
+    for v in range(5, 11):
+        assert db.get(b"k", v) == b"v%d" % v
+
+
+def test_floor_gc_drops_cleared_keys(tmp_path):
+    db = VersionedLsm(str(tmp_path / "db"))
+    db.apply(1, [(S, b"dead", b"x"), (S, b"live", b"y")])
+    db.apply(2, [(C, b"dead", b"dead\x00")])
+    db.flush()
+    db.set_floor(10)
+    db.compact()
+    assert db.get(b"dead", 10) is None
+    assert db.get(b"live", 10) == b"y"
+    # the dead key is physically gone, not just shadowed
+    assert db.range(b"", b"\xff", 10) == [(b"live", b"y")]
+
+
+def test_data_larger_than_memtable_budget(tmp_path):
+    """Stream 20k keys through a tiny flush budget: memtable stays
+    bounded, reads come off disk runs, compaction keeps the run count
+    flat, and a reopen sees everything durable."""
+    d = str(tmp_path / "db")
+    db = VersionedLsm(d)
+    budget = 64 * 1024
+    n, version = 20_000, 0
+    for i in range(0, n, 500):
+        version += 1
+        db.apply(version, [
+            (S, b"key%08d" % j, b"val%08d" % j) for j in range(i, i + 500)
+        ])
+        if db.mem_bytes > budget:
+            db.flush()
+    db.flush()
+    assert db.mem_bytes == 0
+    assert db.num_runs <= 9  # compaction trigger keeps the tier flat
+    for j in (0, 1, 499, 500, 12345, n - 1):
+        assert db.get(b"key%08d" % j, version) == b"val%08d" % j
+    db.close()
+
+    db2 = VersionedLsm(d)
+    assert db2.durable_version == version
+    for j in (0, 777, n - 1):
+        assert db2.get(b"key%08d" % j, version) == b"val%08d" % j
+    assert len(db2.range(b"", b"\xff", version)) == n
+
+
+def test_orphan_run_swept_on_open(tmp_path):
+    d = str(tmp_path / "db")
+    db = VersionedLsm(d)
+    db.apply(1, [(S, b"a", b"1")])
+    db.flush()
+    db.close()
+    # simulate a crash between run fsync and manifest rename
+    orphan = os.path.join(d, "999999.sst")
+    with open(orphan, "wb") as f:
+        f.write(b"garbage that is not a run")
+    db2 = VersionedLsm(d)
+    assert not os.path.exists(orphan)
+    assert db2.get(b"a", 1) == b"1"
+
+
+def test_many_reopens_idempotent(tmp_path):
+    d = str(tmp_path / "db")
+    for cycle in range(5):
+        db = VersionedLsm(d)
+        v = cycle + 1
+        db.apply(v, [(S, b"cycle", b"%d" % cycle)])
+        db.flush()
+        db.close()
+    db = VersionedLsm(d)
+    assert db.get(b"cycle", 10) == b"4"
+    assert db.durable_version == 5
