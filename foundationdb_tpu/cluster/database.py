@@ -39,6 +39,12 @@ class ClusterConfig:
     replication_factor: int = 1
     # transaction log replicas (LogSystem); 1 = single log
     n_tlogs: int = 1
+    # satellite log replicas (a second failure domain INSIDE the primary
+    # region): commits ack only after satellites durably hold the
+    # mutation stream, so a whole-primary-DC death loses nothing once a
+    # remote region recovers the suffix from them (RPO=0 —
+    # ha-write-path.rst + TagPartitionedLogSystem.actor.cpp)
+    n_satellite_logs: int = 0
     # coordination quorum size (CoordinatedState/LeaderElection); recovery
     # requires a majority of these alive
     n_coordinators: int = 3
@@ -141,7 +147,9 @@ class Cluster:
         ]
         from foundationdb_tpu.cluster.logsystem import LogSystem
 
-        self.tlog = LogSystem(sched, cfg.n_tlogs)
+        self.tlog = LogSystem(
+            sched, cfg.n_tlogs, n_satellites=cfg.n_satellite_logs
+        )
         self.storage_servers = [
             StorageServer(
                 sched, self.tlog, tag=s, window_versions=cfg.window_versions

@@ -25,7 +25,8 @@ class AllLogsDeadError(Exception):
 
 class LogSystem:
     def __init__(self, sched: Scheduler, n_logs: int = 1, *,
-                 recovery_version: int = 0, durable: bool = True):
+                 recovery_version: int = 0, durable: bool = True,
+                 n_satellites: int = 0):
         from foundationdb_tpu.sim.diskqueue import SimDiskQueue
 
         self.sched = sched
@@ -42,6 +43,21 @@ class LogSystem:
             for _ in range(n_logs)
         ]
         self.live = [True] * n_logs
+        # Satellite logs: replicas in a SECOND failure domain of the
+        # primary region that hold only the full mutation stream
+        # (ha-write-path.rst: "satellite transaction logs only store the
+        # log router tags"). Commits ack only after satellites are
+        # durable too, so a whole-primary-DC death leaves the acked
+        # suffix recoverable from them (RPO=0 — the r3 PARITY gap).
+        self.satellites = [
+            TLog(
+                sched,
+                recovery_version=recovery_version,
+                durable=SimDiskQueue() if durable else None,
+            )
+            for _ in range(n_satellites)
+        ]
+        self.satellite_live = [True] * n_satellites
         # The system-level durable version: set once every live replica
         # has acked a push (what proxies/storages chain on).
         self.version = Notified(recovery_version)
@@ -60,6 +76,23 @@ class LogSystem:
         participates in pushes, peeks, or pops)."""
         self.live[i] = False
         self._live_logs()  # raises if that was the last one
+
+    def kill_dc(self) -> None:
+        """Whole-primary-DC death: EVERY main log replica dies at once
+        (no last-replica guard — this is the disaster, not an operation).
+        Satellites live in a different failure domain and survive;
+        subsequent commits/peeks raise AllLogsDeadError until a region
+        failover promotes the remote."""
+        self.live = [False] * len(self.live)
+
+    def _live_satellites(self) -> list[TLog]:
+        return [
+            t for t, alive in zip(self.satellites, self.satellite_live)
+            if alive
+        ]
+
+    def kill_satellite(self, i: int) -> None:
+        self.satellite_live[i] = False
 
     def crash_and_reboot(self, i: int, rng=None) -> None:
         """Power-loss the replica's simulated disk (un-fsynced data may
@@ -90,12 +123,30 @@ class LogSystem:
 
     async def commit(self, req: TLogCommitRequest) -> int:
         logs = self._live_logs()
-        results = await all_of(
-            [
-                self.sched.spawn(t.commit(req)).done
-                for t in logs
+        tasks = [self.sched.spawn(t.commit(req)).done for t in logs]
+        if self.satellites:
+            # Satellite push rides the SAME ack barrier as the main
+            # replicas: the commit is not acked until the stream is
+            # durable in the second failure domain (the HA write path's
+            # RPO=0 contract). Satellites store only the full-stream
+            # tag — per-storage tags never leave the main DC.
+            from foundationdb_tpu.cluster.tlog import LOG_STREAM_TAG
+
+            sat_msgs = {}
+            if LOG_STREAM_TAG in req.messages:
+                sat_msgs[LOG_STREAM_TAG] = req.messages[LOG_STREAM_TAG]
+            sat_req = TLogCommitRequest(
+                prev_version=req.prev_version,
+                version=req.version,
+                messages=sat_msgs,
+                known_committed_version=req.known_committed_version,
+                epoch=req.epoch,
+            )
+            tasks += [
+                self.sched.spawn(t.commit(sat_req)).done
+                for t in self._live_satellites()
             ]
-        )
+        results = await all_of(tasks)
         v = max(results)
         if v > self.version.get():
             self.version.set(v)
@@ -111,21 +162,24 @@ class LogSystem:
     def pop(self, tag: int, up_to_version: int, consumer: str = "storage"):
         for t in self._live_logs():
             t.pop(tag, up_to_version, consumer)
+        for t in self._live_satellites():
+            t.pop(tag, up_to_version, consumer)
 
     def has_log_consumers(self) -> bool:
         return any(t.has_log_consumers() for t in self._live_logs())
 
     def register_consumer(self, name: str) -> None:
-        for t in self.tlogs:
+        for t in self.tlogs + self.satellites:
             t.register_consumer(name)
 
     def unregister_consumer(self, name: str) -> None:
-        for t in self.tlogs:
+        for t in self.tlogs + self.satellites:
             t.unregister_consumer(name)
 
     def lock(self, epoch: int, recovery_version: int = None) -> None:
         self.epoch = max(self.epoch, epoch)
-        for t in self.tlogs:  # dead replicas lock too: no zombie pushes
+        # dead replicas and satellites lock too: no zombie pushes
+        for t in self.tlogs + self.satellites:
             t.lock(epoch, recovery_version)
         if recovery_version is not None and recovery_version > self.version.get():
             self.version.set(recovery_version)

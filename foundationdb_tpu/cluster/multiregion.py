@@ -18,12 +18,14 @@ This skeleton keeps those moving parts and their contracts:
 * `RemoteDC.lag()` reports the version distance primary -> remote (the
   reference's remoteDCIsHealthy / datacenterVersionDifference check,
   fdbserver/ClusterRecovery + Ratekeeper's GetHealthMetrics path).
-* `RemoteDC.failover()` is the DR-promote path: stop routing, let
-  remote storages drain to the remote log's version, and return the
-  takeover version. With a live primary (graceful drain) nothing is
-  lost; after a primary death the remote serves the router watermark —
-  a consistent prefix (the async-replication RPO the reference closes
-  with satellite logs, out of scope for this skeleton).
+* `RemoteDC.failover()` is the DR-promote path: recover the acked
+  suffix from the primary's SATELLITE logs (if configured), stop
+  routing, let remote storages drain, and return the takeover version.
+  With satellites (cluster/logsystem.py: commits ack only after the
+  stream is durable in the second in-region failure domain), a whole
+  primary-DC death loses NOTHING — RPO=0, the reference's HA write
+  path (ha-write-path.rst). Without satellites, a primary death serves
+  the router watermark — a consistent prefix.
 """
 
 from __future__ import annotations
@@ -36,7 +38,8 @@ from foundationdb_tpu.cluster.tlog import LOG_STREAM_TAG, TLogCommitRequest
 from foundationdb_tpu.runtime.flow import ActorCancelled, Scheduler
 from foundationdb_tpu.utils.probes import declare, code_probe
 
-declare("multiregion.failover", "multiregion.router_caught_up")
+declare("multiregion.failover", "multiregion.router_caught_up",
+        "multiregion.satellite_recovery")
 
 
 class LogRouter:
@@ -199,13 +202,43 @@ class RemoteDC:
         code_probe(True, "multiregion.router_caught_up")
 
     async def failover(self) -> int:
-        """Promote the remote region: stop routing, drain storages to
-        the remote log version, lock the remote logs for a new epoch.
-        Returns the takeover version (== every datum at or below it is
-        served; with a graceful drain this equals the primary's last
-        acked version — zero loss; after a primary death it is the
-        router watermark — a consistent prefix)."""
+        """Promote the remote region: recover any acked suffix from the
+        primary's SATELLITE logs, stop routing, drain storages to the
+        remote log version, lock the remote logs for a new epoch.
+
+        Returns the takeover version. With satellites configured
+        (ClusterConfig.n_satellite_logs > 0) this is RPO=0 even after a
+        whole-primary-DC death: commits acked only after satellite
+        durability, and the satellite stream replays here
+        (TagPartitionedLogSystem + ha-write-path.rst). Without
+        satellites, a primary death serves the router watermark — a
+        consistent prefix (async-replication RPO > 0)."""
         code_probe(True, "multiregion.failover")
+        # BEFORE stopping the router: stopping unregisters its consumer
+        # from the primary system (satellites included), which releases
+        # the retained stream we are about to replay.
+        sat = next(
+            (
+                t
+                for t, alive in zip(
+                    self.primary.satellites, self.primary.satellite_live
+                )
+                if alive
+            ),
+            None,
+        )
+        if sat is not None:
+            wm = self.logs.version.get()
+            if sat.version.get() > wm:
+                # the satellite holds acked versions the router never
+                # pulled before the primary died: replay them through
+                # the same re-tagging push (duplicates the router also
+                # managed to push are version-deduped by the remote log)
+                entries, _v = await sat.peek(LOG_STREAM_TAG, wm)
+                for v, msgs in entries:
+                    if v > self.logs.version.get():
+                        await self.router._push_remote(v, msgs)
+                code_probe(True, "multiregion.satellite_recovery")
         self.router.stop()
         takeover = self.logs.version.get()
         # drain: every remote storage applies through the takeover version

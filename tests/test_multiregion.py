@@ -109,3 +109,87 @@ def test_remote_dc_primary_death_serves_watermark_prefix(world):
         if v_committed <= takeover:
             got = _run(sched, remote.read_at(k, takeover))
             assert got == v
+
+
+def test_satellite_logs_rpo_zero_on_primary_dc_death():
+    """The VERDICT r3 gap: with satellite logs, kill the WHOLE primary
+    DC while the router is behind — every acked commit must survive
+    into the promoted remote region (RPO=0, ha-write-path.rst)."""
+    sched, cluster, db = open_cluster(
+        ClusterConfig(n_storage=2, n_tlogs=2, n_satellite_logs=2)
+    )
+    try:
+        remote = RemoteDC(sched, cluster.tlog, n_tlogs=1, n_storage=2,
+                          storage_boundaries=[b"m"])
+        remote.start()
+
+        committed: dict[bytes, tuple[int, bytes]] = {}
+
+        async def workload(n0, n1):
+            for i in range(n0, n1):
+                txn = db.create_transaction()
+                k = b"sat%02d" % (i % 10)
+                v = b"s%d" % i
+                txn.set(k, v)
+                await txn.commit()
+                committed[k] = (txn.committed_version, v)
+
+        _run(sched, workload(0, 10))
+        _run(sched, remote.wait_caught_up())
+
+        # wedge the router (network partition between regions): commits
+        # keep flowing and keep acking — satellites hold the stream the
+        # remote has NOT seen
+        remote.router._task.cancel()
+        remote.router._task = None
+        _run(sched, workload(10, 25))
+        last_acked = max(v for v, _ in committed.values())
+        assert remote.logs.version.get() < last_acked  # genuinely behind
+
+        # the disaster: every main log replica dies at once
+        cluster.tlog.kill_dc()
+
+        takeover = _run(sched, remote.failover())
+        # RPO=0: the takeover covers every acked commit, and each one
+        # reads back correctly from the promoted region
+        assert takeover >= last_acked, (takeover, last_acked)
+        for k, (v_committed, v) in committed.items():
+            got = _run(sched, remote.read_at(k, takeover))
+            assert got == v, f"{k!r}: {got!r} != {v!r}"
+    finally:
+        cluster.stop()
+
+
+def test_satellite_death_does_not_lose_acked_data():
+    """One satellite dying leaves the other carrying the stream: the
+    failover still recovers everything acked."""
+    sched, cluster, db = open_cluster(
+        ClusterConfig(n_storage=1, n_tlogs=1, n_satellite_logs=2)
+    )
+    try:
+        remote = RemoteDC(sched, cluster.tlog, n_tlogs=1, n_storage=1)
+        remote.start()
+        committed = {}
+
+        async def workload(n0, n1):
+            for i in range(n0, n1):
+                txn = db.create_transaction()
+                k = b"sd%02d" % (i % 6)
+                v = b"d%d" % i
+                txn.set(k, v)
+                await txn.commit()
+                committed[k] = (txn.committed_version, v)
+
+        _run(sched, workload(0, 8))
+        cluster.tlog.kill_satellite(0)
+        remote.router._task.cancel()
+        remote.router._task = None
+        _run(sched, workload(8, 16))
+
+        cluster.tlog.kill_dc()
+        takeover = _run(sched, remote.failover())
+        assert takeover >= max(v for v, _ in committed.values())
+        for k, (_vc, v) in committed.items():
+            assert _run(sched, remote.read_at(k, takeover)) == v
+    finally:
+        cluster.stop()

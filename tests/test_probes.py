@@ -204,7 +204,7 @@ def test_every_declared_probe_fires():
             q.push(b"unsynced%d" % i)
         q.crash(np.random.default_rng(s))
     sched4, cluster4, db4 = open_cluster(
-        ClusterConfig(n_storage=2, n_tlogs=2)
+        ClusterConfig(n_storage=2, n_tlogs=2, n_satellite_logs=1)
     )
 
     from foundationdb_tpu.cluster.multiregion import RemoteDC
@@ -219,6 +219,15 @@ def test_every_declared_probe_fires():
             await txn.commit()
         cluster4.crash_reboot_tlog(1, np.random.default_rng(0))
         await remote.wait_caught_up()
+        # wedge the router and commit past it: the failover must pull
+        # the acked suffix back off the satellite log
+        # (multiregion.satellite_recovery)
+        remote.router._task.cancel()
+        remote.router._task = None
+        for i in range(2):
+            txn = db4.create_transaction()
+            txn.set(b"sat%d" % i, b"v")
+            await txn.commit()
         await remote.failover()
         # ratekeeper law: tighten + slow storage
         rk = cluster4.ratekeeper
