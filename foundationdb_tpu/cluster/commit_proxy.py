@@ -548,6 +548,19 @@ class CommitProxy:
             txn_resolver_map.append(tmap)
             range_maps.append(ridx)
 
+        # version-vector path (knob-gated): ship the batch's written
+        # storage tags so resolvers can answer tpcvMap
+        # (ResolverInterface.h:139 writtenTags)
+        from foundationdb_tpu.utils.knobs import SERVER_KNOBS
+
+        written_tags: frozenset = frozenset()
+        if SERVER_KNOBS.ENABLE_VERSION_VECTOR_TLOG_UNICAST:
+            tags: set = set()
+            for tr in txns:
+                for b, e in tr.write_conflict_ranges:
+                    tags.update(self.key_servers.tags_of_range(b, e))
+            written_tags = frozenset(tags)
+
         reqs = [
             ResolveTransactionBatchRequest(
                 prev_version=prev_version,
@@ -556,6 +569,7 @@ class CommitProxy:
                 transactions=per_res_txns[s],
                 txn_state_transactions=per_res_state[s],
                 proxy_id=self.proxy_id,
+                written_tags=written_tags,
             )
             for s in range(n_res)
         ]

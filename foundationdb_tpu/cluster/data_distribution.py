@@ -153,9 +153,16 @@ class DataDistributor:
             self._moving = False
 
     async def _drop_after(self, owner: int, b: bytes, e: bytes, version: int):
-        ss = self.cluster.storage_servers[owner]
-        await ss.version.when_at_least(version)
-        ss.drop_shard(b, e)
+        # Re-resolve the CURRENT server object each wait: a reboot
+        # replaces cluster.storage_servers[owner], and a waiter pinned
+        # to the dead object would never drop — the rebooted server
+        # would then serve the moved range's stale values to clients
+        # with stale location caches (code-review r4).
+        while self.cluster.storage_servers[owner].version.get() < version:
+            # poll, never pin: an unbounded when_at_least on an object
+            # that dies mid-wait would strand this waiter forever
+            await self.sched.delay(0.02)
+        self.cluster.storage_servers[owner].drop_shard(b, e)
 
     async def repair(self, dead: int, replacement: int = None) -> int:
         """Re-replicate every shard that lost `dead` (DDTeamCollection's

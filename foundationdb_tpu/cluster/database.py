@@ -193,8 +193,27 @@ class Cluster:
             for s, ss in enumerate(self.storage_servers)
         ]
         from foundationdb_tpu.cluster.data_distribution import DataDistributor
+        from foundationdb_tpu.cluster.failure_monitor import FailureMonitor
         from foundationdb_tpu.cluster.recovery import ClusterController
 
+        # Address-level failure monitor (fdbrpc/FailureMonitor.actor.cpp):
+        # pings every storage endpoint (through the SimNetwork when one
+        # exists, so partitions look like death from the controller's
+        # vantage) and maintains the shared storage_live view every
+        # consumer reads. Client requests that hit a dead process report
+        # it immediately (the loadBalance fast path).
+        self.failure_monitor = FailureMonitor(sched)
+        for s, ss in enumerate(self.storage_servers):
+            self.failure_monitor.register(
+                f"storage{s}",
+                self._wrapped("cc", f"storage{s}", ss, ["ping"]).ping,
+            )
+
+        def _on_liveness_change(addr: str, failed: bool) -> None:
+            if addr.startswith("storage"):
+                self.storage_live[int(addr[len("storage"):])] = not failed
+
+        self.failure_monitor.on_change(_on_liveness_change)
         self.controller = ClusterController(self)
         self.data_distributor = DataDistributor(self)
         self._started = False
@@ -242,6 +261,13 @@ class Cluster:
         new.restore(old.snapshot())
         self.storage_servers[s] = new
         self.storage_live[s] = True
+        # the replacement process answers pings now; re-point the
+        # monitor's probe at it and clear the failure state
+        self.failure_monitor.register(
+            f"storage{s}",
+            self._wrapped("cc", f"storage{s}", new, ["ping"]).ping,
+        )
+        self.failure_monitor.report_alive(f"storage{s}")
         if self.net is None:
             self.client_storages[s] = new
         else:
@@ -267,9 +293,18 @@ class Cluster:
         self.tlog.crash_and_reboot(i, rng)
 
     def kill_storage(self, s: int) -> None:
-        """Mark a storage server dead (reads fail over to team peers)."""
+        """Kill a storage server with an immediate failure report (the
+        path a client's errored request takes); reads fail over to team
+        peers at once."""
         self.storage_servers[s].stop()
-        self.storage_live[s] = False
+        self.failure_monitor.report_failed(f"storage{s}")
+
+    def kill_storage_silent(self, s: int) -> None:
+        """Kill a storage server WITHOUT telling anyone: only the
+        failure monitor's ping loop (or a client's errored read) can
+        discover it — the detection path the reference exercises with
+        machine kills (fdbrpc/FailureMonitor.actor.cpp)."""
+        self.storage_servers[s].stop()
 
     def _apply_state_mutation(self, m) -> None:
         from foundationdb_tpu.models.types import apply_state_mutation
@@ -309,8 +344,10 @@ class Cluster:
         self.balancer.start()
         self.controller.start()
         self.data_distributor.start()
+        self.failure_monitor.start()
 
     def stop(self) -> None:
+        self.failure_monitor.stop()
         self.data_distributor.stop()
         self.controller.stop()
         self.balancer.stop()

@@ -159,6 +159,8 @@ class GrvProxy:
                     if tag is None or self._tag_tokens[tag] >= 1.0:
                         if tag is not None:
                             self._tag_tokens[tag] -= 1.0
+                            # busyness signal for the auto tag throttler
+                            self.ratekeeper.note_tag_admission(tag)
                         admit.append(p)
                     else:
                         code_probe(True, "ratekeeper.tag_throttled")

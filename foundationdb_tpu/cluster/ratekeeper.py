@@ -19,7 +19,7 @@ from foundationdb_tpu.runtime.flow import ActorCancelled, Scheduler
 from foundationdb_tpu.utils.metrics import CounterCollection
 from foundationdb_tpu.utils.probes import code_probe, declare
 
-declare("ratekeeper.throttled")
+declare("ratekeeper.throttled", "ratekeeper.auto_tag_throttled")
 
 
 class Ratekeeper:
@@ -47,12 +47,20 @@ class Ratekeeper:
         self.min_tps = min_tps
         self.tps_budget = max_tps
         self.counters = CounterCollection("RkMetrics", ["loops", "throttled"])
-        # GlobalTagThrottler (minimal): per-transaction-tag TPS quotas
-        # (fdbserver/GlobalTagThrottler.actor.cpp's enforcement point —
-        # quotas here are set by management rather than derived from
-        # storage busyness). GRV proxies meter tagged requests against
-        # these on top of the global budget.
+        # GlobalTagThrottler: per-transaction-tag TPS quotas. Two tiers,
+        # like the reference (fdbserver/GlobalTagThrottler.actor.cpp):
+        # MANAGEMENT quotas (set_tag_quota) and AUTO quotas derived from
+        # observed busyness — when the pipeline is stressed (lag past
+        # target), a tag dominating admissions gets throttled to its
+        # fair share scaled by the stress factor; healthy intervals
+        # relax the auto quota back until it lifts. Enforcement stays at
+        # the GRV proxies; get_tag_quota returns the tighter tier.
         self.tag_quotas: dict[str, float] = {}
+        self.auto_tag_quotas: dict[str, float] = {}
+        #: a tag is "dominant" past this share of interval admissions
+        self.auto_throttle_share = 0.4
+        self.min_tag_tps = 1.0
+        self._tag_admissions: dict[str, int] = {}
         self._task = None
 
     def start(self) -> None:
@@ -85,7 +93,45 @@ class Ratekeeper:
         self.tag_quotas[tag] = tps
 
     def get_tag_quota(self, tag: str) -> float:
-        return self.tag_quotas.get(tag, float("inf"))
+        return min(
+            self.tag_quotas.get(tag, float("inf")),
+            self.auto_tag_quotas.get(tag, float("inf")),
+        )
+
+    def note_tag_admission(self, tag: str) -> None:
+        """GRV proxies report each admitted tagged request: the busyness
+        signal the auto throttler derives quotas from."""
+        self._tag_admissions[tag] = self._tag_admissions.get(tag, 0) + 1
+
+    def _update_auto_tag_quotas(self, lag: float) -> None:
+        admissions = self._tag_admissions
+        self._tag_admissions = {}
+        total = sum(admissions.values())
+        if lag > self.lag_target and total > 0:
+            stress = min(
+                1.0,
+                (lag - self.lag_target) / (self.lag_limit - self.lag_target),
+            )
+            for tag, n in admissions.items():
+                if n / total < self.auto_throttle_share:
+                    continue
+                rate = n / self.interval
+                # throttle the dominant tag toward its stressed fair
+                # share; repeated stressed intervals ratchet it down
+                target = max(self.min_tag_tps, rate * (1.0 - stress) * 0.5)
+                cur = self.auto_tag_quotas.get(tag, float("inf"))
+                self.auto_tag_quotas[tag] = min(cur, target)
+                code_probe(True, "ratekeeper.auto_tag_throttled")
+        elif lag <= self.lag_target and self.auto_tag_quotas:
+            # healthy interval: relax each auto quota; lift it once it
+            # stops binding (2x headroom over the tag's observed rate)
+            for tag in list(self.auto_tag_quotas):
+                q = self.auto_tag_quotas[tag] * 2.0
+                rate = admissions.get(tag, 0) / self.interval
+                if q > max(rate * 2.0, self.min_tag_tps * 4):
+                    del self.auto_tag_quotas[tag]
+                else:
+                    self.auto_tag_quotas[tag] = q
 
     async def _loop(self) -> None:
         try:
@@ -93,6 +139,7 @@ class Ratekeeper:
                 await self.sched.delay(self.interval)
                 self.counters.add("loops")
                 lag = self.worst_lag()
+                self._update_auto_tag_quotas(lag)
                 if lag <= self.lag_target:
                     self.tps_budget = self.max_tps
                 elif lag >= self.lag_limit:

@@ -73,6 +73,17 @@ class ShardMap:
     def team_of(self, key: bytes) -> tuple:
         return self.owners[bisect.bisect_right(self.boundaries, key)]
 
+    def range_of(self, key: bytes) -> tuple[bytes, bytes, tuple]:
+        """(begin, end, team) of the FULL shard containing `key`; end is
+        b"" for the last segment (unbounded). The client location cache
+        stores whole shard ranges — a clipped sub-range would make range
+        reads crawl key-by-key (getKeyLocation returns the full shard
+        boundary in the reference too, NativeAPI.actor.cpp:2969)."""
+        i = bisect.bisect_right(self.boundaries, key)
+        b = self.boundaries[i - 1] if i > 0 else b""
+        e = self.boundaries[i] if i < len(self.boundaries) else b""
+        return b, e, self.owners[i]
+
     def shard_of(self, key: bytes) -> int:
         """Primary member of the owning team (single-replica callers)."""
         return self.team_of(key)[0]

@@ -35,6 +35,13 @@ class TransactionTooOld(Exception):
     """error_code_transaction_too_old: read below the MVCC window."""
 
 
+class WrongShardServerError(Exception):
+    """error_code_wrong_shard_server: this server no longer owns the
+    range (it moved away and the data was dropped). The client
+    invalidates its location cache entry and re-resolves
+    (fdbclient/NativeAPI.actor.cpp:2969-3097)."""
+
+
 class StorageServer:
     def __init__(
         self,
@@ -65,6 +72,11 @@ class StorageServer:
         # wrong_shard_server for older reads; we raise too-old (both make
         # the client retry at a fresh version)
         self._shard_floors: list[tuple[bytes, bytes, int]] = []
+        # ranges this server relinquished (moved away + data dropped):
+        # reads there answer wrong_shard_server so a stale client
+        # location cache LOUDLY invalidates instead of reading absence
+        self._dropped_ranges: list[tuple[bytes, bytes]] = []
+        self.stopped = False
         # live (non-cleared) key count, maintained incrementally
         self._live_count = 0
         self._last_gc = recovery_version
@@ -75,11 +87,18 @@ class StorageServer:
         self.slowdown = 0.0
 
     def start(self) -> None:
+        self.stopped = False
         self._update_task = self.sched.spawn(self._update_loop(), name="ss-update")
 
     def stop(self) -> None:
+        self.stopped = True
         if self._update_task is not None:
             self._update_task.cancel()
+
+    async def ping(self) -> bool:
+        """Failure-monitor probe (rides the SimNetwork under simulation,
+        so partitions look like death from the monitor's vantage)."""
+        return not self.stopped
 
     # -- write path --------------------------------------------------------
 
@@ -254,6 +273,20 @@ class StorageServer:
             if v > fetch_version:
                 self._apply(v, m)
         self._shard_floors.append((begin, end, fetch_version))
+        # re-acquiring a range lifts its wrong_shard_server refusal by
+        # SUBTRACTION: a partially overlapping re-acquisition (the
+        # balancer moves different range shapes than DD did) must not
+        # leave a permanent refusal over keys this server now owns
+        new_dropped: list[tuple[bytes, bytes]] = []
+        for b, e in self._dropped_ranges:
+            if e <= begin or end <= b:
+                new_dropped.append((b, e))
+                continue
+            if b < begin:
+                new_dropped.append((b, begin))
+            if end < e:
+                new_dropped.append((end, e))
+        self._dropped_ranges = new_dropped
 
     def cancel_fetch(self, begin: bytes, end: bytes) -> None:
         """Abort a fetch (move failed before the routing flip): the
@@ -266,6 +299,7 @@ class StorageServer:
             f for f in self._shard_floors
             if not (f[0] >= begin and f[1] <= end)
         ]
+        self._dropped_ranges.append((begin, end))
 
     def _fetch_range_of(self, m):
         if not self._fetching:
@@ -287,6 +321,11 @@ class StorageServer:
             "oldest_version": self.oldest_version,
             "live_count": self._live_count,
             "shard_floors": list(self._shard_floors),
+            # wrong_shard_server refusals are part of the durable
+            # contract: a rebooted server that forgot them would
+            # silently serve absence for moved-away ranges to clients
+            # holding stale location-cache entries (code-review r4)
+            "dropped_ranges": list(self._dropped_ranges),
         }
 
     def restore(self, snap: dict) -> None:
@@ -296,6 +335,7 @@ class StorageServer:
         self.oldest_version = snap["oldest_version"]
         self._live_count = snap["live_count"]
         self._shard_floors = list(snap["shard_floors"])
+        self._dropped_ranges = list(snap.get("dropped_ranges", []))
         self._last_gc = snap["oldest_version"]
         self.version = Notified(snap["durable_version"])
 
@@ -307,6 +347,15 @@ class StorageServer:
         await self.version.when_at_least(version)
 
     def _check_shard_floor(self, begin: bytes, end: bytes, version: int) -> None:
+        from foundationdb_tpu.cluster.failure_monitor import ProcessFailedError
+
+        if self.stopped:
+            # a read reaching a dead process: the transport-level error
+            # the client's failure-report fast path consumes
+            raise ProcessFailedError(f"storage tag {self.tag} is down")
+        for b, e in self._dropped_ranges:
+            if begin < e and b < end:
+                raise WrongShardServerError((begin, end))
         for b, e, floor in self._shard_floors:
             if begin < e and b < end and version < floor:
                 # a recently-moved-in shard has no history below its
@@ -314,6 +363,7 @@ class StorageServer:
                 raise TransactionTooOld(version)
 
     async def get_value(self, key: bytes, version: int) -> Optional[bytes]:
+        self._check_shard_floor(key, key + b"\x00", version)  # fail fast
         await self._wait_for_version(version)
         self._check_shard_floor(key, key + b"\x00", version)
         return self._value_at(key, version)
@@ -321,6 +371,7 @@ class StorageServer:
     async def get_key_values(
         self, begin: bytes, end: bytes, version: int, *, limit: int = 1 << 30
     ) -> list[tuple[bytes, bytes]]:
+        self._check_shard_floor(begin, end, version)  # fail fast
         await self._wait_for_version(version)
         self._check_shard_floor(begin, end, version)
         lo = bisect.bisect_left(self._keys, begin)

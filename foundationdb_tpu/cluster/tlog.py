@@ -24,7 +24,8 @@ from typing import Any
 from foundationdb_tpu.runtime.flow import Notified, Scheduler
 from foundationdb_tpu.utils.probes import declare
 
-declare("tlog.diskqueue_recovery", "simdisk.torn_tail")
+declare("tlog.diskqueue_recovery", "simdisk.torn_tail",
+        "tlog.spill", "tlog.peek_from_spill")
 
 Tag = int  # storage tag (the reference's Tag{locality, id})
 
@@ -79,6 +80,14 @@ class TLog:
         # per-tag popped bookkeeping generalized to backup workers, which
         # read every tag — fdbserver/BackupWorker.actor.cpp).
         self._popped: dict[str, dict[Tag, int]] = {"storage": {}}
+        # SPILL state (TLogServer.actor.cpp:2311 spill-by-reference):
+        # when retained mutations exceed SERVER_KNOBS.TLOG_SPILL_THRESHOLD,
+        # the OLDEST unpopped versions are evicted from memory and
+        # replaced by per-tag (version, dq seq) index entries; peeks for
+        # spilled versions read the records back off the DiskQueue. A
+        # lagging consumer therefore bounds tlog MEMORY, not disk.
+        self._spilled: dict[Tag, list[tuple[int, int]]] = {}
+        self._mem_mutations = 0
 
     def lock(self, epoch: int, recovery_version: int = None) -> None:
         """Recovery locks the log to a new generation: pushes from older
@@ -112,19 +121,83 @@ class TLog:
             self._seq_of_version.append((req.version, seq))
         for tag, msgs in req.messages.items():
             self._messages.setdefault(tag, []).append((req.version, msgs))
+            self._mem_mutations += len(msgs)
         self.version.set(req.version)
+        self._maybe_spill()
         return req.version
 
-    async def peek(self, tag: Tag, after_version: int):
-        """Messages for `tag` with version > after_version; waits until the
-        log has advanced past after_version (peek cursor contract)."""
-        await self.version.when_at_least(after_version + 1)
-        out = [
+    def _maybe_spill(self) -> None:
+        """Evict the oldest unpopped versions from memory once the
+        retained-mutation budget is exceeded; their DiskQueue records
+        (already durable — commit fsyncs before the in-memory apply)
+        become the backing store, indexed per tag."""
+        from foundationdb_tpu.utils.knobs import SERVER_KNOBS
+        from foundationdb_tpu.utils.probes import code_probe
+
+        budget = SERVER_KNOBS.TLOG_SPILL_THRESHOLD
+        if self.dq is None or self._mem_mutations <= budget:
+            return
+        seq_of = dict(self._seq_of_version)
+        # Pick the eviction set FIRST (oldest versions until back under
+        # budget), then partition each tag's list in ONE pass — the
+        # per-version rescan of every tag was quadratic in backlog under
+        # the sim's randomized small thresholds (code-review r4).
+        ver_sizes: dict[int, int] = {}
+        for entries in self._messages.values():
+            for v, msgs in entries:
+                ver_sizes[v] = ver_sizes.get(v, 0) + len(msgs)
+        evict: set[int] = set()
+        mem = self._mem_mutations
+        for v in sorted(ver_sizes):
+            if mem <= budget:
+                break
+            if v not in seq_of:
+                continue  # not individually addressable — keep in memory
+            evict.add(v)
+            mem -= ver_sizes[v]
+        if not evict:
+            return
+        code_probe(True, "tlog.spill")
+        for tag in list(self._messages):
+            kept = []
+            for ev, msgs in self._messages[tag]:
+                if ev in evict:
+                    self._spilled.setdefault(tag, []).append(
+                        (ev, seq_of[ev])
+                    )
+                    self._mem_mutations -= len(msgs)
+                else:
+                    kept.append((ev, msgs))
+            self._messages[tag] = kept
+
+    def _entries_for(self, tag: Tag, after_version: int):
+        """Merged (version, msgs) view of a tag: spilled versions read
+        back off the DiskQueue + in-memory tail, version-ascending."""
+        import pickle
+
+        from foundationdb_tpu.utils.probes import code_probe
+
+        out = []
+        for v, seq in self._spilled.get(tag, []):
+            if v > after_version:
+                code_probe(True, "tlog.peek_from_spill")
+                _prev, _v, messages = pickle.loads(self.dq.read(seq))
+                out.append((v, messages.get(tag, [])))
+        out.extend(
             (v, msgs)
             for v, msgs in self._messages.get(tag, [])
             if v > after_version
-        ]
-        return out, self.version.get()
+        )
+        out.sort(key=lambda e: e[0])
+        return out
+
+    async def peek(self, tag: Tag, after_version: int):
+        """Messages for `tag` with version > after_version; waits until the
+        log has advanced past after_version (peek cursor contract).
+        Spilled versions are read back off the DiskQueue transparently
+        (peekMessagesFromDisk)."""
+        await self.version.when_at_least(after_version + 1)
+        return self._entries_for(tag, after_version), self.version.get()
 
     def register_consumer(self, name: str) -> None:
         """Retain messages for an extra consumer from this point on."""
@@ -156,7 +229,7 @@ class TLog:
             return
         floors = [
             self._popped["storage"].get(tag, 0)
-            for tag in self._messages
+            for tag in set(self._messages) | set(self._spilled)
             if tag != LOG_STREAM_TAG
         ]
         for name, marks in self._popped.items():
@@ -192,6 +265,8 @@ class TLog:
         code_probe(True, "tlog.diskqueue_recovery")
         assert self.dq is not None
         self._messages = {}
+        self._spilled = {}
+        self._mem_mutations = 0
         self._seq_of_version = []
         last_version = 0
         for seq, blob in self.dq.recovered:
@@ -200,8 +275,10 @@ class TLog:
                 continue  # duplicate record
             for tag, msgs in messages.items():
                 self._messages.setdefault(tag, []).append((v, msgs))
+                self._mem_mutations += len(msgs)
             self._seq_of_version.append((v, seq))
             last_version = v
+        self._maybe_spill()  # a big recovered tail re-spills immediately
         if last_version > self.version.get():
             self.version.set(last_version)
 
@@ -216,11 +293,13 @@ class TLog:
 
         my_v = self.version.get()
         copied: dict[int, dict] = {}
-        for tag, entries in peer._messages.items():
-            for v, msgs in entries:
-                if v > my_v:
-                    self._messages.setdefault(tag, []).append((v, msgs))
-                    copied.setdefault(v, {})[tag] = msgs
+        # the peer's merged view: spilled versions come back off its
+        # DiskQueue (a catch-up must not miss what the peer evicted)
+        for tag in set(peer._messages) | set(peer._spilled):
+            for v, msgs in peer._entries_for(tag, my_v):
+                self._messages.setdefault(tag, []).append((v, msgs))
+                self._mem_mutations += len(msgs)
+                copied.setdefault(v, {})[tag] = msgs
         for tag in self._messages:
             self._messages[tag].sort(key=lambda e: e[0])
         if self.dq is not None:
@@ -236,6 +315,7 @@ class TLog:
         self._popped = {
             n: dict(m) for n, m in peer._popped.items()
         }
+        self._maybe_spill()  # the copied tail respects the memory budget
 
     def _trim(self, tag: Tag) -> None:
         if tag == LOG_STREAM_TAG:
@@ -243,7 +323,11 @@ class TLog:
             # consumers constrain it — none registered = drop everything
             extras = [m for n, m in self._popped.items() if n != "storage"]
             if not extras:
+                self._mem_mutations -= sum(
+                    len(m) for _v, m in self._messages.get(tag, [])
+                )
                 self._messages[tag] = []
+                self._spilled.pop(tag, None)
                 return
             floor = min(m.get(tag, 0) for m in extras)
         else:
@@ -252,6 +336,14 @@ class TLog:
             # never-popped marks pin storage tags would leak the whole
             # log for the lifetime of a backup/DR relationship
             floor = self._popped["storage"].get(tag, 0)
+        dropped = [
+            (v, m) for v, m in self._messages.get(tag, []) if v <= floor
+        ]
+        self._mem_mutations -= sum(len(m) for _v, m in dropped)
         self._messages[tag] = [
             (v, m) for v, m in self._messages.get(tag, []) if v > floor
         ]
+        if tag in self._spilled:
+            self._spilled[tag] = [
+                (v, s) for v, s in self._spilled[tag] if v > floor
+            ]

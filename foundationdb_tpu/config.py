@@ -51,6 +51,21 @@ class KernelConfig:
     #: (overflow) if any live range ever spans more than S rank blocks.
     #: See ops/group.resolve_group.
     short_span_limit: int = 0
+    #: Straight-line fixpoint applications compiled before the residual
+    #: while_loop (ops/group.resolve_group). A while ITERATION measured
+    #: ~5x an unrolled application (r4 ablations), so the unroll should
+    #: cover the workload's typical convergence depth: ~3 at uniform
+    #: contention, ~6 under hot-key (zipf) contention, ~12 for
+    #: wide-range workloads (scripts/iters_model.py). Exactness never
+    #: depends on it — deeper chains fall through to the loop.
+    fixpoint_unroll: int = 3
+    #: True compiles the group kernel WITHOUT the residual while_loop —
+    #: its mere presence costs ~50ms/group of XLA pessimization at zero
+    #: iterations (r4 measured). Convergence is then CHECKED per batch:
+    #: a deeper-than-unroll chain trips GroupVerdict.unconverged, the
+    #: state returns unchanged, and the caller re-dispatches on the
+    #: exact kernel. Loud refusal, never a silent wrong answer.
+    fixpoint_latch: bool = False
 
     def __post_init__(self):
         if self.max_key_bytes % 4 != 0:

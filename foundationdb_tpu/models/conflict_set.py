@@ -105,17 +105,22 @@ _REBASE = jax.jit(_rebase, donate_argnums=0)
 _GROUP_JITS: dict = {}
 
 
-def _resolve_group_jit(short_span_limit: int):
-    """One compiled group kernel per short_span_limit value (a static
-    compile-time switch — see ops/group.resolve_group)."""
-    fn = _GROUP_JITS.get(short_span_limit)
+def _resolve_group_jit(short_span_limit: int, fixpoint_unroll: int = 3,
+                       fixpoint_latch: bool = False):
+    """One compiled group kernel per (short_span_limit, fixpoint_unroll,
+    fixpoint_latch) triple (static compile-time switches — see
+    ops/group.resolve_group)."""
+    key = (short_span_limit, fixpoint_unroll, fixpoint_latch)
+    fn = _GROUP_JITS.get(key)
     if fn is None:
         import functools
 
         fn = jax.jit(functools.partial(
-            _G.resolve_group, short_span_limit=short_span_limit
+            _G.resolve_group, short_span_limit=short_span_limit,
+            fixpoint_unroll=fixpoint_unroll,
+            fixpoint_latch=fixpoint_latch,
         ))
-        _GROUP_JITS[short_span_limit] = fn
+        _GROUP_JITS[key] = fn
     return fn
 
 #: Overflow is checked host-side every this many batches (each check
@@ -210,7 +215,9 @@ class TpuConflictSet:
         contract); a stale host-side check guards the bench path.
         """
         self.state, outs = _resolve_group_jit(
-            getattr(self.config, "short_span_limit", 0)
+            getattr(self.config, "short_span_limit", 0),
+            getattr(self.config, "fixpoint_unroll", 3),
+            getattr(self.config, "fixpoint_latch", False),
         )(self.state, stacked_args)
         self._batches_since_check += int(outs.verdict.shape[0]) - 1
         self._maybe_check_overflow()
@@ -308,12 +315,25 @@ class CpuConflictSet:
 
 def make_conflict_set(config: KernelConfig, backend: str = None):
     """The resolver_backend knob gate (BASELINE.json: the TPU path sits
-    behind a knob; the CPU path remains selectable)."""
+    behind a knob; the CPU path remains selectable).
+
+    With backend "tpu", configs whose batch capacity sits under
+    SERVER_KNOBS.RESOLVER_TPU_MIN_BATCH auto-route to the CPU backend:
+    at small batches the device dispatch alone exceeds the CPU's whole
+    resolve (measured — bench.py BENCH_SMALL=1), so the TPU serves the
+    loaded/batched regime and the CPU the latency regime. Explicit
+    backend="tpu-force" bypasses the threshold (benches, tests)."""
     if backend is None:
         from foundationdb_tpu.utils.knobs import SERVER_KNOBS
 
         backend = SERVER_KNOBS.RESOLVER_BACKEND
     if backend == "tpu":
+        from foundationdb_tpu.utils.knobs import SERVER_KNOBS
+
+        if config.max_txns < SERVER_KNOBS.RESOLVER_TPU_MIN_BATCH:
+            return CpuConflictSet(config)
+        return TpuConflictSet(config)
+    if backend == "tpu-force":
         return TpuConflictSet(config)
     if backend == "cpu":
         return CpuConflictSet(config)

@@ -70,6 +70,10 @@ class ResolveTransactionBatchRequest:
     # OTEL-style span context (trace_id, span_id) — the reference threads
     # a SpanContext on every request (ResolverInterface.h:129)
     span: Optional[tuple] = None
+    # Storage tags written by this batch, proxy-computed from the shard
+    # map (ResolverInterface.h:139 writtenTags; feeds the version-vector
+    # tpcvMap path when ENABLE_VERSION_VECTOR_TLOG_UNICAST is on).
+    written_tags: frozenset = frozenset()
 
 
 @dataclasses.dataclass
@@ -94,6 +98,13 @@ class ResolveTransactionBatchReply:
         default_factory=dict
     )
     debug_id: Optional[str] = None
+    # Version-vector surface (knob ENABLE_VERSION_VECTOR_TLOG_UNICAST;
+    # ResolverInterface.h:140-151 + Resolver.actor.cpp:475-495): per
+    # written tlog, the PREVIOUS commit version that touched it — what
+    # lets tlogs chain unicast pushes without hearing every version.
+    # Empty when the knob is off.
+    tpcv_map: dict[int, int] = dataclasses.field(default_factory=dict)
+    written_tags: frozenset = frozenset()
 
 
 #: the \xff system keyspace prefix (fdbclient/SystemData.cpp)

@@ -91,10 +91,37 @@ class GroupVerdict(NamedTuple):
     conflict_count: jnp.ndarray      # [G] int32
     too_old_count: jnp.ndarray       # [G] int32
     overflow: jnp.ndarray            # [G] bool (latched, broadcast)
+    unconverged: jnp.ndarray         # [G] bool — fixpoint_latch mode
+    #   only: some batch needed more than fixpoint_unroll applications.
+    #   The returned STATE is the UNCHANGED input state and the verdicts
+    #   are not trustworthy; the host re-dispatches with the exact
+    #   (while_loop) kernel. Always False with fixpoint_latch=False.
 
 
 def _next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
+
+
+def _sorted_counts(ids, n_seg: int):
+    """off[t] = #{ids < t} for t in [0, n_seg], via two sorts.
+
+    The sort+cumsum replacement for searchsorted/scatter histograms
+    (the platform cost model: a sort streams at ~0.45ns/row/operand
+    while a scatter pays ~50ns/update): co-sort the ids with the query
+    points 0..n_seg (queries FIRST among equal keys), read the running
+    id-count at each query row, then compact the query rows back to
+    index order with a second sort. Returns [n_seg + 1] int32.
+    """
+    n = ids.shape[0]
+    q = jnp.arange(n_seg + 1, dtype=jnp.int32)
+    keys = jnp.concatenate([ids.astype(jnp.int32), q])
+    isid = jnp.concatenate(
+        [jnp.ones((n,), jnp.int32), jnp.zeros((n_seg + 1,), jnp.int32)]
+    )
+    sk, si = jax.lax.sort([keys, isid], num_keys=2)
+    cnt = jnp.cumsum(si)  # at a query row (si == 0): #ids strictly < t
+    _si2, _sk2, out = jax.lax.sort([si, sk, cnt], num_keys=2)
+    return out[: n_seg + 1]
 
 
 def _shift_down(x, fill):
@@ -104,6 +131,8 @@ def _shift_down(x, fill):
 
 def resolve_group(state: H.VersionHistory, g: dict, *,
                   short_span_limit: int = 0,
+                  fixpoint_unroll: int = 3,
+                  fixpoint_latch: bool = False,
                   _ablate: frozenset = frozenset()):
     """Resolve G stacked batches in one program.
 
@@ -205,10 +234,17 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
         return jnp.where(sent, K.SENTINEL_WORD, jnp.concatenate(cols))
 
     iota = jnp.arange(r_rows, dtype=jnp.int32)
-    ops = [col(i) for i in range(w - 1)] + [pks, iota]
+    # main_ver rides the sort as a value operand (+1 operand at
+    # ~0.45ns/row) so the merge phase needs no 2.9M-row gather for it
+    mver_col = jnp.concatenate([
+        state.main_ver,
+        jnp.full((2 * rn + 2 * wn,), VERSION_NEG, jnp.int32),
+    ])
+    ops = [col(i) for i in range(w - 1)] + [pks, iota, mver_col]
     s = jax.lax.sort(ops, num_keys=w)
     skw = s[: w - 1]
     spk, siota = s[w - 1], s[w]
+    s_mver = s[w + 1]
 
     is_sent = spk == K.SENTINEL_WORD
     s_is_point = (((spk >> sh_pt) & 1) == 1) & ~is_sent
@@ -226,10 +262,11 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
 
     bi = jnp.cumsum(key_new.astype(jnp.int32)) - 1          # block index
     cm = jnp.cumsum(s_is_main.astype(jnp.int32))            # incl. main count
-    # block start row index (monotone -> running max works)
-    bs = jax.lax.cummax(jnp.where(key_new, iota, -1))
-    mains_before_block = cm[jnp.clip(bs, 0, r_rows - 1)] - jnp.where(
-        s_is_main[jnp.clip(bs, 0, r_rows - 1)], 1, 0
+    # mains before each row's BLOCK: at a block-start row that is
+    # cm - is_main there; cm is nondecreasing, so a running max carries
+    # it across the block — no block-start gathers needed
+    mains_before_block = jax.lax.cummax(
+        jnp.where(key_new, cm - s_is_main.astype(jnp.int32), -1)
     )
     il_row = cm - 1                    # searchsorted-right(key) - 1 vs main
     ir_row = mains_before_block - 1    # searchsorted-left(key) - 1 vs main
@@ -248,27 +285,29 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
         same_block = ~key_new
         first_in_block = onehot & ~(prev_onehot & same_block[:, None])
         lcum = jnp.cumsum(first_in_block.astype(jnp.int32), axis=0)  # [R, G]
+        # FLAT 1D gather, not take_along_axis: 2D data-dependent gathers
+        # measure in the ~140ns/element class on v5e vs ~5ns flattened
+        # (the same asymmetry as rangemax.query — measured round 3)
         lrank_row = (
-            jnp.take_along_axis(
-                lcum, jnp.clip(s_batch, 0, gn - 1)[:, None], axis=1
-            )[:, 0]
-            - 1
+            lcum.reshape(-1)[iota * gn + jnp.clip(s_batch, 0, gn - 1)] - 1
         )
 
-    # ---- scatter per-point data back to input order --------------------
+    # ---- per-point data back to input order: ONE sort, not scatters ----
+    # Route by ROW ORIGIN (point rows are siota >= m, live or dead), so
+    # every point ordinal 0..p_pts-1 appears exactly once and a stable
+    # sort keyed by ordinal is a perfect inverse permutation. One
+    # 5-operand sort (~r_rows x 5 x 0.45ns) replaces four ~50ns/update
+    # scatters. Dead points now carry GARBAGE values (the old scatters
+    # filled -1/0): every consumer masks by read_live/write_live.
     p_pts = 2 * rn + 2 * wn
-    po = siota - m  # point ordinal (negative for main rows)
-    po_c = jnp.where(s_is_point, po, p_pts)  # main/sentinel -> trash row
-
-    def to_points(vals, fill):
-        return (
-            jnp.full((p_pts + 1,), fill, vals.dtype).at[po_c].set(vals)[:p_pts]
-        )
-
-    rank_pt = to_points(bi, 0)
-    lrank_pt = to_points(lrank_row, 0)
-    il_pt = to_points(il_row, -1)
-    ir_pt = to_points(ir_row, -1)
+    po_all = jnp.where(siota >= m, siota - m, p_pts)
+    sp = jax.lax.sort(
+        [po_all, bi, lrank_row, il_row, ir_row], num_keys=1
+    )
+    rank_pt = sp[1][:p_pts]
+    lrank_pt = sp[2][:p_pts]
+    il_pt = sp[3][:p_pts]
+    ir_pt = sp[4][:p_pts]
 
     rank_rb, rank_re = rank_pt[:rn], rank_pt[rn : 2 * rn]
     rank_wb = rank_pt[2 * rn : 2 * rn + wn]
@@ -313,13 +352,27 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
         vmax = rangemax.query(main_tab, jnp.maximum(il, 0), ir + 1, op="max")
         stale_hit = (vmax > read_snap) & read_live
 
-    trash = gn * b
+    # ---- per-txn read windows (replaces scatter segment-reductions) ----
+    # LAYOUT CONTRACT (utils/packing.pack_batch): within a batch, reads
+    # are grouped by txn in nondecreasing txn order, and padded rows
+    # carry read_txn == B — so the flat segment id below is globally
+    # nondecreasing and every txn's reads occupy one contiguous window
+    # [off[t], off[t+1]) of the flat read array. Per-txn reductions then
+    # become cumsum + two flat gathers instead of a ~50ns/update
+    # scatter. (The sharded path only flips validity bits, never
+    # reorders rows, so clipping preserves the contract.)
+    seg_id = r_batch * (b + 1) + r_txn              # [RN], nondecreasing
+    off_flat = _sorted_counts(seg_id, gn * (b + 1))  # [G*(b+1)+1]
+    offs2 = off_flat[:-1].reshape(gn, b + 1)         # off[i*(b+1)+k]
+    win_lo = offs2[:, :b]                            # [G, B] flat bounds
+    win_hi = offs2[:, 1:]
+
     def per_txn_any(read_bits):
-        return (
-            jnp.zeros((gn * b + 1,), jnp.int32)
-            .at[jnp.where(read_live, r_gid, trash)]
-            .max(read_bits.astype(jnp.int32))[: gn * b]
-        ) > 0
+        cs = jnp.concatenate([
+            jnp.zeros((1,), jnp.int32),
+            jnp.cumsum(read_bits.astype(jnp.int32)),
+        ])
+        return (cs[win_hi.reshape(-1)] - cs[win_lo.reshape(-1)]) > 0
 
     hist_conflict_txn0 = per_txn_any(stale_hit)
 
@@ -358,16 +411,19 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
     # initial all-NEG seg_ver answers every cross query with "no
     # earlier write".
     def batch_step(carry, xs):
-        seg_ver, span_ok = carry
+        seg_ver, span_ok, fix_ok = carry
         (lqlo, lqhi, wlo, whi, rrb, rre, rwb, rwe, rtxn, rlive, wlive,
-         wtxn, snap, stale, toold, tvalid, ridx, ver) = xs
+         wtxn, snap, stale, toold, tvalid, ridx, ver, twl, twh) = xs
+        converged = jnp.asarray(True)
 
         def per_txn(read_bits):
-            return (
-                jnp.zeros((b + 1,), jnp.int32)
-                .at[jnp.where(rlive, rtxn, b)]
-                .max(read_bits.astype(jnp.int32))[:b]
-            ) > 0
+            # txn-window cumsum-diff (bits must be pre-masked by rlive;
+            # see the layout contract where the windows are built)
+            cs = jnp.concatenate([
+                jnp.zeros((1,), jnp.int32),
+                jnp.cumsum(read_bits.astype(jnp.int32)),
+            ])
+            return (cs[twh] - cs[twl]) > 0
 
         if short_span_limit:
             # the cross-batch query walks GLOBAL block ranks — its span
@@ -391,8 +447,12 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
             )
             cross_g = (gmax > snap) & rlive
         else:
-            gtab = rangemax.build(seg_ver, op="max")
-            gmax = rangemax.query(gtab, rrb, rre, op="max")
+            # two-level table: this build runs once PER BATCH inside the
+            # scan over the full ~r_rows domain — the flat doubling
+            # table's 23 full-width levels were the cross phase's cost
+            # (~70ms/group, r4 ablations); build2 writes ~6.6 passes
+            gtab = rangemax.build2(seg_ver, op="max")
+            gmax = rangemax.query2(gtab, rrb, rre, op="max")
             cross_g = (gmax > snap) & rlive
         ok_g = tvalid & ~toold & ~per_txn(stale | cross_g)
 
@@ -435,11 +495,36 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
             committed_g = ok_g & ~per_txn(h0 & ok_g[rtxn])
             final_same_g = h0 & ok_g[rtxn]
         else:
-            h0 = same_hits_g(ok_g)
-            c1 = ok_g & ~per_txn(h0 & ok_g[rtxn])
-            committed_g, _, last_h = jax.lax.while_loop(
-                cond, body, (c1, ok_g, h0)
-            )
+            # Unrolled applications first, residual while_loop after: a
+            # while ITERATION under the batch scan measured ~5x an
+            # unrolled application (r4 ablations: 129ms/group of loop
+            # iterations at uniform vs 13ms/group for an application),
+            # so `fixpoint_unroll` straight-line applications cover the
+            # workload's typical convergence depth and the loop usually
+            # runs ZERO iterations. Deeper chains still resolve exactly
+            # in the loop — the unroll is a perf knob, never semantics.
+            h_prev = same_hits_g(ok_g)
+            c_prev = ok_g
+            c_cur = ok_g & ~per_txn(h_prev & ok_g[rtxn])
+            for _ in range(max(1, fixpoint_unroll) - 1):
+                h_prev = same_hits_g(c_cur)
+                c_prev, c_cur = c_cur, ok_g & ~per_txn(
+                    h_prev & ok_g[rtxn]
+                )
+            if fixpoint_latch or "nowhile" in _ablate:
+                # LATCH mode: no residual while_loop at all — its mere
+                # presence measured ~50ms/group of XLA pessimization
+                # even at zero iterations (r4: 405 vs 354 ms/group).
+                # Convergence is CHECKED, not assumed: an unconverged
+                # batch trips the group-wide latch, the state returns
+                # UNCHANGED, and the host re-dispatches on the exact
+                # while kernel (the short_span_limit refusal pattern).
+                converged = ~jnp.any(c_cur != c_prev)
+                committed_g, last_h = c_cur, h_prev
+            else:
+                committed_g, _, last_h = jax.lax.while_loop(
+                    cond, body, (c_cur, c_prev, h_prev)
+                )
             # last_h is the hits AT the fixpoint (carried from prev ==
             # fixpoint — the round-2 kernel's argument).
             final_same_g = last_h & ok_g[rtxn]
@@ -455,12 +540,25 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
             covered = jnp.cumsum(dd) > 0
             seg_ver = jnp.where(covered, ver, seg_ver)
 
-        first_g = (
-            jnp.full((b + 1,), INT32_POS, jnp.int32)
-            .at[jnp.where(final_same_g, rtxn, b)]
-            .min(jnp.where(final_same_g, ridx, INT32_POS))[:b]
+        # first conflicting read-range index per txn: reads sit in range
+        # order inside their window, so the first hit POSITION carries
+        # the min index — locate it by compacting hit positions to the
+        # front with one small sort and gathering at the window's
+        # preceding-hit count.
+        csh = jnp.concatenate([
+            jnp.zeros((1,), jnp.int32),
+            jnp.cumsum(final_same_g.astype(jnp.int32)),
+        ])
+        n_before = csh[twl]
+        tot_h = csh[twh] - n_before
+        iota_nr = jnp.arange(nr, dtype=jnp.int32)
+        (tpos,) = jax.lax.sort(
+            [jnp.where(final_same_g, iota_nr, jnp.int32(nr))]
         )
-        return (seg_ver, span_ok), (
+        p = tpos[jnp.clip(n_before, 0, nr - 1)]
+        fidx = ridx[jnp.clip(p, 0, nr - 1)]
+        first_g = jnp.where(tot_h > 0, fidx, INT32_POS)
+        return (seg_ver, span_ok, fix_ok & converged), (
             committed_g, final_same_g, cross_g, first_g
         )
 
@@ -471,13 +569,16 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
     # when anything does; adding 0*bi[0] is numerically a no-op.
     seg_ver0 = jnp.full((r_rows,), VERSION_NEG, jnp.int32) + 0 * bi[0]
     span_ok = span_ok & (bi[0] == bi[0])
+    fix_ok0 = bi[0] == bi[0]  # True, with the shard_map varying type
+    lane_base = (jnp.arange(gn, dtype=jnp.int32) * nr)[:, None]
     xs = (
         lq_lo, lq_hi, wlo2, whi2, rank_rb2, rank_re2, rank_wb2,
         rank_we2, r_txn2, read_live2, w_live2, w_txn2, snap2, stale2,
         too_old2, txn_valid2, read_index2, versions,
+        win_lo - lane_base, win_hi - lane_base,
     )
-    (seg_ver, span_ok), (committed2, same2, cross2, first2) = jax.lax.scan(
-        batch_step, (seg_ver0, span_ok), xs
+    (seg_ver, span_ok, fix_ok), (committed2, same2, cross2, first2) = (
+        jax.lax.scan(batch_step, (seg_ver0, span_ok, fix_ok0), xs)
     )
     committed = committed2.reshape(-1)
     final_same = same2.reshape(-1)
@@ -521,11 +622,7 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
     # (last writer's version per block — what sequential merges leave).
     gval = seg_ver[jnp.clip(bi, 0, r_rows - 1)]
 
-    mval = jnp.where(
-        s_is_main,
-        state.main_ver[jnp.clip(siota, 0, m - 1)],
-        VERSION_NEG,
-    )
+    mval = jnp.where(s_is_main, s_mver, VERSION_NEG)
 
     def last_valid(a, bb):
         av, am = a
@@ -581,6 +678,14 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
             oldest=jnp.maximum(state.oldest, final_floor),
             overflow=overflow,
         )
+    unconv = ~fix_ok
+    if fixpoint_latch:
+        # a tripped latch must leave the persistent history UNTOUCHED:
+        # the host re-runs the whole group on the exact while kernel
+        # against the same input state
+        new_state = jax.tree.map(
+            lambda old, new: jnp.where(unconv, old, new), state, new_state
+        )
     out = GroupVerdict(
         verdict=v2,
         hist_conflict_read=hist_conflict_read.reshape(gn, nr),
@@ -589,5 +694,6 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
         conflict_count=conflict_count,
         too_old_count=too_old_count,
         overflow=jnp.broadcast_to(overflow, (gn,)),
+        unconverged=jnp.broadcast_to(unconv, (gn,)),
     )
     return new_state, out

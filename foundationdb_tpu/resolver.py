@@ -138,6 +138,7 @@ class Resolver:
         state_memory_limit: int = None,  # None -> the server knob
         init_version: int = -1,  # reference: Resolver() : version(-1)
         backend: str = None,  # resolver_backend knob: "tpu" | "cpu"
+        num_logs: int = 1,  # tlog count for the version-vector tpcv path
     ):
         from foundationdb_tpu.models.conflict_set import make_conflict_set
         from foundationdb_tpu.utils.knobs import SERVER_KNOBS
@@ -163,6 +164,12 @@ class Resolver:
         self.total_state_bytes = 0
         self.recent_state = _RecentStateTransactionsInfo()
         self.proxy_info: dict[Optional[str], _ProxyRequestsInfo] = {}
+        # Version-vector state (knob ENABLE_VERSION_VECTOR_TLOG_UNICAST;
+        # Resolver.actor.cpp:746-750 tpcvVector): per-tlog previous
+        # commit version, lazily initialized to the first batch's
+        # prev_version (the :486-488 invalidVersion fill).
+        self.num_logs = num_logs
+        self.tpcv_vector: Optional[list[int]] = None
         # Knob-gated private-mutations path (Resolver.actor.cpp:372-441 +
         # design/transaction-state-store.md): when on, this resolver
         # materializes committed state-txn mutations into its own
@@ -406,6 +413,33 @@ class Resolver:
                 erased = self.recent_state.erase_up_to(oldest_proxy_version)
                 any_popped = erased > 0
                 state_bytes -= erased
+
+            # ---- version-vector tpcvMap (:475-495, knob-gated) ---------
+            from foundationdb_tpu.utils.knobs import SERVER_KNOBS
+
+            if (
+                SERVER_KNOBS.ENABLE_VERSION_VECTOR_TLOG_UNICAST
+                and self.num_logs
+            ):
+                # state/metadata batches broadcast to every log; plain
+                # batches touch only the written tags' log locations
+                # (tag -> log via round-robin, our LogSystem's layout)
+                if state_txns or reply.private_mutations:
+                    written_tlogs = set(range(self.num_logs))
+                else:
+                    written_tlogs = {
+                        t % self.num_logs for t in req.written_tags
+                    }
+                # the reference refills while tpcvVector[0] ==
+                # invalidVersion (-1): a recovery batch's prev_version
+                # of -1 leaves the vector "uninitialized" so the first
+                # real batch seeds it with ITS prev_version (:486-488)
+                if self.tpcv_vector is None or self.tpcv_vector[0] == -1:
+                    self.tpcv_vector = [req.prev_version] * self.num_logs
+                for tl in sorted(written_tlogs):
+                    reply.tpcv_map[tl] = self.tpcv_vector[tl]
+                    self.tpcv_vector[tl] = req.version
+                reply.written_tags = frozenset(req.written_tags)
 
             self.version.set(req.version)
             breached = (

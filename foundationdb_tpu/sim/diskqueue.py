@@ -49,6 +49,8 @@ class SimDiskQueue:
         self._buffer: list[_Record] = []
         self._next_seq = 0
         self._pop_floor = 0
+        # seq -> data cache for read(); invalidated whenever _disk changes
+        self._by_seq: dict | None = None
 
     # -- the DiskQueue API -------------------------------------------------
 
@@ -71,6 +73,7 @@ class SimDiskQueue:
         self._disk.extend(self._buffer)
         self._buffer = []
         self._compact()
+        self._by_seq = None
         return self._next_seq - 1 if self._next_seq else None
 
     def _compact(self) -> None:
@@ -107,6 +110,25 @@ class SimDiskQueue:
             if not r.is_pop and r.seq >= floor
         ]
 
+    def read(self, seq: int) -> bytes:
+        """Random-access read of a committed record — the
+        spill-by-reference peek path: a TLog that evicted a version from
+        memory reads it back off the queue (the reference's
+        DiskQueueAdapter reads for spilled tag peeks,
+        fdbserver/TLogServer.actor.cpp peekMessagesFromDisk). Indexed:
+        a lagging follower re-peeks its spilled tail every tick, and a
+        linear scan made that quadratic in backlog (code-review r4)."""
+        if self._by_seq is None:
+            self._by_seq = {
+                r.seq: r.data for r in self._disk if not r.is_pop
+            }
+        try:
+            return self._by_seq[seq]
+        except KeyError:
+            raise KeyError(
+                f"seq {seq} not on disk (popped or never committed)"
+            ) from None
+
     @property
     def next_seq(self) -> int:
         return self._next_seq
@@ -138,12 +160,14 @@ class SimDiskQueue:
                     torn.data[:cut], corrupt=True,
                 ))
         self._buffer = []
+        self._by_seq = None
         self.recover()
 
     def recover(self) -> None:
         """The recovery scan: truncate the torn tail (an invalid frame
         ends recovery — only a plausible tail is ever dropped, matching
         the native policy), restore seq allocation and the pop floor."""
+        self._by_seq = None
         while self._disk and self._disk[-1].corrupt:
             self._disk.pop()
         assert not any(r.corrupt for r in self._disk), (

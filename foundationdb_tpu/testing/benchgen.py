@@ -82,9 +82,12 @@ def skiplist_style_batch(
     )
     has_reads = txn_valid.copy()
 
-    iota_r = np.zeros((nr,), np.int32)
+    # padding rows carry txn id == b: the kernel's per-txn cumsum
+    # windows need the flat segment id monotone (packing.pack_batch's
+    # layout contract)
+    iota_r = np.full((nr,), b, np.int32)
     iota_r[:n_txns] = np.arange(n_txns, dtype=np.int32)
-    iota_w = np.zeros((nw,), np.int32)
+    iota_w = np.full((nw,), b, np.int32)
     iota_w[:n_txns] = np.arange(n_txns, dtype=np.int32)
     rvalid = np.zeros((nr,), bool)
     rvalid[:n_txns] = True

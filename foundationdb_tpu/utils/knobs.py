@@ -129,6 +129,27 @@ def make_server_knobs() -> Knobs:
         randomize=lambda r: float(r.choice([0.001, 0.005, 0.01])),
     )
     k.define("RESOLVER_BACKEND", "tpu")  # the resolver_backend knob
+    # Below this batch capacity the TPU path cannot win: per-dispatch
+    # overhead dominates and the CPU resolves a small batch in well
+    # under the device round trip (measured r4 — bench.py BENCH_SMALL=1
+    # small-batch sweep; see README). make_conflict_set auto-selects the
+    # CPU backend for configs under the threshold — a deliberate,
+    # measured TPU-first design decision: the accelerator serves the
+    # loaded/batched regime, the CPU serves the latency regime.
+    k.define("RESOLVER_TPU_MIN_BATCH", 8192)
+    # Version-vector unicast (default off, like the reference's
+    # ENABLE_VERSION_VECTOR_TLOG_UNICAST, fdbclient/ServerKnobs.cpp):
+    # resolvers track a per-tlog previous-commit-version vector and
+    # replies carry tpcvMap + writtenTags (ResolverInterface.h:140-151).
+    k.define("ENABLE_VERSION_VECTOR_TLOG_UNICAST", False)
+    # TLog memory budget (in retained mutations) before old unpopped
+    # versions spill by reference to the DiskQueue — a lagging storage
+    # follower must not grow tlog memory without bound
+    # (fdbserver/TLogServer.actor.cpp:2311 + TLOG_SPILL_THRESHOLD)
+    k.define(
+        "TLOG_SPILL_THRESHOLD", 1_000_000,
+        randomize=lambda r: int(r.choice([20, 100, 1_000, 1_000_000])),
+    )
     # BUGGIFY: proxies re-send resolve requests (a retry after a lost
     # reply) so the resolver's duplicate-reply window is exercised —
     # Resolver.actor.cpp:513's cached-reply path and the Never() path

@@ -193,8 +193,8 @@ def pack_batch(
     rb, re = _flat(r_begin, r_end, nr)
     wb, we = _flat(w_begin, w_end, nw)
 
-    def _col(vals, cap, dtype=np.int32):
-        out = np.zeros((cap,), dtype)
+    def _col(vals, cap, dtype=np.int32, fill=0):
+        out = np.full((cap,), fill, dtype)
         out[: len(vals)] = vals
         return out
 
@@ -210,12 +210,18 @@ def pack_batch(
         has_reads=has_reads,
         read_begin=rb,
         read_end=re,
-        read_txn=_col(r_txn, nr),
+        # KERNEL LAYOUT CONTRACT (ops/group.py per-txn windows): rows are
+        # grouped by txn in nondecreasing txn order with ranges in
+        # declaration order, and PADDING rows carry txn id == max_txns —
+        # the flat (batch, txn) segment id is then monotone, which lets
+        # the kernel do per-txn reductions with cumsum windows instead
+        # of scatters.
+        read_txn=_col(r_txn, nr, fill=b),
         read_index=_col(r_idx, nr),
         read_valid=_col([True] * nread, nr, bool),
         write_begin=wb,
         write_end=we,
-        write_txn=_col(w_txn, nw),
+        write_txn=_col(w_txn, nw, fill=b),
         write_valid=_col([True] * nwrite, nw, bool),
     )
 

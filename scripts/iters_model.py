@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""How many alternating-fixpoint iterations does each bench mode need?
+
+Simulates the kernel's intra-batch fixpoint (ops/group.py batch_step)
+in numpy at bench shapes: committed_{k+1}[t] = ok[t] and no committed_k
+earlier writer covers any of t's reads. Reports iterations-to-converge
+per batch — the while_loop trip count that prices the fixpoint phase on
+device (and the unroll bound an unrolled variant would need).
+"""
+
+import sys
+
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+MODE = sys.argv[2] if len(sys.argv) > 2 else "uniform"
+BATCHES = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+
+rng = np.random.default_rng(0)
+keyspace = 1_000_000
+gen = {
+    "uniform": dict(keyspace=1_000_000, zipf=None, range_len=1),
+    "zipf": dict(keyspace=10_000_000, zipf=1.1, range_len=1),
+    "range": dict(keyspace=1_000_000, zipf=None, range_len=500),
+}[MODE]
+
+
+def draw(n):
+    if gen["zipf"]:
+        z = rng.zipf(gen["zipf"], size=n)
+        return np.minimum(z - 1, gen["keyspace"] - 1)
+    return rng.integers(0, gen["keyspace"], size=n)
+
+
+def min_cover_writers(wb, we, qb, qe, writer_idx):
+    """For each query range: min writer index among ranges covering any
+    overlap — same-batch same_hits. O((n+q) log) via rank-space segment
+    min over a coordinate-compressed domain."""
+    pts = np.unique(np.concatenate([wb, we, qb, qe]))
+    leaves = len(pts)
+    lo = np.searchsorted(pts, wb)
+    hi = np.searchsorted(pts, we)
+    INF = 1 << 30
+    # heap sweep over begin-sorted intervals: res[l] = min writer index
+    # among intervals covering leaf l
+    import heapq
+
+    order = np.argsort(lo, kind="stable")
+    res = np.full(leaves, INF, np.int64)
+    h = []
+    oi = 0
+    for leaf in range(leaves):
+        while oi < len(order) and lo[order[oi]] <= leaf:
+            w = order[oi]
+            if hi[w] > lo[w]:
+                heapq.heappush(h, (int(writer_idx[w]), int(hi[w])))
+            oi += 1
+        while h and h[0][1] <= leaf:
+            heapq.heappop(h)
+        if h:
+            res[leaf] = h[0][0]
+    qlo = np.searchsorted(pts, qb)
+    qhi = np.searchsorted(pts, qe)
+    # min over res[qlo:qhi): prefix-min sparse table
+    L = max(1, (leaves - 1).bit_length() + 1)
+    tab = [res]
+    for k in range(1, L):
+        half = min(1 << (k - 1), leaves - 1)
+        prev = tab[-1]
+        tab.append(np.minimum(prev, np.concatenate([prev[half:], np.full(half, INF, np.int64)])))
+    length = np.maximum(qhi - qlo, 1)
+    ks = np.maximum(0, np.frexp(length.astype(np.float64))[1] - 1)
+    ks = np.minimum(ks, L - 1)
+    a = np.clip(qlo, 0, leaves - 1)
+    b = np.clip(qhi - (1 << ks), 0, leaves - 1)
+    tabs = np.stack(tab)
+    out = np.minimum(tabs[ks, a], tabs[ks, b])
+    return np.where(qhi > qlo, out, INF)
+
+
+for bi in range(BATCHES):
+    rb = draw(N)
+    re_ = rb + gen["range_len"]
+    wb = draw(N)
+    we = wb + (1 if MODE == "range" else gen["range_len"])
+    ok = np.ones(N, bool)  # assume history passed everyone (worst case)
+    committed = ok.copy()
+    prev = None
+    iters = 0
+    while prev is None or (committed != prev).any():
+        prev = committed.copy()
+        widx = np.where(committed, np.arange(N), 1 << 30)
+        minw = min_cover_writers(wb, we, rb, re_, widx)
+        committed = ok & ~((minw < np.arange(N)))
+        iters += 1
+    print(f"{MODE} batch {bi}: converged in {iters} iterations; "
+          f"committed {committed.sum()}/{N}")

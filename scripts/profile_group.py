@@ -39,6 +39,7 @@ _ALL = [
     ("-mainq", frozenset({"mainq"})),
     ("-seg", frozenset({"seg", "cross"})),
     ("-lcum-fix", frozenset({"lcum", "fixpoint"})),
+    ("nowhile", frozenset({"nowhile"})),
     ("skeleton", frozenset(
         {"fixpoint", "cross", "merge", "mainq", "seg", "lcum"})),
 ]
@@ -73,10 +74,13 @@ def main():
     print(f"N={N} FUSE={FUSE} MODE={MODE}", flush=True)
 
     span = int(os.environ.get("SPAN", "0"))
+    unroll = int(os.environ.get("UNROLL", "3"))
+    latch = bool(int(os.environ.get("LATCH", "0")))
     base = None
     for name, ab in VARIANTS:
         jf = jax.jit(functools.partial(
-            G.resolve_group, _ablate=ab, short_span_limit=span))
+            G.resolve_group, _ablate=ab, short_span_limit=span,
+            fixpoint_unroll=unroll, fixpoint_latch=latch))
         state = H.init(config)
         s1, o = jf(state, g1)
         np.asarray(o.verdict[0][:4])  # compile+warm

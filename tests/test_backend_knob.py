@@ -14,10 +14,26 @@ from foundationdb_tpu.testing import workloads
 
 
 def test_knob_gate_selects_backend():
-    assert isinstance(make_conflict_set(TEST_CONFIG, "tpu"), TpuConflictSet)
+    from foundationdb_tpu.utils.knobs import SERVER_KNOBS
+
+    # "tpu" auto-routes SMALL configs to the CPU backend (the measured
+    # latency-regime threshold, RESOLVER_TPU_MIN_BATCH): TEST_CONFIG's
+    # capacity sits far below it
+    assert TEST_CONFIG.max_txns < SERVER_KNOBS.RESOLVER_TPU_MIN_BATCH
+    assert isinstance(make_conflict_set(TEST_CONFIG, "tpu"), CpuConflictSet)
+    assert isinstance(make_conflict_set(TEST_CONFIG), CpuConflictSet)
+    # lowering the threshold sends the same config to the device path
+    old = SERVER_KNOBS.RESOLVER_TPU_MIN_BATCH
+    try:
+        SERVER_KNOBS.set("RESOLVER_TPU_MIN_BATCH", 1)
+        assert isinstance(make_conflict_set(TEST_CONFIG, "tpu"), TpuConflictSet)
+    finally:
+        SERVER_KNOBS.set("RESOLVER_TPU_MIN_BATCH", old)
+    # "tpu-force" bypasses the threshold outright
+    assert isinstance(
+        make_conflict_set(TEST_CONFIG, "tpu-force"), TpuConflictSet
+    )
     assert isinstance(make_conflict_set(TEST_CONFIG, "cpu"), CpuConflictSet)
-    # the default comes from SERVER_KNOBS.RESOLVER_BACKEND (= "tpu")
-    assert isinstance(make_conflict_set(TEST_CONFIG), TpuConflictSet)
     with pytest.raises(ValueError):
         make_conflict_set(TEST_CONFIG, "gpu")
 
@@ -25,7 +41,7 @@ def test_knob_gate_selects_backend():
 def test_backends_agree_on_random_workload():
     rng = np.random.default_rng(5)
     wcfg = workloads.WorkloadConfig(n_txns=24, keyspace=32, report_fraction=1.0)
-    tpu = make_conflict_set(TEST_CONFIG, "tpu")
+    tpu = make_conflict_set(TEST_CONFIG, "tpu-force")
     cpu = make_conflict_set(TEST_CONFIG, "cpu")
     version = 0
     for _ in range(6):

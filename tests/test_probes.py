@@ -203,6 +203,35 @@ def test_every_declared_probe_fires():
         for i in range(4):
             q.push(b"unsynced%d" % i)
         q.crash(np.random.default_rng(s))
+
+    # -- tlog spill: tiny budget + lagging consumer ----------------------
+    from foundationdb_tpu.cluster.tlog import TLog, TLogCommitRequest
+    from foundationdb_tpu.utils.knobs import SERVER_KNOBS as _SK
+
+    _old_budget = _SK.TLOG_SPILL_THRESHOLD
+    _SK.set("TLOG_SPILL_THRESHOLD", 8)
+    try:
+        sched_sp = Scheduler(sim=True)
+        spill_log = TLog(sched_sp, durable=SimDiskQueue())
+
+        async def spill_drive():
+            prev = 0
+            for i in range(12):
+                v = (i + 1) * 10
+                await spill_log.commit(TLogCommitRequest(
+                    prev_version=prev, version=v,
+                    messages={0: [("set", b"sp%d" % i, b"v")]},
+                ))
+                prev = v
+            entries, _v = await spill_log.peek(0, 0)  # reads from spill
+            return len(entries)
+
+        t = sched_sp.spawn(spill_drive())
+        sched_sp.run_until(t.done)
+        assert t.done.get() == 12
+    finally:
+        _SK.set("TLOG_SPILL_THRESHOLD", _old_budget)
+
     sched4, cluster4, db4 = open_cluster(
         ClusterConfig(n_storage=2, n_tlogs=2, n_satellite_logs=1)
     )
@@ -236,7 +265,31 @@ def test_every_declared_probe_fires():
         for i in range(8):
             txn = db4.create_transaction(tag="batch")
             await txn.get_read_version()
+        # auto tag throttling: a dominant tag during stressed intervals
+        # earns a busyness-derived quota (ratekeeper.auto_tag_throttled)
+        for _ in range(8):
+            for _ in range(50):
+                rk.note_tag_admission("batch")
+            await sched4.delay(rk.interval)
+            if rk.auto_tag_quotas:
+                break
+        assert rk.auto_tag_quotas, "auto tag throttle never engaged"
         cluster4.storage_servers[0].slowdown = 0.0
+        # failure monitor: a SILENT kill must be detected by the ping
+        # loop (failmon.detected_by_ping), and a revived process must be
+        # marked live by a ping again (failmon.recovered)
+        cluster4.kill_storage_silent(1)
+        for _ in range(40):
+            await sched4.delay(0.05)
+            if cluster4.failure_monitor.is_failed("storage1"):
+                break
+        assert cluster4.failure_monitor.is_failed("storage1")
+        cluster4.storage_servers[1].start()  # back from the dead
+        for _ in range(40):
+            await sched4.delay(0.05)
+            if not cluster4.failure_monitor.is_failed("storage1"):
+                break
+        assert not cluster4.failure_monitor.is_failed("storage1")
         return True
 
     cluster4.ratekeeper.set_tag_quota("batch", 3.0)

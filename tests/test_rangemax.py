@@ -59,3 +59,32 @@ def test_segtree_empty_updates():
     val = jnp.asarray([1, 2], jnp.int32)
     got = np.asarray(segtree.min_cover(leaves, lo, hi, val))
     assert (got == int(segtree.INT32_POS)).all()
+
+
+def test_two_level_table_matches_flat():
+    """build2/query2 (the low-traffic two-level structure the group
+    kernel's cross phase uses) must agree with the flat doubling table
+    on every span class: sub-chunk, chunk-straddling, wide, clamped."""
+    import numpy as np
+
+    from foundationdb_tpu.ops import rangemax as rm
+
+    rng = np.random.default_rng(5)
+    for m in (100, 1024, 4097):
+        vals = rng.integers(-2**30, 2**30, size=m).astype(np.int32)
+        q = 512
+        lo = rng.integers(-5, m + 5, size=q).astype(np.int32)
+        length = np.where(
+            rng.random(q) < 0.5,
+            rng.integers(0, 40, size=q),      # sub/at-chunk spans
+            rng.integers(40, m + 64, size=q),  # wide spans
+        )
+        hi = (lo + length).astype(np.int32)
+        for op in ("max", "min"):
+            flat = rm.build(jnp.asarray(vals), op=op)
+            two = rm.build2(jnp.asarray(vals), op=op)
+            want = np.asarray(rm.query(flat, lo, hi, op=op))
+            got = np.asarray(rm.query2(two, lo, hi, op=op))
+            assert (got == want).all(), (
+                m, op, lo[got != want][:4], hi[got != want][:4]
+            )

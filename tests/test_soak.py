@@ -110,8 +110,16 @@ def soak(seed: int, *, kill_proxy: bool, rounds: int = 30,
     sched.run_for(1.0)
 
     async def final_verify():
-        txn = db.create_transaction()
-        return dict(await txn.get_range(b"s", b"t"))
+        # a GRV delivered after a hard ratekeeper throttle can be older
+        # than the MVCC window by the time the read lands — the client
+        # contract is past_version/too_old => retry with a fresh GRV
+        for _ in range(20):
+            txn = db.create_transaction()
+            try:
+                return dict(await txn.get_range(b"s", b"t"))
+            except RETRYABLE:
+                await sched.delay(0.05)
+        raise AssertionError("final verify never got a fresh-enough GRV")
 
     got = sched.run_until(sched.spawn(final_verify()).done)
     check(got, b"s", b"t")

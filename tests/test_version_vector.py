@@ -1,0 +1,125 @@
+"""Version-vector wire surface (VERDICT r3 missing #4): tpcvMap +
+writtenTags on the resolver reply, knob-gated like the reference
+(ENABLE_VERSION_VECTOR_TLOG_UNICAST; ResolverInterface.h:140-151,
+Resolver.actor.cpp:475-495).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from foundationdb_tpu.config import TEST_CONFIG
+from foundationdb_tpu.models.types import (
+    CommitTransaction,
+    ResolveTransactionBatchRequest,
+)
+from foundationdb_tpu.resolver import Resolver
+from foundationdb_tpu.runtime.flow import Scheduler
+from foundationdb_tpu.utils.knobs import SERVER_KNOBS
+
+
+@pytest.fixture
+def vv_knob():
+    old = SERVER_KNOBS.ENABLE_VERSION_VECTOR_TLOG_UNICAST
+    SERVER_KNOBS.set("ENABLE_VERSION_VECTOR_TLOG_UNICAST", True)
+    yield
+    SERVER_KNOBS.set("ENABLE_VERSION_VECTOR_TLOG_UNICAST", old)
+
+
+def run(sched, coro):
+    t = sched.spawn(coro)
+    sched.run_until(t.done)
+    return t.done.get()
+
+
+def test_tpcv_map_recurrence(vv_knob):
+    """reply.tpcvMap[log] = the PREVIOUS version that wrote that log;
+    the vector lazily fills with the first batch's prev_version."""
+    sched = Scheduler(sim=True)
+    res = Resolver(sched, TEST_CONFIG, backend="cpu", num_logs=3)
+
+    def req(prev, version, tags, txns=()):
+        return ResolveTransactionBatchRequest(
+            prev_version=prev, version=version, last_received_version=prev,
+            transactions=list(txns), written_tags=frozenset(tags),
+            proxy_id="p0",
+        )
+
+    async def drive():
+        # recovery batch from the master
+        await res.resolve(req(-1, 0, ()))
+        # batch v10 writes tags {0, 1} -> logs {0, 1}
+        r1 = await res.resolve(req(0, 10, (0, 1)))
+        assert r1.tpcv_map == {0: 0, 1: 0}
+        assert r1.written_tags == frozenset((0, 1))
+        # batch v20 writes tags {1, 2}: log1 last written at 10, log2
+        # never since the fill (0)
+        r2 = await res.resolve(req(10, 20, (1, 2)))
+        assert r2.tpcv_map == {1: 10, 2: 0}
+        # batch v30 writes tag 0 only: log0 last written at 10
+        r3 = await res.resolve(req(20, 30, (0,)))
+        assert r3.tpcv_map == {0: 10}
+        return True
+
+    assert run(sched, drive())
+
+
+def test_tpcv_state_txns_broadcast(vv_knob):
+    """Metadata/state batches touch EVERY log (the shardChanged ||
+    privateMutationCount branch at :481-484)."""
+    sched = Scheduler(sim=True)
+    res = Resolver(sched, TEST_CONFIG, backend="cpu", num_logs=3)
+
+    async def drive():
+        await res.resolve(ResolveTransactionBatchRequest(
+            prev_version=-1, version=0, last_received_version=-1,
+        ))
+        state_txn = CommitTransaction(
+            mutations=[("set", b"\xff/conf/x", b"1")]
+        )
+        r = await res.resolve(ResolveTransactionBatchRequest(
+            prev_version=0, version=10, last_received_version=0,
+            transactions=[state_txn], txn_state_transactions=[0],
+            written_tags=frozenset((1,)), proxy_id="p0",
+        ))
+        assert set(r.tpcv_map) == {0, 1, 2}
+        return True
+
+    assert run(sched, drive())
+
+
+def test_knob_off_leaves_surface_empty():
+    sched = Scheduler(sim=True)
+    res = Resolver(sched, TEST_CONFIG, backend="cpu", num_logs=3)
+
+    async def drive():
+        await res.resolve(ResolveTransactionBatchRequest(
+            prev_version=-1, version=0, last_received_version=-1,
+        ))
+        r = await res.resolve(ResolveTransactionBatchRequest(
+            prev_version=0, version=10, last_received_version=0,
+            written_tags=frozenset((0,)), proxy_id="p0",
+        ))
+        assert r.tpcv_map == {} and r.written_tags == frozenset()
+        return True
+
+    assert run(sched, drive())
+
+
+def test_cluster_commits_with_version_vector_on(vv_knob):
+    """End to end: the proxy computes written tags from the shard map
+    and commits flow normally with the knob on."""
+    from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+
+    sched, cluster, db = open_cluster(ClusterConfig(n_storage=2))
+    try:
+        async def body():
+            txn = db.create_transaction()
+            txn.set(b"vv-key", b"1")
+            await txn.commit()
+            txn = db.create_transaction()
+            return await txn.get(b"vv-key")
+
+        assert run(sched, body()) == b"1"
+    finally:
+        cluster.stop()
