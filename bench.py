@@ -61,14 +61,16 @@ def main():
     # of XLA pessimization at ZERO iterations); a deeper-than-unroll
     # chain trips the unconverged latch and this script re-runs the
     # stream on the exact while kernel — loud fallback, never wrong.
-    # The bench stream is DETERMINISTIC (seeded), so the warm pass's
-    # unconverged check proves the depth suffices for the exact batches
-    # every run (incl. the graded one) resolves; a trip falls back to
-    # the exact while kernel. The idealized model says uniform 3 /
-    # zipf 6 / range 12, but real history masks deepen chains (uniform
-    # tripped at 3) — margins are cheap (~3ms/batch each) next to a
-    # tripped latch.
-    unroll = {"uniform": 4, "zipf": 8, "range": 14}[mode]
+    # Fixpoint depth per mode: the idealized model (scripts/
+    # iters_model.py) says uniform 3 / zipf 6 / range 12, but the REAL
+    # uniform stream's history masks deepen chains past 4 (the latch
+    # tripped at both 3 and 4), and at depth >= 5 the latch's unrolled
+    # applications cost as much as the exact kernel's residual while —
+    # so uniform runs the EXACT kernel outright. zipf/range keep the
+    # latch with margin; a trip falls back to the exact kernel (loud,
+    # never wrong — the warm pass checks before any timed pass).
+    unroll = {"uniform": 3, "zipf": 8, "range": 14}[mode]
+    latch = mode != "uniform"
 
     import jax
 
@@ -102,7 +104,7 @@ def main():
         history_capacity=12 * cap,
         window_versions=window,
         fixpoint_unroll=unroll,
-        fixpoint_latch=True,
+        fixpoint_latch=latch,
     )
     import dataclasses as _dc
 
