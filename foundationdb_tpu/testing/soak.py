@@ -49,6 +49,12 @@ class SeedPlan:
     crash_tlog: bool           # power-loss + DiskQueue recovery of a log
     slow_storage: bool         # IO slowdown -> ratekeeper must throttle
     tag_quota: bool            # per-tag GRV throttling exercised
+    # round-4 fault classes
+    silent_kill: bool          # unannounced storage death: only the
+    #                            failure monitor's ping loop can see it
+    tlog_spill: bool           # tiny spill budget + lagging consumer:
+    #                            old versions spill by reference and the
+    #                            catch-up peek reads them off the queue
 
 
 def plan_for_seed(seed: int) -> SeedPlan:
@@ -78,6 +84,8 @@ def plan_for_seed(seed: int) -> SeedPlan:
         crash_tlog=bool(r.random() < 0.4),
         slow_storage=bool(r.random() < 0.3),
         tag_quota=bool(r.random() < 0.3),
+        silent_kill=bool(r.random() < 0.35),
+        tlog_spill=bool(r.random() < 0.35),
     )
 
 
@@ -96,11 +104,18 @@ def run_seed(seed: int, collect_probes: bool = False):
     from foundationdb_tpu.runtime.flow import all_of
     from foundationdb_tpu.utils.knobs import SERVER_KNOBS
 
+    from foundationdb_tpu.cluster.failure_monitor import ProcessFailedError
+
     retryable = (
         NotCommitted,
         TransactionTooOldError,
         CommitUnknownResult,
         GrvProxyFailedError,
+        # every replica of a team can be transiently dead under composed
+        # faults (silent kill + reboot): the read retry budget exhausts
+        # and surfaces the process failure — a real client backs off and
+        # retries exactly like any other retryable transaction error
+        ProcessFailedError,
     )
     plan = plan_for_seed(seed)
     if collect_probes:
@@ -123,7 +138,7 @@ def run_seed(seed: int, collect_probes: bool = False):
     if plan.state_squeeze:
         # tiny resolver memory limit: metadata bursts breach it and the
         # backpressure loop must drain via the version chain
-        SERVER_KNOBS.set("RESOLVER_STATE_MEMORY_LIMIT", 600)
+        SERVER_KNOBS.set("RESOLVER_STATE_MEMORY_LIMIT", 60)
 
     window = 1_000_000 if plan.small_window else 5_000_000
     from foundationdb_tpu.cluster.database import ClusterConfig as _CC
@@ -327,6 +342,32 @@ def run_seed(seed: int, collect_probes: bool = False):
             if plan.kill_tlog and plan.n_tlogs > 1:
                 await sched.delay(0.05)
                 cluster.kill_tlog(0)
+            if plan.silent_kill and plan.replication >= 2:
+                # unannounced death: reads that hit it report via the
+                # client fast path, but DETECTION is the ping loop's job
+                # (failmon.detected_by_ping); the revived process is
+                # marked live by a later ping
+                await sched.delay(0.05)
+                victim = int(rng.integers(0, plan.n_storage))
+                cluster.kill_storage_silent(victim)
+                for _ in range(40):
+                    await sched.delay(0.05)
+                    if cluster.failure_monitor.is_failed(
+                        f"storage{victim}"
+                    ):
+                        break
+                cluster.storage_servers[victim].start()
+            if plan.tlog_spill:
+                # a tiny retained-mutation budget + a briefly-lagging
+                # consumer: the tlog must spill old unpopped versions by
+                # reference (tlog.spill) and the catch-up peek must read
+                # them back off the disk queue (tlog.peek_from_spill)
+                SERVER_KNOBS.set("TLOG_SPILL_THRESHOLD", 8)
+                lag_ss = cluster.storage_servers[0]
+                lag_ss.slowdown = 2.0
+                await sched.delay(0.5)
+                lag_ss.slowdown = 0.0
+                await sched.delay(0.3)  # drain the spilled tail
             if plan.kill_proxy:
                 await sched.delay(0.1)
                 p = cluster.commit_proxies[0]

@@ -21,8 +21,10 @@ from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
 from foundationdb_tpu.cluster.grv_proxy import GrvProxyFailedError
 from foundationdb_tpu.runtime.flow import all_of
 
+from foundationdb_tpu.cluster.failure_monitor import ProcessFailedError
+
 RETRYABLE = (NotCommitted, TransactionTooOldError, CommitUnknownResult,
-             GrvProxyFailedError)
+             GrvProxyFailedError, ProcessFailedError)
 
 
 def soak(seed: int, *, kill_proxy: bool, rounds: int = 30,
