@@ -454,6 +454,11 @@ class Database:
                     f"read at {rv} below the storage MVCC window"
                 )
             cursor = seg_end
+            # budget retries per segment, not per scan: a long range
+            # crossing many concurrently-moving shards must not exhaust
+            # the budget when each individual segment retry would have
+            # succeeded (ADVICE r4; NativeAPI retries per getRange leg)
+            attempts = 0
         return items
 
     def create_transaction(self, tag: str = None) -> Transaction:

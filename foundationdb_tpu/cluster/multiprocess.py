@@ -636,9 +636,18 @@ class StorageRole:
                             lsm.set_floor(durable - self.window)
                             self._compact_log(durable)
 
-                        await asyncio.get_event_loop().run_in_executor(
-                            None, lsm_flush
-                        )
+                        # _compact_log pops the native WAL DiskQueue and
+                        # swaps _seq_by_version; a concurrent apply()'s
+                        # _log_apply_durably pushes the SAME queue from
+                        # another executor thread and the native queue
+                        # does no internal locking — serialize through
+                        # _log_lock (ADVICE r4)
+                        if self._log_lock is None:
+                            self._log_lock = asyncio.Lock()
+                        async with self._log_lock:
+                            await asyncio.get_event_loop().run_in_executor(
+                                None, lsm_flush
+                            )
                 elif self._data_dir:
                     self._applies_since_ckpt += 1
                     if self._applies_since_ckpt >= self.CHECKPOINT_INTERVAL:
@@ -653,9 +662,15 @@ class StorageRole:
                             self._write_checkpoint_blob(blob)
                             self._compact_log(ckpt_version)
 
-                        await asyncio.get_event_loop().run_in_executor(
-                            None, install
-                        )
+                        # same WAL push/pop race as the LSM branch above:
+                        # _compact_log must not run concurrently with
+                        # _log_apply_durably on the unlocked native queue
+                        if self._log_lock is None:
+                            self._log_lock = asyncio.Lock()
+                        async with self._log_lock:
+                            await asyncio.get_event_loop().run_in_executor(
+                                None, install
+                            )
                 cond.notify_all()
             return StorageApplyReply(durable_version=self.version)
 

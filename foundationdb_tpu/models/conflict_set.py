@@ -206,22 +206,58 @@ class TpuConflictSet:
         self._maybe_check_overflow()
         return outs
 
-    def resolve_group_args(self, stacked_args):
+    def resolve_group_args(self, stacked_args, check_latch: bool = True):
         """Resolve K stacked batches via the GROUP kernel (ops/group.py):
         one mega-sort program instead of a lax.scan of per-batch
         kernels — same decisions (tests/test_group_parity.py), one
         dispatch, and the per-batch history merge amortized across the
         group. Versions must ascend across the stack (sequencer
         contract); a stale host-side check guards the bench path.
+
+        With `config.fixpoint_latch` the latched kernel may REFUSE a
+        group whose conflict chains run deeper than `fixpoint_unroll`
+        (GroupVerdict.unconverged; the returned state is the unchanged
+        input state). By default this method honors the kernel contract
+        itself: it host-checks the latch and re-dispatches the same args
+        on the exact while-loop kernel (ADVICE r4 — callers must never
+        see untrustworthy verdicts). The check costs one device sync per
+        group; pipelined callers that fence once per stream (bench.py)
+        pass check_latch=False and fall back themselves. Call
+        `prewarm_exact` up front so the fallback swaps programs in
+        milliseconds instead of paying an XLA compile mid-stream.
         """
-        self.state, outs = _resolve_group_jit(
-            getattr(self.config, "short_span_limit", 0),
-            getattr(self.config, "fixpoint_unroll", 3),
-            getattr(self.config, "fixpoint_latch", False),
-        )(self.state, stacked_args)
+        ssl = getattr(self.config, "short_span_limit", 0)
+        unroll = getattr(self.config, "fixpoint_unroll", 3)
+        latch = getattr(self.config, "fixpoint_latch", False)
+        state2, outs = _resolve_group_jit(ssl, unroll, latch)(
+            self.state, stacked_args
+        )
+        if latch and check_latch and bool(np.asarray(outs.unconverged).any()):
+            state2, outs = _resolve_group_jit(ssl, unroll, False)(
+                self.state, stacked_args
+            )
+        self.state = state2
         self._batches_since_check += int(outs.verdict.shape[0]) - 1
         self._maybe_check_overflow()
         return outs
+
+    def prewarm_exact(self, stacked_args) -> None:
+        """Warm the exact while-loop group kernel for this args shape so
+        a fixpoint-latch trip swaps programs in milliseconds instead of
+        stalling the version chain behind an XLA compile — the reference
+        resolver never stalls its chain (fdbserver/Resolver.actor.cpp:
+        283-296). The group kernel does not donate state, so executing
+        it once and discarding the results is side-effect-free; the
+        compile lands in both the jit call cache and the persistent
+        compile cache. No-op when fixpoint_latch is off."""
+        if not getattr(self.config, "fixpoint_latch", False):
+            return
+        ssl = getattr(self.config, "short_span_limit", 0)
+        unroll = getattr(self.config, "fixpoint_unroll", 3)
+        st, outs = _resolve_group_jit(ssl, unroll, False)(
+            self.state, stacked_args
+        )
+        jax.block_until_ready(outs.verdict)
 
     def _maybe_check_overflow(self) -> None:
         self._batches_since_check += 1
@@ -331,6 +367,22 @@ def make_conflict_set(config: KernelConfig, backend: str = None):
         from foundationdb_tpu.utils.knobs import SERVER_KNOBS
 
         if config.max_txns < SERVER_KNOBS.RESOLVER_TPU_MIN_BATCH:
+            # Loud reroute (ADVICE r4): the default KernelConfig sizes
+            # max_txns at 1024, well under the measured device/CPU
+            # crossover, so backend="tpu" quietly serving CPU would be
+            # a silent surprise. The gate is on the config's static
+            # batch CAPACITY — the kernel is compiled for max_txns, so
+            # capacity bounds the largest batch this instance could
+            # ever route and is the honest static proxy for load.
+            from foundationdb_tpu.utils.trace import SEV_WARN, TraceEvent
+
+            TraceEvent(
+                "ResolverBackendAutoRouted", severity=SEV_WARN
+            ).detail("Requested", "tpu").detail("Chosen", "cpu").detail(
+                "MaxTxns", config.max_txns
+            ).detail(
+                "MinBatch", SERVER_KNOBS.RESOLVER_TPU_MIN_BATCH
+            ).log()
             return CpuConflictSet(config)
         return TpuConflictSet(config)
     if backend == "tpu-force":
