@@ -585,6 +585,11 @@ class StorageRole:
         finally:
             await conn.close()
 
+    def _log_lock_lazy(self) -> asyncio.Lock:
+        if self._log_lock is None:
+            self._log_lock = asyncio.Lock()
+        return self._log_lock
+
     def _cond_lazy(self) -> asyncio.Condition:
         if self._cond is None:
             self._cond = asyncio.Condition()
@@ -606,9 +611,7 @@ class StorageRole:
         lock: log records must hit the disk in version order (replay
         skips any version at or below the restart cursor, so an
         out-of-order pair would silently drop the lower one)."""
-        if self._log_lock is None:
-            self._log_lock = asyncio.Lock()
-        async with self._log_lock:
+        async with self._log_lock_lazy():
             await asyncio.get_event_loop().run_in_executor(
                 None, self._log_apply_durably, reqs
             )
@@ -642,9 +645,7 @@ class StorageRole:
                         # another executor thread and the native queue
                         # does no internal locking — serialize through
                         # _log_lock (ADVICE r4)
-                        if self._log_lock is None:
-                            self._log_lock = asyncio.Lock()
-                        async with self._log_lock:
+                        async with self._log_lock_lazy():
                             await asyncio.get_event_loop().run_in_executor(
                                 None, lsm_flush
                             )
@@ -665,9 +666,7 @@ class StorageRole:
                         # same WAL push/pop race as the LSM branch above:
                         # _compact_log must not run concurrently with
                         # _log_apply_durably on the unlocked native queue
-                        if self._log_lock is None:
-                            self._log_lock = asyncio.Lock()
-                        async with self._log_lock:
+                        async with self._log_lock_lazy():
                             await asyncio.get_event_loop().run_in_executor(
                                 None, install
                             )

@@ -254,7 +254,7 @@ class TpuConflictSet:
             return
         ssl = getattr(self.config, "short_span_limit", 0)
         unroll = getattr(self.config, "fixpoint_unroll", 3)
-        st, outs = _resolve_group_jit(ssl, unroll, False)(
+        _, outs = _resolve_group_jit(ssl, unroll, False)(
             self.state, stacked_args
         )
         jax.block_until_ready(outs.verdict)
