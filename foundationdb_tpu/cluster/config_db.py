@@ -2,36 +2,180 @@
 
 Behavioral mirror of the reference's dynamic-knobs subsystem
 (design/dynamic-knobs.md; fdbserver/ConfigNode.actor.cpp +
-ConfigBroadcaster.actor.cpp + LocalConfiguration.actor.cpp), using this
-build's own primitives: overrides are committed transactionally into the
-`\\xff/conf/` keyspace (the ConfigNode's versioned store), and each
-process's LocalConfiguration watches the generation key and re-applies
-the full override set to its live Knobs object when it changes — roles
-see knob changes without restarts, in commit order.
+PaxosConfigConsumer.actor.cpp + ConfigBroadcaster.actor.cpp +
+LocalConfiguration.actor.cpp), using this build's own primitives:
+
+* The AUTHORITATIVE override set lives on the coordinators through
+  CoordinatedState (PaxosConfigStore below) — the reference's ConfigNode
+  quorum. Knob data therefore survives coordinator minority loss and
+  does not depend on the data plane (tlogs/storage) being recoverable.
+* Each committed change is then broadcast by writing the overrides into
+  the `\\xff/conf/` keyspace and bumping a generation key; every
+  process's LocalConfiguration watches the generation key and re-applies
+  the full override set to its live Knobs object when it changes — roles
+  see knob changes without restarts, in commit order (the
+  ConfigBroadcaster push path).
+* After a data-plane wipe/recovery, `restore_broadcast` re-seeds the
+  keyspace from the quorum (PaxosConfigConsumer catching a broadcaster
+  up from the ConfigNodes).
 """
 
 from __future__ import annotations
 
 from foundationdb_tpu.runtime.flow import ActorCancelled, Scheduler
 from foundationdb_tpu.utils.knobs import Knobs
+from foundationdb_tpu.utils.probes import code_probe, declare
+
+declare(
+    "config.quorum_write",
+    "config.quorum_write_raced",
+    "config.restored_from_quorum",
+)
 
 CONF_PREFIX = b"\xff/conf/"
 CONF_GENERATION = b"\xff/confGeneration"
+#: quorum generation of the last broadcast landed in the keyspace —
+#: orders racing broadcasts (see _broadcast)
+CONF_QUORUM_GEN = b"\xff/confQuorumGeneration"
+
+
+class PaxosConfigStore:
+    """Quorum-held knob overrides (fdbserver/ConfigNode.actor.cpp).
+
+    The value in CoordinatedState is {"generation": int, "overrides":
+    {name: repr(value)}}. Mutations are read-modify-write rounds;
+    StaleGeneration (a racing writer / deposed generation) retries with
+    a fresh read, exactly like PaxosConfigTransaction's commit loop.
+    """
+
+    RETRIES = 8
+
+    def __init__(self, sched, coordinators, client_id: str = "config"):
+        from foundationdb_tpu.cluster.coordination import CoordinatedState
+
+        self._sched = sched
+        self._cs = CoordinatedState(sched, coordinators, client_id)
+
+    async def snapshot(self) -> tuple[int, dict]:
+        val = await self._cs.read()
+        if not val:
+            return 0, {}
+        return val["generation"], dict(val["overrides"])
+
+    async def _mutate(self, fn) -> tuple[int, dict]:
+        from foundationdb_tpu.cluster.coordination import StaleGeneration
+
+        for _attempt in range(self.RETRIES):
+            gen, overrides = await self.snapshot()
+            fn(overrides)
+            # a real client pays at least a network round between its
+            # read and its write; the in-process Coordinator stubs never
+            # suspend, so without this yield two RMW rounds could never
+            # interleave and the raced path would be unreachable in sim
+            await self._sched.delay(0)
+            try:
+                await self._cs.write(
+                    {"generation": gen + 1, "overrides": overrides}
+                )
+            except StaleGeneration:
+                code_probe(True, "config.quorum_write_raced")
+                continue
+            code_probe(True, "config.quorum_write")
+            return gen + 1, overrides
+        raise StaleGeneration("knob write outran %d times" % self.RETRIES)
+
+    async def set(self, name: str, raw: bytes) -> tuple[int, dict]:
+        return await self._mutate(lambda o: o.__setitem__(name, raw))
+
+    async def clear(self, name: str) -> tuple[int, dict]:
+        return await self._mutate(lambda o: o.pop(name, None))
+
+
+def _quorum_store(db) -> "PaxosConfigStore | None":
+    cluster = getattr(db, "cluster", None)
+    if cluster is None or not getattr(cluster, "config_nodes", None):
+        return None
+    store = getattr(cluster, "_config_store", None)
+    if store is None:
+        store = PaxosConfigStore(cluster.sched, cluster.config_nodes)
+        cluster._config_store = store
+    return store
+
+
+async def _broadcast(db, gen: int, overrides: dict, *,
+                     force: bool = False) -> None:
+    """Commit the FULL override set into `\\xff/conf/` + bump the
+    generation key (the ConfigBroadcaster push: watchers re-apply).
+
+    Ordered by the QUORUM generation: the snapshot read of
+    CONF_QUORUM_GEN is a conflict range, so two racing broadcasts
+    serialize — the one carrying the older quorum state either aborts
+    and re-reads or sees a newer stored generation and stands down.
+    Without this, a slower writer's clear_range+rewrite could land
+    AFTER a newer one and silently un-apply an acked knob cluster-wide.
+    """
+    from foundationdb_tpu.cluster.commit_proxy import NotCommitted
+
+    for _attempt in range(8):
+        txn = db.create_transaction()
+        cur_raw = await txn.get(CONF_QUORUM_GEN)
+        cur = int.from_bytes(cur_raw, "big") if cur_raw else 0
+        if cur >= gen and not force:
+            return  # a broadcast at least this new already landed
+        txn.clear_range(CONF_PREFIX, CONF_PREFIX + b"\xff")
+        for name, raw in overrides.items():
+            txn.set(CONF_PREFIX + name.encode(), raw)
+        txn.set(CONF_QUORUM_GEN, gen.to_bytes(8, "big"))
+        txn.add(CONF_GENERATION, 1)
+        try:
+            await txn.commit()
+            return
+        except NotCommitted:
+            continue  # raced: re-read the stored generation
+    raise NotCommitted("knob broadcast raced out 8 times")
 
 
 async def set_knob(db, name: str, value) -> None:
-    """Commit one knob override (fdbcli `setknob`)."""
-    txn = db.create_transaction()
-    txn.set(CONF_PREFIX + name.encode(), repr(value).encode())
-    txn.add(CONF_GENERATION, 1)
-    await txn.commit()
+    """Commit one knob override (fdbcli `setknob`): quorum first —
+    the write is durable once the coordinators accept it — then the
+    keyspace broadcast."""
+    store = _quorum_store(db)
+    if store is None:  # no coordinators (bare DB): keyspace only
+        txn = db.create_transaction()
+        txn.set(CONF_PREFIX + name.encode(), repr(value).encode())
+        txn.add(CONF_GENERATION, 1)
+        await txn.commit()
+        return
+    gen, overrides = await store.set(name, repr(value).encode())
+    await _broadcast(db, gen, overrides)
 
 
 async def clear_knob(db, name: str) -> None:
-    txn = db.create_transaction()
-    txn.clear(CONF_PREFIX + name.encode())
-    txn.add(CONF_GENERATION, 1)
-    await txn.commit()
+    store = _quorum_store(db)
+    if store is None:
+        txn = db.create_transaction()
+        txn.clear(CONF_PREFIX + name.encode())
+        txn.add(CONF_GENERATION, 1)
+        await txn.commit()
+        return
+    gen, overrides = await store.clear(name)
+    await _broadcast(db, gen, overrides)
+
+
+async def restore_broadcast(db) -> dict:
+    """Re-seed `\\xff/conf/` from the coordinator quorum — the recovery
+    path after data-plane loss (the broadcaster's snapshot-from-
+    ConfigNodes catch-up). Returns the restored overrides."""
+    store = _quorum_store(db)
+    if store is None:
+        return {}
+    gen, overrides = await store.snapshot()
+    code_probe(bool(overrides), "config.restored_from_quorum")
+    # force: the keyspace copy may have been wiped while the stored
+    # CONF_QUORUM_GEN survived (partial loss) — restore must overwrite
+    # regardless; the read still serializes racing broadcasts
+    await _broadcast(db, gen, overrides, force=True)
+    return await read_overrides(db)
 
 
 async def read_overrides(db) -> dict[str, object]:

@@ -172,6 +172,14 @@ class Cluster:
         self.coordinators = [
             Coordinator(f"coord{i}") for i in range(cfg.n_coordinators)
         ]
+        # Dynamic-knob quorum registers (fdbserver/ConfigNode.actor.cpp):
+        # a SEPARATE generation-disciplined register per coordinator host
+        # — the leader-election register above holds the LeaderLease and
+        # cannot double as the knob store. Killed/revived with their
+        # coordinator (colocated role).
+        self.config_nodes = [
+            Coordinator(f"confignode{i}") for i in range(cfg.n_coordinators)
+        ]
 
         self.build_proxies(epoch=1)
         from foundationdb_tpu.cluster.balancer import ResolutionBalancer
@@ -278,10 +286,14 @@ class Cluster:
             new.start()
 
     def kill_coordinator(self, i: int) -> None:
+        # the ConfigNode register is colocated with the coordinator
+        # (one host in the reference deployment): it dies with it
         self.coordinators[i].kill()
+        self.config_nodes[i].kill()
 
     def revive_coordinator(self, i: int) -> None:
         self.coordinators[i].revive()
+        self.config_nodes[i].revive()
 
     def kill_tlog(self, i: int) -> None:
         """Mark a log replica dead; commits continue on the survivors."""

@@ -55,6 +55,11 @@ class SeedPlan:
     tlog_spill: bool           # tiny spill budget + lagging consumer:
     #                            old versions spill by reference and the
     #                            catch-up peek reads them off the queue
+    # round-5 fault classes
+    knob_quorum: bool          # dynamic-knob writes race through the
+    #                            ConfigNode quorum under a coordinator
+    #                            minority kill; the broadcast copy is
+    #                            wiped and restored from the quorum
 
 
 def plan_for_seed(seed: int) -> SeedPlan:
@@ -86,6 +91,7 @@ def plan_for_seed(seed: int) -> SeedPlan:
         tag_quota=bool(r.random() < 0.3),
         silent_kill=bool(r.random() < 0.35),
         tlog_spill=bool(r.random() < 0.35),
+        knob_quorum=bool(r.random() < 0.35),
     )
 
 
@@ -368,6 +374,57 @@ def run_seed(seed: int, collect_probes: bool = False):
                 await sched.delay(0.5)
                 lag_ss.slowdown = 0.0
                 await sched.delay(0.3)  # drain the spilled tail
+            if plan.knob_quorum:
+                # knob writes race through the ConfigNode quorum while a
+                # coordinator minority is down; then the broadcast copy
+                # is wiped and must come back from the quorum alone
+                from foundationdb_tpu.cluster.config_db import (
+                    CONF_PREFIX,
+                    PaxosConfigStore,
+                    restore_broadcast,
+                )
+                from foundationdb_tpu.cluster.coordination import (
+                    QuorumUnreachable,
+                    StaleGeneration,
+                )
+
+                await sched.delay(0.06)
+                victim = int(rng.integers(0, 3))
+                cluster.kill_coordinator(victim)
+                ws = [
+                    PaxosConfigStore(
+                        sched, cluster.config_nodes, f"soak-knob-{i}"
+                    )
+                    for i in (0, 1)
+                ]
+                tasks = [
+                    sched.spawn(w.set("SOAK_KNOB_%d" % i, b"%d" % i))
+                    for i, w in enumerate(ws)
+                ]
+                landed = {}
+                for i, t in enumerate(tasks):
+                    try:
+                        await t.done
+                        landed["SOAK_KNOB_%d" % i] = i
+                    except (QuorumUnreachable, StaleGeneration):
+                        # composed chaos (coordinator_outage) can take
+                        # the quorum below majority: failing loudly is
+                        # the write's correct behavior
+                        pass
+                cluster.revive_coordinator(victim)
+                try:
+                    txn = db.create_transaction()
+                    txn.clear_range(CONF_PREFIX, CONF_PREFIX + b"\xff")
+                    await txn.commit()
+                    restored = await restore_broadcast(db)
+                    # every ACKED quorum write must come back; writes
+                    # that failed loudly carry no promise
+                    for k, v in landed.items():
+                        assert restored.get(k) == v, (k, restored)
+                except retryable:
+                    pass  # data-plane chaos may abort the broadcast txn
+                except (QuorumUnreachable, StaleGeneration):
+                    pass  # quorum still degraded at restore time
             if plan.kill_proxy:
                 await sched.delay(0.1)
                 p = cluster.commit_proxies[0]

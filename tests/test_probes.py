@@ -298,6 +298,38 @@ def test_every_declared_probe_fires():
     assert t.done.get()
     cluster4.stop()
 
+    # -- dynamic-knob quorum: write / race / restore ----------------------
+    from foundationdb_tpu.cluster.config_db import (
+        CONF_PREFIX,
+        PaxosConfigStore,
+        restore_broadcast,
+        set_knob,
+    )
+
+    sched5, cluster5, db5 = open_cluster(ClusterConfig(n_storage=2))
+    wa = PaxosConfigStore(sched5, cluster5.config_nodes, "probe-a")
+    wb = PaxosConfigStore(sched5, cluster5.config_nodes, "probe-b")
+
+    async def knob_paths():
+        cluster5.kill_coordinator(0)  # minority: writes must still land
+        ta = sched5.spawn(wa.set("KA", b"1"))  # race at the RMW yield
+        tb = sched5.spawn(wb.set("KB", b"2"))
+        await ta.done
+        await tb.done
+        cluster5.revive_coordinator(0)
+        await set_knob(db5, "KC", 3)
+        txn = db5.create_transaction()
+        txn.clear_range(CONF_PREFIX, CONF_PREFIX + b"\xff")
+        await txn.commit()
+        restored = await restore_broadcast(db5)
+        assert restored["KC"] == 3
+        return True
+
+    t = sched5.spawn(knob_paths(), name="drive")
+    sched5.run_until(t.done)
+    assert t.done.get()
+    cluster5.stop()
+
     assert probes.missed() == [], (
         f"declared CODE_PROBEs never fired: {probes.missed()}\n"
         f"fired: { {k: v for k, v in probes.snapshot().items() if v} }"
