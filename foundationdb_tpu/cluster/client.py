@@ -342,12 +342,17 @@ class Database:
     READ_ATTEMPTS = 8
 
     def __init__(self, cluster):
+        from foundationdb_tpu.cluster.queue_model import QueueModel
+
         self.cluster = cluster
         self.sched = cluster.sched
         self._next_proxy = 0
         self._read_rr = 0  # replica rotation (loadBalance's next-replica)
         self.location_cache = LocationCache(cluster)
         self.dr_locked = False  # set while this db is a DR destination
+        # per-replica latency estimates driving read load balancing
+        # (fdbrpc/QueueModel.cpp; see cluster/queue_model.py)
+        self.queue_model = QueueModel(cluster.sched)
 
     @property
     def grv_proxy(self):
@@ -362,15 +367,21 @@ class Database:
         self._next_proxy += 1
         return p
 
-    def _pick_replica(self, team: tuple) -> int:
-        """Rotate over the LIVE members of a team (fdbrpc/LoadBalance:
-        replica selection; dead replicas are skipped — the failure-
-        monitor contract)."""
+    def _live_rotated(self, team: tuple) -> list:
+        """LIVE members of a team, rotated so latency-tied (cold)
+        replicas share load round-robin (dead replicas are skipped —
+        the failure-monitor contract)."""
         live = [s for s in team if self.cluster.storage_live[s]]
         if not live:
             live = list(team)  # nothing marked live: fall back, will hang
         self._read_rr += 1
-        return live[self._read_rr % len(live)]
+        k = self._read_rr % len(live)
+        return live[k:] + live[:k]
+
+    def _pick_replica(self, team: tuple) -> int:
+        """Best replica by the QueueModel latency estimate
+        (fdbrpc/LoadBalance.actor.h replica selection)."""
+        return self.queue_model.order(self._live_rotated(team))[0]
 
     def storage_for(self, key: bytes):
         _b, _e, team = self.location_cache.locate(key)
@@ -394,18 +405,34 @@ class Database:
             WrongShardServerError,
         )
 
+        from foundationdb_tpu.cluster.queue_model import load_balanced_call
+
+        def issue(s):
+            async def go():
+                try:
+                    return await self.cluster.client_storages[s].get_value(
+                        key, rv
+                    )
+                except ProcessFailedError:
+                    # report at the issuing site: the balancer only sees
+                    # "some replica failed", the monitor needs WHICH
+                    self._report_failed(s)
+                    raise
+            return go()
+
         err = None
         for _ in range(self.READ_ATTEMPTS):
             _b, _e, team = self.location_cache.locate(key)
-            s = self._pick_replica(team)
             try:
-                return await self.cluster.client_storages[s].get_value(key, rv)
+                return await load_balanced_call(
+                    self.sched, self.queue_model,
+                    self._live_rotated(team), issue,
+                )
             except WrongShardServerError as e:
                 err = e
                 self.location_cache.invalidate(key)
             except ProcessFailedError as e:
                 err = e
-                self._report_failed(s)
             except TransactionTooOld:
                 # the storage GC'd past our read version: surface the
                 # CLIENT-level retryable error (error_code_transaction_
@@ -431,12 +458,15 @@ class Database:
             _b, seg_e, team = self.location_cache.locate(cursor)
             seg_end = end if seg_e == b"" else min(seg_e, end)
             s = self._pick_replica(team)
+            t0 = self.queue_model.start(s)
+            ok = False
             try:
                 items.extend(
                     await self.cluster.client_storages[s].get_key_values(
                         cursor, seg_end, rv
                     )
                 )
+                ok = True
             except WrongShardServerError:
                 self.location_cache.invalidate(cursor)
                 attempts += 1
@@ -453,6 +483,11 @@ class Database:
                 raise TransactionTooOldError(
                     f"read at {rv} below the storage MVCC window"
                 )
+            finally:
+                # finally, not per-handler: an unexpected error (or the
+                # task being cancelled at the await) must not leak the
+                # outstanding increment and bias reads off this replica
+                self.queue_model.finish(s, t0, failed=not ok)
             cursor = seg_end
             # budget retries per segment, not per scan: a long range
             # crossing many concurrently-moving shards must not exhaust

@@ -85,6 +85,10 @@ class StorageServer:
         #: disk/IO path; the Ratekeeper must observe the growing lag and
         #: throttle admission — Ratekeeper.actor.cpp's control input)
         self.slowdown = 0.0
+        #: fault injection on the READ path: extra seconds per get —
+        #: a slow-but-alive replica; the client QueueModel (not the
+        #: failure monitor) is what must shed load off it
+        self.read_slowdown = 0.0
 
     def start(self) -> None:
         self.stopped = False
@@ -364,6 +368,8 @@ class StorageServer:
 
     async def get_value(self, key: bytes, version: int) -> Optional[bytes]:
         self._check_shard_floor(key, key + b"\x00", version)  # fail fast
+        if self.read_slowdown:
+            await self.sched.delay(self.read_slowdown)
         await self._wait_for_version(version)
         self._check_shard_floor(key, key + b"\x00", version)
         return self._value_at(key, version)
@@ -372,6 +378,8 @@ class StorageServer:
         self, begin: bytes, end: bytes, version: int, *, limit: int = 1 << 30
     ) -> list[tuple[bytes, bytes]]:
         self._check_shard_floor(begin, end, version)  # fail fast
+        if self.read_slowdown:
+            await self.sched.delay(self.read_slowdown)
         await self._wait_for_version(version)
         self._check_shard_floor(begin, end, version)
         lo = bisect.bisect_left(self._keys, begin)

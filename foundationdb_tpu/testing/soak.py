@@ -332,8 +332,12 @@ def run_seed(seed: int, collect_probes: bool = False):
                 old = (rk.lag_target, rk.lag_limit, rk.interval)
                 rk.lag_target, rk.lag_limit, rk.interval = 40_000, 300_000, 0.05
                 ss.slowdown = 0.1
+                # slow READS too: the client QueueModel must shed load /
+                # fire backup requests at the slow-but-alive replica
+                ss.read_slowdown = 0.02
                 await sched.delay(0.6)
                 ss.slowdown = 0.0
+                ss.read_slowdown = 0.0
                 await sched.delay(0.4)  # drain under throttle
                 rk.lag_target, rk.lag_limit, rk.interval = old
             if plan.crash_tlog and plan.n_tlogs > 1:

@@ -330,6 +330,33 @@ def test_every_declared_probe_fires():
     assert t.done.get()
     cluster5.stop()
 
+    # -- QueueModel load balancing: backup request / shun -----------------
+    sched6, cluster6, db6 = open_cluster(
+        ClusterConfig(n_storage=2, replication_factor=2)
+    )
+
+    async def lb_paths():
+        txn = db6.create_transaction()
+        txn.set(b"lb", b"v")
+        await txn.commit()
+        cluster6.storage_servers[0].read_slowdown = 0.05
+        for _ in range(10):
+            t = db6.create_transaction()
+            assert await t.get(b"lb") == b"v"
+        # let the duplicated slow request COMPLETE so its latency lands
+        # in the model (0.2s < STALE_AFTER: no decay) — the shun probe
+        # requires a genuinely slow estimate, not a cold-start artifact
+        await sched6.delay(0.2)
+        for _ in range(10):
+            t = db6.create_transaction()
+            assert await t.get(b"lb") == b"v"
+        return True
+
+    t = sched6.spawn(lb_paths(), name="drive")
+    sched6.run_until(t.done)
+    assert t.done.get()
+    cluster6.stop()
+
     assert probes.missed() == [], (
         f"declared CODE_PROBEs never fired: {probes.missed()}\n"
         f"fired: { {k: v for k, v in probes.snapshot().items() if v} }"
