@@ -128,7 +128,7 @@ async def load_balanced_call(sched, model: QueueModel, replicas: list,
     stalled replica slow). Errors surface from whichever request fails
     last-standing.
     """
-    from foundationdb_tpu.runtime.flow import any_of
+    from foundationdb_tpu.runtime.flow import ActorCancelled, any_of
 
     order = model.order(replicas)
     primary = order[0]
@@ -159,6 +159,9 @@ async def load_balanced_call(sched, model: QueueModel, replicas: list,
     )
     try:
         await any_of([pt.done, sched.delay(backup_after)])
+    except ActorCancelled:
+        model.finish(primary, t0, failed=True)
+        raise  # cancellation must not leak the outstanding increment
     except BaseException:
         pass  # a primary error is handled by inspecting pt.done below
     if pt.done.is_ready:
@@ -177,6 +180,10 @@ async def load_balanced_call(sched, model: QueueModel, replicas: list,
     bt = sched.spawn(issue(secondary), name="lb-backup")
     try:
         await any_of([pt.done, bt.done])
+    except ActorCancelled:
+        model.finish(primary, t0, failed=True)
+        model.finish(secondary, t1, failed=True)
+        raise
     except BaseException:
         pass  # per-request errors handled below
     first, other = (pt, bt) if pt.done.is_ready else (bt, pt)

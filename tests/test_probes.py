@@ -357,6 +357,38 @@ def test_every_declared_probe_fires():
     assert t.done.get()
     cluster6.stop()
 
+    # -- blob granules: flush / resnapshot / split / time travel ----------
+    from foundationdb_tpu.cluster.backup import BackupContainer
+    from foundationdb_tpu.cluster.blob_granules import BlobManager, BlobWorker
+
+    sched7, cluster7, db7 = open_cluster(ClusterConfig(n_storage=2))
+    bw = BlobWorker(sched7, cluster7.tlog, BackupContainer())
+    bw.start()
+    bmgr = BlobManager(db7, [bw])
+
+    async def blob_paths():
+        await bmgr.blobbify(b"", b"", {}, 0)
+        txn = db7.create_transaction()
+        txn.set(b"bg-first", b"1")
+        await txn.commit()
+        v_past = cluster7.tlog.version.get()
+        await sched7.delay(0.05)
+        val = b"z" * 512
+        for i in range(160):  # crosses flush, resnapshot AND split bars
+            txn = db7.create_transaction()
+            txn.set(b"bg%04d" % i, val)
+            await txn.commit()
+        await sched7.delay(0.3)
+        past = bmgr.read(b"", b"", v_past)
+        assert past.get(b"bg-first") == b"1" and b"bg0000" not in past
+        return True
+
+    t = sched7.spawn(blob_paths(), name="drive")
+    sched7.run_until(t.done)
+    assert t.done.get()
+    bw.stop()
+    cluster7.stop()
+
     assert probes.missed() == [], (
         f"declared CODE_PROBEs never fired: {probes.missed()}\n"
         f"fired: { {k: v for k, v in probes.snapshot().items() if v} }"
