@@ -114,6 +114,10 @@ class BlobWorker:
         if self._task is not None:
             self._task.cancel()
             self._task = None
+        if hasattr(self.tlog, "unregister_consumer"):
+            # a stopped worker must not pin the full log stream: its pop
+            # mark would freeze the tlog's trim floor forever
+            self.tlog.unregister_consumer(self.name)
 
     def assign(self, g: Granule) -> None:
         self.granules[g.gid] = g
@@ -124,24 +128,21 @@ class BlobWorker:
     # -- the log tail ----------------------------------------------------
 
     async def _pull(self) -> None:
-        try:
-            after = self.version
-            while True:
-                got, log_version = await self.tlog.peek(self._tag, after)
-                for v, msgs in got:
-                    for m in msgs:
-                        self._route(v, m)
-                after = max(log_version, max((v for v, _ in got), default=0))
-                self.version = after
-                # snapshot the dict: a flush can trigger a manager split
-                # that assigns the new child granule to this worker
-                for g in list(self.granules.values()):
-                    if g.buffer_bytes >= self.DELTA_FLUSH_BYTES:
-                        self._flush_delta(g)
-                self.tlog.pop(self._tag, after, consumer=self.name)
-                await self.tlog.version.when_at_least(after + 1)
-        except ActorCancelled:
-            raise
+        after = self.version
+        while True:
+            got, log_version = await self.tlog.peek(self._tag, after)
+            for v, msgs in got:
+                for m in msgs:
+                    self._route(v, m)
+            after = max(log_version, max((v for v, _ in got), default=0))
+            self.version = after
+            # snapshot the dict: a flush can trigger a manager split
+            # that assigns the new child granule to this worker
+            for g in list(self.granules.values()):
+                if g.buffer_bytes >= self.DELTA_FLUSH_BYTES:
+                    self._flush_delta(g)
+            self.tlog.pop(self._tag, after, consumer=self.name)
+            await self.tlog.version.when_at_least(after + 1)
 
     def _route(self, v: int, m) -> None:
         if m[0] == "set":
@@ -156,7 +157,7 @@ class BlobWorker:
             _, cb, ce = m
             for g in self.granules.values():
                 lo = max(cb, g.begin)
-                hi = min(ce, g.end)
+                hi = ce if g.end == b"" else min(ce, g.end)
                 if lo < hi:
                     g.buffer.append((v, ("clear", lo, hi)))
                     g.buffer_bytes += len(lo) + len(hi) + 16
@@ -283,6 +284,12 @@ class BlobManager:
         lives there."""
         if end == b"" or end > b"\xff":
             end = b"\xff"
+        for other in self.granules.values():
+            if begin < other.end and other.begin < end:
+                raise ValueError(
+                    f"range overlaps granule {other.gid} "
+                    f"[{other.begin!r}, {other.end!r})"
+                )
         g = Granule(self._next_gid, begin, end, [])
         self._next_gid += 1
         self.granules[g.gid] = g
@@ -372,14 +379,17 @@ class BlobManager:
         else:
             version_eff = version
         code_probe(version is not None, "blob.time_travel_read")
-        # list(): force_flush can split a granule mid-iteration
+        # flush FIRST, then snapshot the granule list: a flush-triggered
+        # split narrows a parent and creates a child, and a list taken
+        # before the flush would miss the child's half of the keyspace
+        for w in {self.assignment[g.gid] for g in list(self.granules.values())}:
+            w.force_flush(version_eff)
         for g in list(self.granules.values()):
             if g.end != b"" and g.end <= begin:
                 continue
             if end != b"" and g.begin >= end:
                 continue
             w = self.assignment[g.gid]
-            w.force_flush(version_eff)
             for k, val in w.materialize(g, version_eff).items():
                 if k >= begin and (end == b"" or k < end):
                     out[k] = val

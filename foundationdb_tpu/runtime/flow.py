@@ -32,6 +32,10 @@ import heapq
 import time as _time
 from typing import Any, Awaitable, Callable, Generator, Iterable, Optional
 
+from foundationdb_tpu.utils.probes import declare
+
+declare("runtime.slow_task")
+
 
 class ActorCancelled(BaseException):
     """Raised inside an actor when its task is cancelled (actor_cancelled)."""
@@ -277,6 +281,13 @@ class Task:
     def _step(self, fut: Optional[Future]) -> None:
         if self.done.is_ready or self._cancelled:
             return
+        t0 = _time.perf_counter()
+        try:
+            self._step_inner(fut)
+        finally:
+            self._sched._note_step(self._name, _time.perf_counter() - t0)
+
+    def _step_inner(self, fut: Optional[Future]) -> None:
         try:
             if fut is not None and fut.is_error:
                 waited = self._coro.throw(fut._error)
@@ -324,12 +335,55 @@ class Scheduler:
     sim=False — timers wait on the wall clock (time.monotonic).
     """
 
+    #: one actor step blocking the loop longer than this (WALL seconds)
+    #: is a slow task: the single-threaded run loop serves nothing else
+    #: meanwhile (flow/Net2.actor.cpp:1462 checkForSlowTask)
+    SLOW_TASK_THRESHOLD = 0.05
+
     def __init__(self, *, sim: bool = True, start_time: float = 0.0):
         self.sim = sim
         self._now = start_time if sim else _time.monotonic()
         self._seq = 0
         self._heap: list[tuple[float, int, int, Callable[[], None]]] = []
         self._running = False
+        #: per-actor-name step profile: [steps, total_wall_s, max_wall_s]
+        #: — the ActorLineageProfiler collapsed to what a single-threaded
+        #: deterministic loop can measure honestly (every step IS
+        #: sampled, no thread required)
+        self.actor_profile: dict[str, list] = {}
+        self.slow_tasks: list[tuple[str, float]] = []
+
+    def _note_step(self, name: str, elapsed: float) -> None:
+        st = self.actor_profile.get(name)
+        if st is None:
+            st = self.actor_profile[name] = [0, 0.0, 0.0]
+        st[0] += 1
+        st[1] += elapsed
+        if elapsed > st[2]:
+            st[2] = elapsed
+        if elapsed > self.SLOW_TASK_THRESHOLD:
+            if len(self.slow_tasks) >= 256:  # bounded, like trace rolls
+                del self.slow_tasks[:128]
+            from foundationdb_tpu.utils.probes import code_probe
+
+            code_probe(True, "runtime.slow_task")
+            self.slow_tasks.append((name, elapsed))
+            from foundationdb_tpu.utils.trace import SEV_WARN, TraceEvent
+
+            TraceEvent("SlowTask", severity=SEV_WARN).detail(
+                "Actor", name
+            ).detail("Ms", round(elapsed * 1e3, 1)).log()
+
+    def profile_top(self, n: int = 10) -> list[tuple[str, int, float, float]]:
+        """Top actors by cumulative wall time in their steps: (name,
+        steps, total_s, max_step_s) — the profiler surface the reference
+        gets from ActorLineageProfiler sampling."""
+        rows = [
+            (name, st[0], st[1], st[2])
+            for name, st in self.actor_profile.items()
+        ]
+        rows.sort(key=lambda r: -r[2])
+        return rows[:n]
 
     # -- time -------------------------------------------------------------
 

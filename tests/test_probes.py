@@ -389,6 +389,17 @@ def test_every_declared_probe_fires():
     bw.stop()
     cluster7.stop()
 
+    # -- slow-task detection ----------------------------------------------
+    import time as _t
+
+    sched8 = Scheduler(sim=True)
+
+    async def _blocker():
+        _t.sleep(Scheduler.SLOW_TASK_THRESHOLD + 0.01)
+
+    sched8.run_until(sched8.spawn(_blocker(), name="probe-blocker").done)
+    assert sched8.slow_tasks
+
     assert probes.missed() == [], (
         f"declared CODE_PROBEs never fired: {probes.missed()}\n"
         f"fired: { {k: v for k, v in probes.snapshot().items() if v} }"
