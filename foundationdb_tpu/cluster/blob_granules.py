@@ -381,8 +381,19 @@ class BlobManager:
         code_probe(version is not None, "blob.time_travel_read")
         # flush FIRST, then snapshot the granule list: a flush-triggered
         # split narrows a parent and creates a child, and a list taken
-        # before the flush would miss the child's half of the keyspace
-        for w in {self.assignment[g.gid] for g in list(self.granules.values())}:
+        # before the flush would miss the child's half of the keyspace.
+        # Only workers owning RANGE-OVERLAPPING granules flush (children
+        # stay on the parent's worker), and dict.fromkeys keeps the
+        # iteration order deterministic — a set of objects would flush
+        # in id() order and let split gid allocation diverge between
+        # same-seed runs
+        overlapping = [
+            self.assignment[g.gid]
+            for g in list(self.granules.values())
+            if not (g.end != b"" and g.end <= begin)
+            and not (end != b"" and g.begin >= end)
+        ]
+        for w in dict.fromkeys(overlapping):
             w.force_flush(version_eff)
         for g in list(self.granules.values()):
             if g.end != b"" and g.end <= begin:

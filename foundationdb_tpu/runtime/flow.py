@@ -32,7 +32,7 @@ import heapq
 import time as _time
 from typing import Any, Awaitable, Callable, Generator, Iterable, Optional
 
-from foundationdb_tpu.utils.probes import declare
+from foundationdb_tpu.utils.probes import code_probe, declare
 
 declare("runtime.slow_task")
 
@@ -285,7 +285,12 @@ class Task:
         try:
             self._step_inner(fut)
         finally:
-            self._sched._note_step(self._name, _time.perf_counter() - t0)
+            sched = self._sched
+            elapsed = _time.perf_counter() - t0
+            # fast path: two clock reads + one compare per step; the
+            # full per-actor profile is opt-in (Scheduler(profile=True))
+            if sched._profile or elapsed > sched.SLOW_TASK_THRESHOLD:
+                sched._note_step(self._name, elapsed)
 
     def _step_inner(self, fut: Optional[Future]) -> None:
         try:
@@ -340,8 +345,10 @@ class Scheduler:
     #: meanwhile (flow/Net2.actor.cpp:1462 checkForSlowTask)
     SLOW_TASK_THRESHOLD = 0.05
 
-    def __init__(self, *, sim: bool = True, start_time: float = 0.0):
+    def __init__(self, *, sim: bool = True, start_time: float = 0.0,
+                 profile: bool = False):
         self.sim = sim
+        self._profile = profile
         self._now = start_time if sim else _time.monotonic()
         self._seq = 0
         self._heap: list[tuple[float, int, int, Callable[[], None]]] = []
@@ -364,8 +371,6 @@ class Scheduler:
         if elapsed > self.SLOW_TASK_THRESHOLD:
             if len(self.slow_tasks) >= 256:  # bounded, like trace rolls
                 del self.slow_tasks[:128]
-            from foundationdb_tpu.utils.probes import code_probe
-
             code_probe(True, "runtime.slow_task")
             self.slow_tasks.append((name, elapsed))
             from foundationdb_tpu.utils.trace import SEV_WARN, TraceEvent

