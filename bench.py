@@ -63,14 +63,19 @@ def main():
     # stream on the exact while kernel — loud fallback, never wrong.
     # Fixpoint depth per mode: the idealized model (scripts/
     # iters_model.py) says uniform 3 / zipf 6 / range 12, but the REAL
-    # uniform stream's history masks deepen chains past 4 (the latch
-    # tripped at both 3 and 4), and at depth >= 5 the latch's unrolled
-    # applications cost as much as the exact kernel's residual while —
-    # so uniform runs the EXACT kernel outright. zipf/range keep the
-    # latch with margin; a trip falls back to the exact kernel (loud,
-    # never wrong — the warm pass checks before any timed pass).
-    unroll = {"uniform": 3, "zipf": 8, "range": 14}[mode]
-    latch = mode != "uniform"
+    # uniform stream's history masks deepen chains past 4 (the r4 latch
+    # tripped at 3 and 4). r4 ran uniform on the EXACT kernel because at
+    # the old per-application cost unroll>=5 broke even with the
+    # residual while; the r5 kernel made applications ~2x cheaper
+    # (build2 min-tables in same_hits) and removed the cross table
+    # build, so uniform now runs LATCHED at depth 6 — six straight-line
+    # applications cost less than the while machinery's ~50ms presence
+    # tax + iteration overhead. A deeper-than-6 chain trips the latch
+    # and this script re-runs on the exact while kernel (loud, never
+    # wrong — the warm pass checks before any timed pass; the exact
+    # program is pre-warmed so the swap is not a compile stall).
+    unroll = {"uniform": 6, "zipf": 8, "range": 14}[mode]
+    latch = True
 
     import jax
 
@@ -133,18 +138,7 @@ def main():
         NativeSkipListConflictSet,
     )
 
-    def flat(batch, which):
-        begin = batch.read_begin if which == "r" else batch.write_begin
-        end = batch.read_end if which == "r" else batch.write_end
-        txn = batch.read_txn if which == "r" else batch.write_txn
-        n = batch.n_reads if which == "r" else batch.n_writes
-        w = (begin.shape[1] - 1) * 4
-        # interleave begin_i, end_i into one byte blob
-        kb = np.frombuffer(begin[:n, :-1].astype(">u4").tobytes(), np.uint8)
-        ke = np.frombuffer(end[:n, :-1].astype(">u4").tobytes(), np.uint8)
-        blob = np.stack([kb.reshape(n, w), ke.reshape(n, w)], axis=1).reshape(-1)
-        off = np.arange(2 * n + 1, dtype=np.int64) * w
-        return blob, off, txn[:n].astype(np.int32)
+    from foundationdb_tpu.testing.benchgen import flatten_for_native as flat
 
     flats = [(flat(b, "r"), flat(b, "w")) for b in batches]
 

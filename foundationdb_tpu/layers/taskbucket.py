@@ -67,15 +67,35 @@ class TaskBucket:
 
     # -- producer --------------------------------------------------------
 
+    def _blocked_prefix(self, after: bytes) -> bytes:
+        # length-prefixed parent key: task keys may contain b"/", so a
+        # plain separator would let finish(b"a") release tasks parked on
+        # b"a/b" (with corrupted child keys to boot)
+        return self._blocked + b"%08d/" % len(after) + after + b"/"
+
     async def add(self, key: bytes, params: dict,
                   after: Optional[bytes] = None) -> None:
         """Enqueue a task. With `after`, the task stays parked until the
-        task with that key finishes (FutureBucket dependency)."""
+        task with that key finishes (FutureBucket dependency). A parent
+        that is not present anywhere in the bucket counts as already
+        finished (the reference FutureBucket's isSet check): the task
+        enqueues immediately instead of parking forever."""
         txn = self.db.create_transaction()
-        if after is None:
-            txn.set(self._avail + key, _enc(params))
-        else:
-            txn.set(self._blocked + after + b"/" + key, _enc(params))
+        if after is not None:
+            parent_live = (
+                await txn.get(self._avail + after) is not None
+                or any(
+                    k.endswith(b"/" + after)
+                    for k, _ in await txn.get_range(
+                        self._timeout, self._timeout + b"\xff"
+                    )
+                )
+            )
+            if parent_live:
+                txn.set(self._blocked_prefix(after) + key, _enc(params))
+                await txn.commit()
+                return
+        txn.set(self._avail + key, _enc(params))
         await txn.commit()
 
     # -- executor --------------------------------------------------------
@@ -127,10 +147,18 @@ class TaskBucket:
         await txn.commit()
 
     async def finish(self, task: Task) -> None:
-        """Complete: remove the task and release anything parked on it."""
+        """Complete: remove the task and release anything parked on it.
+
+        Verifies the lease is still HELD first: a stale executor whose
+        task was requeued and re-claimed must not mark it done (and must
+        not release dependents under the new owner's feet) — it gets a
+        KeyError, like extend."""
         txn = self.db.create_transaction()
-        txn.clear(self._timeout_key(task))
-        pfx = self._blocked + task.key + b"/"
+        tk = self._timeout_key(task)
+        if await txn.get(tk) is None:
+            raise KeyError(f"lease lost for {task.key!r}")
+        txn.clear(tk)
+        pfx = self._blocked_prefix(task.key)
         parked = await txn.get_range(pfx, pfx + b"\xff")
         for k, raw in parked:
             txn.clear(k)
@@ -143,6 +171,8 @@ class TaskBucket:
     async def check_timeouts(self) -> int:
         """Requeue every task whose lease expired (run by ANY executor,
         like the reference's checkTimeouts sweep). Returns the count."""
+        from foundationdb_tpu.cluster.commit_proxy import NotCommitted
+
         now_us = int(self.db.sched.now() * 1e6)
         txn = self.db.create_transaction()
         expired = await txn.get_range(
@@ -155,7 +185,11 @@ class TaskBucket:
             txn.set(self._avail + key, raw)
             code_probe(True, "taskbucket.lease_expired_requeued")
         if expired:
-            await txn.commit()
+            try:
+                await txn.commit()
+            except NotCommitted:
+                return 0  # a concurrent sweep (any executor may run one)
+                #           won the race; its commit did the requeue
         return len(expired)
 
     async def is_empty(self) -> bool:

@@ -355,8 +355,10 @@ class Scheduler:
         self._running = False
         #: per-actor-name step profile: [steps, total_wall_s, max_wall_s]
         #: — the ActorLineageProfiler collapsed to what a single-threaded
-        #: deterministic loop can measure honestly (every step IS
-        #: sampled, no thread required)
+        #: deterministic loop can measure honestly. With profile=True
+        #: EVERY step is recorded (no sampling thread required); by
+        #: default only steps over SLOW_TASK_THRESHOLD land here, so
+        #: step counts/totals for fast actors are intentionally absent
         self.actor_profile: dict[str, list] = {}
         self.slow_tasks: list[tuple[str, float]] = []
 

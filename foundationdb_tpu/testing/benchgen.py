@@ -113,3 +113,22 @@ def skiplist_style_batch(
         write_txn=iota_w,
         write_valid=wvalid,
     )
+
+
+def flatten_for_native(batch, which: str):
+    """Flatten one side of a packed batch into the native ConflictBatch
+    ABI (interleaved big-endian begin/end key blob + offsets + txn ids)
+    — the single definition of the >u4 interleaving contract shared by
+    bench.py and scripts/sweep_small.py."""
+    import numpy as np
+
+    begin = batch.read_begin if which == "r" else batch.write_begin
+    end = batch.read_end if which == "r" else batch.write_end
+    txn = batch.read_txn if which == "r" else batch.write_txn
+    n = batch.n_reads if which == "r" else batch.n_writes
+    w = (begin.shape[1] - 1) * 4
+    kb = np.frombuffer(begin[:n, :-1].astype(">u4").tobytes(), np.uint8)
+    ke = np.frombuffer(end[:n, :-1].astype(">u4").tobytes(), np.uint8)
+    blob = np.stack([kb.reshape(n, w), ke.reshape(n, w)], axis=1).reshape(-1)
+    off = np.arange(2 * n + 1, dtype=np.int64) * w
+    return blob, off, txn[:n].astype(np.int32)

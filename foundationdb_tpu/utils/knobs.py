@@ -131,12 +131,21 @@ def make_server_knobs() -> Knobs:
     k.define("RESOLVER_BACKEND", "tpu")  # the resolver_backend knob
     # Below this batch capacity the TPU path cannot win: per-dispatch
     # overhead dominates and the CPU resolves a small batch in well
-    # under the device round trip (measured r4 — bench.py BENCH_SMALL=1
-    # small-batch sweep; see README). make_conflict_set auto-selects the
-    # CPU backend for configs under the threshold — a deliberate,
-    # measured TPU-first design decision: the accelerator serves the
-    # loaded/batched regime, the CPU serves the latency regime.
-    k.define("RESOLVER_TPU_MIN_BATCH", 8192)
+    # under the device round trip. The default is the MEASURED
+    # single-dispatch crossover (scripts/sweep_small.py on v5e,
+    # sweep_small_r5*.log; device-resident p50 vs CPU skiplist p50):
+    #   n:            512   2048   8192   16384  32768  65536
+    #   device txn/s: 4.2K  16.8K  64K    112K   203K   347K
+    #   cpu txn/s:    701K  756K   485K   543K   465K   338K
+    # — the device first beats the CPU at n=65536. (Under GROUPED
+    # dispatch, the loaded resolver's regime, the same device does
+    # ~0.9-1.1M txn/s at 64K batches — grouping, not batch size alone,
+    # is what the accelerator's advantage rides on.) make_conflict_set
+    # auto-selects the CPU backend for configs under the threshold — a
+    # deliberate, measured TPU-first design decision: the accelerator
+    # serves the loaded/batched regime, the CPU serves the latency
+    # regime. tests/test_routing_crossover.py pins this decision.
+    k.define("RESOLVER_TPU_MIN_BATCH", 65536)
     # Version-vector unicast (default off, like the reference's
     # ENABLE_VERSION_VECTOR_TLOG_UNICAST, fdbclient/ServerKnobs.cpp):
     # resolvers track a per-tlog previous-commit-version vector and

@@ -123,3 +123,78 @@ def test_concurrent_claimers_get_distinct_tasks():
 
     assert run(sched, body())
     cluster.stop()
+
+
+def test_after_already_finished_parent_enqueues_immediately():
+    sched, cluster, db = open_cluster(ClusterConfig())
+    tb = TaskBucket(db)
+
+    async def body():
+        await tb.add(b"p", {})
+        t = await tb.get_one()
+        await tb.finish(t)
+        # parent gone: the dependent must NOT park forever
+        await tb.add(b"c", {}, after=b"p")
+        c = await tb.get_one()
+        assert c is not None and c.key == b"c"
+        await tb.finish(c)
+        assert await tb.is_empty()
+        return True
+
+    assert run(sched, body())
+    cluster.stop()
+
+
+def test_stale_finish_raises_after_requeue():
+    """An executor that lost its lease must not mark the task done or
+    release dependents under the new owner's feet."""
+    sched, cluster, db = open_cluster(ClusterConfig())
+    tb = TaskBucket(db)
+
+    async def body():
+        await tb.add(b"t", {})
+        await tb.add(b"dep", {}, after=b"t")
+        a = await tb.get_one()
+        await sched.delay(TaskBucket.LEASE + 0.1)
+        assert await tb.check_timeouts() == 1
+        b = await tb.get_one()
+        assert b is not None and b.key == b"t"
+        try:
+            await tb.finish(a)  # stale: lease was lost
+            raise AssertionError("stale finish must raise")
+        except KeyError:
+            pass
+        # dep is still parked (the stale finish released nothing)
+        assert (await tb.get_one()) is None
+        await tb.finish(b)
+        c = await tb.get_one()
+        assert c is not None and c.key == b"dep"
+        await tb.finish(c)
+        return True
+
+    assert run(sched, body())
+    cluster.stop()
+
+
+def test_slashed_parent_keys_unambiguous():
+    sched, cluster, db = open_cluster(ClusterConfig())
+    tb = TaskBucket(db)
+
+    async def body():
+        await tb.add(b"a", {})
+        await tb.add(b"a/b", {})
+        await tb.add(b"x", {}, after=b"a/b")  # parked on a/b, NOT on a
+        pa = await tb.get_one()
+        pab = await tb.get_one()
+        by_key = {t.key: t for t in (pa, pab)}
+        await tb.finish(by_key[b"a"])
+        assert (await tb.get_one()) is None  # x still parked
+        await tb.finish(by_key[b"a/b"])
+        x = await tb.get_one()
+        assert x is not None and x.key == b"x"  # key NOT corrupted
+        await tb.finish(x)
+        assert await tb.is_empty()
+        return True
+
+    assert run(sched, body())
+    cluster.stop()
