@@ -186,10 +186,6 @@ class CommitProxy:
         # conflicts (the reference applies resolverChanges with the same
         # conservative effect at the transition version).
         self.conservative_writes: list[tuple[bytes, bytes]] = []
-        # DataDistribution dual-tagging during shard moves: mutations in
-        # [begin, end) ALSO go to `tag` (the serverKeys intermediate
-        # state of MoveKeys).
-        self.extra_tag_ranges: list[tuple[bytes, bytes, int]] = []
         self._task = None
         self._inflight: set = set()
         self._collecting: list[CommitRequest] = []
@@ -642,7 +638,9 @@ class CommitProxy:
                     shards = self.key_servers.tags_of_range(m[1], m[2])
                 else:
                     raise ValueError(f"unknown mutation {m!r}")
-                for b, e, tag in self.extra_tag_ranges:
+                # dual-tag state lives on the SHARED shard map so it
+                # survives proxy-generation changes (see ShardMap)
+                for b, e, tag in self.key_servers.extra_tag_ranges:
                     if span[0] < e and b < span[1] and tag not in shards:
                         shards.append(tag)
                 for s in shards:

@@ -51,6 +51,43 @@ class DataDistributor:
 
     # -- MoveKeys ---------------------------------------------------------
 
+    async def _fence(self) -> int:
+        """Commit an empty barrier transaction through a LIVE proxy and
+        return its version. The r5 2000-seed ensemble found the original
+        fence (pinned to commit_proxies[0]) hanging forever when that
+        proxy was killed mid-move — with the flip already done, the old
+        owners then never dropped and served stale data indefinitely.
+        This fence retries across proxies AND across proxy generations
+        (recovery rebuilds cluster.commit_proxies), with a timeout on
+        each attempt: a proxy that dies mid-commit leaves its reply
+        future unresolved forever.
+
+        One fence version V* suffices to bound ALL earlier commits: the
+        tlog's prev_version chain totally orders versions, so a storage
+        server at version >= V* has applied every commit below V*."""
+        from foundationdb_tpu.runtime.flow import any_of
+
+        while True:
+            live = [
+                p for p in self.cluster.commit_proxies
+                if getattr(p, "failed", None) is None
+            ]
+            for p in live:
+                fut = p.commit(CommitTransaction()).future
+                try:
+                    await any_of([fut, self.sched.delay(0.5)])
+                except Exception:
+                    continue  # this proxy failed the barrier; try next
+                if fut.is_ready:
+                    try:
+                        return fut.get().version
+                    except Exception:
+                        continue
+                # timed out (proxy died mid-commit): next candidate
+            # no live proxy answered: recovery is (or will be)
+            # recruiting a new generation — wait and re-read the list
+            await self.sched.delay(0.05)
+
     async def move_shard(self, begin: bytes, end: bytes, dest) -> None:
         """Move [begin, end) to team `dest` — an int or a tuple of server
         ids (end=None -> +inf). Each joining member fetches the segment;
@@ -74,19 +111,16 @@ class DataDistributor:
         tagged = False
         fetching: list[tuple[bytes, bytes, int]] = []
         try:
-            # 1+2. dual-tag the moving segments to every joiner on every
-            # proxy + start buffering, then fence so Vd is pinned.
+            # 1+2. dual-tag the moving segments to every joiner (on the
+            # SHARED shard map: every proxy of every generation consults
+            # it) + start buffering, then fence so Vd is pinned.
             for b, e, _team, joiners in moving:
                 for j in joiners:
-                    for p in cluster.commit_proxies:
-                        p.extra_tag_ranges.append((b, e, j))
+                    shard_map.extra_tag_ranges.append((b, e, j))
                     cluster.storage_servers[j].begin_fetch(b, e)
                     fetching.append((b, e, j))
             tagged = True
-            fence = await cluster.commit_proxies[0].commit(
-                CommitTransaction()
-            ).future
-            vd = fence.version
+            vd = await self._fence()
 
             # 3+4. fetch each segment's snapshot at Vd from a live old
             # member and install it on every joiner. A fully-dead old
@@ -106,26 +140,38 @@ class DataDistributor:
                     cluster.storage_servers[j].install_shard(b, e, items, vd)
                     fetching.remove((b, e, j))
 
-            # 5. flip routing; stop dual-tagging.
+            # 5a. CEDE before the flip: versions not yet in the log may
+            # have their mutations tagged AFTER the flip (allocation and
+            # tagging are separate steps in the proxy), i.e. to the new
+            # team only — so leavers must refuse reads above the LOGGED
+            # version (WrongShardServerError -> client re-resolves).
+            # Everything at or below the logged version was tagged while
+            # the old map was in force, so the leaver is complete there.
+            # The sequencer's allocation counter is NOT a safe ceiling:
+            # the r5 2000-seed ensemble caught a commit whose version was
+            # allocated pre-flip but tagged post-flip slipping under it.
+            # Without any ceiling, a read between the flip and the
+            # eventual drop returned silently stale data.
+            v_cede = cluster.tlog.version.get()
+            for b, e, team, _joiners in moving:
+                for leaver in team:
+                    if leaver not in dest_team:
+                        cluster.storage_servers[leaver].cede_shard(
+                            b, e, v_cede
+                        )
+            # 5b. flip routing; stop dual-tagging.
             shard_map.move(begin, end, dest_team)
             for b, e, _team, joiners in moving:
                 for j in joiners:
-                    for p in cluster.commit_proxies:
-                        if (b, e, j) in p.extra_tag_ranges:
-                            p.extra_tag_ranges.remove((b, e, j))
+                    if (b, e, j) in shard_map.extra_tag_ranges:
+                        shard_map.extra_tag_ranges.remove((b, e, j))
 
             # 6. Leaving members drop their data — but only once they
             #    have applied every mutation tagged to them before the
-            #    flip. A post-flip fence through every proxy bounds those
-            #    versions; each leaver waits past it.
-            fences = [
-                p.commit(CommitTransaction()).future
-                for p in cluster.commit_proxies
-            ]
-            vmax = 0
-            for f in fences:
-                reply = await f
-                vmax = max(vmax, reply.version)
+            #    flip. One post-flip fence version bounds them (the
+            #    tlog's prev_version chain totally orders commits), and
+            #    _fence survives dead proxies and generation changes.
+            vmax = await self._fence()
             for b, e, team, _joiners in moving:
                 for leaver in team:
                     if leaver not in dest_team:
@@ -143,9 +189,8 @@ class DataDistributor:
             if tagged:
                 for b, e, _team, joiners in moving:
                     for j in joiners:
-                        for p in cluster.commit_proxies:
-                            if (b, e, j) in p.extra_tag_ranges:
-                                p.extra_tag_ranges.remove((b, e, j))
+                        if (b, e, j) in shard_map.extra_tag_ranges:
+                            shard_map.extra_tag_ranges.remove((b, e, j))
             for b, e, j in fetching:
                 cluster.storage_servers[j].cancel_fetch(b, e)
             raise

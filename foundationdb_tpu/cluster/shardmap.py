@@ -26,6 +26,13 @@ class ShardMap:
         owners[i]; boundaries has len(owners)-1 interior split keys."""
         if len(owners) != len(boundaries) + 1:
             raise ValueError("need len(owners) == len(boundaries) + 1")
+        # MoveKeys dual-tag state (the serverKeys intermediate state):
+        # mutations in [begin, end) ALSO tag to `tag` while a move is in
+        # flight. Lives on the SHARED map — not on the proxies — so a
+        # recovery that recruits a new proxy generation cannot silently
+        # drop in-flight dual-tagging (the r5 2000-seed ensemble found
+        # exactly that data loss).
+        self.extra_tag_ranges: list[tuple[bytes, bytes, int]] = []
         self.boundaries = list(boundaries)
         self.owners = [_team(o) for o in owners]
 

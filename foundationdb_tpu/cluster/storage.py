@@ -76,6 +76,12 @@ class StorageServer:
         # reads there answer wrong_shard_server so a stale client
         # location cache LOUDLY invalidates instead of reading absence
         self._dropped_ranges: list[tuple[bytes, bytes]] = []
+        # ownership ceilings: [(begin, end, last_owned_version)] — a
+        # leaver set this at the routing flip; reads ABOVE the ceiling
+        # must go to the new team (the reference's serverKeys ownership
+        # check on the storage, storageserver.actor.cpp) while reads at
+        # or below it stay servable until the data actually drops
+        self._ceded_ranges: list[tuple[bytes, bytes, int]] = []
         self.stopped = False
         # live (non-cleared) key count, maintained incrementally
         self._live_count = 0
@@ -131,7 +137,8 @@ class StorageServer:
             raise
 
     def _ingest(self, v: int, m) -> None:
-        """Route one mutation: buffer if its span is being fetched."""
+        """Route one mutation: buffer if its span is being fetched;
+        discard if an installed shard's snapshot already covers it."""
         if self._fetching and m[0] == "clear":
             # clears may straddle a fetching range: buffer the clipped
             # overlap for post-install replay AND apply now (the fetching
@@ -140,13 +147,48 @@ class StorageServer:
                 cb, ce = max(m[1], b), min(m[2], e)
                 if cb < ce:
                     buf.append((v, ("clear", cb, ce)))
-            self._apply(v, m)
+            self._apply_above_floors(v, m)
             return
         rng = self._fetch_range_of(m)
         if rng is not None:
             self._fetching[rng].append((v, m))
         else:
+            self._apply_above_floors(v, m)
+
+    def _apply_above_floors(self, v: int, m) -> None:
+        """Apply, skipping spans an installed snapshot already covers.
+
+        The update loop's cursor can lag a concurrent install_shard: a
+        dual-tagged entry at version <= an installed shard's floor
+        arrives AFTER the snapshot (which already reflects it) was
+        recorded at the floor version — applying it would write an older
+        version on top of a newer one (history out of order; the r5
+        2000-seed ensemble, seed 166). Sets/atomics in a floored range
+        with v <= floor drop; clears clip to the parts outside such
+        ranges."""
+        if m[0] != "clear":
+            key = m[2] if m[0] == "atomic" else m[1]
+            for b, e, floor in self._shard_floors:
+                if b <= key < e and v <= floor:
+                    return
             self._apply(v, m)
+            return
+        spans = [(m[1], m[2])]
+        for b, e, floor in self._shard_floors:
+            if v > floor:
+                continue
+            nxt = []
+            for cb, ce in spans:
+                if ce <= b or e <= cb:
+                    nxt.append((cb, ce))
+                    continue
+                if cb < b:
+                    nxt.append((cb, b))
+                if e < ce:
+                    nxt.append((e, ce))
+            spans = nxt
+        for cb, ce in spans:
+            self._apply(v, ("clear", cb, ce))
 
     def _record(self, v: int, k: bytes, value: Optional[bytes]) -> None:
         if k not in self._hist:
@@ -281,6 +323,18 @@ class StorageServer:
         # SUBTRACTION: a partially overlapping re-acquisition (the
         # balancer moves different range shapes than DD did) must not
         # leave a permanent refusal over keys this server now owns
+        # re-acquiring also lifts stale cede ceilings (an aborted move
+        # can leave one behind; a current owner must not refuse reads)
+        new_ceded: list[tuple[bytes, bytes, int]] = []
+        for b, e, ceil_v in self._ceded_ranges:
+            if e <= begin or end <= b:
+                new_ceded.append((b, e, ceil_v))
+                continue
+            if b < begin:
+                new_ceded.append((b, begin, ceil_v))
+            if end < e:
+                new_ceded.append((end, e, ceil_v))
+        self._ceded_ranges = new_ceded
         new_dropped: list[tuple[bytes, bytes]] = []
         for b, e in self._dropped_ranges:
             if e <= begin or end <= b:
@@ -297,11 +351,24 @@ class StorageServer:
         buffered mutations belong to the still-current owner — discard."""
         self._fetching.pop((begin, end), None)
 
+    def cede_shard(self, begin: bytes, end: bytes, version: int) -> None:
+        """Ownership of [begin, end) ends at `version`: refuse reads
+        above it (WrongShardServerError -> the client re-resolves to the
+        new team). Set BEFORE the routing flip — this closes the window
+        where a leaver would serve reads at versions whose mutations are
+        tagged only to the new team (the r5 2000-seed ensemble's
+        lost-write class)."""
+        self._ceded_ranges.append((begin, end, version))
+
     def drop_shard(self, begin: bytes, end: bytes) -> None:
         self._apply(self.version.get(), ("clear", begin, end))
         self._shard_floors = [
             f for f in self._shard_floors
             if not (f[0] >= begin and f[1] <= end)
+        ]
+        self._ceded_ranges = [
+            c for c in self._ceded_ranges
+            if not (c[0] >= begin and c[1] <= end)
         ]
         self._dropped_ranges.append((begin, end))
 
@@ -330,6 +397,7 @@ class StorageServer:
             # silently serve absence for moved-away ranges to clients
             # holding stale location-cache entries (code-review r4)
             "dropped_ranges": list(self._dropped_ranges),
+            "ceded_ranges": list(self._ceded_ranges),
         }
 
     def restore(self, snap: dict) -> None:
@@ -340,6 +408,7 @@ class StorageServer:
         self._live_count = snap["live_count"]
         self._shard_floors = list(snap["shard_floors"])
         self._dropped_ranges = list(snap.get("dropped_ranges", []))
+        self._ceded_ranges = list(snap.get("ceded_ranges", []))
         self._last_gc = snap["oldest_version"]
         self.version = Notified(snap["durable_version"])
 
@@ -359,6 +428,9 @@ class StorageServer:
             raise ProcessFailedError(f"storage tag {self.tag} is down")
         for b, e in self._dropped_ranges:
             if begin < e and b < end:
+                raise WrongShardServerError((begin, end))
+        for b, e, ceiling in self._ceded_ranges:
+            if begin < e and b < end and version > ceiling:
                 raise WrongShardServerError((begin, end))
         for b, e, floor in self._shard_floors:
             if begin < e and b < end and version < floor:

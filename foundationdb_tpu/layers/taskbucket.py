@@ -64,6 +64,12 @@ class TaskBucket:
         self._avail = prefix + b"available/"
         self._timeout = prefix + b"timeouts/"
         self._blocked = prefix + b"blocked/"
+        # liveness registry: all/<key> exists from add() until finish().
+        # Parent-liveness checks read exactly ONE key — scanning the
+        # available/timeouts/blocked namespaces would (a) miss parked
+        # parents, (b) false-match slash-ambiguous claimed keys, and
+        # (c) conflict with every concurrent claim (r5 code review).
+        self._all = prefix + b"all/"
 
     # -- producer --------------------------------------------------------
 
@@ -80,23 +86,25 @@ class TaskBucket:
         that is not present anywhere in the bucket counts as already
         finished (the reference FutureBucket's isSet check): the task
         enqueues immediately instead of parking forever."""
-        txn = self.db.create_transaction()
-        if after is not None:
-            parent_live = (
-                await txn.get(self._avail + after) is not None
-                or any(
-                    k.endswith(b"/" + after)
-                    for k, _ in await txn.get_range(
-                        self._timeout, self._timeout + b"\xff"
-                    )
-                )
-            )
-            if parent_live:
+        from foundationdb_tpu.cluster.commit_proxy import NotCommitted
+
+        while True:
+            txn = self.db.create_transaction()
+            txn.set(self._all + key, b"\x01")
+            if after is not None and (
+                await txn.get(self._all + after) is not None
+            ):
+                # the read of all/<after> conflicts with the parent's
+                # finish(), so a parent finishing concurrently aborts
+                # this park and the retry enqueues directly
                 txn.set(self._blocked_prefix(after) + key, _enc(params))
+            else:
+                txn.set(self._avail + key, _enc(params))
+            try:
                 await txn.commit()
                 return
-        txn.set(self._avail + key, _enc(params))
-        await txn.commit()
+            except NotCommitted:
+                continue
 
     # -- executor --------------------------------------------------------
 
@@ -153,18 +161,26 @@ class TaskBucket:
         task was requeued and re-claimed must not mark it done (and must
         not release dependents under the new owner's feet) — it gets a
         KeyError, like extend."""
-        txn = self.db.create_transaction()
-        tk = self._timeout_key(task)
-        if await txn.get(tk) is None:
-            raise KeyError(f"lease lost for {task.key!r}")
-        txn.clear(tk)
-        pfx = self._blocked_prefix(task.key)
-        parked = await txn.get_range(pfx, pfx + b"\xff")
-        for k, raw in parked:
-            txn.clear(k)
-            txn.set(self._avail + k[len(pfx):], raw)
-            code_probe(True, "taskbucket.unblocked")
-        await txn.commit()
+        from foundationdb_tpu.cluster.commit_proxy import NotCommitted
+
+        while True:
+            txn = self.db.create_transaction()
+            tk = self._timeout_key(task)
+            if await txn.get(tk) is None:
+                raise KeyError(f"lease lost for {task.key!r}")
+            txn.clear(tk)
+            txn.clear(self._all + task.key)
+            pfx = self._blocked_prefix(task.key)
+            parked = await txn.get_range(pfx, pfx + b"\xff")
+            for k, raw in parked:
+                txn.clear(k)
+                txn.set(self._avail + k[len(pfx):], raw)
+                code_probe(True, "taskbucket.unblocked")
+            try:
+                await txn.commit()
+                return
+            except NotCommitted:
+                continue  # raced a concurrent add()'s park; re-read
 
     # -- maintenance -----------------------------------------------------
 
@@ -198,3 +214,9 @@ class TaskBucket:
             if await txn.get_range(pfx, pfx + b"\xff", limit=1):
                 return False
         return True
+
+    async def task_exists(self, key: bytes) -> bool:
+        """True while `key` is anywhere in the bucket (the all/
+        registry: add() -> finish() lifetime)."""
+        txn = self.db.create_transaction()
+        return await txn.get(self._all + key) is not None

@@ -241,6 +241,31 @@ class TpuConflictSet:
         self._maybe_check_overflow()
         return outs
 
+    def resolve_group_stream(self, host_groups: list,
+                             check_latch: bool = True) -> list:
+        """Resolve a stream of stacked groups with DOUBLE-BUFFERED
+        staging: the host->device copy of group g+1 is issued before
+        group g's compute is consumed, so transfer overlaps compute
+        (VERDICT r4 task 4 — the reference's pipeline-overlap
+        discipline, CommitProxyServer.actor.cpp:822-853). jax.device_put
+        is asynchronous: the copy rides its own stream while the device
+        crunches the previous group. Returns the GroupVerdicts in order;
+        the caller fences (reads verdicts) when it consumes them."""
+        if not host_groups:
+            return []
+        staged = jax.device_put(host_groups[0])
+        outs = []
+        for i in range(len(host_groups)):
+            nxt = (
+                jax.device_put(host_groups[i + 1])
+                if i + 1 < len(host_groups) else None
+            )
+            outs.append(
+                self.resolve_group_args(staged, check_latch=check_latch)
+            )
+            staged = nxt
+        return outs
+
     def prewarm_exact(self, stacked_args) -> None:
         """Warm the exact while-loop group kernel for this args shape so
         a fixpoint-latch trip swaps programs in milliseconds instead of

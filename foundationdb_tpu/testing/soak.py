@@ -60,6 +60,12 @@ class SeedPlan:
     #                            ConfigNode quorum under a coordinator
     #                            minority kill; the broadcast copy is
     #                            wiped and restored from the quorum
+    sideband: bool             # Sideband.actor.cpp analog: a commit's
+    #                            version handed to a checker must make
+    #                            the write visible at exactly that
+    #                            version (causal consistency)
+    random_clogging: bool      # RandomClogging.actor.cpp analog:
+    #                            repeated random role-pair clogs
 
 
 def plan_for_seed(seed: int) -> SeedPlan:
@@ -92,6 +98,8 @@ def plan_for_seed(seed: int) -> SeedPlan:
         silent_kill=bool(r.random() < 0.35),
         tlog_spill=bool(r.random() < 0.35),
         knob_quorum=bool(r.random() < 0.35),
+        sideband=bool(r.random() < 0.5),
+        random_clogging=bool(r.random() < 0.4),
     )
 
 
@@ -220,6 +228,49 @@ def run_seed(seed: int, collect_probes: bool = False):
                 except retryable:
                     outcome["aborted"] += 1
                     await sched.delay(0.01)
+
+        async def sideband():
+            """Sideband.actor.cpp in miniature: the committed version is
+            the 'sideband message'; a reader pinned AT that version must
+            see the write (causality / external consistency). Keys live
+            under cb/ — outside the final-verify range on purpose."""
+            from foundationdb_tpu.utils.probes import code_probe
+
+            for i in range(10):
+                await sched.delay(0.04)
+                key = b"cb/sb%02d" % i
+                val = b"v%d" % i
+                txn = db.create_transaction()
+                txn.set(key, val)
+                try:
+                    cv = await txn.commit()
+                except retryable:
+                    continue
+                t2 = db.create_transaction()
+                t2._read_version = cv  # read AT the commit version
+                try:
+                    got = await t2.get(key)
+                except retryable:
+                    continue
+                assert got == val, (
+                    f"seed {seed}: sideband causality violation at "
+                    f"{key!r}: read@{cv} saw {got!r}"
+                )
+                code_probe(True, "workload.sideband_checked")
+
+        async def random_clogging():
+            """RandomClogging.actor.cpp: clog random role pairs for
+            random durations while the workload runs."""
+            procs = ["proxy0", "resolver0", "tlog0"] + [
+                f"storage{i}" for i in range(plan.n_storage)
+            ]
+            for _ in range(6):
+                await sched.delay(float(rng.uniform(0.03, 0.12)))
+                a, b_ = rng.choice(len(procs), size=2, replace=False)
+                cluster.net.clog_pair(
+                    procs[int(a)], procs[int(b_)],
+                    float(rng.uniform(0.05, 0.25)),
+                )
 
         async def laggard():
             """A transaction whose snapshot ages past the MVCC window:
@@ -441,6 +492,12 @@ def run_seed(seed: int, collect_probes: bool = False):
         tasks = [w.done, c.done, cc.done]
         if plan.laggard_txn:
             tasks.append(sched.spawn(laggard(), name="soak-laggard").done)
+        if plan.sideband:
+            tasks.append(sched.spawn(sideband(), name="soak-sideband").done)
+        if plan.random_clogging and cluster.net is not None:
+            tasks.append(
+                sched.spawn(random_clogging(), name="soak-clogging").done
+            )
         sched.run_until(all_of(tasks))
         sched.run_for(2.0)  # settle: recovery tail, deferred drops
 

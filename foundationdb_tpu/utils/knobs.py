@@ -137,10 +137,12 @@ def make_server_knobs() -> Knobs:
     #   n:            512   2048   8192   16384  32768  65536
     #   device txn/s: 4.2K  16.8K  64K    112K   203K   347K
     #   cpu txn/s:    701K  756K   485K   543K   465K   338K
-    # — the device first beats the CPU at n=65536. (Under GROUPED
-    # dispatch, the loaded resolver's regime, the same device does
-    # ~0.9-1.1M txn/s at 64K batches — grouping, not batch size alone,
-    # is what the accelerator's advantage rides on.) make_conflict_set
+    # — the device first beats the CPU at n=65536 with inputs
+    # device-resident. The RESIDENT basis is deliberate: the TPU
+    # resolver operates in GROUPED dispatch with double-buffered
+    # staging (~0.9-1.1M txn/s at 64K batches — transfer overlapped
+    # with compute), and the sweep's transfer-inclusive numbers pay a
+    # dev-tunnel RTT a production PCIe host does not. make_conflict_set
     # auto-selects the CPU backend for configs under the threshold — a
     # deliberate, measured TPU-first design decision: the accelerator
     # serves the loaded/batched regime, the CPU serves the latency

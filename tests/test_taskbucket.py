@@ -198,3 +198,31 @@ def test_slashed_parent_keys_unambiguous():
 
     assert run(sched, body())
     cluster.stop()
+
+
+def test_blocked_parent_counts_as_live():
+    """A parked (blocked) parent is still pending: a grandchild chained
+    on it must park, not run early (r5 code review)."""
+    sched, cluster, db = open_cluster(ClusterConfig())
+    tb = TaskBucket(db)
+
+    async def body():
+        await tb.add(b"A", {})
+        await tb.add(b"B", {}, after=b"A")   # parked
+        await tb.add(b"C", {}, after=b"B")   # B live (parked) -> C parks
+        a = await tb.get_one()
+        assert a.key == b"A"
+        assert (await tb.get_one()) is None  # B and C both parked
+        await tb.finish(a)
+        b = await tb.get_one()
+        assert b.key == b"B"
+        assert (await tb.get_one()) is None  # C still waits on B
+        await tb.finish(b)
+        c = await tb.get_one()
+        assert c.key == b"C"
+        await tb.finish(c)
+        assert await tb.is_empty()
+        return True
+
+    assert run(sched, body())
+    cluster.stop()
