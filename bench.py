@@ -66,16 +66,14 @@ def main():
     # uniform stream's history masks deepen chains past 4 (the r4 latch
     # tripped at 3 and 4). r4 ran uniform on the EXACT kernel because at
     # the old per-application cost unroll>=5 broke even with the
-    # residual while; the r5 kernel made applications ~2x cheaper
-    # (build2 min-tables in same_hits) and removed the cross table
-    # build, so uniform now runs LATCHED at depth 6 — six straight-line
-    # applications cost less than the while machinery's ~50ms presence
-    # tax + iteration overhead. A deeper-than-6 chain trips the latch
-    # and this script re-runs on the exact while kernel (loud, never
-    # wrong — the warm pass checks before any timed pass; the exact
-    # program is pre-warmed so the swap is not a compile stall).
-    unroll = {"uniform": 6, "zipf": 8, "range": 14}[mode]
-    latch = True
+    # residual while — and the r5 attempt (latched unroll 6 + the
+    # prefix-count cross) MEASURED 702K txn/s vs the exact path's
+    # 891-973K, so uniform stays on the EXACT kernel. zipf/range keep
+    # the latch with margin; a trip falls back to the exact kernel
+    # (loud, never wrong — the warm pass checks before any timed pass,
+    # and prewarm_exact makes the swap compile-free).
+    unroll = {"uniform": 3, "zipf": 8, "range": 14}[mode]
+    latch = mode != "uniform"
 
     import jax
 

@@ -109,6 +109,7 @@ class DataDistributor:
             return
         self._moving = True
         tagged = False
+        flipped = False
         fetching: list[tuple[bytes, bytes, int]] = []
         try:
             # 1+2. dual-tag the moving segments to every joiner (on the
@@ -161,6 +162,7 @@ class DataDistributor:
                         )
             # 5b. flip routing; stop dual-tagging.
             shard_map.move(begin, end, dest_team)
+            flipped = True
             for b, e, _team, joiners in moving:
                 for j in joiners:
                     if (b, e, j) in shard_map.extra_tag_ranges:
@@ -184,15 +186,31 @@ class DataDistributor:
                 "End", fence_end
             ).detail("Dest", str(dest_team)).log()
         except BaseException:
-            # unwind: stop dual-tagging, discard fetch buffers — the
-            # old team remains authoritative, nothing was flipped
             if tagged:
                 for b, e, _team, joiners in moving:
                     for j in joiners:
                         if (b, e, j) in shard_map.extra_tag_ranges:
                             shard_map.extra_tag_ranges.remove((b, e, j))
-            for b, e, j in fetching:
-                cluster.storage_servers[j].cancel_fetch(b, e)
+            if flipped:
+                # cancelled AFTER the flip (e.g. mid post-flip fence):
+                # the new team is authoritative and the leavers already
+                # ceded — they must still DROP, or they hold the range's
+                # live keys forever (consistency check failure). Waiting
+                # to v_cede is sound: every tagged-to-leaver version is
+                # at or below it from the flip on, and a drop is safe
+                # any time after the flip (reads re-resolve loudly).
+                for b, e, team, _joiners in moving:
+                    for leaver in team:
+                        if leaver not in dest_team:
+                            self.sched.spawn(
+                                self._drop_after(leaver, b, e, v_cede),
+                                name=f"dd-drop-{leaver}",
+                            )
+            else:
+                # nothing flipped: the old team remains authoritative —
+                # discard fetch buffers
+                for b, e, j in fetching:
+                    cluster.storage_servers[j].cancel_fetch(b, e)
             raise
         finally:
             self._moving = False

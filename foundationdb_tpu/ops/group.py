@@ -403,29 +403,17 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
     txn_valid2 = txn_valid.reshape(gn, b)
     read_index2 = fl(g["read_index"]).reshape(gn, nr)
 
-    # Cross-batch visibility indices: for each read, snapidx = #group
-    # versions <= its snapshot. Versions ascend, so the batches whose
-    # committed writes the read must see form the ORDINAL RANGE
-    # [snapidx, i): prefix-count subtraction answers "does any of their
-    # committed write intervals overlap my range" without any per-batch
-    # range-max table (the r4 kernel rebuilt a two-level doubling table
-    # over all ~r_rows cells per batch — ~72ms/group, the r5 ablation
-    # ledger; the counts are two small flat gathers per read).
-    snapidx2 = jnp.sum(
-        (versions[None, None, :] <= snap2[:, :, None]).astype(jnp.int32),
-        axis=-1,
-    )  # [G, nr]
-
-    # static: the prefix-count structures exist only on the general
-    # (non-short-span, non-ablated) cross path — the short-span fast
-    # path must not carry two (G+1) x (r_rows+1) dead arrays per step
-    use_counts = "cross" not in _ablate and not short_span_limit
-
+    # The per-batch step runs under lax.scan: ONE traced/compiled body
+    # regardless of G (the unrolled loop's compile time grew ~linearly
+    # with G and exceeded 35 minutes at G=16 on this host). The carry is
+    # the running coverage map (+ the span latch); everything else rides
+    # the scan's per-batch xs slices. Batch 0 needs no special case: the
+    # initial all-NEG seg_ver answers every cross query with "no
+    # earlier write".
     def batch_step(carry, xs):
-        seg_ver, cb, ce, span_ok, fix_ok = carry
-        (ordinal, lqlo, lqhi, wlo, whi, rrb, rre, rwb, rwe, rtxn, rlive,
-         wlive, wtxn, snap, sidx, stale, toold, tvalid, ridx, ver, twl,
-         twh) = xs
+        seg_ver, span_ok, fix_ok = carry
+        (lqlo, lqhi, wlo, whi, rrb, rre, rwb, rwe, rtxn, rlive, wlive,
+         wtxn, snap, stale, toold, tvalid, ridx, ver, twl, twh) = xs
         converged = jnp.asarray(True)
 
         def per_txn(read_bits):
@@ -459,36 +447,20 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
             )
             cross_g = (gmax > snap) & rlive
         else:
-            # Prefix-count overlap (r5): the batches whose committed
-            # writes this read must see are the ordinal range
-            # [sidx, ordinal) — versions ascend, so v_j > snap is a
-            # SUFFIX of earlier batches. A read [rb, re) overlaps some
-            # interval of that family iff
-            #   #(begins < re) - #(ends <= rb) > 0
-            # (exact for arbitrary interval families: an interval with
-            # end <= rb also has begin < re and cancels; begin >= re
-            # counts in neither; an overlapper counts +1). Two flat
-            # gathers per endpoint against carried per-batch prefix
-            # counts replace the per-batch two-level range-max build
-            # over all r_rows cells (~72ms/group, r5 ablation ledger).
-            w1 = r_rows + 1
-            cbf = cb.reshape(-1)
-            cef = ce.reshape(-1)
-            re_c = jnp.clip(rre, 0, r_rows)
-            rb_c = jnp.clip(rrb, 0, r_rows - 1)
-            # clamp: a snapshot at/above every earlier version yields
-            # sidx > ordinal, whose prefix row is not written yet —
-            # the correct window is then empty (no earlier batch
-            # qualifies), i.e. prefix(ordinal) - prefix(ordinal)
-            s_eff = jnp.minimum(sidx, ordinal)
-            n_begin = cbf[ordinal * w1 + re_c] - cbf[s_eff * w1 + re_c]
-            n_end = (
-                cef[ordinal * w1 + rb_c + 1] - cef[s_eff * w1 + rb_c + 1]
-            )
-            # rrb < rre: a degenerate empty read range must not count
-            # an interval spanning its rank (the range-max identity of
-            # the path this replaces)
-            cross_g = ((n_begin - n_end) > 0) & rlive & (rrb < rre)
+            # two-level table: this build runs once PER BATCH inside the
+            # scan over the full ~r_rows domain — the flat doubling
+            # table's 23 full-width levels were the cross phase's cost
+            # (~70ms/group, r4 ablations); build2 writes ~6.6 passes
+            # (an r5 experiment replaced this per-batch build with
+            # scan-carried prefix COUNTS of committed-write endpoints —
+            # algorithmically fewer full-width passes, but it measured
+            # 526.6 vs 415.5 ms/group on v5e: the big carried arrays +
+            # dynamic_update_slice under the scan cost more than the
+            # build they removed. Reverted; ledger in
+            # prof_r5_newkernel.log and the round-5 README notes.)
+            gtab = rangemax.build2(seg_ver, op="max")
+            gmax = rangemax.query2(gtab, rrb, rre, op="max")
+            cross_g = (gmax > snap) & rlive
         ok_g = tvalid & ~toold & ~per_txn(stale | cross_g)
 
         def same_hits_g(committed_g):
@@ -509,12 +481,8 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
                 )
             else:
                 mw = segtree.min_cover(leaves_local, wlo, whi, val)
-                # two-level table (r5): build's 18 full-width levels at
-                # leaves_local ran once per fixpoint APPLICATION per
-                # batch; build2 writes ~6.6 passes for the same exact
-                # queries
-                mtab = rangemax.build2(mw, op="min")
-                minw = rangemax.query2(mtab, lqlo, lqhi, op="min")
+                mtab = rangemax.build(mw, op="min")
+                minw = rangemax.query(mtab, lqlo, lqhi, op="min")
             return (minw < rtxn) & rlive
 
         def cond(c):
@@ -579,38 +547,6 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
             covered = jnp.cumsum(dd) > 0
             seg_ver = jnp.where(covered, ver, seg_ver)
 
-        if use_counts:
-            # append this batch's committed-write endpoint counts as
-            # prefix row ordinal+1 (dead/aborted/degenerate writers land
-            # on the sentinel slot and are dropped by the [:r_rows]
-            # slice; a zero-width interval covers nothing but its
-            # endpoints would otherwise count)
-            cw2 = committed_g[wtxn] & wlive & (rwb < rwe)
-            cntb = jnp.zeros((r_rows + 1,), jnp.int32).at[
-                jnp.where(cw2, rwb, r_rows)
-            ].add(1)
-            cnte = jnp.zeros((r_rows + 1,), jnp.int32).at[
-                jnp.where(cw2, rwe, r_rows)
-            ].add(1)
-            row_b = jnp.concatenate([
-                jnp.zeros((1,), jnp.int32), jnp.cumsum(cntb[:r_rows])
-            ])
-            row_e = jnp.concatenate([
-                jnp.zeros((1,), jnp.int32), jnp.cumsum(cnte[:r_rows])
-            ])
-            prev_b = jax.lax.dynamic_slice(
-                cb, (ordinal, 0), (1, r_rows + 1)
-            )
-            prev_e = jax.lax.dynamic_slice(
-                ce, (ordinal, 0), (1, r_rows + 1)
-            )
-            cb = jax.lax.dynamic_update_slice(
-                cb, prev_b + row_b[None], (ordinal + 1, 0)
-            )
-            ce = jax.lax.dynamic_update_slice(
-                ce, prev_e + row_e[None], (ordinal + 1, 0)
-            )
-
         # first conflicting read-range index per txn: reads sit in range
         # order inside their window, so the first hit POSITION carries
         # the min index — locate it by compacting hit positions to the
@@ -629,7 +565,7 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
         p = tpos[jnp.clip(n_before, 0, nr - 1)]
         fidx = ridx[jnp.clip(p, 0, nr - 1)]
         first_g = jnp.where(tot_h > 0, fidx, INT32_POS)
-        return (seg_ver, cb, ce, span_ok, fix_ok & converged), (
+        return (seg_ver, span_ok, fix_ok & converged), (
             committed_g, final_same_g, cross_g, first_g
         )
 
@@ -639,22 +575,18 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
     # history state, so it carries the manual-axis varyingness exactly
     # when anything does; adding 0*bi[0] is numerically a no-op.
     seg_ver0 = jnp.full((r_rows,), VERSION_NEG, jnp.int32) + 0 * bi[0]
-    _cshape = (gn + 1, r_rows + 1) if use_counts else (1, 1)
-    cb0 = jnp.zeros(_cshape, jnp.int32) + 0 * bi[0]
-    ce0 = jnp.zeros(_cshape, jnp.int32) + 0 * bi[0]
     span_ok = span_ok & (bi[0] == bi[0])
     fix_ok0 = bi[0] == bi[0]  # True, with the shard_map varying type
     lane_base = (jnp.arange(gn, dtype=jnp.int32) * nr)[:, None]
     xs = (
-        jnp.arange(gn, dtype=jnp.int32), lq_lo, lq_hi, wlo2, whi2,
-        rank_rb2, rank_re2, rank_wb2, rank_we2, r_txn2, read_live2,
-        w_live2, w_txn2, snap2, snapidx2, stale2,
+        lq_lo, lq_hi, wlo2, whi2, rank_rb2, rank_re2, rank_wb2,
+        rank_we2, r_txn2, read_live2, w_live2, w_txn2, snap2, stale2,
         too_old2, txn_valid2, read_index2, versions,
         win_lo - lane_base, win_hi - lane_base,
     )
-    (seg_ver, _cb, _ce, span_ok, fix_ok), (
-        committed2, same2, cross2, first2
-    ) = jax.lax.scan(batch_step, (seg_ver0, cb0, ce0, span_ok, fix_ok0), xs)
+    (seg_ver, span_ok, fix_ok), (committed2, same2, cross2, first2) = (
+        jax.lax.scan(batch_step, (seg_ver0, span_ok, fix_ok0), xs)
+    )
     committed = committed2.reshape(-1)
     final_same = same2.reshape(-1)
     # The cross-batch report is NOT masked by `ok`: sequentially these

@@ -20,6 +20,10 @@ import dataclasses
 
 import numpy as np
 
+from foundationdb_tpu.utils.probes import declare
+
+declare("workload.sideband_checked")
+
 
 @dataclasses.dataclass
 class SeedPlan:
