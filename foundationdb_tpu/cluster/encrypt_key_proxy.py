@@ -1,0 +1,136 @@
+"""EncryptKeyProxy: the role between the KMS and every encrypting role.
+
+Capability match for fdbserver/EncryptKeyProxy.actor.cpp: one process
+per cluster talks to the KMS, derives record-encryption keys from base
+secrets, caches them, and serves getLatestCipher / getCipherById to
+storage servers, TLogs, backup workers and blob workers — so the KMS
+sees one client and key material is derived in one place.
+
+Derived keys (never base secrets) are what roles receive, exactly the
+reference's split. Refresh: an encryption key older than
+ENCRYPT_KEY_REFRESH_INTERVAL re-derives under a fresh salt (cheap, no
+KMS trip); a KMS rotation (new base id) is picked up on the next
+refresh. Old derived keys stay served for decryption until expired.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from foundationdb_tpu.crypto.blob_cipher import (
+    BlobCipherKey,
+    BlobCipherKeyCache,
+    derive_key,
+)
+from foundationdb_tpu.utils.knobs import SERVER_KNOBS
+
+
+class EncryptKeyProxy:
+    def __init__(self, kms, *, refresh_interval: float = None,
+                 expire_interval: float = None):
+        self.kms = kms
+        self.cache = BlobCipherKeyCache()
+        self.refresh_interval = (
+            SERVER_KNOBS.ENCRYPT_KEY_REFRESH_INTERVAL
+            if refresh_interval is None else refresh_interval
+        )
+        self.expire_interval = expire_interval  # None = never expire
+        self.fetches = 0  # KMS round trips (observability/tests)
+        self._refreshing: set[int] = set()
+        self._lock = threading.Lock()
+
+    # -- the role API (EncryptKeyProxyInterface.h) -----------------------
+
+    def get_latest_cipher(self, domain_id: int) -> BlobCipherKey:
+        """The key roles encrypt new records with. Re-derives under a
+        fresh salt (and picks up KMS rotations) when the cached latest
+        passes its refresh deadline."""
+        try:
+            return self.cache.latest(domain_id)
+        except KeyError:
+            pass
+        base_id, secret = self.kms.fetch_base_key(domain_id)
+        self.fetches += 1
+        salt = os.urandom(16)
+        now = time.time()
+        key = BlobCipherKey(
+            domain_id=domain_id, base_id=base_id, salt=salt,
+            key=derive_key(secret, domain_id, base_id, salt),
+            refresh_at=now + self.refresh_interval,
+            expire_at=(
+                float("inf") if self.expire_interval is None
+                else now + self.expire_interval
+            ),
+        )
+        self.cache.insert(key)
+        return key
+
+    def get_latest_cipher_nonblocking(self, domain_id: int) -> BlobCipherKey:
+        """Seal-path variant that NEVER blocks on the KMS once a domain
+        is warm: a stale (past-refresh) key is still used while one
+        background thread refreshes it — the reference's refresh is a
+        background actor too (EncryptKeyProxy.actor.cpp
+        refreshEncryptionKeysCore); a commit path must not stall up to
+        the KMS timeout under the apply lock (code review r5). Blocks
+        only on the very first use of a domain (nothing cached at all —
+        role init prefetches to avoid even that)."""
+        key = self.cache.latest_any(domain_id)
+        if key is None or not key.usable_for_decrypt():
+            # nothing cached, or the cached latest passed its EXPIRE
+            # deadline — sealing under an expired key would produce
+            # records the same process refuses to read back (code
+            # review r5): block for a fresh key, correctness over
+            # latency
+            return self.get_latest_cipher(domain_id)
+        if key.usable_for_encrypt():
+            return key
+        with self._lock:
+            spawn = domain_id not in self._refreshing
+            if spawn:
+                self._refreshing.add(domain_id)
+        if spawn:
+            def refresh():
+                try:
+                    self.get_latest_cipher(domain_id)
+                except Exception:
+                    pass  # keep sealing under the stale key; retry next call
+                finally:
+                    with self._lock:
+                        self._refreshing.discard(domain_id)
+
+            threading.Thread(target=refresh, daemon=True).start()
+        return key
+
+    def get_cipher_by_id(self, domain_id: int, base_id: int,
+                         salt: bytes) -> BlobCipherKey:
+        """The key a stored record's header names (decryption path).
+        Cache miss goes to the KMS by id — the reference's
+        getEncryptCipherKeys-by-baseCipherId path. An EXPIRED key is
+        not a miss: retirement stands; re-deriving it would make
+        expire_interval unenforceable. (Scope: in-process expiry is a
+        cache policy — a RESTARTED process re-fetches unless the KMS
+        itself revoked the base id (kms.revoke), which is the
+        cross-restart retirement mechanism; by-id keys re-derived here
+        inherit expire_interval rather than living forever.)"""
+        from foundationdb_tpu.crypto.blob_cipher import CipherKeyExpiredError
+
+        try:
+            return self.cache.lookup(domain_id, base_id, salt)
+        except CipherKeyExpiredError:
+            raise
+        except KeyError:
+            secret = self.kms.fetch_base_key_by_id(domain_id, base_id)
+            self.fetches += 1
+            key = BlobCipherKey(
+                domain_id=domain_id, base_id=base_id, salt=salt,
+                key=derive_key(secret, domain_id, base_id, salt),
+                refresh_at=0.0,  # by-id keys serve decryption only
+                expire_at=(
+                    float("inf") if self.expire_interval is None
+                    else time.time() + self.expire_interval
+                ),
+            )
+            self.cache.insert(key, latest=False)
+            return key

@@ -42,6 +42,8 @@ class RoleSpec:
     backend: str = "native"
     data_dir: Optional[str] = None
     tlog_address: Optional[str] = None
+    storage_engine: str = "memory"
+    encrypt: bool = False
 
     @property
     def address(self) -> str:
@@ -68,6 +70,8 @@ def parse_conf(path: str) -> dict[str, RoleSpec]:
             backend=sec.get("backend", "native"),
             data_dir=sec.get("data_dir", None),
             tlog_address=sec.get("tlog_address", None),
+            storage_engine=sec.get("storage_engine", "memory"),
+            encrypt=sec.getboolean("encrypt", False),
         )
         if spec.address in addresses:
             raise ValueError(
@@ -125,6 +129,10 @@ class Monitor:
             index=spec.index,
             data_dir=spec.data_dir,
             tlog_address=spec.tlog_address,
+            storage_engine=spec.storage_engine,
+            # without this, a supervised restart of an encrypted store
+            # would crash-loop on the ENCRYPTION_MODE marker
+            encrypt=spec.encrypt,
         )
         self.children[spec.name] = _Child(
             spec=spec, proc=proc, started_at=time.monotonic(),

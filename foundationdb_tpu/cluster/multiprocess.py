@@ -314,6 +314,42 @@ class ResolverRole:
         )
 
 
+def _looks_sealed(blob: bytes) -> bool:
+    from foundationdb_tpu.crypto.blob_cipher import is_encrypted
+
+    return is_encrypted(blob)
+
+
+def _check_encryption_marker(data_dir: str, encryption) -> None:
+    """Persisted encryption mode (the reference persists
+    encryptionAtRestMode in the database configuration and refuses mode
+    flips — DatabaseConfiguration.h): a store written encrypted must
+    never be opened unencrypted, or sealed bytes would be served as
+    data. Sniffing record magic alone can false-positive on user bytes;
+    the marker is deterministic."""
+    marker = os.path.join(data_dir, "ENCRYPTION_MODE")
+    if encryption is not None:
+        if not os.path.exists(marker):
+            # fsync file AND directory: the data records are all
+            # fsynced, so the marker must be at least as durable — a
+            # power loss that keeps sealed records but drops the
+            # marker would downgrade the store silently
+            with open(marker, "w") as f:
+                f.write("aes-256-ctr\n")
+                f.flush()
+                os.fsync(f.fileno())
+            dfd = os.open(data_dir, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+    elif os.path.exists(marker):
+        raise RuntimeError(
+            f"{data_dir} was written with encryption-at-rest; "
+            "restart the role with --encrypt (and the same KMS)"
+        )
+
+
 class TLogRole:
     """Wire-served transaction log: version-ordered append + peek.
 
@@ -324,16 +360,31 @@ class TLogRole:
     entries via the crc-checked recovery scan.
     """
 
-    def __init__(self, data_dir: str | None = None):
+    def __init__(self, data_dir: str | None = None, encryption=None):
         self.entries: list[tuple[int, list]] = []  # (version, mutations)
         self.version = -1
         self._dq = None
+        # the tlog persists the SAME mutation bytes storage seals — an
+        # unencrypted tlog disk would hollow out the at-rest guarantee
+        # (code review r5); whole records are sealed here (no ordering
+        # constraint on tlog frames, unlike LSM keys)
+        self._enc = encryption if data_dir else None
         if data_dir:
             from foundationdb_tpu.native import DiskQueue
 
             os.makedirs(data_dir, exist_ok=True)
+            _check_encryption_marker(data_dir, self._enc)
+            if self._enc is not None:
+                # first push must not block the loop on a KMS trip
+                self._enc.prefetch()
             self._dq = DiskQueue(os.path.join(data_dir, "tlog"))
             for _seq, blob in self._dq.recovered:
+                if self._enc is not None:
+                    blob = self._enc.open(blob)
+                elif _looks_sealed(blob):
+                    raise RuntimeError(
+                        "sealed tlog record but encryption is disabled"
+                    )
                 rec = codec.decode(blob)
                 self.entries.append((rec.version, list(rec.mutations)))
                 self.version = max(self.version, rec.version)
@@ -349,7 +400,10 @@ class TLogRole:
         # restarts the chain above lastEpochEnd). Only regressions are
         # rejected (the <= check above).
         if self._dq is not None:
-            self._dq.push(codec.encode(req))
+            blob = codec.encode(req)
+            if self._enc is not None:
+                blob = self._enc.seal(blob)
+            self._dq.push(blob)
             if self._dq.commit() is None:
                 # fsync/pwrite failed: the data is NOT durable — refuse
                 # the ack rather than lie (tLogCommit discipline)
@@ -401,7 +455,20 @@ class StorageRole:
     LSM_FLUSH_BYTES = 4 << 20
 
     def __init__(self, data_dir: str | None = None, engine: str = "memory",
-                 window: int = 5_000_000):
+                 window: int = 5_000_000, encryption=None):
+        # Encryption-at-rest (crypto/at_rest.StorageEncryption): every
+        # SET value is sealed ONCE, in the executor, before it reaches
+        # the WAL, the store, or a checkpoint — so no crypto runs on
+        # the event loop under the apply lock and nothing is encrypted
+        # twice (code review r5). Keys stay plaintext (run/checkpoint
+        # ordering); reads open values through the cipher cache
+        # (mixed-mode: plaintext legacy records pass through).
+        self._enc = encryption if data_dir else None
+        if self._enc is not None:
+            # prefetch both cipher identities so the seal path starts
+            # warm; a REST KMS still pays one refresh trip per
+            # ENCRYPT_KEY_REFRESH_INTERVAL, off the hot path
+            encryption.prefetch()
         # key -> list[(version, value|None)] ascending  (memory engine)
         self.history: dict[bytes, list[tuple[int, Optional[bytes]]]] = {}
         # the empty store is readable at version 0 (a GRV before any commit
@@ -436,6 +503,7 @@ class StorageRole:
         self.window = window
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
+            _check_encryption_marker(data_dir, encryption)
             from foundationdb_tpu import native
 
             self._dq = native.DiskQueue(os.path.join(data_dir, "mutlog"))
@@ -471,6 +539,7 @@ class StorageRole:
         return b"".join(out)
 
     def _write_checkpoint_blob(self, blob: bytes) -> None:
+        # values inside the blob are already sealed (seal-once at apply)
         tmp = self._ckpt_path() + ".tmp"
         with open(tmp, "wb") as f:
             f.write(blob)
@@ -497,10 +566,34 @@ class StorageRole:
     # registered wire codec the RPC layer uses (TLogRole logs its
     # DiskQueue records the same way; no second serialization path).
 
+    def _seal_values(self, req):
+        """Seal every SET value of a StorageApply (the ONE place values
+        are encrypted — WAL, store and checkpoints all carry the sealed
+        bytes from here on). Runs in the executor."""
+        return StorageApply(
+            version=req.version,
+            mutations=[
+                codec.Mutation(m.op, m.param1, self._enc.seal(m.param2))
+                if m.op == self.MUT_SET
+                else m
+                for m in req.mutations
+            ],
+        )
+
     def _replay_local_log(self) -> None:
         """Restart: replay the log tail above the checkpoint — cost
-        proportional to the tail, not the dataset."""
+        proportional to the tail, not the dataset. (Values inside the
+        records are sealed; they are stored as-is and opened on read.)"""
         for seq, blob in self._dq.recovered:
+            if self._enc is None and _looks_sealed(blob):
+                # defense in depth behind the fsynced marker: codec
+                # records never start with the cipher magic, so a
+                # whole-sealed blob here means a lost marker (note the
+                # seal-once format stores sealed VALUES inside plain
+                # codec records — for those only the marker protects)
+                raise RuntimeError(
+                    "sealed storage WAL record but encryption is disabled"
+                )
             rec = codec.decode(blob)
             if rec.version > self.version:
                 self._apply_mutations(rec.version, rec.mutations)
@@ -541,6 +634,8 @@ class StorageRole:
 
     def _apply_mutations(self, version: int, mutations) -> None:
         if self._lsm is not None:
+            # values arrive pre-sealed (seal-once in apply/catch-up);
+            # keys stay plaintext for run ordering (crypto/at_rest.py)
             self._lsm.apply(
                 version, [(m.op, m.param1, m.param2) for m in mutations]
             )
@@ -576,6 +671,12 @@ class StorageRole:
                     for v, muts in zip(rep.versions, rep.groups)
                     if v > self.version
                 ]
+                if reqs and self._enc is not None:
+                    loop = asyncio.get_event_loop()
+                    reqs = await loop.run_in_executor(
+                        None, lambda rs: [self._seal_values(r) for r in rs],
+                        reqs,
+                    )
                 if reqs and self._dq is not None:
                     # group commit: ONE fsync per peek chunk, not per
                     # version — restart catch-up stays O(chunks) fsyncs
@@ -602,8 +703,14 @@ class StorageRole:
         # condition lock so reads at already-applied versions never
         # stall behind the disk; a stale/duplicate record logged by a
         # lost race is skipped idempotently on replay.
-        if self._dq is not None and req.version > self.version:
-            await self._log_durably([req])
+        if req.version > self.version:
+            if self._enc is not None:
+                # seal-once, off the event loop (code review r5)
+                req = await asyncio.get_event_loop().run_in_executor(
+                    None, self._seal_values, req
+                )
+            if self._dq is not None:
+                await self._log_durably([req])
         return await self._apply_logged(req)
 
     async def _log_durably(self, reqs: list) -> None:
@@ -683,8 +790,20 @@ class StorageRole:
         if self._lsm is not None:
             # disk preads off the event loop: a cold read must not stall
             # unrelated requests
+            # read AND open (decrypt + possible by-id KMS fetch) in the
+            # executor: neither disk preads nor a KMS round trip may
+            # stall the event loop (code review r5)
+            # plain pass-through when encryption is off: the marker
+            # check at startup guarantees the store is unencrypted, and
+            # user values may legitimately start with the header magic
+            def read_open():
+                v = self._lsm.get(req.key, req.version)
+                if v is None or self._enc is None:
+                    return v
+                return self._enc.open(v)
+
             value = await asyncio.get_event_loop().run_in_executor(
-                None, self._lsm.get, req.key, req.version
+                None, read_open
             )
             return StorageGetReply(value=value)
         hist = self.history.get(req.key, [])
@@ -694,6 +813,12 @@ class StorageRole:
                 value = val
             else:
                 break
+        if value is not None and self._enc is not None:
+            # decrypt (and a possible cold by-id KMS fetch) off the
+            # loop — same discipline as the LSM read closures
+            value = await asyncio.get_event_loop().run_in_executor(
+                None, self._enc.open, value
+            )
         return StorageGetReply(value=value)
 
     async def snapshot(self, req: StorageSnapshotReq) -> StorageSnapshotReply:
@@ -701,8 +826,17 @@ class StorageRole:
         async with cond:
             await cond.wait_for(lambda: self.version >= req.version)
         if self._lsm is not None:
+            # range + per-value open() together in the executor — a
+            # full-dataset decrypt inline on the loop would stall every
+            # unrelated request proportionally to dataset size
+            def range_open():
+                rows = self._lsm.range(b"", b"", req.version)
+                if self._enc is None:
+                    return rows
+                return [(k, self._enc.open(v)) for k, v in rows]
+
             kvs = await asyncio.get_event_loop().run_in_executor(
-                None, self._lsm.range, b"", b"", req.version
+                None, range_open
             )
             return StorageSnapshotReply(version=self.version, kvs=kvs)
         kvs = []
@@ -713,6 +847,15 @@ class StorageRole:
                     value = val  # leaves the newest value <= version
             if value is not None:
                 kvs.append((k, value))
+        if self._enc is not None:
+            # full-dataset decrypt belongs in the executor (the sealed
+            # kvs list is already materialized, so the loop may mutate
+            # history freely meanwhile)
+            kvs = await asyncio.get_event_loop().run_in_executor(
+                None,
+                lambda rows: [(k, self._enc.open(v)) for k, v in rows],
+                kvs,
+            )
         return StorageSnapshotReply(version=self.version, kvs=kvs)
 
 
@@ -723,6 +866,7 @@ async def _serve_role(
     data_dir: str | None = None,
     tlog_address: str | None = None,
     storage_engine: str = "memory",
+    encrypt: bool = False,
 ) -> None:
     server = transport.RpcServer(address)
 
@@ -730,6 +874,18 @@ async def _serve_role(
         return Pong(payload=msg.payload)
 
     server.register(TOKEN_PING, ping)
+    # --encrypt is the only switch that reaches this child process:
+    # spawn_role translates the launcher's ENABLE_ENCRYPTION knob into
+    # the flag (a knob read in a fresh child interpreter would always
+    # be the default — dead configuration). Encryption is meaningless
+    # without a data dir (nothing at rest).
+    encryption = None
+    if encrypt and data_dir:
+        from foundationdb_tpu.crypto.at_rest import default_encryption
+
+        encryption = default_encryption(
+            kms_endpoint=os.environ.get("FDB_TPU_KMS")
+        )
     if role_name == "resolver":
         role = ResolverRole(backend=backend)
         server.register(TOKEN_RESOLVE, role.resolve)
@@ -739,13 +895,15 @@ async def _serve_role(
 
         server.register(TOKEN_RESOLVER_VERSION, rv)
     elif role_name == "tlog":
-        role = TLogRole(data_dir=data_dir)
+        role = TLogRole(data_dir=data_dir, encryption=encryption)
         server.register(TOKEN_TLOG_PUSH, role.push)
         server.register(TOKEN_TLOG_PEEK, role.peek)
         server.register(TOKEN_TLOG_PEEK_BATCH, role.peek_batch)
         server.register(TOKEN_TLOG_VERSION, role.get_version)
     elif role_name == "storage":
-        role = StorageRole(data_dir=data_dir, engine=storage_engine)
+        role = StorageRole(
+            data_dir=data_dir, engine=storage_engine, encryption=encryption
+        )
         if tlog_address:
             await role.catch_up_from_tlog(tlog_address)
         server.register(TOKEN_STORAGE_APPLY, role.apply)
@@ -787,6 +945,7 @@ def spawn_role(
     data_dir: str | None = None,
     tlog_address: str | None = None,
     storage_engine: str = "memory",
+    encrypt: bool = False,
 ) -> RoleProcess:
     """Start one role as a child OS process serving a UDS in socket_dir.
 
@@ -822,6 +981,16 @@ def spawn_role(
         cmd += ["--tlog-address", tlog_address]
     if storage_engine != "memory":
         cmd += ["--storage-engine", storage_engine]
+    # knob propagation: the child is a fresh interpreter with default
+    # knobs, so the launcher's ENABLE_ENCRYPTION must travel as the
+    # explicit flag (code review r5 — a knob read only child-side is
+    # dead configuration)
+    if not encrypt:
+        from foundationdb_tpu.utils.knobs import SERVER_KNOBS
+
+        encrypt = bool(SERVER_KNOBS.ENABLE_ENCRYPTION)
+    if encrypt:
+        cmd += ["--encrypt"]
     proc = subprocess.Popen(cmd, env=env)
     return RoleProcess(name=name, address=address, proc=proc)
 
@@ -996,6 +1165,7 @@ def main() -> None:
     ap.add_argument("--tlog-address", default=None)
     ap.add_argument("--storage-engine", default="memory",
                     choices=("memory", "lsm"))
+    ap.add_argument("--encrypt", action="store_true")
     args = ap.parse_args()
     asyncio.run(
         _serve_role(
@@ -1005,6 +1175,7 @@ def main() -> None:
             data_dir=args.data_dir,
             tlog_address=args.tlog_address,
             storage_engine=args.storage_engine,
+            encrypt=args.encrypt,
         )
     )
 

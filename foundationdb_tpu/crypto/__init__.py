@@ -1,0 +1,17 @@
+"""Encryption-at-rest: cipher-key cache + authenticated AES-256-CTR.
+
+The reference's at-rest encryption stack is fdbclient/BlobCipher.cpp
+(cipher-key cache, key derivation, AES-256-CTR with an authenticated
+header), served to roles by fdbserver/EncryptKeyProxy.actor.cpp from a
+KMS connector (fdbserver/SimKmsConnector.actor.cpp in simulation,
+fdbserver/RESTKmsConnector.actor.cpp in production).
+"""
+
+from foundationdb_tpu.crypto.blob_cipher import (  # noqa: F401
+    AuthTokenError,
+    BlobCipherKey,
+    BlobCipherKeyCache,
+    EncryptHeader,
+    decrypt,
+    encrypt,
+)

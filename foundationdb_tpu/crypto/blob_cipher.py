@@ -1,0 +1,240 @@
+"""Authenticated AES-256-CTR record encryption + the cipher-key cache.
+
+Capability match for fdbclient/BlobCipher.cpp:
+
+* **BlobCipherKey** (BlobCipher.h:215-320): a derived encryption key.
+  The KMS hands out a *base* secret per encryption domain; the actual
+  data key is derived per (base key, random salt) with HMAC-SHA256 —
+  compromise of one derived key never exposes the base secret, and
+  rotation is a new salt, not a KMS round trip
+  (BlobCipher.cpp applyHmacKeyDerivationFunc).
+* **BlobCipherKeyCache** (BlobCipher.cpp:1194-1383): per-domain cache of
+  derived keys — the newest key for encryption, every still-referenced
+  (baseId, salt) pair for decryption of older records; TTL-based refresh
+  is the EncryptKeyProxy's job (cluster/encrypt_key_proxy.py).
+* **EncryptHeader** (BlobCipherEncryptHeaderRef): a self-describing
+  preamble naming the text-cipher identity (domain, baseId, salt), the
+  16-byte CTR IV, and an HMAC-SHA256 auth token over header+ciphertext
+  computed with a SEPARATE header-auth key — AES-CTR is malleable, so
+  every decrypt verifies the token first (BlobCipher.cpp:1456-1520's
+  single-auth-token mode) and tampering raises AuthTokenError, never
+  returns garbage plaintext.
+
+The cipher itself comes from the `cryptography` package (OpenSSL-backed,
+the same primitive the reference calls through EVP_EncryptUpdate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import hmac
+import os
+import struct
+import time
+
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+ENCRYPT_HEADER_MAGIC = b"FDBE"
+ENCRYPT_HEADER_VERSION = 1
+AES_KEY_BYTES = 32
+IV_BYTES = 16
+AUTH_TOKEN_BYTES = 32
+
+#: Reserved system encryption domains (fdbclient/EncryptKeyProxyInterface.h:
+#: SYSTEM_KEYSPACE_ENCRYPT_DOMAIN_ID / FDB_DEFAULT_ENCRYPT_DOMAIN_ID).
+SYSTEM_DOMAIN_ID = -2
+DEFAULT_DOMAIN_ID = -1
+
+
+class AuthTokenError(RuntimeError):
+    """Auth-token mismatch: the record was tampered with (or decrypted
+    with the wrong header-auth key). Mirrors encrypt_header_authtoken_
+    mismatch — the reference treats this as data corruption, never as a
+    soft error."""
+
+
+class CipherKeyNotFoundError(KeyError):
+    """No cached cipher for the (domain, baseId, salt) a header names."""
+
+
+class CipherKeyExpiredError(CipherKeyNotFoundError):
+    """The named cipher exists but passed its expire deadline — key
+    retirement must NOT be undone by a KMS re-fetch (the proxy treats
+    this differently from a plain cache miss)."""
+
+
+def derive_key(base_key: bytes, domain_id: int, base_id: int,
+               salt: bytes) -> bytes:
+    """HMAC-SHA256 key-derivation from the KMS base secret
+    (BlobCipher.cpp applyHmacKeyDerivationFunc: the derived key binds
+    the domain, the base-key id, and the random salt)."""
+    msg = struct.pack("<qq", domain_id, base_id) + salt
+    return hmac.new(base_key, msg, hashlib.sha256).digest()[:AES_KEY_BYTES]
+
+
+@dataclasses.dataclass(frozen=True)
+class BlobCipherKey:
+    domain_id: int
+    base_id: int
+    salt: bytes          # 16 random bytes chosen at derivation time
+    key: bytes           # the derived AES-256 key (never the base secret)
+    refresh_at: float    # wall-clock after which encryption must re-derive
+    expire_at: float     # after which even decryption refuses (key revoked)
+
+    def usable_for_encrypt(self, now: float = None) -> bool:
+        now = time.time() if now is None else now
+        return now < self.refresh_at
+
+    def usable_for_decrypt(self, now: float = None) -> bool:
+        now = time.time() if now is None else now
+        return self.expire_at == float("inf") or now < self.expire_at
+
+
+class BlobCipherKeyCache:
+    """Per-domain derived-key cache (BlobCipher.cpp BlobCipherKeyCache).
+
+    `insert` registers a derived key; `latest(domain)` serves encryption;
+    `lookup(domain, base_id, salt)` serves decryption of older records.
+    The cache never talks to the KMS itself — the EncryptKeyProxy owns
+    fetch/refresh and feeds caches (the reference's split of
+    BlobCipherKeyCache vs EncryptKeyProxy.actor.cpp).
+    """
+
+    def __init__(self):
+        self._latest: dict[int, BlobCipherKey] = {}
+        self._by_id: dict[tuple[int, int, bytes], BlobCipherKey] = {}
+
+    def insert(self, key: BlobCipherKey, *, latest: bool = True) -> None:
+        self._by_id[(key.domain_id, key.base_id, key.salt)] = key
+        if latest:
+            cur = self._latest.get(key.domain_id)
+            if cur is None or key.base_id >= cur.base_id:
+                self._latest[key.domain_id] = key
+
+    def latest(self, domain_id: int) -> BlobCipherKey:
+        key = self._latest.get(domain_id)
+        if key is None or not key.usable_for_encrypt():
+            raise CipherKeyNotFoundError(
+                f"no fresh encryption key for domain {domain_id}"
+            )
+        return key
+
+    def latest_any(self, domain_id: int) -> "BlobCipherKey | None":
+        """The newest cached key even if past its refresh deadline —
+        the non-blocking seal path encrypts under it while a refresh
+        runs in the background."""
+        return self._latest.get(domain_id)
+
+    def lookup(self, domain_id: int, base_id: int, salt: bytes) -> BlobCipherKey:
+        key = self._by_id.get((domain_id, base_id, salt))
+        if key is None:
+            raise CipherKeyNotFoundError(
+                f"no cipher for domain={domain_id} baseId={base_id}"
+            )
+        if not key.usable_for_decrypt():
+            raise CipherKeyExpiredError(
+                f"cipher domain={domain_id} baseId={base_id} expired"
+            )
+        return key
+
+    def domains(self) -> list[int]:
+        return sorted(self._latest)
+
+
+# magic, ver, textDomain, textBaseId, headerDomain, headerBaseId,
+# textSalt, headerSalt, iv — the reference's BlobCipherEncryptHeader
+# likewise names BOTH cipher identities (textCipherDetails +
+# headerCipherDetails) so decrypt can locate the data key and the
+# auth key independently.
+_HEADER = struct.Struct("<4sBqqqq16s16s16s")
+
+
+@dataclasses.dataclass(frozen=True)
+class EncryptHeader:
+    domain_id: int
+    base_id: int
+    header_domain_id: int  # auth key identity (a separate cipher)
+    header_base_id: int
+    salt: bytes
+    header_salt: bytes
+    iv: bytes
+
+    def pack(self) -> bytes:
+        return _HEADER.pack(
+            ENCRYPT_HEADER_MAGIC, ENCRYPT_HEADER_VERSION, self.domain_id,
+            self.base_id, self.header_domain_id, self.header_base_id,
+            self.salt, self.header_salt, self.iv,
+        )
+
+    @classmethod
+    def unpack(cls, blob: bytes) -> "EncryptHeader":
+        magic, ver, dom, base, hdom, hbase, salt, hsalt, iv = _HEADER.unpack(
+            blob[: _HEADER.size]
+        )
+        if magic != ENCRYPT_HEADER_MAGIC or ver != ENCRYPT_HEADER_VERSION:
+            raise AuthTokenError("bad encrypt header magic/version")
+        return cls(dom, base, hdom, hbase, salt, hsalt, iv)
+
+
+HEADER_BYTES = _HEADER.size + AUTH_TOKEN_BYTES
+
+
+def _auth_token(header_bytes: bytes, ciphertext: bytes,
+                auth_key: bytes) -> bytes:
+    return hmac.new(auth_key, header_bytes + ciphertext,
+                    hashlib.sha256).digest()
+
+
+def encrypt(plaintext: bytes, text_key: BlobCipherKey,
+            auth_key: BlobCipherKey, *, iv: bytes = None) -> bytes:
+    """Encrypt one record: header | auth_token | ciphertext.
+
+    AES-256-CTR with a fresh random IV per record, authenticated by
+    HMAC-SHA256 over header+ciphertext under the separate auth key
+    (BlobCipher.cpp EncryptBlobCipherAes265Ctr::encrypt)."""
+    iv = os.urandom(IV_BYTES) if iv is None else iv
+    enc = Cipher(algorithms.AES(text_key.key), modes.CTR(iv)).encryptor()
+    ciphertext = enc.update(plaintext) + enc.finalize()
+    header = EncryptHeader(
+        domain_id=text_key.domain_id, base_id=text_key.base_id,
+        header_domain_id=auth_key.domain_id,
+        header_base_id=auth_key.base_id,
+        salt=text_key.salt, header_salt=auth_key.salt, iv=iv,
+    ).pack()
+    return header + _auth_token(header, ciphertext, auth_key.key) + ciphertext
+
+
+def decrypt(blob: bytes, cache: BlobCipherKeyCache,
+            auth_key: BlobCipherKey = None) -> bytes:
+    """Verify the auth token, then decrypt. The text cipher is located
+    in the cache by the header's (domain, baseId, salt); the auth key
+    defaults to the cache's key for the header's auth identity."""
+    if len(blob) < HEADER_BYTES:
+        raise AuthTokenError("truncated encrypted record")
+    header_bytes = blob[: _HEADER.size]
+    token = blob[_HEADER.size : HEADER_BYTES]
+    ciphertext = blob[HEADER_BYTES:]
+    header = EncryptHeader.unpack(header_bytes)
+    if auth_key is None:
+        auth_key = cache.lookup(
+            header.header_domain_id, header.header_base_id,
+            header.header_salt,
+        )
+    want = _auth_token(header_bytes, ciphertext, auth_key.key)
+    if not hmac.compare_digest(token, want):
+        raise AuthTokenError(
+            f"auth token mismatch (domain={header.domain_id}, "
+            f"baseId={header.base_id}) — record tampered or wrong key"
+        )
+    text_key = cache.lookup(header.domain_id, header.base_id, header.salt)
+    dec = Cipher(
+        algorithms.AES(text_key.key), modes.CTR(header.iv)
+    ).decryptor()
+    return dec.update(ciphertext) + dec.finalize()
+
+
+def is_encrypted(blob: bytes) -> bool:
+    """Cheap header sniff (the storage read path must accept records
+    written before encryption was enabled)."""
+    return blob[:4] == ENCRYPT_HEADER_MAGIC and len(blob) >= HEADER_BYTES

@@ -148,6 +148,17 @@ def make_server_knobs() -> Knobs:
     # serves the loaded/batched regime, the CPU serves the latency
     # regime. tests/test_routing_crossover.py pins this decision.
     k.define("RESOLVER_TPU_MIN_BATCH", 65536)
+    # Encryption-at-rest (fdbclient/ServerKnobs.cpp ENABLE_ENCRYPTION +
+    # fdbserver/EncryptKeyProxy.actor.cpp): storage WAL/checkpoint/LSM
+    # payloads are AES-256-CTR sealed under per-domain keys served by
+    # the EncryptKeyProxy. Consumed by multiprocess._serve_role; NOT
+    # randomized in the sim ensemble — the soak's storage is the
+    # in-process sim role, which has no disk to seal (the reference
+    # randomizes it because its simulated disks are real files).
+    k.define("ENABLE_ENCRYPTION", False)
+    # Encryption keys re-derive under a fresh salt after this many
+    # seconds (ServerKnobs ENCRYPT_KEY_REFRESH_INTERVAL).
+    k.define("ENCRYPT_KEY_REFRESH_INTERVAL", 600.0)
     # Version-vector unicast (default off, like the reference's
     # ENABLE_VERSION_VECTOR_TLOG_UNICAST, fdbclient/ServerKnobs.cpp):
     # resolvers track a per-tlog previous-commit-version vector and

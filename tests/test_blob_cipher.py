@@ -1,0 +1,200 @@
+"""Encryption-at-rest: cipher cache, auth, rotation, KMS connectors.
+
+Mirrors the reference's BlobCipher unit suite
+(fdbclient/BlobCipher.cpp TESTCASE "/blobCipher/...": roundtrip,
+header auth-token mismatch on tamper, key-cache identity) plus the
+EncryptKeyProxy/KMS split (fdbserver/EncryptKeyProxy.actor.cpp,
+SimKmsConnector / RESTKmsConnector).
+"""
+
+import pytest
+
+from foundationdb_tpu.cluster.encrypt_key_proxy import EncryptKeyProxy
+from foundationdb_tpu.cluster.kms import (
+    KmsError,
+    RestKmsConnector,
+    SimKmsConnector,
+    serve_stub_kms,
+)
+from foundationdb_tpu.crypto import (
+    AuthTokenError,
+    BlobCipherKeyCache,
+    decrypt,
+    encrypt,
+)
+from foundationdb_tpu.crypto.blob_cipher import (
+    CipherKeyNotFoundError,
+    is_encrypted,
+)
+
+
+def make_proxy(**kw):
+    return EncryptKeyProxy(SimKmsConnector(), refresh_interval=600, **kw)
+
+
+def test_roundtrip_and_header_identity():
+    proxy = make_proxy()
+    key = proxy.get_latest_cipher(7)
+    blob = encrypt(b"hello at rest", key, key)
+    assert is_encrypted(blob)
+    assert b"hello at rest" not in blob
+    assert decrypt(blob, proxy.cache) == b"hello at rest"
+
+
+def test_tamper_raises_auth_token_error():
+    proxy = make_proxy()
+    key = proxy.get_latest_cipher(1)
+    blob = bytearray(encrypt(b"payload" * 100, key, key))
+    blob[-1] ^= 0x40  # flip a ciphertext bit
+    with pytest.raises(AuthTokenError):
+        decrypt(bytes(blob), proxy.cache)
+    # header tamper (different domain id) also refuses
+    blob2 = bytearray(encrypt(b"x", key, key))
+    blob2[6] ^= 0x01
+    with pytest.raises((AuthTokenError, CipherKeyNotFoundError)):
+        decrypt(bytes(blob2), proxy.cache)
+
+
+def test_wrong_key_refuses():
+    proxy_a, proxy_b = make_proxy(), EncryptKeyProxy(
+        SimKmsConnector(b"other-kms"), refresh_interval=600
+    )
+    key_a = proxy_a.get_latest_cipher(1)
+    proxy_b.get_latest_cipher(1)
+    blob = encrypt(b"secret", key_a, key_a)
+    # proxy_b's cache has domain 1 but a DIFFERENT derived key identity
+    # (different salt) -> not found; forcing its key as auth -> mismatch
+    with pytest.raises((AuthTokenError, CipherKeyNotFoundError)):
+        decrypt(blob, proxy_b.cache)
+
+
+def test_rotation_old_records_still_decrypt():
+    kms = SimKmsConnector()
+    proxy = EncryptKeyProxy(kms, refresh_interval=0)  # refresh every call
+    k1 = proxy.get_latest_cipher(3)
+    old = encrypt(b"written under base 1", k1, k1)
+    kms.rotate(3)
+    k2 = proxy.get_latest_cipher(3)
+    assert k2.base_id == k1.base_id + 1
+    new = encrypt(b"written under base 2", k2, k2)
+    # both generations decrypt from the same cache
+    assert decrypt(old, proxy.cache) == b"written under base 1"
+    assert decrypt(new, proxy.cache) == b"written under base 2"
+
+
+def test_by_id_fetch_after_cache_loss():
+    """A restarted process holds records naming (baseId, salt) pairs its
+    fresh cache has never seen — the by-id KMS path must rebuild them."""
+    kms = SimKmsConnector()
+    proxy = EncryptKeyProxy(kms, refresh_interval=600)
+    key = proxy.get_latest_cipher(5)
+    blob = encrypt(b"survives restart", key, key)
+
+    fresh = EncryptKeyProxy(kms, refresh_interval=600)
+    from foundationdb_tpu.crypto.blob_cipher import EncryptHeader
+
+    hdr = EncryptHeader.unpack(blob)
+    fresh.get_cipher_by_id(hdr.domain_id, hdr.base_id, hdr.salt)
+    assert decrypt(blob, fresh.cache) == b"survives restart"
+
+
+def test_revoked_base_key():
+    kms = SimKmsConnector()
+    proxy = EncryptKeyProxy(kms, refresh_interval=600)
+    key = proxy.get_latest_cipher(9)
+    kms.revoke(9, key.base_id)
+    fresh = EncryptKeyProxy(kms, refresh_interval=600)
+    with pytest.raises(KmsError):
+        fresh.get_cipher_by_id(9, key.base_id, key.salt)
+
+
+def test_proxy_caches_kms_round_trips():
+    proxy = make_proxy()
+    for _ in range(10):
+        proxy.get_latest_cipher(1)
+        proxy.get_latest_cipher(2)
+    assert proxy.fetches == 2  # one per domain
+
+
+def test_rest_kms_stub_server():
+    srv, port = serve_stub_kms()
+    try:
+        rest = RestKmsConnector(f"127.0.0.1:{port}")
+        proxy = EncryptKeyProxy(rest, refresh_interval=600)
+        key = proxy.get_latest_cipher(11)
+        blob = encrypt(b"over REST", key, key)
+        assert decrypt(blob, proxy.cache) == b"over REST"
+        # rotation via REST; by-id fetch of the old generation still works
+        rest.rotate(11)
+        proxy2 = EncryptKeyProxy(rest, refresh_interval=600)
+        k2 = proxy2.get_latest_cipher(11)
+        assert k2.base_id == key.base_id + 1
+        proxy2.get_cipher_by_id(key.domain_id, key.base_id, key.salt)
+        assert decrypt(blob, proxy2.cache) == b"over REST"
+    finally:
+        srv.shutdown()
+
+
+def test_empty_and_large_payloads():
+    proxy = make_proxy()
+    key = proxy.get_latest_cipher(0)
+    for payload in (b"", b"\x00" * 1024, bytes(range(256)) * 4096):
+        assert decrypt(encrypt(payload, key, key), proxy.cache) == payload
+
+
+def test_rotation_survives_fresh_kms_connector():
+    """A restarted process builds a FRESH SimKmsConnector; records sealed
+    under a rotated (higher) base id must still be recoverable — the
+    secrets are deterministic, so by-id serving must not be capped by
+    the fresh process's counter (code review r5)."""
+    kms = SimKmsConnector()
+    kms.rotate(4)  # base id 2
+    proxy = EncryptKeyProxy(kms, refresh_interval=600)
+    key = proxy.get_latest_cipher(4)
+    assert key.base_id == 2
+    blob = encrypt(b"post-rotation", key, key)
+
+    fresh = EncryptKeyProxy(SimKmsConnector(), refresh_interval=600)
+    fresh.get_cipher_by_id(key.domain_id, key.base_id, key.salt)
+    assert decrypt(blob, fresh.cache) == b"post-rotation"
+    # by-id serving must NOT mutate the rotation counter (unverified
+    # on-disk ids steering KMS state — second review pass): the fresh
+    # connector still encrypts new data under ITS latest generation,
+    # and old records stay decryptable by id
+    bid, _ = fresh.kms.fetch_base_key(4)
+    assert bid == 1
+
+
+def test_nonblocking_seal_uses_stale_key_and_refreshes():
+    """The seal path never blocks on the KMS: past the refresh deadline
+    it seals under the stale key while a background refresh runs."""
+    import time as _time
+
+    class SlowKms(SimKmsConnector):
+        def __init__(self):
+            super().__init__()
+            self.calls = 0
+
+        def fetch_base_key(self, domain_id):
+            self.calls += 1
+            if self.calls > 1:
+                _time.sleep(0.2)  # a slow KMS after the first fetch
+            return super().fetch_base_key(domain_id)
+
+    kms = SlowKms()
+    proxy = EncryptKeyProxy(kms, refresh_interval=0.01)
+    k1 = proxy.get_latest_cipher(1)
+    _time.sleep(0.02)  # k1 is now past refresh
+    t0 = _time.perf_counter()
+    k2 = proxy.get_latest_cipher_nonblocking(1)
+    took = _time.perf_counter() - t0
+    assert took < 0.1, f"seal path blocked on the KMS ({took:.3f}s)"
+    assert k2.salt == k1.salt  # the stale key, served immediately
+    # the background refresh eventually lands a fresh key
+    deadline = _time.time() + 2
+    while _time.time() < deadline:
+        cur = proxy.cache.latest_any(1)
+        if cur.salt != k1.salt:
+            break
+        _time.sleep(0.02)
+    assert proxy.cache.latest_any(1).salt != k1.salt
