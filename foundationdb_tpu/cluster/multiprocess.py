@@ -654,7 +654,7 @@ class StorageRole:
         """Replay the tlog tail above our durable version (the restart
         path of storageserver.actor.cpp:9117's pull loop) in batched
         chunks — linear in tail length."""
-        conn = transport.RpcConnection(tlog_address)
+        conn = transport.RpcConnection(tlog_address, tls=_tls_from_env())
         await conn.connect()
         try:
             while True:
@@ -868,7 +868,7 @@ async def _serve_role(
     storage_engine: str = "memory",
     encrypt: bool = False,
 ) -> None:
-    server = transport.RpcServer(address)
+    server = transport.RpcServer(address, tls=_tls_from_env())
 
     async def ping(msg: Ping) -> Pong:
         return Pong(payload=msg.payload)
@@ -1150,8 +1150,26 @@ class ProxyPipeline:
                 fut.set_exception(NotCommittedError(TransactionResult(v).name))
 
 
+def _tls_from_env():
+    """Cluster TLS the way the reference's fdbserver picks it up from
+    TLSConfig/environment (flow/TLSConfig.actor.cpp:
+    TLS_CERTIFICATE_FILE etc.): FDB_TPU_TLS_DIR names a directory with
+    ca.crt + node.crt/node.key (crypto.tls.make_test_tls layout); all
+    roles and clients then speak mutual TLS under that CA."""
+    tls_dir = os.environ.get("FDB_TPU_TLS_DIR")
+    if not tls_dir:
+        return None
+    from foundationdb_tpu.crypto.tls import TLSConfig
+
+    return TLSConfig(
+        ca_file=os.path.join(tls_dir, "ca.crt"),
+        cert_file=os.path.join(tls_dir, "node.crt"),
+        key_file=os.path.join(tls_dir, "node.key"),
+    )
+
+
 async def connect(address, **kw) -> transport.RpcConnection:
-    conn = transport.RpcConnection(address)
+    conn = transport.RpcConnection(address, tls=_tls_from_env())
     await conn.connect(**kw)
     return conn
 

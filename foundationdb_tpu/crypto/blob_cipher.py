@@ -114,7 +114,15 @@ class BlobCipherKeyCache:
 
     def latest(self, domain_id: int) -> BlobCipherKey:
         key = self._latest.get(domain_id)
-        if key is None or not key.usable_for_encrypt():
+        # an EXPIRED key must not serve encryption either (with
+        # expire_interval < refresh_interval a record sealed under it
+        # would be durably unreadable — code review r5): both
+        # deadlines gate here so the proxy re-derives.
+        if (
+            key is None
+            or not key.usable_for_encrypt()
+            or not key.usable_for_decrypt()
+        ):
             raise CipherKeyNotFoundError(
                 f"no fresh encryption key for domain {domain_id}"
             )

@@ -1,0 +1,181 @@
+"""Mutual TLS for the wire transport — the flow/TLSConfig analog.
+
+The reference's transport security (flow/TLSConfig.actor.cpp,
+fdbrpc/FlowTransport.actor.cpp TLS paths): every connection is mutual
+TLS — server AND client present certificates chained to the cluster's
+CA, and either side drops peers that fail verification (verify_peers).
+Same contract here over asyncio's ssl support:
+
+* `generate_ca` / `issue_cert` mint a cluster CA and per-node certs
+  with the `cryptography` package (the reference ships mkcert.sh and
+  loads PEM through OpenSSL — same primitives).
+* `TLSConfig` holds PEM paths + an optional verify-peers check on the
+  peer certificate's subject (the reference's verify_peers strings,
+  e.g. requiring an O= match, TLSPolicy::verify_peer).
+* `server_context` / `client_context` build ssl.SSLContexts enforcing
+  TLS >= 1.2, CERT_REQUIRED both ways, and our CA as the only root.
+
+Hostname checking is disabled in favor of CA pinning + subject
+verification: cluster nodes are addressed by socket path/ephemeral
+port, not DNS names — exactly why the reference verifies by
+certificate attributes rather than hostnames.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import ipaddress
+import os
+import ssl
+from typing import Optional
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+
+def _name(common_name: str, organization: str) -> x509.Name:
+    return x509.Name([
+        x509.NameAttribute(NameOID.COMMON_NAME, common_name),
+        x509.NameAttribute(NameOID.ORGANIZATION_NAME, organization),
+    ])
+
+
+def generate_ca(directory: str, *, organization: str = "fdb-tpu-cluster",
+                days: int = 3650) -> tuple[str, str]:
+    """Mint a cluster CA; returns (ca_cert_pem_path, ca_key_pem_path)."""
+    os.makedirs(directory, exist_ok=True)
+    key = ec.generate_private_key(ec.SECP256R1())
+    now = datetime.datetime.now(datetime.timezone.utc)
+    subject = _name("fdb-tpu-ca", organization)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(subject)
+        .issuer_name(subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=days))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=0),
+                       critical=True)
+        .sign(key, hashes.SHA256())
+    )
+    cert_path = os.path.join(directory, "ca.crt")
+    key_path = os.path.join(directory, "ca.key")
+    with open(cert_path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    with open(key_path, "wb") as f:
+        f.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        ))
+    return cert_path, key_path
+
+
+def issue_cert(directory: str, ca_cert_path: str, ca_key_path: str,
+               common_name: str, *, organization: str = "fdb-tpu-cluster",
+               days: int = 825) -> tuple[str, str]:
+    """Issue a node certificate signed by the CA; returns
+    (cert_pem_path, key_pem_path)."""
+    with open(ca_cert_path, "rb") as f:
+        ca_cert = x509.load_pem_x509_certificate(f.read())
+    with open(ca_key_path, "rb") as f:
+        ca_key = serialization.load_pem_private_key(f.read(), password=None)
+    key = ec.generate_private_key(ec.SECP256R1())
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(_name(common_name, organization))
+        .issuer_name(ca_cert.subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=days))
+        .add_extension(
+            x509.SubjectAlternativeName([
+                x509.DNSName(common_name),
+                x509.IPAddress(ipaddress.ip_address("127.0.0.1")),
+            ]),
+            critical=False,
+        )
+        .sign(ca_key, hashes.SHA256())
+    )
+    cert_path = os.path.join(directory, f"{common_name}.crt")
+    key_path = os.path.join(directory, f"{common_name}.key")
+    with open(cert_path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    with open(key_path, "wb") as f:
+        f.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        ))
+    return cert_path, key_path
+
+
+@dataclasses.dataclass
+class TLSConfig:
+    """PEM paths + peer verification policy (TLSConfig + verify_peers)."""
+
+    ca_file: str
+    cert_file: str
+    key_file: str
+    #: Optional required O= (organization) on the PEER certificate —
+    #: the reference's verify_peers "O=..." check class. None = any
+    #: cert under the CA.
+    verify_peer_organization: Optional[str] = None
+
+    def _base_context(self, purpose: ssl.Purpose) -> ssl.SSLContext:
+        ctx = ssl.SSLContext(
+            ssl.PROTOCOL_TLS_SERVER
+            if purpose is ssl.Purpose.CLIENT_AUTH
+            else ssl.PROTOCOL_TLS_CLIENT
+        )
+        ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+        ctx.load_cert_chain(self.cert_file, self.key_file)
+        ctx.load_verify_locations(self.ca_file)
+        ctx.verify_mode = ssl.CERT_REQUIRED  # mutual TLS both ways
+        ctx.check_hostname = False  # CA pinning + subject checks instead
+        return ctx
+
+    def server_context(self) -> ssl.SSLContext:
+        return self._base_context(ssl.Purpose.CLIENT_AUTH)
+
+    def client_context(self) -> ssl.SSLContext:
+        return self._base_context(ssl.Purpose.SERVER_AUTH)
+
+    def verify_peer(self, ssl_object) -> None:
+        """Post-handshake peer-attribute check (TLSPolicy::verify_peer):
+        raises ssl.SSLError when the peer cert's subject does not carry
+        the required organization."""
+        if self.verify_peer_organization is None:
+            return
+        der = ssl_object.getpeercert(binary_form=True)
+        if der is None:
+            raise ssl.SSLError("peer presented no certificate")
+        cert = x509.load_der_x509_certificate(der)
+        orgs = [
+            a.value
+            for a in cert.subject.get_attributes_for_oid(
+                NameOID.ORGANIZATION_NAME
+            )
+        ]
+        if self.verify_peer_organization not in orgs:
+            raise ssl.SSLError(
+                f"peer organization {orgs!r} does not match required "
+                f"{self.verify_peer_organization!r}"
+            )
+
+
+def make_test_tls(directory: str, names=("server", "client"), **kw):
+    """One CA + one cert per name: the test/cluster-bootstrap helper.
+    Returns {name: TLSConfig}."""
+    ca_cert, ca_key = generate_ca(directory, **kw)
+    out = {}
+    for n in names:
+        cert, key = issue_cert(directory, ca_cert, ca_key, n, **kw)
+        out[n] = TLSConfig(ca_file=ca_cert, cert_file=cert, key_file=key)
+    return out

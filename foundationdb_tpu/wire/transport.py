@@ -32,6 +32,7 @@ sim tests never touch this module.
 from __future__ import annotations
 
 import asyncio
+import ssl as _ssl
 import struct
 import zlib
 from typing import Any, Callable
@@ -104,10 +105,17 @@ Address = "str | tuple[str, int]"  # UDS path or (host, port)
 
 
 class RpcServer:
-    """Serves registered endpoint tokens over UDS or TCP."""
+    """Serves registered endpoint tokens over UDS or TCP.
 
-    def __init__(self, address):
+    With `tls` (a crypto.tls.TLSConfig), every connection is MUTUAL
+    TLS under the cluster CA — the reference's FlowTransport TLS mode
+    (flow/TLSConfig.actor.cpp): a client without a CA-chained cert is
+    dropped at handshake, and verify_peers-style subject checks run
+    before any frame is served."""
+
+    def __init__(self, address, *, tls=None):
         self.address = address
+        self.tls = tls
         self._handlers: dict[int, Callable] = {}
         self._server: asyncio.AbstractServer | None = None
 
@@ -118,14 +126,15 @@ class RpcServer:
         self._handlers[token] = handler
 
     async def start(self) -> None:
+        ssl_ctx = self.tls.server_context() if self.tls else None
         if isinstance(self.address, str):
             self._server = await asyncio.start_unix_server(
-                self._serve_conn, path=self.address
+                self._serve_conn, path=self.address, ssl=ssl_ctx
             )
         else:
             host, port = self.address
             self._server = await asyncio.start_server(
-                self._serve_conn, host=host, port=port
+                self._serve_conn, host=host, port=port, ssl=ssl_ctx
             )
 
     async def close(self) -> None:
@@ -136,6 +145,11 @@ class RpcServer:
 
     async def _serve_conn(self, reader, writer) -> None:
         try:
+            if self.tls is not None:
+                # verify_peers-style subject check on the CLIENT cert
+                # (mutual TLS: the context already required one)
+                sslobj = writer.get_extra_info("ssl_object")
+                self.tls.verify_peer(sslobj)
             await _handshake(reader, writer)
             pending: set[asyncio.Task] = set()
             while True:
@@ -156,6 +170,8 @@ class RpcServer:
             ChecksumError,
         ):
             pass
+        except _ssl.SSLError:
+            pass  # failed peer verification / non-TLS client: drop
         finally:
             writer.close()
 
@@ -178,8 +194,9 @@ class RpcServer:
 class RpcConnection:
     """Client side: one connection, correlated request/reply."""
 
-    def __init__(self, address):
+    def __init__(self, address, *, tls=None):
         self.address = address
+        self.tls = tls
         self._reader = None
         self._writer = None
         self._next_id = 1
@@ -188,24 +205,52 @@ class RpcConnection:
 
     async def connect(self, *, retries: int = 50, delay: float = 0.1) -> None:
         last = None
+        ssl_ctx = self.tls.client_context() if self.tls else None
         for _ in range(retries):
             try:
                 if isinstance(self.address, str):
                     self._reader, self._writer = await asyncio.open_unix_connection(
-                        path=self.address
+                        path=self.address, ssl=ssl_ctx,
+                        server_hostname="" if ssl_ctx else None,
                     )
                 else:
                     host, port = self.address
                     self._reader, self._writer = await asyncio.open_connection(
-                        host=host, port=port
+                        host=host, port=port, ssl=ssl_ctx
                     )
                 break
+            except _ssl.SSLError as e:
+                # a certificate the server refuses (or a plaintext
+                # server) will refuse identically on every retry —
+                # surface it now instead of burning the retry budget
+                raise TransportError(
+                    f"TLS handshake with {self.address} failed: {e}"
+                )
             except (ConnectionError, FileNotFoundError, OSError) as e:
                 last = e
                 await asyncio.sleep(delay)
         else:
             raise TransportError(f"cannot connect to {self.address}: {last}")
-        await _handshake(self._reader, self._writer)
+        if self.tls is not None:
+            # verify_peers-style subject check on the SERVER cert
+            try:
+                self.tls.verify_peer(
+                    self._writer.get_extra_info("ssl_object")
+                )
+            except _ssl.SSLError as e:
+                self._writer.close()
+                raise TransportError(f"server failed peer verification: {e}")
+        try:
+            await _handshake(self._reader, self._writer)
+        except (asyncio.IncompleteReadError, ConnectionError) as e:
+            # the peer hung up mid-handshake — with TLS configured this
+            # is typically cert refusal (mutual TLS / verify_peers);
+            # without, a TLS server refusing a plaintext client
+            self._writer.close()
+            raise TransportError(
+                f"handshake with {self.address} failed "
+                f"(peer closed: {e!r})"
+            )
         self._reader_task = asyncio.ensure_future(self._read_loop())
 
     async def close(self) -> None:

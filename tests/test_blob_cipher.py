@@ -198,3 +198,22 @@ def test_nonblocking_seal_uses_stale_key_and_refreshes():
             break
         _time.sleep(0.02)
     assert proxy.cache.latest_any(1).salt != k1.salt
+
+
+def test_expired_latest_forces_fresh_derivation():
+    """expire_interval < refresh_interval: once the latest key expires,
+    the NEXT seal must derive a fresh key — sealing under the expired
+    key would produce durably unreadable records (code review r5)."""
+    import time as _time
+
+    proxy = EncryptKeyProxy(
+        SimKmsConnector(), refresh_interval=600, expire_interval=0.05
+    )
+    k1 = proxy.get_latest_cipher(1)
+    _time.sleep(0.06)
+    k2 = proxy.get_latest_cipher(1)       # blocking path
+    assert k2.salt != k1.salt             # re-derived, not the expired key
+    k3 = proxy.get_latest_cipher_nonblocking(1)
+    assert k3.salt != k1.salt
+    blob = encrypt(b"readable", k3, k3)
+    assert decrypt(blob, proxy.cache) == b"readable"
