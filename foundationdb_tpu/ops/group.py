@@ -247,9 +247,7 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
     s_mver = s[w + 1]
 
     is_sent = spk == K.SENTINEL_WORD
-    s_is_point = (((spk >> sh_pt) & 1) == 1) & ~is_sent
     s_is_main = (((spk >> sh_pt) & 1) == 0) & ~is_sent
-    s_batch = ((spk >> 2) & ((1 << bits_b) - 1)).astype(jnp.int32)
     s_len = spk >> sh_len
 
     # block = run of rows with one full key (byte words + len)
@@ -271,54 +269,74 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
     il_row = cm - 1                    # searchsorted-right(key) - 1 vs main
     ir_row = mains_before_block - 1    # searchsorted-left(key) - 1 vs main
 
-    # per-batch local ranks: dense block count within each batch's rows
+    # ---- per-batch local ranks: one BATCHED sort over [G, P] ----------
+    # Dense ranks of the full key (byte words + len) among each batch's
+    # own point rows — identical to the global block ranks restricted
+    # per batch (what the intra-batch fixpoint needs), but computed by
+    # a [G, 2(NR+NW)]-shaped sort + row cumsum + inverse sort instead
+    # of the r3-r5 [r_rows, G] one-hot cumsum + flat gather: the r5
+    # jax.profiler trace attributed the two largest skeleton fusions
+    # (~41 ms/group at bench shapes) to that one-hot machinery, while
+    # these sorts stream ~2.1M rows once. Dead rows key to the
+    # sentinel; their ranks are garbage and every consumer masks by
+    # read_live/write_live (unchanged contract).
     if "lcum" in _ablate:
-        lrank_row = jnp.zeros((r_rows,), jnp.int32)
+        lq_lo = lq_hi = jnp.zeros((gn, nr), jnp.int32)
+        lw_lo = lw_hi = jnp.zeros((gn, nw), jnp.int32)
     else:
-        onehot = (
-            s_is_point[:, None]
-            & (s_batch[:, None] == jnp.arange(gn, dtype=jnp.int32)[None, :])
+        p_per = 2 * nr + 2 * nw
+        rl2 = read_live.reshape(gn, nr)
+        wl2 = write_live.reshape(gn, nw)
+        live_p = jnp.concatenate([rl2, rl2, wl2, wl2], axis=1)  # [G, P]
+
+        def pcol(i):
+            c = jnp.concatenate([
+                rb_k[:, i].reshape(gn, nr), re_k[:, i].reshape(gn, nr),
+                wb_k[:, i].reshape(gn, nw), we_k[:, i].reshape(gn, nw),
+            ], axis=1)
+            return jnp.where(live_p, c, K.SENTINEL_WORD)
+
+        iota_p = jnp.broadcast_to(
+            jnp.arange(p_per, dtype=jnp.int32)[None, :], (gn, p_per)
         )
-        prev_onehot = jnp.concatenate(
-            [jnp.zeros((1, gn), bool), onehot[:-1]], axis=0
+        ps = jax.lax.sort(
+            [pcol(i) for i in range(w)] + [iota_p], num_keys=w
         )
-        same_block = ~key_new
-        first_in_block = onehot & ~(prev_onehot & same_block[:, None])
-        lcum = jnp.cumsum(first_in_block.astype(jnp.int32), axis=0)  # [R, G]
-        # FLAT 1D gather, not take_along_axis: 2D data-dependent gathers
-        # measure in the ~140ns/element class on v5e vs ~5ns flattened
-        # (the same asymmetry as rangemax.query — measured round 3)
-        lrank_row = (
-            lcum.reshape(-1)[iota * gn + jnp.clip(s_batch, 0, gn - 1)] - 1
-        )
+        pnew = jnp.zeros((gn, p_per), bool)
+        for c in ps[:w]:
+            prev = jnp.concatenate(
+                [jnp.full((gn, 1), 0xDEADBEEF, c.dtype), c[:, :-1]], axis=1
+            )
+            pnew |= c != prev
+        pnew = pnew.at[:, 0].set(True)
+        prank = jnp.cumsum(pnew.astype(jnp.int32), axis=1) - 1
+        _, lrank2 = jax.lax.sort([ps[w], prank], num_keys=1)  # [G, P]
+        lq_lo = lrank2[:, :nr]
+        lq_hi = lrank2[:, nr : 2 * nr]
+        lw_lo = lrank2[:, 2 * nr : 2 * nr + nw]
+        lw_hi = lrank2[:, 2 * nr + nw :]
 
     # ---- per-point data back to input order: ONE sort, not scatters ----
     # Route by ROW ORIGIN (point rows are siota >= m, live or dead), so
     # every point ordinal 0..p_pts-1 appears exactly once and a stable
     # sort keyed by ordinal is a perfect inverse permutation. One
-    # 5-operand sort (~r_rows x 5 x 0.45ns) replaces four ~50ns/update
+    # 4-operand sort (~r_rows x 4 x 0.45ns) replaces four ~50ns/update
     # scatters. Dead points now carry GARBAGE values (the old scatters
     # filled -1/0): every consumer masks by read_live/write_live.
     p_pts = 2 * rn + 2 * wn
     po_all = jnp.where(siota >= m, siota - m, p_pts)
     sp = jax.lax.sort(
-        [po_all, bi, lrank_row, il_row, ir_row], num_keys=1
+        [po_all, bi, il_row, ir_row], num_keys=1
     )
     rank_pt = sp[1][:p_pts]
-    lrank_pt = sp[2][:p_pts]
-    il_pt = sp[3][:p_pts]
-    ir_pt = sp[4][:p_pts]
+    il_pt = sp[2][:p_pts]
+    ir_pt = sp[3][:p_pts]
 
     rank_rb, rank_re = rank_pt[:rn], rank_pt[rn : 2 * rn]
     rank_wb = rank_pt[2 * rn : 2 * rn + wn]
     rank_we = rank_pt[2 * rn + wn :]
     il = il_pt[:rn]
     ir = ir_pt[rn : 2 * rn]
-
-    lq_lo = lrank_pt[:rn].reshape(gn, nr)
-    lq_hi = lrank_pt[rn : 2 * rn].reshape(gn, nr)
-    lw_lo = lrank_pt[2 * rn : 2 * rn + wn].reshape(gn, nw)
-    lw_hi = lrank_pt[2 * rn + wn :].reshape(gn, nw)
 
     # span-violation latch for the short_span_limit fast paths
     span_ok = jnp.asarray(True)

@@ -92,10 +92,12 @@ def query(table: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray, *, op: str = "ma
     # data-dependent gathers (landing on the wrong level); bench.py's
     # per-run decision-parity assertion against the CPU baselines and
     # the TPU parity suites guard against a regression of that bug.
+    # ONE concatenated gather for both endpoints (r5: two 64K-index
+    # gathers cost ~2 x fixed overhead of one 128K gather)
     flat = table.reshape(-1)
-    va = flat[k * m + a]
-    vb = flat[k * m + b]
-    return jnp.where(hic > loc, fn(va, vb), ident)
+    q = a.shape[0]
+    g = flat[jnp.concatenate([k * m + a, k * m + b])]
+    return jnp.where(hic > loc, fn(g[:q], g[q:]), ident)
 
 
 # ---------------------------------------------------------------------------
@@ -151,17 +153,21 @@ def query2(tables, lo: jnp.ndarray, hi: jnp.ndarray, *, op: str = "max"):
     length = jnp.maximum(hic - loc, 1)
     flat = fine.reshape(-1)
 
-    # spans <= CHUNK: standard two-gather sparse query on the fine table
+    # spans <= CHUNK: sparse query on the fine table; spans > CHUNK:
+    # head chunk-span + contained chunks + tail chunk-span (overlapping
+    # cover — exact for idempotent ops). All four fine-table gathers
+    # ride ONE concatenated gather (r5 batching).
     ks = _floor_log2(jnp.minimum(length, CHUNK), CHUNK_BITS + 1)
     a = jnp.clip(loc, 0, m2 - 1)
     b = jnp.clip(hic - (1 << ks), 0, m2 - 1)
-    short = fn(flat[ks * m2 + a], flat[ks * m2 + b])
-
-    # spans > CHUNK: head chunk-span + contained chunks + tail chunk-span
-    # (overlapping cover — exact for idempotent ops)
     top = CHUNK_BITS * m2
-    head = flat[top + a]
-    tail = flat[top + jnp.clip(hic - CHUNK, 0, m2 - 1)]
+    q = a.shape[0]
+    g = flat[jnp.concatenate([
+        ks * m2 + a, ks * m2 + b,
+        top + a, top + jnp.clip(hic - CHUNK, 0, m2 - 1),
+    ])]
+    short = fn(g[:q], g[q : 2 * q])
+    head, tail = g[2 * q : 3 * q], g[3 * q :]
     c0 = (loc + CHUNK - 1) >> CHUNK_BITS
     c1 = hic >> CHUNK_BITS  # exclusive
     mid = query(coarse, c0, c1, op=op)

@@ -55,10 +55,11 @@ def min_cover(
     k_idx = jnp.where(valid, k, levels)
     pos1 = jnp.where(valid, lo, 0)
     pos2 = jnp.where(valid, hi - (1 << k), 0)
+    # ONE concatenated scatter for both endpoints (r5 batching)
     table = (
         jnp.full(((levels + 1) * leaves,), INT32_POS, jnp.int32)
-        .at[k_idx * leaves + pos1].min(val)
-        .at[k_idx * leaves + pos2].min(val)
+        .at[jnp.concatenate([k_idx * leaves + pos1, k_idx * leaves + pos2])]
+        .min(jnp.concatenate([val, val]))
         .reshape(levels + 1, leaves)
     )
     t = table[:levels]
