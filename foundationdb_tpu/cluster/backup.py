@@ -17,8 +17,6 @@ import json
 import os
 from typing import Any, Optional
 
-from foundationdb_tpu.runtime.flow import ActorCancelled
-
 
 class BackupContainer:
     """In-memory container (the IBackupContainer shape)."""
@@ -83,13 +81,33 @@ def _unjsonable(x):
     return x
 
 
+def select_snapshot(container, target_version=None) -> int:
+    """Newest snapshot at-or-below target (shared by the sequential
+    and parallel restore paths — one eligibility rule, not two)."""
+    snaps = [
+        int(n.split("/")[1])
+        for n in container.list_files("snapshots/")
+        if n.endswith("/manifest")
+    ]
+    if not snaps:
+        raise ValueError("container has no snapshots")
+    eligible = [
+        v for v in snaps if target_version is None or v <= target_version
+    ]
+    if not eligible:
+        raise ValueError(
+            f"no snapshot at or below target version {target_version}"
+        )
+    return max(eligible)
+
+
 class BackupAgent:
     """Drives snapshot + log backup against a live cluster."""
 
     def __init__(self, db, container: BackupContainer):
         self.db = db
         self.container = container
-        self._log_task = None
+        self._manager = None
         self.log_version = 0
 
     # -- snapshot (range files; FileBackupAgent range tasks) ---------------
@@ -122,66 +140,40 @@ class BackupAgent:
         )
         return version
 
-    # -- continuous mutation log (BackupWorker pull loop) -----------------
+    # -- continuous mutation log (BackupWorker roles) ---------------------
 
     def start_log_backup(self, cluster) -> None:
-        sched = self.db.sched
-        tlog = cluster.tlog
-        from foundationdb_tpu.cluster.tlog import LOG_STREAM_TAG
+        """Recruit per-epoch BackupWorkers (cluster/backup_worker.py):
+        the full-stream tag — every committed mutation exactly once, in
+        commit order — flows into log files, and recoveries hand off
+        between workers with chained watermarks (the reference's
+        BackupWorker displacement discipline)."""
+        from foundationdb_tpu.cluster.backup_worker import (
+            BackupWorkerManager,
+        )
 
         self.register_log_consumer(cluster)
-
-        async def pull():
-            try:
-                # the full-stream tag: every committed mutation exactly
-                # once, in commit order — per-storage tags would replay a
-                # mutation once per team replica (atomics would double-
-                # apply on restore in replicated configs)
-                after = self.log_version
-                while True:
-                    got, log_version = await tlog.peek(LOG_STREAM_TAG, after)
-                    entries = {v: msgs for v, msgs in got if msgs}
-                    if entries:
-                        # zero-padded version keys: restore sorts these
-                        # strings, so unpadded digits would replay out of
-                        # numeric order
-                        self.container.write_file(
-                            f"logs/{min(entries):016d}",
-                            {f"{v:016d}": m for v, m in sorted(entries.items())},
-                        )
-                    after = max(log_version, max(entries, default=0))
-                    self.log_version = after
-                    tlog.pop(LOG_STREAM_TAG, after, consumer="backup")
-                    await tlog.version.when_at_least(after + 1)
-            except ActorCancelled:
-                raise
-
-        self._log_task = sched.spawn(pull(), name="backup-worker")
+        self._manager = BackupWorkerManager(
+            self.db.sched, lambda: cluster, self.container,
+            start_version=self.log_version,
+        )
+        self._manager.start()
 
     def stop_log_backup(self) -> None:
-        if self._log_task is not None:
-            self._log_task.cancel()
-            self._tlog.unregister_consumer("backup")
+        if self._manager is not None:
+            self.log_version = self._manager.saved_version
+            if self._manager.worker is not None:
+                self.log_version = max(
+                    self.log_version, self._manager.worker.saved_version
+                )
+            self._manager.stop()  # owns the consumer registration
+            self._manager = None
 
     # -- restore (parallel-restore roles, compressed to one pass) ----------
 
     async def restore(self, *, target_version: Optional[int] = None) -> int:
         """Clear the keyspace and restore snapshot + logs up to target."""
-        snaps = [
-            int(n.split("/")[1])
-            for n in self.container.list_files("snapshots/")
-            if n.endswith("/manifest")
-        ]
-        if not snaps:
-            raise ValueError("container has no snapshots")
-        eligible = [
-            v for v in snaps if target_version is None or v <= target_version
-        ]
-        if not eligible:
-            raise ValueError(
-                f"no snapshot at or below target version {target_version}"
-            )
-        base = max(eligible)
+        base = select_snapshot(self.container, target_version)
         manifest = self.container.read_file(f"snapshots/{base:016d}/manifest")
 
         txn = self.db.create_transaction()

@@ -414,6 +414,33 @@ def test_every_declared_probe_fires():
     t = sched9.spawn(taskbucket_paths(), name="drive")
     sched9.run_until(t.done)
     assert t.done.get()
+
+    # -- BackupWorker displacement (per-epoch handoff) --------------------
+    from foundationdb_tpu.cluster.backup import BackupContainer
+    from foundationdb_tpu.cluster.backup_worker import BackupWorker
+
+    bw_cont = BackupContainer()
+    bwk = BackupWorker(
+        sched9, cluster9.tlog, bw_cont, epoch=cluster9.tlog.epoch
+    )
+    bwk.start()
+
+    async def displace_paths():
+        txn = db9.create_transaction()
+        txn.set(b"bw-probe", b"1")
+        await txn.commit()
+        await sched9.delay(0.1)
+        # recovery-style epoch bump: the worker drains and hands off
+        cluster9.tlog.lock(
+            cluster9.tlog.epoch + 1, cluster9.tlog.version.get() + 1000
+        )
+        await bwk.displaced.future
+        return True
+
+    t = sched9.spawn(displace_paths(), name="drive")
+    sched9.run_until(t.done)
+    assert t.done.get()
+    bwk.stop()
     cluster9.stop()
 
     # -- slow-task detection ----------------------------------------------
