@@ -152,31 +152,51 @@ class BlobStoreContainer(BackupContainer):
     def __init__(self, endpoint: str, bucket: str = "backup"):
         self.endpoint = endpoint  # "host:port"
         self.bucket = bucket
+        self._conn = None  # persistent HTTP/1.1 keep-alive connection
+
+    def _connection(self):
+        if self._conn is None:
+            import http.client
+
+            host, port = self.endpoint.rsplit(":", 1)
+            self._conn = http.client.HTTPConnection(
+                host, int(port), timeout=30
+            )
+        return self._conn
 
     def _request(self, method: str, key: str = "", body: bytes = None,
                  query: str = ""):
-        import http.client
+        path = f"/{_escape(self.bucket)}"
+        if key:
+            path += f"/{_escape(key)}"
+        if query:
+            path += f"?{query}"
+        # one persistent keep-alive connection per container (a backup
+        # writes one object per pulled batch — per-request TCP setup
+        # was pure overhead; code review r5); one reconnect retry
+        # covers a server-side idle close
+        for attempt in (0, 1):
+            conn = self._connection()
+            try:
+                conn.request(method, path, body)
+                resp = conn.getresponse()
+                data = resp.read()
+                break
+            except (ConnectionError, OSError):
+                self._conn = None
+                conn.close()
+                if attempt:
+                    raise
+        if resp.status == 404:
+            raise FileNotFoundError(key)
+        if resp.status >= 300:
+            raise BlobStoreError(f"{method} {path} -> HTTP {resp.status}")
+        return data
 
-        host, port = self.endpoint.rsplit(":", 1)
-        conn = http.client.HTTPConnection(host, int(port), timeout=30)
-        try:
-            path = f"/{_escape(self.bucket)}"
-            if key:
-                path += f"/{_escape(key)}"
-            if query:
-                path += f"?{query}"
-            conn.request(method, path, body)
-            resp = conn.getresponse()
-            data = resp.read()
-            if resp.status == 404:
-                raise FileNotFoundError(key)
-            if resp.status >= 300:
-                raise BlobStoreError(
-                    f"{method} {path} -> HTTP {resp.status}"
-                )
-            return data
-        finally:
-            conn.close()
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
 
     def write_file(self, name: str, data) -> None:
         self._request(

@@ -53,6 +53,10 @@ class ClusterConfig:
     # satisfy (PolicyAcross zones/DCs — fdbrpc/ReplicationPolicy.cpp)
     storage_localities: dict = None
     replication_policy: object = None
+    # TSS mirror pairs (design/tss.md): TSS i mirrors storage server i
+    # for i < n_tss — same log tag, so identical content by
+    # construction; clients duplicate a read sample for comparison
+    n_tss: int = 0
     # When set, role-to-role calls go through a SimNetwork with this seed
     # (deterministic latency; clogging/partition fault injection).
     sim_seed: int = None
@@ -156,6 +160,17 @@ class Cluster:
             )
             for s in range(cfg.n_storage)
         ]
+        # TSS mirrors: same tag as their paired server => the
+        # tag-partitioned log delivers them the identical mutation
+        # stream (cluster/tss.py; fdbserver/storageserver.actor.cpp TSS)
+        self.tss_servers = {
+            s: StorageServer(
+                sched, self.tlog, tag=s,
+                window_versions=cfg.window_versions,
+                consumer=f"tss{s}",
+            )
+            for s in range(cfg.n_tss)
+        }
         # failure-monitor view of storage liveness (clients skip dead
         # replicas; see fdbrpc/FailureMonitor.actor.cpp)
         self.storage_live = [True] * cfg.n_storage
@@ -200,6 +215,12 @@ class Cluster:
             )
             for s, ss in enumerate(self.storage_servers)
         ]
+        self.client_tss = {
+            s: self._wrapped(
+                "client", f"tss{s}", ss, ["get_value", "get_key_values"]
+            )
+            for s, ss in self.tss_servers.items()
+        }
         from foundationdb_tpu.cluster.data_distribution import DataDistributor
         from foundationdb_tpu.cluster.failure_monitor import FailureMonitor
         from foundationdb_tpu.cluster.recovery import ClusterController
@@ -349,6 +370,8 @@ class Cluster:
         self.sched.run_until(self.sched.spawn(self._bootstrap()).done)
         for ss in self.storage_servers:
             ss.start()
+        for ss in self.tss_servers.values():
+            ss.start()
         for cp in self.commit_proxies:
             cp.start()
         self.grv_proxy.start()
@@ -364,6 +387,8 @@ class Cluster:
         self.controller.stop()
         self.balancer.stop()
         for ss in self.storage_servers:
+            ss.stop()
+        for ss in self.tss_servers.values():
             ss.stop()
         for cp in self.commit_proxies:
             cp.stop()

@@ -172,6 +172,14 @@ class LogSystem:
         for t in self.tlogs + self.satellites:
             t.register_consumer(name)
 
+    def register_tag_mirror(self, tag: int, name: str) -> None:
+        for t in self.tlogs + self.satellites:
+            t.register_tag_mirror(tag, name)
+
+    def unregister_tag_mirror(self, tag: int, name: str) -> None:
+        for t in self.tlogs + self.satellites:
+            t.unregister_tag_mirror(tag, name)
+
     def unregister_consumer(self, name: str) -> None:
         for t in self.tlogs + self.satellites:
             t.unregister_consumer(name)

@@ -71,9 +71,14 @@ class ParallelRestore:
         log_files = cont.list_files("logs/")
 
         # ---- controller: sample keys, cut applier shards ----------------
+        # sampled files are cached — the loader pass reads them again,
+        # and against an object store every read is a full HTTP GET
+        # (code review r5)
+        file_cache: dict[str, list] = {}
         sample: list[bytes] = []
         for name in range_files[:: max(1, len(range_files) // 8)]:
             kvs = cont.read_file(name)
+            file_cache[name] = kvs
             sample.extend(bytes(k) for k, _v in kvs[:: max(1, len(kvs) // 64)])
         sample.sort()
         shards = _partition(sample, self.n_appliers)
@@ -94,7 +99,10 @@ class ParallelRestore:
         restored = base
         for name in range_files:
             files_loaded += 1
-            for k, v in cont.read_file(name):
+            kvs = file_cache.pop(name, None)
+            if kvs is None:
+                kvs = cont.read_file(name)
+            for k, v in kvs:
                 k = bytes(k)
                 plans[owner(k)]["kvs"].append((k, bytes(v)))
         for name in log_files:

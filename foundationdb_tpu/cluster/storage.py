@@ -51,10 +51,18 @@ class StorageServer:
         *,
         recovery_version: int = 0,
         window_versions: int = 5_000_000,
+        consumer: str = "storage",
     ):
         self.sched = sched
         self.tlog = tlog
         self.tag = tag
+        # the tlog pop identity: a TSS mirror shares its pair's TAG but
+        # must pop under its OWN consumer name, or whichever of the
+        # pair pulls first trims messages the other never saw
+        # (design/tss.md — the TSS has an independent pop cursor)
+        self.consumer = consumer
+        if consumer != "storage":
+            tlog.register_tag_mirror(tag, consumer)
         self.version = Notified(recovery_version)
         self.durable_version = recovery_version
         self.oldest_version = recovery_version
@@ -104,6 +112,10 @@ class StorageServer:
         self.stopped = True
         if self._update_task is not None:
             self._update_task.cancel()
+        if self.consumer != "storage":
+            # release the mirror cursor: a dead TSS must not pin its
+            # pair's tag retention (code review r5)
+            self.tlog.unregister_tag_mirror(self.tag, self.consumer)
 
     async def ping(self) -> bool:
         """Failure-monitor probe (rides the SimNetwork under simulation,
@@ -131,7 +143,9 @@ class StorageServer:
                     self.version.set(log_version)
                 self.durable_version = self.version.get()
                 self._gc(self.durable_version - self.window_versions)
-                self.tlog.pop(self.tag, self.durable_version)
+                self.tlog.pop(
+                    self.tag, self.durable_version, consumer=self.consumer
+                )
                 await self.tlog.version.when_at_least(self.version.get() + 1)
         except ActorCancelled:
             raise

@@ -80,6 +80,12 @@ class TLog:
         # per-tag popped bookkeeping generalized to backup workers, which
         # read every tag — fdbserver/BackupWorker.actor.cpp).
         self._popped: dict[str, dict[Tag, int]] = {"storage": {}}
+        # TSS mirror consumers per tag (design/tss.md): a mirror reads
+        # a STORAGE tag with its own pop cursor — retention for that
+        # tag floors at the SLOWEST of the pair, and mirror consumers
+        # never constrain LOG_STREAM_TAG (they don't read it; letting
+        # their never-popped stream marks pin it would leak the log)
+        self._tag_mirrors: dict[Tag, set[str]] = {}
         # SPILL state (TLogServer.actor.cpp:2311 spill-by-reference):
         # when retained mutations exceed SERVER_KNOBS.TLOG_SPILL_THRESHOLD,
         # the OLDEST unpopped versions are evicted from memory and
@@ -203,10 +209,35 @@ class TLog:
         """Retain messages for an extra consumer from this point on."""
         self._popped.setdefault(name, {})
 
+    def register_tag_mirror(self, tag: Tag, name: str) -> None:
+        """A TSS pair: `name` reads `tag` like a storage server with an
+        independent pop cursor (design/tss.md)."""
+        self._tag_mirrors.setdefault(tag, set()).add(name)
+        self._popped.setdefault(name, {})
+
+    def unregister_tag_mirror(self, tag: Tag, name: str) -> None:
+        """A dead TSS must release its cursor, or its frozen pop mark
+        pins the pair's tag retention forever (code review r5)."""
+        mirrors = self._tag_mirrors.get(tag)
+        if mirrors is not None:
+            mirrors.discard(name)
+            if not mirrors:
+                del self._tag_mirrors[tag]
+        self._popped.pop(name, None)
+        self._trim(tag)
+
     def has_log_consumers(self) -> bool:
-        """Any non-storage consumer registered (proxies emit the
-        full-stream tag only when someone will read it)?"""
-        return any(name != "storage" for name in self._popped)
+        """Any non-storage STREAM consumer registered (proxies emit the
+        full-stream tag only when someone will read it)? TSS mirrors
+        read storage tags only — counting them would make proxies emit
+        a stream nothing pops (unbounded growth; code review r5)."""
+        mirror_names = set().union(
+            *self._tag_mirrors.values()
+        ) if self._tag_mirrors else set()
+        return any(
+            name != "storage" and name not in mirror_names
+            for name in self._popped
+        )
 
     def unregister_consumer(self, name: str) -> None:
         if name != "storage":
@@ -321,7 +352,14 @@ class TLog:
         if tag == LOG_STREAM_TAG:
             # storage never pops the full stream; only backup/DR
             # consumers constrain it — none registered = drop everything
-            extras = [m for n, m in self._popped.items() if n != "storage"]
+            # (TSS mirrors read storage tags only, never the stream)
+            mirror_names = set().union(
+                *self._tag_mirrors.values()
+            ) if self._tag_mirrors else set()
+            extras = [
+                m for n, m in self._popped.items()
+                if n != "storage" and n not in mirror_names
+            ]
             if not extras:
                 self._mem_mutations -= sum(
                     len(m) for _v, m in self._messages.get(tag, [])
@@ -331,11 +369,14 @@ class TLog:
                 return
             floor = min(m.get(tag, 0) for m in extras)
         else:
-            # per-storage tags are governed by storage ALONE: stream
+            # per-storage tags are governed by storage ALONE (stream
             # consumers read only LOG_STREAM_TAG, and letting their
             # never-popped marks pin storage tags would leak the whole
-            # log for the lifetime of a backup/DR relationship
+            # log for the lifetime of a backup/DR relationship) — plus
+            # any TSS mirror of the tag: the pair's SLOWEST cursor
             floor = self._popped["storage"].get(tag, 0)
+            for m in self._tag_mirrors.get(tag, ()):
+                floor = min(floor, self._popped.get(m, {}).get(tag, 0))
         dropped = [
             (v, m) for v, m in self._messages.get(tag, []) if v <= floor
         ]

@@ -498,6 +498,14 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
                     mw, lqlo, lqhi, op="min", span=short_span_limit
                 )
             else:
+                # radix-2 structures. An r5 experiment switched this
+                # pipeline to radix-4 (min_cover4/build4/query4 — half
+                # the sequential levels, 4-endpoint batched gathers):
+                # it measured SLOWER in-kernel, 431.7 vs 379.2 ms/group
+                # at bench shapes (prof_r5d_radix4.log) — the 2x
+                # gather/scatter data outweighs the halved level count
+                # here. The radix-4 structures stay in ops/ (parity-
+                # tested) as a measured-negative option.
                 mw = segtree.min_cover(leaves_local, wlo, whi, val)
                 mtab = rangemax.build(mw, op="min")
                 minw = rangemax.query(mtab, lqlo, lqhi, op="min")

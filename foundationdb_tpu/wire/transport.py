@@ -71,16 +71,17 @@ class UnknownEndpointError(RemoteError):
     pass
 
 
-async def _handshake(reader, writer) -> None:
-    writer.write(MAGIC + struct.pack("<Q", codec.PROTOCOL_VERSION))
+async def _handshake(reader, writer, protocol_version: int = None) -> None:
+    ours = codec.PROTOCOL_VERSION if protocol_version is None else protocol_version
+    writer.write(MAGIC + struct.pack("<Q", ours))
     await writer.drain()
     peer = await reader.readexactly(len(MAGIC) + 8)
     if peer[: len(MAGIC)] != MAGIC:
         raise HandshakeError("bad magic from peer")
     (version,) = struct.unpack("<Q", peer[len(MAGIC) :])
-    if version != codec.PROTOCOL_VERSION:
+    if version != ours:
         raise HandshakeError(
-            f"protocol version mismatch: ours {codec.PROTOCOL_VERSION:#x}, "
+            f"protocol version mismatch: ours {ours:#x}, "
             f"peer {version:#x}"
         )
 
@@ -113,11 +114,13 @@ class RpcServer:
     dropped at handshake, and verify_peers-style subject checks run
     before any frame is served."""
 
-    def __init__(self, address, *, tls=None):
+    def __init__(self, address, *, tls=None, protocol_version: int = None):
         self.address = address
         self.tls = tls
+        self.protocol_version = protocol_version  # None = current
         self._handlers: dict[int, Callable] = {}
         self._server: asyncio.AbstractServer | None = None
+        self._conns: set = set()  # live connection writers
 
     def register(self, token: int, handler: Callable) -> None:
         """handler: async (msg) -> reply msg (codec-registered types)."""
@@ -140,17 +143,23 @@ class RpcServer:
     async def close(self) -> None:
         if self._server is not None:
             self._server.close()
+            # drop live connections too: wait_closed() (3.12) waits for
+            # every transport, so a close with clients still attached
+            # would hang forever — a stopping server hangs up
+            for w in list(self._conns):
+                w.close()
             await self._server.wait_closed()
             self._server = None
 
     async def _serve_conn(self, reader, writer) -> None:
+        self._conns.add(writer)
         try:
             if self.tls is not None:
                 # verify_peers-style subject check on the CLIENT cert
                 # (mutual TLS: the context already required one)
                 sslobj = writer.get_extra_info("ssl_object")
                 self.tls.verify_peer(sslobj)
-            await _handshake(reader, writer)
+            await _handshake(reader, writer, self.protocol_version)
             pending: set[asyncio.Task] = set()
             while True:
                 body = await _read_frame(reader)
@@ -173,6 +182,7 @@ class RpcServer:
         except _ssl.SSLError:
             pass  # failed peer verification / non-TLS client: drop
         finally:
+            self._conns.discard(writer)
             writer.close()
 
     async def _dispatch(self, writer, reqid: int, token: int, payload: bytes):
@@ -194,9 +204,10 @@ class RpcServer:
 class RpcConnection:
     """Client side: one connection, correlated request/reply."""
 
-    def __init__(self, address, *, tls=None):
+    def __init__(self, address, *, tls=None, protocol_version: int = None):
         self.address = address
         self.tls = tls
+        self.protocol_version = protocol_version  # None = current
         self._reader = None
         self._writer = None
         self._next_id = 1
@@ -241,7 +252,9 @@ class RpcConnection:
                 self._writer.close()
                 raise TransportError(f"server failed peer verification: {e}")
         try:
-            await _handshake(self._reader, self._writer)
+            await _handshake(
+                self._reader, self._writer, self.protocol_version
+            )
         except (asyncio.IncompleteReadError, ConnectionError) as e:
             # the peer hung up mid-handshake — with TLS configured this
             # is typically cert refusal (mutual TLS / verify_peers);

@@ -1,0 +1,125 @@
+"""MultiVersion client: protocol probing + upgrade hot-swap
+(fdbclient/MultiVersionTransaction.actor.cpp capability)."""
+
+import asyncio
+
+import pytest
+
+from foundationdb_tpu.cluster.multiprocess import Ping, Pong
+from foundationdb_tpu.cluster.multiversion import (
+    ClusterVersionChangedError,
+    MultiVersionClient,
+)
+from foundationdb_tpu.wire import transport
+
+TOKEN = 0x5151
+PV_OLD = 0x0FDB_7E50_0004
+PV_NEW = 0x0FDB_7E50_0005
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+async def _serve(address, pv):
+    server = transport.RpcServer(address, protocol_version=pv)
+
+    async def ping(msg: Ping) -> Pong:
+        return Pong(payload=msg.payload + b"@%x" % pv)
+
+    server.register(TOKEN, ping)
+    await server.start()
+    return server
+
+
+def test_probes_down_to_older_cluster(tmp_path):
+    """A client shipping [new, old] connects to an OLD cluster by
+    probing down — the multi-version external-client walk."""
+    address = str(tmp_path / "mv.sock")
+
+    async def go():
+        server = await _serve(address, PV_OLD)
+        mv = MultiVersionClient(address, [PV_NEW, PV_OLD])
+        rep = await mv.call(TOKEN, Ping(payload=b"x"))
+        assert rep.payload == b"x@%x" % PV_OLD
+        assert mv.protocol_version == PV_OLD
+        await mv.close()
+        await server.close()
+
+    run(go())
+
+
+def test_upgrade_raises_cluster_version_changed_then_works(tmp_path):
+    """Cluster restarts on a NEWER protocol mid-session: the in-flight
+    call fails with cluster_version_changed (retryable), and the retry
+    runs on the hot-swapped client."""
+    import os
+
+    address = str(tmp_path / "mv.sock")
+
+    async def go():
+        server = await _serve(address, PV_OLD)
+        mv = MultiVersionClient(address, [PV_NEW, PV_OLD])
+        rep = await mv.call(TOKEN, Ping(payload=b"a"))
+        assert mv.protocol_version == PV_OLD
+
+        # the upgrade: old server gone, new one at PV_NEW
+        await server.close()
+        os.unlink(address)
+        server2 = await _serve(address, PV_NEW)
+        with pytest.raises(ClusterVersionChangedError):
+            await mv.call(TOKEN, Ping(payload=b"b"))
+        assert mv.swaps == 1
+        # the retry loop's next attempt succeeds on the new client
+        rep = await mv.call(TOKEN, Ping(payload=b"c"))
+        assert rep.payload == b"c@%x" % PV_NEW
+        assert mv.protocol_version == PV_NEW
+        await mv.close()
+        await server2.close()
+
+    run(go())
+
+
+def test_same_version_restart_is_at_most_once(tmp_path):
+    """A crash/restart at the SAME protocol is NOT a version change —
+    but the lost call must RAISE (the request may have executed;
+    silently re-sending would double-apply non-idempotent work). The
+    client reconnects underneath, so the caller's retry succeeds."""
+    import os
+
+    address = str(tmp_path / "mv.sock")
+
+    async def go():
+        server = await _serve(address, PV_NEW)
+        mv = MultiVersionClient(address, [PV_NEW, PV_OLD])
+        await mv.call(TOKEN, Ping(payload=b"a"))
+        await server.close()
+        os.unlink(address)
+        server2 = await _serve(address, PV_NEW)
+        with pytest.raises(transport.TransportError):
+            await mv.call(TOKEN, Ping(payload=b"b"))
+        assert mv.swaps == 0
+        # the caller's retry rides the reconnected client
+        rep = await mv.call(TOKEN, Ping(payload=b"b"))
+        assert rep.payload == b"b@%x" % PV_NEW
+        await mv.close()
+        await server2.close()
+
+    run(go())
+
+
+def test_no_common_version_fails_loudly(tmp_path):
+    address = str(tmp_path / "mv.sock")
+
+    async def go():
+        server = await _serve(address, 0x0FDB_7E50_0001)
+        mv = MultiVersionClient(address, [PV_NEW, PV_OLD])
+        with pytest.raises(transport.TransportError, match="protocol"):
+            await mv.connect(retries=2, delay=0.01)
+        await server.close()
+
+    run(go())

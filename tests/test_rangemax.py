@@ -88,3 +88,36 @@ def test_two_level_table_matches_flat():
             assert (got == want).all(), (
                 m, op, lo[got != want][:4], hi[got != want][:4]
             )
+
+
+def test_radix4_parity_with_radix2():
+    """build4/query4 and min_cover4 agree with the radix-2 structures
+    on randomized ranges (the fixpoint switched to radix-4 in r5)."""
+    import numpy as np
+
+    from foundationdb_tpu.ops import rangemax, segtree
+
+    rng = np.random.default_rng(42)
+    for leaves in (1024, 4096, 131072):  # incl. an odd-log2 width
+        vals = jnp.asarray(
+            rng.integers(0, 1 << 30, leaves).astype(np.int32))
+        q = 2048
+        lo = jnp.asarray(rng.integers(0, leaves, q).astype(np.int32))
+        ln = jnp.asarray(rng.integers(0, leaves, q).astype(np.int32))
+        hi = jnp.minimum(lo + ln, leaves)
+        for op in ("max", "min"):
+            t2 = rangemax.build(vals, op=op)
+            t4 = rangemax.build4(vals, op=op)
+            g2 = np.asarray(rangemax.query(t2, lo, hi, op=op))
+            g4 = np.asarray(rangemax.query4(t4, lo, hi, op=op))
+            assert (g2 == g4).all(), (leaves, op)
+
+        n_int = 4096
+        ilo = jnp.asarray(rng.integers(0, leaves, n_int).astype(np.int32))
+        iln = jnp.asarray(
+            rng.integers(0, max(leaves // 4, 2), n_int).astype(np.int32))
+        ihi = jnp.minimum(ilo + iln, leaves)
+        ival = jnp.asarray(rng.integers(0, n_int, n_int).astype(np.int32))
+        c2 = np.asarray(segtree.min_cover(leaves, ilo, ihi, ival))
+        c4 = np.asarray(segtree.min_cover4(leaves, ilo, ihi, ival))
+        assert (c2 == c4).all(), leaves
