@@ -120,11 +120,52 @@ class BackupAgent:
         cluster.tlog.register_consumer("backup")
         self._tlog = cluster.tlog
 
+    async def _stream_barrier(self, cluster) -> None:
+        """Close the registration race: a batch IN FLIGHT when the
+        consumer registers may have assigned its tags pre-registration
+        while committing ABOVE the snapshot's read version — on neither
+        the snapshot nor the stream (found by the soak's
+        BackupToDBCorrectness workload, seed 6). Each proxy's pipeline
+        assigns batches serially, so one barrier commit PER PROXY after
+        registration guarantees every later-version batch on that proxy
+        emits the stream tag; the snapshot read version, taken after
+        the barriers, then covers everything that didn't. The reference
+        gets the same fence from writing the backup config through a
+        transaction the proxies apply at a version."""
+        n = getattr(getattr(cluster, "config", None), "n_commit_proxies", 1)
+        # n CONSECUTIVE successes: the client round-robins proxies per
+        # commit attempt, so n consecutive successful commits land on n
+        # distinct proxies; a failure resets the streak (the failed
+        # attempt still advanced the round-robin pointer)
+        streak = 0
+        attempts = 0
+        last = None
+        while streak < n:
+            attempts += 1
+            if attempts > 50 + 10 * n:
+                # permanent failure (e.g. a LOCKED DR destination) must
+                # surface, not hang the snapshot forever (code review
+                # r5) — the barrier is best-effort fencing, the error
+                # class belongs to the caller
+                raise last if last is not None else RuntimeError(
+                    "stream barrier could not commit"
+                )
+            txn = self.db.create_transaction()
+            txn.set(b"\xff/backup/barrier", b"%d" % streak)
+            try:
+                await txn.commit()
+                streak += 1
+            except Exception as e:
+                last = e
+                streak = 0
+                await self.db.sched.delay(0.02)
+
     async def snapshot(self, *, chunk: int = 1000) -> int:
+        """Full range snapshot at one read version; returns that version."""
         cluster = getattr(self.db, "cluster", None)
         if cluster is not None:
             self.register_log_consumer(cluster)
-        """Full range snapshot at one read version; returns that version."""
+            await self._stream_barrier(cluster)
         txn = self.db.create_transaction()
         version = await txn.get_read_version()
         items = await txn.get_range(b"", b"\xff")

@@ -340,6 +340,15 @@ class LocationCache:
     def _remove_at(self, i: int) -> None:
         b = self._begins.pop(i)
         del self._by_begin[b]
+        # stale FIFO tokens drain in the eviction loop, but that loop
+        # only runs when the cache is over cap — under invalidate/
+        # re-locate churn the deque would otherwise grow unboundedly
+        # (code review r5): compact when it bloats past 4x the cap
+        if len(self._fifo) > 4 * self.MAX_ENTRIES:
+            live = set(self._by_begin)
+            self._fifo = type(self._fifo)(
+                t for t in self._fifo if t in live
+            )
 
     def _insert(self, b: bytes, e: bytes, team: tuple) -> None:
         import bisect

@@ -100,8 +100,11 @@ class MultiVersionClient:
             return await self.conn.call(token, msg, timeout=timeout)
         except (transport.TransportError, ConnectionError) as e:
             old_pv = self.protocol_version
-            await self.conn.close()
-            self.conn = None
+            # concurrent calls share the connection and fail together;
+            # only the first handler tears it down (code review r5)
+            if self.conn is not None:
+                await self.conn.close()
+                self.conn = None
             await self.connect()  # next call rides the fresh client
             if self.protocol_version != old_pv:
                 raise ClusterVersionChangedError(
