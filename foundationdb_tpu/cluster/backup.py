@@ -132,33 +132,32 @@ class BackupAgent:
         the barriers, then covers everything that didn't. The reference
         gets the same fence from writing the backup config through a
         transaction the proxies apply at a version."""
-        n = getattr(getattr(cluster, "config", None), "n_commit_proxies", 1)
-        # n CONSECUTIVE successes: the client round-robins proxies per
-        # commit attempt, so n consecutive successful commits land on n
-        # distinct proxies; a failure resets the streak (the failed
-        # attempt still advanced the round-robin pointer)
-        streak = 0
-        attempts = 0
-        last = None
-        while streak < n:
-            attempts += 1
-            if attempts > 50 + 10 * n:
+        # Fence EACH proxy that existed at registration with a PINNED
+        # commit — round-robin adjacency is broken by concurrent
+        # traffic (second review pass). A proxy replaced by recovery
+        # needs no fence: post-registration proxies see the consumer
+        # from their first batch.
+        fence_set = list(getattr(cluster, "commit_proxies", []))
+        for i, proxy in enumerate(fence_set):
+            last = None
+            for _attempt in range(60):
+                if proxy not in getattr(cluster, "commit_proxies", []):
+                    break  # replaced by a post-registration generation
+                txn = self.db.create_transaction()
+                txn.set(b"\xff/backup/barrier", b"%d" % i)
+                txn._pin_proxy = proxy
+                try:
+                    await txn.commit()
+                    break
+                except Exception as e:
+                    last = e
+                    await self.db.sched.delay(0.02)
+            else:
                 # permanent failure (e.g. a LOCKED DR destination) must
-                # surface, not hang the snapshot forever (code review
-                # r5) — the barrier is best-effort fencing, the error
-                # class belongs to the caller
+                # surface, not hang the snapshot forever (code review r5)
                 raise last if last is not None else RuntimeError(
                     "stream barrier could not commit"
                 )
-            txn = self.db.create_transaction()
-            txn.set(b"\xff/backup/barrier", b"%d" % streak)
-            try:
-                await txn.commit()
-                streak += 1
-            except Exception as e:
-                last = e
-                streak = 0
-                await self.db.sched.delay(0.02)
 
     async def snapshot(self, *, chunk: int = 1000) -> int:
         """Full range snapshot at one read version; returns that version."""

@@ -144,6 +144,42 @@ class Transaction:
         self, begin: bytes, end: bytes, *, limit: int = 1 << 30,
         snapshot: bool = False,
     ) -> list[tuple[bytes, bytes]]:
+        from foundationdb_tpu.cluster import system_data as SD
+
+        for mod_b, mod_e in (
+            (SD.KEY_SERVERS_PREFIX, SD.KEY_SERVERS_END),
+            (SD.SERVER_KEYS_PREFIX, SD.SERVER_KEYS_END),
+        ):
+            if begin < mod_e and mod_b < end and not (
+                mod_b <= begin and end <= mod_e
+            ):
+                # module-bounds discipline (the reference's
+                # SpecialKeySpace CROSS_MODULE_READ error): a scan may
+                # not straddle a materialized schema module — silently
+                # mixing schema rows with stored rows would drop data
+                raise ValueError(
+                    f"range [{begin!r}, {end!r}) crosses the "
+                    f"materialized schema module [{mod_b!r}, {mod_e!r}); "
+                    "query within the module bounds"
+                )
+        if begin.startswith(SD.KEY_SERVERS_PREFIX):
+            # the shard-location schema (SystemData.cpp keyServersKeys):
+            # materialized from the authoritative shard map
+            strip = len(SD.KEY_SERVERS_PREFIX)
+            rows = SD.materialize_key_servers(
+                self.db.cluster.key_servers,
+                begin[strip:],
+                end[strip:] if end.startswith(SD.KEY_SERVERS_PREFIX)
+                else b"\xff",
+            )
+            return rows[:limit]
+        if begin.startswith(SD.SERVER_KEYS_PREFIX) or (
+            begin == SD.SERVER_KEYS_PREFIX[:-1] + b"/"
+        ):
+            rows = SD.materialize_all_server_keys(
+                self.db.cluster.key_servers
+            )
+            return [r for r in rows if begin <= r[0] < end][:limit]
         rv = await self.get_read_version()
         items = await self.db.read_range(begin, end, rv)
         merged = self.writes.overlay(items, begin, end)[:limit]
@@ -273,7 +309,11 @@ class Transaction:
             lock_aware=self.dr_bypass,
         )
         ctr.validate()
-        commit_id = await self.db.commit_proxy().commit(ctr).future
+        # _pin_proxy: targeted fencing (backup's stream barrier) must
+        # hit a SPECIFIC proxy — round-robin adjacency is not a
+        # guarantee under concurrent traffic
+        proxy = getattr(self, "_pin_proxy", None) or self.db.commit_proxy()
+        commit_id = await proxy.commit(ctr).future
         self.committed_version = commit_id.version
         self._versionstamp = commit_id.versionstamp
         return commit_id.version

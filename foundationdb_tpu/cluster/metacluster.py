@@ -1,0 +1,239 @@
+"""Metacluster: tenant management across multiple data clusters.
+
+Capability match for fdbclient/Metacluster*.cpp +
+MetaclusterManagement.actor.h: one MANAGEMENT cluster stores the
+registry of data clusters (capacity, connection info) and the
+tenant->cluster assignment; tenant creation picks a data cluster with
+free capacity, creates the tenant THERE, and records the assignment in
+the management cluster; clients open a tenant by name through the
+metacluster and get a handle bound to the right data cluster.
+
+Concurrency/atomicity discipline (the reference's multi-step tenant
+states, MetaclusterManagement CreateTenantImpl):
+
+* Load accounting has ONE source of truth — the assignment rows
+  themselves, counted inside the SAME transaction that writes a new
+  assignment (read conflicts make concurrent creates serialize; no
+  counter rows to drift).
+* Cross-cluster steps are staged: the assignment is committed in state
+  CREATING first, then the tenant is created on the data cluster
+  (idempotently), then the assignment flips to READY — a crash between
+  steps leaves a CREATING row that the next create/open repairs or
+  surfaces, never an orphaned unreachable tenant.
+* register_cluster writes the data cluster's registration marker FIRST
+  (the double-registration guard must exist before the registry entry
+  does); a partial failure is repaired by re-registering under the
+  SAME name.
+"""
+
+from __future__ import annotations
+
+import json
+
+from foundationdb_tpu.cluster import tenant as T
+
+_CLUSTERS = b"\xff/metacluster/clusters/"
+_TENANTS = b"\xff/metacluster/tenants/"
+_REGISTRATION = b"\xff/metacluster/registration"
+
+_CREATING = b"\x00creating/"  # assignment-value prefix while staging
+
+
+def _retryable(e: BaseException) -> bool:
+    from foundationdb_tpu.cluster.commit_proxy import (
+        CommitUnknownResult,
+        NotCommitted,
+    )
+
+    return isinstance(e, (NotCommitted, CommitUnknownResult))
+
+
+class ClusterExists(Exception):
+    pass
+
+
+class ClusterNotFound(Exception):
+    pass
+
+
+class ClusterNotEmpty(Exception):
+    pass
+
+
+class ClusterAlreadyRegistered(Exception):
+    pass
+
+
+class MetaclusterCapacityExceeded(Exception):
+    pass
+
+
+class Metacluster:
+    """The management-cluster API. `data_dbs` maps cluster name ->
+    Database handle (the reference stores ClusterConnectionString; in
+    one process the handle IS the connection)."""
+
+    def __init__(self, management_db):
+        self.db = management_db
+        self.data_dbs: dict[bytes, object] = {}
+
+    # -- data-cluster registry (MetaclusterManagement register/remove) --
+
+    async def register_cluster(self, name: bytes, data_db,
+                               *, capacity: int = 10) -> None:
+        # marker FIRST: the double-registration guard must exist before
+        # the registry entry (a partial failure re-registers under the
+        # SAME name and repairs)
+        rtxn = data_db.create_transaction()
+        existing = await rtxn.get(_REGISTRATION)
+        if existing is not None and json.loads(existing)["name"] != (
+            name.decode()
+        ):
+            raise ClusterAlreadyRegistered(
+                f"data cluster already registered as "
+                f"{json.loads(existing)['name']!r}"
+            )
+        if existing is None:
+            rtxn.set(
+                _REGISTRATION, json.dumps({"name": name.decode()}).encode()
+            )
+            await rtxn.commit()
+        txn = self.db.create_transaction()
+        if await txn.get(_CLUSTERS + name) is not None:
+            raise ClusterExists(name)
+        txn.set(_CLUSTERS + name, json.dumps({"capacity": capacity}).encode())
+        await txn.commit()
+        self.data_dbs[name] = data_db
+
+    async def remove_cluster(self, name: bytes) -> None:
+        txn = self.db.create_transaction()
+        meta = await txn.get(_CLUSTERS + name)
+        if meta is None:
+            raise ClusterNotFound(name)
+        # assignment rows are the truth; the read adds conflict ranges
+        # so a racing create_tenant serializes against the removal
+        assigned = await txn.get_range(_TENANTS, _TENANTS + b"\xff")
+        hosted = [
+            k for k, v in assigned
+            if v == name or v == _CREATING + name
+        ]
+        if hosted:
+            raise ClusterNotEmpty(
+                f"{name!r} still hosts {len(hosted)} tenants"
+            )
+        txn.clear(_CLUSTERS + name)
+        await txn.commit()
+        data_db = self.data_dbs.pop(name, None)
+        if data_db is not None:
+            rtxn = data_db.create_transaction()
+            rtxn.clear(_REGISTRATION)
+            await rtxn.commit()
+
+    async def list_clusters(self) -> dict[bytes, dict]:
+        txn = self.db.create_transaction()
+        rows = await txn.get_range(_CLUSTERS, _CLUSTERS + b"\xff")
+        assigned = await txn.get_range(_TENANTS, _TENANTS + b"\xff")
+        out = {}
+        for k, v in rows:
+            cname = k[len(_CLUSTERS):]
+            meta = json.loads(v)
+            meta["tenants"] = sum(
+                1 for _t, c in assigned
+                if c == cname or c == _CREATING + cname
+            )
+            out[cname] = meta
+        return out
+
+    # -- tenant management (createTenant through the metacluster) --------
+
+    async def create_tenant(self, name: bytes) -> bytes:
+        """Assign the tenant to the least-loaded data cluster with free
+        capacity, create it there, record the assignment. Staged:
+        CREATING assignment -> data-cluster create -> READY."""
+        # phase 1: commit the CREATING assignment. Reads of the
+        # registry + every assignment ride THIS transaction, so two
+        # concurrent creates (or a racing remove_cluster) conflict and
+        # serialize; the loser RETRIES and re-reads — the reference's
+        # management ops run under runTransaction's retry loop too.
+        while True:
+            txn = self.db.create_transaction()
+            cur = await txn.get(_TENANTS + name)
+            if cur is not None and not cur.startswith(_CREATING):
+                raise T.TenantExists(name)
+            if cur is not None:
+                chosen = cur[len(_CREATING):]  # crashed mid-create: repair
+                break
+            clusters = await txn.get_range(_CLUSTERS, _CLUSTERS + b"\xff")
+            assigned = await txn.get_range(_TENANTS, _TENANTS + b"\xff")
+            load: dict[bytes, int] = {}
+            for _t, c in assigned:
+                c = c[len(_CREATING):] if c.startswith(_CREATING) else c
+                load[c] = load.get(c, 0) + 1
+            candidates = sorted(
+                (load.get(k[len(_CLUSTERS):], 0), k[len(_CLUSTERS):])
+                for k, v in clusters
+                if load.get(k[len(_CLUSTERS):], 0) < json.loads(v)["capacity"]
+            )
+            if not candidates:
+                raise MetaclusterCapacityExceeded(
+                    "no data cluster has free tenant capacity"
+                )
+            chosen = candidates[0][1]
+            txn.set(_TENANTS + name, _CREATING + chosen)
+            try:
+                await txn.commit()
+                break
+            except Exception as e:
+                if not _retryable(e):
+                    raise
+                await self.db.sched.delay(0.01)
+        # phase 2: create on the data cluster — idempotent: a repair
+        # pass finding it already there proceeds to phase 3
+        try:
+            await T.create_tenant(self.data_dbs[chosen], name)
+        except T.TenantExists:
+            pass
+        # phase 3: flip to READY
+        txn = self.db.create_transaction()
+        txn.set(_TENANTS + name, chosen)
+        await txn.commit()
+        return chosen
+
+    async def delete_tenant(self, name: bytes) -> None:
+        txn = self.db.create_transaction()
+        cname = await txn.get(_TENANTS + name)
+        if cname is None:
+            raise T.TenantNotFound(name)
+        if cname.startswith(_CREATING):
+            cname = cname[len(_CREATING):]
+        # data-cluster delete FIRST (raises TenantNotEmpty with the
+        # assignment intact); tolerate a repair pass where the tenant
+        # never finished creating
+        try:
+            await T.delete_tenant(self.data_dbs[cname], name)
+        except T.TenantNotFound:
+            pass
+        txn.clear(_TENANTS + name)
+        await txn.commit()
+
+    async def list_tenants(self) -> dict[bytes, bytes]:
+        txn = self.db.create_transaction()
+        rows = await txn.get_range(_TENANTS, _TENANTS + b"\xff")
+        return {
+            k[len(_TENANTS):]: (
+                v[len(_CREATING):] if v.startswith(_CREATING) else v
+            )
+            for k, v in rows
+        }
+
+    async def open_tenant(self, name: bytes) -> T.Tenant:
+        """A tenant handle bound to its assigned data cluster. A
+        CREATING assignment (crash mid-create) is repaired first."""
+        txn = self.db.create_transaction()
+        cname = await txn.get(_TENANTS + name)
+        if cname is None:
+            raise T.TenantNotFound(name)
+        if cname.startswith(_CREATING):
+            await self.create_tenant(name)  # finish the staged create
+            cname = cname[len(_CREATING):]
+        return T.Tenant(self.data_dbs[cname], name)

@@ -96,16 +96,19 @@ class MultiVersionClient:
         errors to onError)."""
         if self.conn is None:
             await self.connect()
+        conn = self.conn
         try:
-            return await self.conn.call(token, msg, timeout=timeout)
+            return await conn.call(token, msg, timeout=timeout)
         except (transport.TransportError, ConnectionError) as e:
             old_pv = self.protocol_version
             # concurrent calls share the connection and fail together;
-            # only the first handler tears it down (code review r5)
-            if self.conn is not None:
-                await self.conn.close()
+            # tear down only the conn THIS call used — by identity, so
+            # a second handler never closes the freshly rebuilt one
+            # (second review pass)
+            if self.conn is conn:
                 self.conn = None
-            await self.connect()  # next call rides the fresh client
+                await conn.close()
+                await self.connect()  # next call rides the fresh client
             if self.protocol_version != old_pv:
                 raise ClusterVersionChangedError(
                     f"cluster protocol moved {old_pv:#x} -> "
