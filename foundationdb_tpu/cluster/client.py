@@ -173,9 +173,7 @@ class Transaction:
                 else b"\xff",
             )
             return rows[:limit]
-        if begin.startswith(SD.SERVER_KEYS_PREFIX) or (
-            begin == SD.SERVER_KEYS_PREFIX[:-1] + b"/"
-        ):
+        if begin.startswith(SD.SERVER_KEYS_PREFIX):
             rows = SD.materialize_all_server_keys(
                 self.db.cluster.key_servers
             )

@@ -182,13 +182,17 @@ class DrAgent:
         await tl.commit()
         # pipelined batches admitted before the lock became visible can
         # still commit ABOVE the lock version; one lock-aware sentinel
-        # through EVERY proxy serializes behind them (per-proxy batch
+        # PINNED to every proxy serializes behind them (per-proxy batch
         # chains), so everything acknowledged lands at/below the final
-        # version we drain to
-        for _ in range(len(self.src.commit_proxies)):
+        # version we drain to. Pinning, not round-robin adjacency:
+        # concurrent traffic advances the shared pointer, so counting
+        # commits does not fence every proxy (code review r5 — the
+        # same defect class fixed in backup's stream barrier)
+        for proxy in list(self.src.commit_proxies):
             sent = self.src_db.create_transaction()
             sent.dr_bypass = True
             sent.set(LOCK_KEY + b"/fence", b"1")
+            sent._pin_proxy = proxy
             await sent.commit()
         final = self.src.tlog.version.get()
         await self.drain_to(final)

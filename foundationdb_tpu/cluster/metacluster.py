@@ -39,15 +39,6 @@ _REGISTRATION = b"\xff/metacluster/registration"
 _CREATING = b"\x00creating/"  # assignment-value prefix while staging
 
 
-def _retryable(e: BaseException) -> bool:
-    from foundationdb_tpu.cluster.commit_proxy import (
-        CommitUnknownResult,
-        NotCommitted,
-    )
-
-    return isinstance(e, (NotCommitted, CommitUnknownResult))
-
-
 class ClusterExists(Exception):
     pass
 
@@ -81,9 +72,12 @@ class Metacluster:
 
     async def register_cluster(self, name: bytes, data_db,
                                *, capacity: int = 10) -> None:
-        # marker FIRST: the double-registration guard must exist before
-        # the registry entry (a partial failure re-registers under the
-        # SAME name and repairs)
+        # precheck the registry so a NAME COLLISION never writes the
+        # marker (a poisoned marker would block the data cluster under
+        # every name — third review pass); the marker then lands before
+        # the registry entry (crash between the two re-registers under
+        # the SAME name and repairs), and a post-commit ClusterExists
+        # rolls the marker back.
         rtxn = data_db.create_transaction()
         existing = await rtxn.get(_REGISTRATION)
         if existing is not None and json.loads(existing)["name"] != (
@@ -93,36 +87,52 @@ class Metacluster:
                 f"data cluster already registered as "
                 f"{json.loads(existing)['name']!r}"
             )
+        pre = self.db.create_transaction()
+        if await pre.get(_CLUSTERS + name) is not None:
+            raise ClusterExists(name)
         if existing is None:
             rtxn.set(
                 _REGISTRATION, json.dumps({"name": name.decode()}).encode()
             )
             await rtxn.commit()
-        txn = self.db.create_transaction()
-        if await txn.get(_CLUSTERS + name) is not None:
-            raise ClusterExists(name)
-        txn.set(_CLUSTERS + name, json.dumps({"capacity": capacity}).encode())
-        await txn.commit()
+        try:
+            async def write_registry(txn):
+                if await txn.get(_CLUSTERS + name) is not None:
+                    raise ClusterExists(name)
+                txn.set(
+                    _CLUSTERS + name,
+                    json.dumps({"capacity": capacity}).encode(),
+                )
+
+            await self.db.run(write_registry)
+        except ClusterExists:
+            if existing is None:  # roll the fresh marker back
+                rb = data_db.create_transaction()
+                rb.clear(_REGISTRATION)
+                await rb.commit()
+            raise
         self.data_dbs[name] = data_db
 
     async def remove_cluster(self, name: bytes) -> None:
-        txn = self.db.create_transaction()
-        meta = await txn.get(_CLUSTERS + name)
-        if meta is None:
-            raise ClusterNotFound(name)
-        # assignment rows are the truth; the read adds conflict ranges
-        # so a racing create_tenant serializes against the removal
-        assigned = await txn.get_range(_TENANTS, _TENANTS + b"\xff")
-        hosted = [
-            k for k, v in assigned
-            if v == name or v == _CREATING + name
-        ]
-        if hosted:
-            raise ClusterNotEmpty(
-                f"{name!r} still hosts {len(hosted)} tenants"
-            )
-        txn.clear(_CLUSTERS + name)
-        await txn.commit()
+        async def remove(txn):
+            meta = await txn.get(_CLUSTERS + name)
+            if meta is None:
+                raise ClusterNotFound(name)
+            # assignment rows are the truth; the reads add conflict
+            # ranges so a racing create_tenant serializes against the
+            # removal
+            assigned = await txn.get_range(_TENANTS, _TENANTS + b"\xff")
+            hosted = [
+                k for k, v in assigned
+                if v == name or v == _CREATING + name
+            ]
+            if hosted:
+                raise ClusterNotEmpty(
+                    f"{name!r} still hosts {len(hosted)} tenants"
+                )
+            txn.clear(_CLUSTERS + name)
+
+        await self.db.run(remove)
         data_db = self.data_dbs.pop(name, None)
         if data_db is not None:
             rtxn = data_db.create_transaction()
@@ -151,18 +161,18 @@ class Metacluster:
         capacity, create it there, record the assignment. Staged:
         CREATING assignment -> data-cluster create -> READY."""
         # phase 1: commit the CREATING assignment. Reads of the
-        # registry + every assignment ride THIS transaction, so two
-        # concurrent creates (or a racing remove_cluster) conflict and
-        # serialize; the loser RETRIES and re-reads — the reference's
-        # management ops run under runTransaction's retry loop too.
-        while True:
-            txn = self.db.create_transaction()
+        # registry + every assignment ride THE COMMITTING transaction,
+        # so two concurrent creates (or a racing remove_cluster)
+        # conflict and serialize; Database.run supplies the standard
+        # retry loop (the reference's management ops run under
+        # runTransaction too — third review pass: no hand-rolled
+        # weaker retry).
+        async def phase1(txn):
             cur = await txn.get(_TENANTS + name)
             if cur is not None and not cur.startswith(_CREATING):
                 raise T.TenantExists(name)
             if cur is not None:
-                chosen = cur[len(_CREATING):]  # crashed mid-create: repair
-                break
+                return cur[len(_CREATING):]  # crashed mid-create: repair
             clusters = await txn.get_range(_CLUSTERS, _CLUSTERS + b"\xff")
             assigned = await txn.get_range(_TENANTS, _TENANTS + b"\xff")
             load: dict[bytes, int] = {}
@@ -180,13 +190,9 @@ class Metacluster:
                 )
             chosen = candidates[0][1]
             txn.set(_TENANTS + name, _CREATING + chosen)
-            try:
-                await txn.commit()
-                break
-            except Exception as e:
-                if not _retryable(e):
-                    raise
-                await self.db.sched.delay(0.01)
+            return chosen
+
+        chosen = await self.db.run(phase1)
         # phase 2: create on the data cluster — idempotent: a repair
         # pass finding it already there proceeds to phase 3
         try:
@@ -194,9 +200,10 @@ class Metacluster:
         except T.TenantExists:
             pass
         # phase 3: flip to READY
-        txn = self.db.create_transaction()
-        txn.set(_TENANTS + name, chosen)
-        await txn.commit()
+        async def phase3(txn):
+            txn.set(_TENANTS + name, chosen)
+
+        await self.db.run(phase3)
         return chosen
 
     async def delete_tenant(self, name: bytes) -> None:
@@ -213,8 +220,11 @@ class Metacluster:
             await T.delete_tenant(self.data_dbs[cname], name)
         except T.TenantNotFound:
             pass
-        txn.clear(_TENANTS + name)
-        await txn.commit()
+
+        async def clear_assignment(txn):
+            txn.clear(_TENANTS + name)
+
+        await self.db.run(clear_assignment)
 
     async def list_tenants(self) -> dict[bytes, bytes]:
         txn = self.db.create_transaction()
@@ -234,6 +244,9 @@ class Metacluster:
         if cname is None:
             raise T.TenantNotFound(name)
         if cname.startswith(_CREATING):
-            await self.create_tenant(name)  # finish the staged create
+            try:
+                await self.create_tenant(name)  # finish the staged create
+            except T.TenantExists:
+                pass  # a concurrent repair won the race — equally done
             cname = cname[len(_CREATING):]
         return T.Tenant(self.data_dbs[cname], name)

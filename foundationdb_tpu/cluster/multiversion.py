@@ -51,10 +51,24 @@ class MultiVersionClient:
         self.conn = None
         self.protocol_version: int | None = None
         self.swaps = 0  # upgrades survived (observability/tests)
+        self._connect_lock = None  # single-flight connect (lazy: needs loop)
 
     async def connect(self, *, retries: int = 20, delay: float = 0.05):
         """Probe supported versions newest-first until one handshakes —
-        the reference's protocol discovery. Returns the connection."""
+        the reference's protocol discovery. SINGLE-FLIGHT: concurrent
+        failed calls reconnect once, not once each (a racing pair would
+        overwrite and leak a live connection — third review pass).
+        Returns the connection."""
+        import asyncio
+
+        if self._connect_lock is None:
+            self._connect_lock = asyncio.Lock()
+        async with self._connect_lock:
+            if self.conn is not None:
+                return self.conn
+            return await self._connect_locked(retries, delay)
+
+    async def _connect_locked(self, retries: int, delay: float):
         last = None
         for _ in range(retries):
             for pv in self.versions:

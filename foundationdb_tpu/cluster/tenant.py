@@ -70,14 +70,34 @@ async def list_tenants(db) -> list[bytes]:
 
 
 class Tenant:
-    """Database-like handle scoped to one tenant's keyspace."""
+    """Database-like handle scoped to one tenant's keyspace.
 
-    def __init__(self, db, name: bytes):
+    With authorization enabled on the cluster (a
+    crypto.token_sign.TokenVerifier on cluster.token_verifier), every
+    transaction against the tenant requires a signed token granting
+    this tenant — the reference's tenant authorization
+    (design/authorization.md, fdbrpc/TokenSign): no token, an expired
+    one, or one naming other tenants is permission_denied before any
+    key resolves."""
+
+    def __init__(self, db, name: bytes, *, token: bytes = None):
         self.db = db
         self.name = name
+        self.token = token
         self._prefix: Optional[bytes] = None
 
+    def _authorize(self) -> None:
+        verifier = getattr(
+            getattr(self.db, "cluster", None), "token_verifier", None
+        )
+        if verifier is not None:
+            # expiry against the SCHEDULER clock, not wall time: under
+            # deterministic simulation a wall-clock comparison would
+            # make token expiry nondeterministic across re-runs
+            verifier.check(self.token, self.name, now=self.db.sched.now())
+
     async def _resolve(self) -> bytes:
+        self._authorize()
         if self._prefix is None:
             txn = self.db.create_transaction()
             prefix = await txn.get(TENANT_MAP_PREFIX + self.name)
@@ -87,6 +107,7 @@ class Tenant:
         return self._prefix
 
     def create_transaction(self) -> "TenantTransaction":
+        self._authorize()
         return TenantTransaction(self, self.db.create_transaction())
 
     async def run(self, fn, **kw):

@@ -56,7 +56,13 @@ def main():
     failures = []
     done = 0
     committed = aborted = rechecks = det_checked = 0
-    with ProcessPoolExecutor(max_workers=args.jobs) as pool:
+    # max_tasks_per_child: long-lived pool workers accumulate RSS
+    # across seeds (observed ~20GB by seed ~2000 once the backup
+    # workload added a second cluster per seed) — recycling workers
+    # bounds it
+    with ProcessPoolExecutor(
+        max_workers=args.jobs, max_tasks_per_child=64
+    ) as pool:
         futs = {pool.submit(_one, w): w[0] for w in work}
         for fut in as_completed(futs):
             seed = futs[fut]
