@@ -104,7 +104,10 @@ class Metacluster:
                     json.dumps({"capacity": capacity}).encode(),
                 )
 
-            await self.db.run(write_registry)
+            # idempotent: a CommitUnknownResult whose commit APPLIED
+            # must not re-read its own write and self-ClusterExists
+            # (which would roll back a marker that should stand)
+            await self.db.run(write_registry, idempotent=True)
         except ClusterExists:
             if existing is None:  # roll the fresh marker back
                 rb = data_db.create_transaction()
@@ -132,7 +135,9 @@ class Metacluster:
                 )
             txn.clear(_CLUSTERS + name)
 
-        await self.db.run(remove)
+        # idempotent: an applied-but-unknown clear must not retry into
+        # a spurious ClusterNotFound that skips the marker cleanup below
+        await self.db.run(remove, idempotent=True)
         data_db = self.data_dbs.pop(name, None)
         if data_db is not None:
             rtxn = data_db.create_transaction()
@@ -222,7 +227,12 @@ class Metacluster:
             pass
 
         async def clear_assignment(txn):
-            txn.clear(_TENANTS + name)
+            # re-read under THIS transaction: the read conflict makes a
+            # concurrent delete+re-create abort us instead of the blind
+            # clear silently erasing the NEW assignment
+            cur = await txn.get(_TENANTS + name)
+            if cur == cname or cur == _CREATING + cname:
+                txn.clear(_TENANTS + name)
 
         await self.db.run(clear_assignment)
 

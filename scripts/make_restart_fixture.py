@@ -117,21 +117,68 @@ def build_lsm(d):
     }
 
 
+OUT_R5 = os.path.join(
+    os.path.dirname(__file__), "..", "tests", "fixtures", "ondisk_r5"
+)
+
+
+def build_encrypted_lsm(d):
+    """Round-5's encryption-at-rest format: sealed values + the
+    ENCRYPTION_MODE marker, under the DETERMINISTIC sim KMS (the
+    default master seed) so any future round can re-derive the by-id
+    keys from the record headers alone."""
+    from foundationdb_tpu.cluster.encrypt_key_proxy import EncryptKeyProxy
+    from foundationdb_tpu.cluster.kms import SimKmsConnector
+    from foundationdb_tpu.crypto.at_rest import StorageEncryption
+
+    enc = StorageEncryption(
+        EncryptKeyProxy(SimKmsConnector(), refresh_interval=10**9)
+    )
+    role = mp.StorageRole(d, engine="lsm", encryption=enc)
+
+    async def load():
+        for i in range(12):
+            await role.apply(mp.StorageApply(
+                version=(i + 1) * 10,
+                mutations=[Mutation(0, b"enc%03d" % i, b"secret-%d" % i)],
+            ))
+    run(load())
+    return {
+        "version": 120,
+        "present": {("enc%03d" % i): "secret-%d" % i for i in range(12)},
+        "plaintext_absent": "secret-",
+    }
+
+
 def main():
-    if os.path.exists(OUT):
-        shutil.rmtree(OUT)
-    os.makedirs(OUT)
-    expect = {"format_epoch": "r4", "generated_by": __file__.split("/")[-1]}
-    expect["diskqueue"] = build_diskqueue(os.path.join(OUT, "diskqueue"))
-    expect["memory"] = build_memory(os.path.join(OUT, "memory"))
-    expect["lsm"] = build_lsm(os.path.join(OUT, "lsm"))
-    with open(os.path.join(OUT, "EXPECT.json"), "w") as f:
-        json.dump(expect, f, indent=1, sort_keys=True)
+    # ondisk_r4 is FROZEN prior-round data — regenerating it with
+    # current code would defeat the cross-version test. Only build it
+    # when absent (fresh checkout), and note any deliberate format
+    # break in its EXPECT.json.
+    if not os.path.exists(OUT):
+        os.makedirs(OUT)
+        expect = {
+            "format_epoch": "r4", "generated_by": __file__.split("/")[-1],
+        }
+        expect["diskqueue"] = build_diskqueue(os.path.join(OUT, "diskqueue"))
+        expect["memory"] = build_memory(os.path.join(OUT, "memory"))
+        expect["lsm"] = build_lsm(os.path.join(OUT, "lsm"))
+        with open(os.path.join(OUT, "EXPECT.json"), "w") as f:
+            json.dump(expect, f, indent=1, sort_keys=True)
+    if os.path.exists(OUT_R5):
+        shutil.rmtree(OUT_R5)
+    os.makedirs(OUT_R5)
+    expect5 = {"format_epoch": "r5", "generated_by": __file__.split("/")[-1]}
+    expect5["encrypted_lsm"] = build_encrypted_lsm(
+        os.path.join(OUT_R5, "encrypted_lsm")
+    )
+    with open(os.path.join(OUT_R5, "EXPECT.json"), "w") as f:
+        json.dump(expect5, f, indent=1, sort_keys=True)
     total = sum(
         os.path.getsize(os.path.join(r, f))
         for r, _d, fs in os.walk(OUT) for f in fs
     )
-    print(f"fixture written: {OUT} ({total / 1024:.0f} KiB)")
+    print(f"fixture written: {OUT} ({total / 1024:.0f} KiB) + {OUT_R5}")
 
 
 if __name__ == "__main__":

@@ -439,3 +439,42 @@ def test_prior_format_storage_lsm_opens(tmp_path):
     assert role2.version == v + 10
     assert _role_get(role2, b"newgen", v + 10) == b"ng"
     assert _role_get(role2, b"lsm0002", v + 10) == val
+
+
+FIXTURE_DIR_R5 = os.path.join(
+    os.path.dirname(__file__), "fixtures", "ondisk_r5"
+)
+
+
+def test_prior_format_encrypted_lsm_opens(tmp_path):
+    """Round-5's encrypted store format: a FRESH process (fresh cipher
+    cache) must open the sealed dataset via the deterministic KMS's
+    by-id derivation, serve plaintext through the API, keep the raw
+    files ciphertext, and refuse an unencrypted open (marker)."""
+    import shutil as _sh
+
+    from foundationdb_tpu.cluster.encrypt_key_proxy import EncryptKeyProxy
+    from foundationdb_tpu.cluster.kms import SimKmsConnector
+    from foundationdb_tpu.crypto.at_rest import StorageEncryption
+
+    d = str(tmp_path / "encrypted_lsm")
+    _sh.copytree(os.path.join(FIXTURE_DIR_R5, "encrypted_lsm"), d)
+    with open(os.path.join(FIXTURE_DIR_R5, "EXPECT.json")) as f:
+        exp = json.load(f)["encrypted_lsm"]
+
+    enc = StorageEncryption(
+        EncryptKeyProxy(SimKmsConnector(), refresh_interval=10**9)
+    )
+    role = mp.StorageRole(d, engine="lsm", encryption=enc)
+    assert role.version == exp["version"]
+    for key, val in exp["present"].items():
+        assert _role_get(role, key.encode(), role.version) == val.encode()
+    # raw files stay ciphertext
+    needle = exp["plaintext_absent"].encode()
+    for root, _dirs, files in os.walk(d):
+        for fn in files:
+            with open(os.path.join(root, fn), "rb") as fh:
+                assert needle not in fh.read(), fn
+    # unencrypted open refused (marker survives the round boundary)
+    with pytest.raises(RuntimeError, match="encryption"):
+        mp.StorageRole(d, engine="lsm")
