@@ -187,7 +187,16 @@ class CommitProxy:
         # conservative effect at the transition version).
         self.conservative_writes: list[tuple[bytes, bytes]] = []
         self._task = None
-        self._inflight: set = set()
+        # INSERTION-ORDERED (dict-as-set, not set): stop() cancels these
+        # tasks in iteration order, and a set of Task OBJECTS iterates
+        # in id()-hash order — allocation addresses, which vary run to
+        # run. A recovery killing a proxy with two in-flight batches
+        # then cancels them in varying order, the clients' unknown-
+        # result deliveries swap, and the simulation DIVERGES between
+        # identical seeds (found by the r5 ensemble's determinism
+        # re-runs at 3/2000 seeds; reproduced + bisected via scheduler
+        # event-stream diffing).
+        self._inflight: dict = {}
         self._collecting: list[CommitRequest] = []
         # BUGGIFY_DUPLICATE_RESOLVE: recent resolve requests kept for
         # replay (a proxy retry after a lost reply). Old entries replay
@@ -303,9 +312,9 @@ class CommitProxy:
             self._commit_batch(batch, self._batch_num),
             name=f"{self.proxy_id}-batch{self._batch_num}",
         )
-        self._inflight.add(task)
+        self._inflight[task] = None
         task.done.add_done_callback(
-            lambda _f, t=task: self._inflight.discard(t)
+            lambda _f, t=task: self._inflight.pop(t, None)
         )
 
     # -- phases 1-5 (commitBatch :2516) ------------------------------------

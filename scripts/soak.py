@@ -56,33 +56,36 @@ def main():
     failures = []
     done = 0
     committed = aborted = rechecks = det_checked = 0
-    # max_tasks_per_child: long-lived pool workers accumulate RSS
-    # across seeds (observed ~20GB by seed ~2000 once the backup
-    # workload added a second cluster per seed) — recycling workers
-    # bounds it
-    with ProcessPoolExecutor(
-        max_workers=args.jobs, max_tasks_per_child=64
-    ) as pool:
-        futs = {pool.submit(_one, w): w[0] for w in work}
-        for fut in as_completed(futs):
-            seed = futs[fut]
-            try:
-                s, sig, dt, det, hits = fut.result()
-                _probes.merge(hits)
-                done += 1
-                committed += sig[1]
-                aborted += sig[2]
-                rechecks += sig[3]
-                det_checked += int(det)
-                print(
-                    f"seed {s:5d} ok in {dt:5.1f}s  committed={sig[1]:3d} "
-                    f"aborted={sig[2]:3d} epoch={sig[5]}"
-                    + ("  [determinism OK]" if det else ""),
-                    flush=True,
-                )
-            except Exception as e:
-                failures.append((seed, repr(e)))
-                print(f"seed {seed:5d} FAILED: {e!r}", flush=True)
+    # Worker RSS grows across seeds (~20GB by seed ~2000 once the
+    # backup workload added a second cluster per seed), so workers must
+    # recycle. max_tasks_per_child forces the SPAWN context, whose
+    # worker respawn wedges under this environment's shell — recycle by
+    # CHUNK instead: a fresh fork-context pool every 400 seeds bounds
+    # worker lifetime with no start-method change.
+    CHUNK = 400
+    for lo in range(0, len(work), CHUNK):
+        with ProcessPoolExecutor(max_workers=args.jobs) as pool:
+            futs = {pool.submit(_one, w): w[0] for w in work[lo:lo + CHUNK]}
+            for fut in as_completed(futs):
+                seed = futs[fut]
+                try:
+                    s, sig, dt, det, hits = fut.result()
+                    _probes.merge(hits)
+                    done += 1
+                    committed += sig[1]
+                    aborted += sig[2]
+                    rechecks += sig[3]
+                    det_checked += int(det)
+                    print(
+                        f"seed {s:5d} ok in {dt:5.1f}s  "
+                        f"committed={sig[1]:3d} "
+                        f"aborted={sig[2]:3d} epoch={sig[5]}"
+                        + ("  [determinism OK]" if det else ""),
+                        flush=True,
+                    )
+                except Exception as e:
+                    failures.append((seed, repr(e)))
+                    print(f"seed {seed:5d} FAILED: {e!r}", flush=True)
     wall = time.perf_counter() - t0
     print(
         f"\n{done}/{len(seeds)} seeds passed in {wall:.0f}s "
