@@ -1,0 +1,18 @@
+"""flowcheck: the static enforcement layer the reference gets from its
+build tooling (actor compiler + coveragetool), rebuilt as an AST linter.
+
+Four rule families over the whole package (stdlib `ast`, no imports of
+the scanned code): determinism (no wall clock / unseeded entropy / raw
+asyncio in sim-schedulable actors), actor safety (no silently escaping
+errors), JAX hazards (no recompiles or host syncs in the kernel path),
+and probe accounting (every CODE_PROBE declared exactly once, manifest
+pinned). Run the gate with `python -m foundationdb_tpu.analysis`; see
+the README's "flowcheck" section for baselining and suppressions.
+"""
+
+from foundationdb_tpu.analysis.report import (  # noqa: F401
+    AnalysisResult,
+    analyze_source,
+    run_analysis,
+)
+from foundationdb_tpu.analysis.walker import Finding  # noqa: F401

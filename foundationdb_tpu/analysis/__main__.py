@@ -1,0 +1,84 @@
+"""`python -m foundationdb_tpu.analysis` — the flowcheck gate CLI.
+
+Exit codes: 0 = no new violations (baselined findings don't fail),
+1 = new violations, 2 = bad invocation. `scripts/check.sh` runs this
+before pytest; CI treats nonzero as a failed build.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from foundationdb_tpu.analysis import baseline as baseline_mod
+from foundationdb_tpu.analysis import manifest as manifest_mod
+from foundationdb_tpu.analysis import registry
+from foundationdb_tpu.analysis.report import render, run_analysis
+from foundationdb_tpu.analysis.rules_probes import tree_manifest
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m foundationdb_tpu.analysis",
+        description=(
+            "flowcheck: determinism / actor-safety / JAX-hazard / "
+            "probe-accounting lint gate"
+        ),
+    )
+    ap.add_argument(
+        "--root", type=Path, default=None,
+        help="repo root (default: derived from the package location)",
+    )
+    ap.add_argument(
+        "--all", action="store_true",
+        help="show baselined findings too, not just new ones",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true",
+        help="treat every finding as new (full-tree view, exit 1 if any)",
+    )
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="freeze the current findings as the new baseline",
+    )
+    ap.add_argument(
+        "--write-manifest", action="store_true",
+        help="regenerate analysis/probe_manifest.json from the tree",
+    )
+    ap.add_argument(
+        "--rules", action="store_true", help="print the rule catalog",
+    )
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        registry.load_rules()
+        for r in sorted(registry.RULES.values(), key=lambda r: r.id):
+            print(f"{r.id:26s} {r.doc}")
+        return 0
+
+    result = run_analysis(
+        root=args.root, use_baseline=not args.no_baseline
+    )
+
+    if args.write_manifest:
+        manifest_mod.save_manifest(tree_manifest(result.contexts))
+        print(f"wrote {manifest_mod.manifest_path()}")
+        # manifest drift findings are now stale: re-run for a clean view
+        result = run_analysis(
+            root=args.root, use_baseline=not args.no_baseline
+        )
+    if args.write_baseline:
+        baseline_mod.save_baseline(result.findings)
+        print(
+            f"wrote {baseline_mod.baseline_path()} "
+            f"({len(result.findings)} entries)"
+        )
+        return 0
+
+    render(result, show_all=args.all)
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

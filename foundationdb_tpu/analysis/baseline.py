@@ -1,0 +1,76 @@
+"""Baseline: freeze pre-existing violations so the gate starts at zero.
+
+The gate's contract is zero-NEW-violations from day one: findings the
+tree already had when flowcheck landed live in `analysis/baseline.json`
+and don't fail the run; anything not in the file does. Matching is by
+(path, rule, message) multiset — line numbers drift with every edit, so
+they're recorded for humans but ignored for identity. Fixing a
+baselined finding makes its entry stale; `--write-baseline` re-freezes
+(the ROADMAP tracks burning the file down to empty).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+
+from foundationdb_tpu.analysis.walker import Finding
+
+BASELINE_NAME = "baseline.json"
+
+
+def baseline_path() -> Path:
+    return Path(__file__).resolve().parent / BASELINE_NAME
+
+
+def load_baseline(path: Path | None = None) -> Counter:
+    """(path, rule, message) -> allowed count."""
+    p = path or baseline_path()
+    if not p.exists():
+        return Counter()
+    entries = json.loads(p.read_text(encoding="utf-8"))["entries"]
+    return Counter(
+        (e["path"], e["rule"], e["message"]) for e in entries
+    )
+
+
+def save_baseline(findings: list[Finding], path: Path | None = None) -> None:
+    p = path or baseline_path()
+    payload = {
+        "comment": (
+            "Pre-existing flowcheck violations, frozen so the gate is "
+            "zero-new-violations. Regenerate with `python -m "
+            "foundationdb_tpu.analysis --write-baseline`; the goal is "
+            "to burn this file down to empty (ROADMAP open item)."
+        ),
+        "entries": [
+            {
+                "path": f.path, "line": f.line,
+                "rule": f.rule, "message": f.message,
+            }
+            for f in sorted(
+                findings, key=lambda f: (f.path, f.line, f.rule)
+            )
+        ],
+    }
+    p.write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")
+
+
+def split_findings(
+    findings: list[Finding], allowed: Counter
+) -> tuple[list[Finding], list[Finding], Counter]:
+    """(new, baselined, stale): findings beyond their baseline budget,
+    findings the baseline absorbs, and baseline entries nothing matched
+    (fixed — candidates for --write-baseline)."""
+    budget = Counter(allowed)
+    new: list[Finding] = []
+    baselined: list[Finding] = []
+    for f in findings:
+        if budget[f.fingerprint()] > 0:
+            budget[f.fingerprint()] -= 1
+            baselined.append(f)
+        else:
+            new.append(f)
+    stale = Counter({k: c for k, c in budget.items() if c > 0})
+    return new, baselined, stale

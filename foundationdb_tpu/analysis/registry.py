@@ -1,0 +1,67 @@
+"""Rule registry: the catalog flowcheck's families register into.
+
+The reference enforces its invariants with purpose-built build tooling —
+the actor compiler rejects un-actor-safe control flow, coveragetool
+accounts for every CODE_PROBE (flow/actorcompiler, flow/coveragetool).
+flowcheck is the same idea collapsed to one registry: each rule family
+module registers (a) rule ids with one-line docs (the `--rules` catalog
+and the README table are generated from here) and (b) check callables.
+
+Two check shapes:
+
+* file checks — run once per parsed file (`FileContext`); everything a
+  single module's AST can decide (determinism, actor safety, JAX
+  hazards).
+* tree checks — run once over ALL parsed files; cross-file accounting
+  (the probe ledger: duplicate declares, used-but-never-declared,
+  manifest drift).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str        # e.g. "determinism.wall-clock"
+    family: str    # e.g. "determinism"
+    doc: str       # one line, shown by --rules and in the README catalog
+
+
+#: rule id -> Rule
+RULES: dict[str, Rule] = {}
+#: callables(ctx: FileContext) -> None, appending to ctx.findings
+FILE_CHECKS: list[Callable] = []
+#: callables(ctxs: list[FileContext], options) -> list[Finding]
+TREE_CHECKS: list[Callable] = []
+
+
+def rule(id: str, doc: str) -> str:
+    """Register a rule id; returns the id so modules can bind constants."""
+    family = id.split(".", 1)[0]
+    if id in RULES:
+        raise ValueError(f"duplicate rule id {id}")
+    RULES[id] = Rule(id=id, family=family, doc=doc)
+    return id
+
+
+def file_check(fn: Callable) -> Callable:
+    FILE_CHECKS.append(fn)
+    return fn
+
+
+def tree_check(fn: Callable) -> Callable:
+    TREE_CHECKS.append(fn)
+    return fn
+
+
+def load_rules() -> None:
+    """Import every rule family (registration happens at import)."""
+    from foundationdb_tpu.analysis import (  # noqa: F401
+        rules_actor,
+        rules_determinism,
+        rules_jax,
+        rules_probes,
+    )

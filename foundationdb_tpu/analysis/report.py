@@ -1,0 +1,112 @@
+"""Run the full analysis and render findings: the flowcheck driver.
+
+`run_analysis(root)` is the one entry point everything shares — the CLI
+(`__main__.py`), the self-check test (`tests/test_flowcheck.py`), and
+`scripts/check.sh`. Findings render as `path:line [rule] message`, one
+per line, stable enough to grep and to click in an editor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from collections import Counter
+from pathlib import Path
+
+from foundationdb_tpu.analysis import baseline as baseline_mod
+from foundationdb_tpu.analysis import registry, walker
+from foundationdb_tpu.analysis.walker import FileContext, Finding
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    contexts: list[FileContext]
+    findings: list[Finding]      # every unsuppressed finding in the tree
+    new: list[Finding]           # beyond the baseline: these fail the gate
+    baselined: list[Finding]
+    stale: Counter               # baseline entries nothing matched (fixed)
+    suppressed: int              # findings absorbed by ignore[] comments
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+
+def analyze_source(source: str, path: str = "foundationdb_tpu/cluster/_snippet.py") -> list[Finding]:
+    """Lint one source string as if it lived at `path` (fixture entry
+    point for tests: the path picks the scope rules apply under)."""
+    registry.load_rules()
+    ctx = FileContext(path, source)
+    for check in registry.FILE_CHECKS:
+        check(ctx)
+    return sorted(ctx.findings, key=lambda f: (f.line, f.rule))
+
+
+def run_analysis(
+    root: Path | None = None,
+    baseline_path: Path | None = None,
+    manifest_path: Path | None = None,
+    use_baseline: bool = True,
+) -> AnalysisResult:
+    registry.load_rules()
+    root = (root or Path(__file__).resolve().parents[2])
+    ctxs: list[FileContext] = []
+    findings: list[Finding] = []
+    for path in walker.discover(root):
+        try:
+            ctx = walker.parse_file(root, path)
+        except SyntaxError as e:
+            # a file the interpreter would reject: surface, don't crash
+            findings.append(Finding(
+                path=path.relative_to(root).as_posix(),
+                line=e.lineno or 1,
+                rule="flowcheck.parse-error",
+                message=str(e.msg),
+            ))
+            continue
+        ctxs.append(ctx)
+        for check in registry.FILE_CHECKS:
+            check(ctx)
+        findings.extend(ctx.findings)
+    for tree_rule in registry.TREE_CHECKS:
+        findings.extend(tree_rule(ctxs, manifest_path=manifest_path))
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    allowed = (
+        baseline_mod.load_baseline(baseline_path) if use_baseline
+        else Counter()
+    )
+    new, baselined, stale = baseline_mod.split_findings(findings, allowed)
+    return AnalysisResult(
+        contexts=ctxs,
+        findings=findings,
+        new=new,
+        baselined=baselined,
+        stale=stale,
+        suppressed=sum(len(c.suppressed) for c in ctxs),
+    )
+
+
+def render(result: AnalysisResult, *, show_all: bool = False,
+           out=None) -> None:
+    out = out or sys.stdout
+    shown = result.findings if show_all else result.new
+    for f in shown:
+        tag = ""
+        if show_all and f in result.baselined:
+            tag = "  (baselined)"
+        print(f.render() + tag, file=out)
+    print(
+        f"flowcheck: {len(result.findings)} finding(s) — "
+        f"{len(result.new)} new, {len(result.baselined)} baselined, "
+        f"{result.suppressed} suppressed",
+        file=out,
+    )
+    if result.stale:
+        n = sum(result.stale.values())
+        print(
+            f"flowcheck: {n} baseline entr{'y' if n == 1 else 'ies'} no "
+            "longer match (fixed?) — run --write-baseline to shrink the "
+            "baseline",
+            file=out,
+        )

@@ -1,0 +1,119 @@
+"""Actor-safety rules: errors must not escape the scheduler silently.
+
+The round-5 soak re-run printed 264 unhandled-actor-error tracebacks
+(`config_db.set` racing coordinator outages) and still passed green —
+the exact failure class the reference's actor compiler makes structurally
+loud (an ACTOR's error always lands in its returned Future; dropping
+that future is visible in the code). These rules make the Python port's
+equivalents visible:
+
+* actor.fire-and-forget — a bare `spawn(...)` statement discards the
+  Task: nobody can ever observe its error. Keep the handle and await
+  `task.done` (or suppress with a justification naming how errors
+  surface — the scheduler's unhandled-error accounting turns them into
+  soak failures either way).
+* actor.unawaited-future — a bare `...delay(...)` statement (a no-op
+  bug: the future is never awaited) or a bare call to a local
+  `async def` (builds a coroutine that never runs).
+* actor.swallow — `except:` / `except Exception:` / `except
+  BaseException:` whose body is ONLY pass/continue/`...`: the shape
+  that turns a real fault into silence. Narrow the type, or log before
+  continuing.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from foundationdb_tpu.analysis.registry import file_check, rule
+from foundationdb_tpu.analysis.walker import FileContext
+
+R_FIRE_FORGET = rule(
+    "actor.fire-and-forget",
+    "spawned Task discarded; its error can escape the scheduler unseen",
+)
+R_UNAWAITED = rule(
+    "actor.unawaited-future",
+    "future/coroutine created and never awaited (statement has no effect)",
+)
+R_SWALLOW = rule(
+    "actor.swallow",
+    "broad except whose body only passes: faults become silence",
+)
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _local_async_defs(tree: ast.Module) -> set[str]:
+    return {
+        n.name for n in ast.walk(tree) if isinstance(n, ast.AsyncFunctionDef)
+    }
+
+
+def _only_passes(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(
+            stmt.value, ast.Constant
+        ):
+            continue  # docstring / `...`
+        return False
+    return True
+
+
+@file_check
+def check_actor_safety(ctx: FileContext) -> None:
+    if not ctx.in_sim_scope:
+        return
+    async_defs = _local_async_defs(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            call = node.value
+            fname = ctx.dotted(call.func)
+            leaf = fname.rsplit(".", 1)[-1] if fname else None
+            if leaf == "spawn":
+                ctx.report(
+                    node, R_FIRE_FORGET,
+                    "bare spawn(): keep the Task and observe task.done",
+                )
+            elif leaf == "delay":
+                ctx.report(
+                    node, R_UNAWAITED,
+                    "bare delay(): the returned Future is never awaited",
+                )
+            elif (
+                isinstance(call.func, ast.Name)
+                and call.func.id in async_defs
+            ):
+                ctx.report(
+                    node, R_UNAWAITED,
+                    f"bare call to async def {call.func.id}: coroutine "
+                    "is never scheduled",
+                )
+        elif isinstance(node, ast.ExceptHandler):
+            broad = _broad_name(node.type)
+            if broad is not None and _only_passes(node.body):
+                ctx.report(
+                    node, R_SWALLOW,
+                    f"{broad}: pass — narrow the type or log the fault",
+                )
+
+
+def _broad_name(type_node) -> "str | None":
+    """Human-readable label if this except clause is broad — bare,
+    Exception/BaseException by any spelling (Name, builtins.Exception),
+    or a tuple CONTAINING one (a one-character wrapper must not defeat
+    the rule). None when narrow."""
+    if type_node is None:
+        return "bare except"
+    if isinstance(type_node, ast.Name) and type_node.id in _BROAD:
+        return f"except {type_node.id}"
+    if isinstance(type_node, ast.Attribute) and type_node.attr in _BROAD:
+        return f"except ...{type_node.attr}"
+    if isinstance(type_node, ast.Tuple):
+        for el in type_node.elts:
+            inner = _broad_name(el)
+            if inner is not None:
+                return inner.replace("except", "except tuple containing", 1)
+    return None

@@ -1,0 +1,103 @@
+"""Determinism rules: the simulator's clock/entropy monopoly, enforced.
+
+The whole value of the deterministic simulator (runtime/flow.py, the
+Sim2 strategy) is that two runs from one seed are byte-identical — which
+dies the moment actor code reads the wall clock, draws unseeded entropy,
+or schedules through a loop the `Scheduler` doesn't own. The reference
+gets this by construction (every actor compiles against flow's
+`now()`/`deterministicRandom()`); here the linter enforces it.
+
+Rules (sim scope only — see walker.SIM_SCOPE_PREFIXES):
+
+* determinism.wall-clock — `time.time/monotonic/perf_counter/sleep/
+  process_time`, `datetime.now/utcnow/today`. Use `sched.now()` /
+  `sched.delay()`.
+* determinism.unseeded-random — stdlib `random.*`, numpy's legacy
+  global `numpy.random.<fn>` (anything but `default_rng`/`Generator`/
+  `SeedSequence`), `os.urandom`, `uuid.uuid1/uuid4`, `secrets.*`. Use a
+  seed-derived `numpy.random.default_rng` threaded in from the run.
+* determinism.asyncio — importing or calling `asyncio` primitives:
+  tasks scheduled there are invisible to the sim loop's (time,
+  priority, seq) order, so seeds stop reproducing.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from foundationdb_tpu.analysis.registry import file_check, rule
+from foundationdb_tpu.analysis.walker import FileContext
+
+R_WALL_CLOCK = rule(
+    "determinism.wall-clock",
+    "wall-clock read in sim-schedulable code; use Scheduler.now()/delay()",
+)
+R_UNSEEDED = rule(
+    "determinism.unseeded-random",
+    "unseeded entropy in sim-schedulable code; thread a seeded "
+    "numpy.random.default_rng through instead",
+)
+R_ASYNCIO = rule(
+    "determinism.asyncio",
+    "raw asyncio primitive in sim-schedulable code; only the flow "
+    "Scheduler may own task order",
+)
+
+_WALL_CALLS = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.sleep",
+    "time.process_time", "time.monotonic_ns", "time.time_ns",
+    "time.perf_counter_ns",
+}
+_WALL_SUFFIXES = (
+    "datetime.now", "datetime.utcnow", "datetime.today", "date.today",
+)
+
+
+def _is_wall_suffix(name: str) -> bool:
+    """Dot-boundary suffix match: `datetime.datetime.now` yes,
+    `start_datetime.now` no."""
+    return any(
+        name == s or name.endswith("." + s) for s in _WALL_SUFFIXES
+    )
+_ENTROPY_CALLS = {"os.urandom", "uuid.uuid1", "uuid.uuid4"}
+_NP_RANDOM_OK = {"default_rng", "Generator", "SeedSequence", "PCG64",
+                 "Philox", "BitGenerator"}
+
+
+@file_check
+def check_determinism(ctx: FileContext) -> None:
+    if not ctx.in_sim_scope:
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "asyncio" or a.name.startswith("asyncio."):
+                    ctx.report(node, R_ASYNCIO, "import asyncio")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and (
+                node.module == "asyncio"
+                or node.module.startswith("asyncio.")
+            ):
+                ctx.report(node, R_ASYNCIO, f"from {node.module} import ...")
+        elif isinstance(node, ast.Call):
+            name = ctx.resolved(node.func)
+            if name is None:
+                continue
+            if name in _WALL_CALLS or _is_wall_suffix(name):
+                ctx.report(node, R_WALL_CLOCK, f"call to {name}()")
+            elif name in _ENTROPY_CALLS or name.startswith("secrets."):
+                ctx.report(node, R_UNSEEDED, f"call to {name}()")
+            elif name.startswith("random."):
+                ctx.report(
+                    node, R_UNSEEDED,
+                    f"call to stdlib {name}() (module-level RNG)",
+                )
+            elif name.startswith("numpy.random."):
+                leaf = name.rsplit(".", 1)[1]
+                if leaf not in _NP_RANDOM_OK:
+                    ctx.report(
+                        node, R_UNSEEDED,
+                        f"call to {name}() (legacy global numpy RNG)",
+                    )
+            elif name.startswith("asyncio."):
+                ctx.report(node, R_ASYNCIO, f"call to {name}()")

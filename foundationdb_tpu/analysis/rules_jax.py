@@ -1,0 +1,119 @@
+"""JAX hazard rules: the kernel path must stay recompile- and sync-free.
+
+The ≥5x plateau work (G-independent compile, VERDICT r5 task 1) dies
+quietly on exactly these: a `float()` on a traced value forces a
+device->host sync inside the step, a data-dependent output shape forces
+a recompile per shape, a `block_until_ready` inside a dispatch loop
+serializes what double-buffering was built to overlap
+(models/conflict_set.resolve_group_stream). None of them throw — they
+just erase the throughput the kernel was rewritten for.
+
+Rules:
+
+* jax.host-sync (kernel scope, `ops/`) — `float()/int()/bool()` on a
+  non-literal, and `.item()` / `np.asarray()`-style escapes: each one
+  is a device fence inside code that must stay traceable.
+* jax.host-numpy (kernel scope) — host `numpy.*` calls inside the pure
+  kernel modules: silently moves the computation off-device.
+* jax.data-dep-shape (kernel scope) — `jnp.nonzero/unique/argwhere/
+  flatnonzero/compress/extract` and one-argument `jnp.where`: output
+  shape depends on values, so every batch recompiles.
+* jax.block-in-loop (package-wide) — `.block_until_ready()` inside a
+  for/while body: fences the pipeline once per iteration.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from foundationdb_tpu.analysis.registry import file_check, rule
+from foundationdb_tpu.analysis.walker import FileContext
+
+R_HOST_SYNC = rule(
+    "jax.host-sync",
+    "float()/int()/bool()/.item() on a traced value forces a "
+    "device->host sync in the kernel path",
+)
+R_HOST_NUMPY = rule(
+    "jax.host-numpy",
+    "host numpy call inside a kernel module moves compute off-device",
+)
+R_DATA_DEP = rule(
+    "jax.data-dep-shape",
+    "data-dependent output shape forces a recompile per batch",
+)
+R_BLOCK_LOOP = rule(
+    "jax.block-in-loop",
+    "block_until_ready inside a loop fences the dispatch pipeline "
+    "every iteration",
+)
+
+_CASTS = {"float", "int", "bool"}
+_DATA_DEP_LEAVES = {
+    "nonzero", "unique", "argwhere", "flatnonzero", "compress", "extract",
+}
+
+
+def _is_literal(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) or (
+        isinstance(node, ast.UnaryOp) and isinstance(node.operand, ast.Constant)
+    )
+
+
+@file_check
+def check_jax_hazards(ctx: FileContext) -> None:
+    _walk(ctx, ctx.tree, loop_depth=0)
+
+
+def _walk(ctx: FileContext, node: ast.AST, loop_depth: int) -> None:
+    for child in ast.iter_child_nodes(node):
+        inner = loop_depth
+        if isinstance(child, (ast.For, ast.While, ast.AsyncFor)):
+            inner += 1
+        if isinstance(child, ast.Call):
+            _check_call(ctx, child, loop_depth)
+        _walk(ctx, child, inner)
+
+
+def _check_call(ctx: FileContext, call: ast.Call, loop_depth: int) -> None:
+    fname = ctx.resolved(call.func)
+    leaf = ctx.dotted(call.func)
+    leaf = leaf.rsplit(".", 1)[-1] if leaf else None
+    if leaf == "block_until_ready" and loop_depth > 0:
+        ctx.report(
+            call, R_BLOCK_LOOP,
+            "block_until_ready() inside a loop body",
+        )
+    if not ctx.in_kernel_scope:
+        return
+    if (
+        isinstance(call.func, ast.Name)
+        and call.func.id in _CASTS
+        and call.args
+        and not _is_literal(call.args[0])
+    ):
+        ctx.report(
+            call, R_HOST_SYNC,
+            f"{call.func.id}() on a non-literal value",
+        )
+    elif leaf == "item" and not call.args:
+        ctx.report(call, R_HOST_SYNC, ".item() on a device value")
+    elif fname is not None:
+        if fname.startswith("numpy.") and not fname.startswith(
+            "numpy.random."
+        ):
+            # host numpy is already wrong here regardless of which op;
+            # one finding per call (the data-dep rule covers jax.numpy)
+            ctx.report(call, R_HOST_NUMPY, f"call to {fname}()")
+        elif fname.startswith("jax.numpy."):
+            jleaf = fname.rsplit(".", 1)[-1]
+            if jleaf in _DATA_DEP_LEAVES:
+                ctx.report(
+                    call, R_DATA_DEP, f"{jleaf}() output shape is data-"
+                    "dependent",
+                )
+            elif jleaf == "where" and len(call.args) == 1:
+                ctx.report(
+                    call, R_DATA_DEP,
+                    "one-argument where() output shape is data-dependent",
+                )

@@ -1,0 +1,161 @@
+"""Probe accounting: the coveragetool role, statically.
+
+The reference's coveragetool walks the source for CODE_PROBE sites and
+CI asserts every one fires across the ensemble (flow/coveragetool,
+flow/CodeProbe.h). The runtime side exists here (`utils/probes.py`,
+soak's missed-probe report) — but `code_probe()` auto-registers
+defensively, so an UNDECLARED probe silently opts out of the
+every-probe-must-fire contract: if its path goes dark, nothing notices.
+These tree-wide rules close that hole:
+
+* probe.undeclared — a `code_probe(cond, "name")` whose name no
+  `declare(...)` registers: invisible to missed-probe accounting.
+* probe.duplicate — one name declared at two sites: the ledger can't
+  attribute it, and a rename that misses one site splits the probe.
+* probe.dynamic-name — a non-literal name argument: statically
+  unaccountable (the reference requires literal strings for the same
+  reason).
+* probe.manifest-drift — `analysis/probe_manifest.json` out of date
+  with the tree (run `--write-manifest`).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from foundationdb_tpu.analysis import manifest as manifest_mod
+from foundationdb_tpu.analysis.registry import rule, tree_check
+from foundationdb_tpu.analysis.walker import FileContext, Finding
+
+R_UNDECLARED = rule(
+    "probe.undeclared",
+    "code_probe name never declare()d: invisible to missed-probe "
+    "accounting",
+)
+R_DUPLICATE = rule(
+    "probe.duplicate",
+    "probe name declared at more than one site",
+)
+R_DYNAMIC = rule(
+    "probe.dynamic-name",
+    "probe name is not a string literal: statically unaccountable",
+)
+R_DRIFT = rule(
+    "probe.manifest-drift",
+    "probe_manifest.json does not match the tree (--write-manifest)",
+)
+
+
+def collect_probes(ctxs: list[FileContext]):
+    """(declares, uses, dynamic): declares/uses map name -> [(ctx, node)],
+    dynamic is [(ctx, node, kind)] for non-literal name args."""
+    declares: dict[str, list] = {}
+    uses: dict[str, list] = {}
+    dynamic: list = []
+    for ctx in ctxs:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = ctx.dotted(node.func)
+            leaf = fname.rsplit(".", 1)[-1] if fname else None
+            if leaf == "declare":
+                args = list(node.args) + [k.value for k in node.keywords]
+                for a in args:
+                    if isinstance(a, ast.Constant) and isinstance(
+                        a.value, str
+                    ):
+                        declares.setdefault(a.value, []).append((ctx, a))
+                    else:
+                        dynamic.append((ctx, node, "declare"))
+            elif leaf == "code_probe":
+                # the name may arrive positionally or as name=...; a
+                # call where it is neither a literal nor findable is
+                # dynamic — it must not silently escape the ledger
+                a = node.args[1] if len(node.args) >= 2 else next(
+                    (k.value for k in node.keywords if k.arg == "name"),
+                    None,
+                )
+                if a is None and len(node.args) < 2 and not node.keywords:
+                    continue  # not a real call shape (e.g. re-export)
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    uses.setdefault(a.value, []).append((ctx, node))
+                else:
+                    dynamic.append((ctx, node, "code_probe"))
+    return declares, uses, dynamic
+
+
+@tree_check
+def check_probe_ledger(ctxs: list[FileContext],
+                       manifest_path: Path | None = None) -> list[Finding]:
+    findings: list[Finding] = []
+
+    def report(ctx: FileContext, node: ast.AST, rule_id: str,
+               message: str) -> None:
+        before = len(ctx.findings)
+        ctx.report(node, rule_id, message)
+        # move from the per-file list into the tree result
+        if len(ctx.findings) > before:
+            findings.append(ctx.findings.pop())
+
+    # skip probes.py itself (it defines declare/code_probe) and this
+    # package (rule docs mention the callables by name)
+    ctxs = [
+        c for c in ctxs
+        if c.rel != "utils/probes.py"
+        and not c.rel.startswith("analysis/")
+    ]
+    declares, uses, dynamic = collect_probes(ctxs)
+
+    for name, sites in sorted(declares.items()):
+        if len(sites) > 1:
+            where = ", ".join(c.path for c, _n in sites[1:])
+            ctx, node = sites[0]
+            report(
+                ctx, node, R_DUPLICATE,
+                f"probe {name!r} also declared in {where}",
+            )
+    for name, sites in sorted(uses.items()):
+        if name not in declares:
+            ctx, node = sites[0]
+            report(
+                ctx, node, R_UNDECLARED,
+                f"code_probe({name!r}) has no declare() site",
+            )
+    for ctx, node, kind in dynamic:
+        report(
+            ctx, node, R_DYNAMIC,
+            f"{kind}() with a non-literal probe name",
+        )
+
+    # manifest drift: compare the tree's ledger to the checked-in file
+    tree_manifest = {
+        name: sites[0][0].path for name, sites in declares.items()
+    }
+    stored = manifest_mod.load_manifest(manifest_path)
+    if stored != tree_manifest:
+        missing = sorted(set(tree_manifest) - set(stored))
+        stale = sorted(set(stored) - set(tree_manifest))
+        detail = []
+        if missing:
+            detail.append(f"not in manifest: {missing[:4]}")
+        if stale:
+            detail.append(f"stale in manifest: {stale[:4]}")
+        findings.append(Finding(
+            path="foundationdb_tpu/analysis/" + manifest_mod.MANIFEST_NAME,
+            line=1,
+            rule=R_DRIFT,
+            message="; ".join(detail) or "declaring files moved",
+        ))
+    return findings
+
+
+def tree_manifest(ctxs: list[FileContext]) -> dict[str, str]:
+    """name -> declaring file, for --write-manifest."""
+    ctxs = [
+        c for c in ctxs
+        if c.rel != "utils/probes.py"
+        and not c.rel.startswith("analysis/")
+    ]
+    declares, _uses, _dyn = collect_probes(ctxs)
+    return {name: sites[0][0].path for name, sites in declares.items()}

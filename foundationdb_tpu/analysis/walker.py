@@ -1,0 +1,207 @@
+"""AST walking core: file discovery, scopes, suppressions, findings.
+
+flowcheck is stdlib-`ast` only (no new deps, no imports of the scanned
+modules — files that need unavailable packages still get checked). One
+parse per file produces a `FileContext`; rule families walk the tree
+through it and call `ctx.report(...)`, which applies per-line
+suppressions before a finding lands.
+
+Scopes — which rules apply where — are path-based and fixed here:
+
+* **sim scope**: code that runs (or may run) under `runtime/flow.py`'s
+  deterministic scheduler: `cluster/`, `runtime/`, `sim/`, `testing/`,
+  `layers/`, and `resolver.py`. Determinism and actor-safety families
+  apply here. Three cluster modules are deliberately exempt because
+  they ARE the real-I/O side (never sim-schedulable): see
+  `REAL_IO_EXEMPT` below. `wire/` and `crypto/` are outside the scope
+  by construction.
+* **kernel scope**: `ops/` — the pure-JAX kernel path; the JAX hazard
+  family's recompile/host-sync rules apply here (block-in-loop applies
+  package-wide).
+
+Suppression: `# flowcheck: ignore[rule]` on the finding's line (or the
+line above) suppresses that rule there; the bracket takes a
+comma-separated list, a family name suppresses its whole family, and a
+bare `# flowcheck: ignore` suppresses everything on the line. Every
+suppression should carry a justification in the trailing comment.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+
+SIM_SCOPE_PREFIXES = ("cluster/", "runtime/", "sim/", "testing/", "layers/")
+SIM_SCOPE_FILES = ("resolver.py",)
+#: real-I/O modules inside cluster/: never scheduled by the sim loop
+#: (multiprocess = real-process harness, multiversion = external asyncio
+#: RPC client, monitor = the fdbmonitor-analog OS-process supervisor)
+REAL_IO_EXEMPT = (
+    "cluster/multiprocess.py",
+    "cluster/multiversion.py",
+    "cluster/monitor.py",
+)
+KERNEL_SCOPE_PREFIXES = ("ops/",)
+
+_SUPPRESS_RE = re.compile(r"#\s*flowcheck:\s*ignore(?:\[([^\]]*)\])?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str      # repo-root-relative posix path
+    line: int
+    rule: str
+    message: str
+
+    def fingerprint(self) -> tuple[str, str, str]:
+        """Line-number-free identity: baselines must survive drift."""
+        return (self.path, self.rule, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} [{self.rule}] {self.message}"
+
+
+def _suppressions(source: str) -> dict[int, set[str]]:
+    """line number -> set of suppressed rule patterns ('*' = all).
+
+    Tokenize-based: only REAL comments register — a string literal or
+    docstring merely mentioning the `# flowcheck: ignore` syntax (this
+    module's own docstring does) must not silently suppress findings on
+    its line."""
+    out: dict[int, set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            if m.group(1) is None:
+                pats = {"*"}
+            else:
+                pats = {
+                    p.strip() for p in m.group(1).split(",") if p.strip()
+                }
+            # a TRAILING marker covers exactly its own line; a marker on
+            # a standalone comment line covers the next line (the code it
+            # annotates). Anything looser bleeds: a justified trailing
+            # ignore on line N must not absorb an unrelated new
+            # violation on line N+1.
+            standalone = tok.line[: tok.start[1]].strip() == ""
+            line = tok.start[0] + 1 if standalone else tok.start[0]
+            out.setdefault(line, set()).update(pats)
+    except tokenize.TokenError:
+        pass  # ast.parse succeeded, so this should be unreachable
+    return out
+
+
+def _matches(rule: str, pattern: str) -> bool:
+    return (
+        pattern == "*"
+        or rule == pattern
+        or rule.startswith(pattern + ".")
+    )
+
+
+class FileContext:
+    """One parsed file plus everything rules need to judge it."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path  # repo-root-relative, posix separators
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions = _suppressions(source)
+        self.findings: list[Finding] = []
+        self.suppressed: list[Finding] = []  # what ignores absorbed
+        self.aliases = self._import_aliases()
+        # package-relative path for scoping ("cluster/foo.py")
+        pkg = "foundationdb_tpu/"
+        self.rel = path[len(pkg):] if path.startswith(pkg) else path
+
+    # -- scopes ----------------------------------------------------------
+
+    @property
+    def in_sim_scope(self) -> bool:
+        if self.rel in REAL_IO_EXEMPT:
+            return False
+        return self.rel.startswith(SIM_SCOPE_PREFIXES) or (
+            self.rel in SIM_SCOPE_FILES
+        )
+
+    @property
+    def in_kernel_scope(self) -> bool:
+        return self.rel.startswith(KERNEL_SCOPE_PREFIXES)
+
+    # -- name resolution -------------------------------------------------
+
+    def _import_aliases(self) -> dict[str, str]:
+        """Local name -> canonical dotted origin, from every import in
+        the file (function-local imports included): `import time as
+        _time` maps `_time`->`time`; `from time import time` maps
+        `time`->`time.time`."""
+        aliases: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def dotted(self, node: ast.AST) -> str | None:
+        """`a.b.c` for an attribute chain rooted at a Name, else None."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+
+    def resolved(self, node: ast.AST) -> str | None:
+        """dotted() with the first segment mapped through the import
+        table, so `_time.sleep` resolves to `time.sleep` and `np.random`
+        to `numpy.random`."""
+        d = self.dotted(node)
+        if d is None:
+            return None
+        head, _, rest = d.partition(".")
+        origin = self.aliases.get(head)
+        if origin is None:
+            return d
+        return f"{origin}.{rest}" if rest else origin
+
+    # -- reporting -------------------------------------------------------
+
+    def report(self, node: ast.AST, rule: str, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        f = Finding(path=self.path, line=line, rule=rule, message=message)
+        # _suppressions already resolved placement: trailing markers map
+        # to their own line, standalone comment lines to the next line
+        pats = self.suppressions.get(line)
+        if pats and any(_matches(rule, p) for p in pats):
+            self.suppressed.append(f)
+            return
+        self.findings.append(f)
+
+
+def discover(root: Path) -> list[Path]:
+    """Every .py under the package, deterministic order."""
+    pkg = root / "foundationdb_tpu"
+    return sorted(p for p in pkg.rglob("*.py"))
+
+
+def parse_file(root: Path, path: Path) -> FileContext:
+    rel = path.relative_to(root).as_posix()
+    return FileContext(rel, path.read_text(encoding="utf-8"))

@@ -15,8 +15,10 @@ recovery boundary.
 from __future__ import annotations
 
 from foundationdb_tpu.runtime.flow import ActorCancelled, Promise
-from foundationdb_tpu.utils.probes import code_probe
+from foundationdb_tpu.utils.probes import code_probe, declare
 from foundationdb_tpu.utils.trace import TraceEvent
+
+declare("backup_worker.displaced")
 
 
 class BackupWorker:

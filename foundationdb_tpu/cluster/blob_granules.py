@@ -314,6 +314,21 @@ class BlobManager:
             )
         await txn.commit()
 
+    async def _persist_mapping_bg(self) -> None:
+        """Background persist for the post-split path: a mapping write
+        racing data-plane chaos must not become an escaped actor error —
+        the in-memory mapping is authoritative and the next persist
+        rewrites the full keyspace anyway."""
+        try:
+            await self._persist_mapping()
+        except ActorCancelled:
+            raise
+        except Exception as e:
+            from foundationdb_tpu.utils.trace import SEV_WARN, TraceEvent
+
+            TraceEvent("BlobMappingPersistFailed", severity=SEV_WARN) \
+                .detail("Err", repr(e)).log()
+
     def note_granule_size(self, g: Granule) -> None:
         """Worker size report: split when materialized size crosses
         SPLIT_BYTES (BlobManager maybeSplitRange). Split is local and
@@ -361,7 +376,10 @@ class BlobManager:
         w.snapshot_granule(
             right, {k: val for k, val in kvs.items() if k >= split}, v)
         g.delta_bytes_since_snapshot = 0
-        self.db.sched.spawn(self._persist_mapping(), name="blob-mapping")
+        # fire-and-forget by design (the split already happened; the next
+        # assign/split re-persists the full mapping) — _persist_mapping_bg
+        # contains its own errors so chaos can't crash the manager
+        self.db.sched.spawn(self._persist_mapping_bg(), name="blob-mapping")  # flowcheck: ignore[actor.fire-and-forget]
 
     # -- reads -----------------------------------------------------------
 

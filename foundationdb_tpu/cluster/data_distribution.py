@@ -77,11 +77,15 @@ class DataDistributor:
                 try:
                     await any_of([fut, self.sched.delay(0.5)])
                 except Exception:
-                    continue  # this proxy failed the barrier; try next
+                    # this proxy failed the barrier; count it and try the
+                    # next (a fence that spins here shows up in counters)
+                    self.counters.add("fence_retries")
+                    continue
                 if fut.is_ready:
                     try:
                         return fut.get().version
                     except Exception:
+                        self.counters.add("fence_retries")
                         continue
                 # timed out (proxy died mid-commit): next candidate
             # no live proxy answered: recovery is (or will be)
@@ -177,7 +181,11 @@ class DataDistributor:
             for b, e, team, _joiners in moving:
                 for leaver in team:
                     if leaver not in dest_team:
-                        self.sched.spawn(
+                        # deliberate fire-and-forget: the move is complete
+                        # either way; a crashed drop surfaces through the
+                        # scheduler's unhandled-error ledger (soak fails
+                        # the seed) and the consistency check
+                        self.sched.spawn(  # flowcheck: ignore[actor.fire-and-forget]
                             self._drop_after(leaver, b, e, vmax),
                             name=f"dd-drop-{leaver}",
                         )
@@ -202,7 +210,9 @@ class DataDistributor:
                 for b, e, team, _joiners in moving:
                     for leaver in team:
                         if leaver not in dest_team:
-                            self.sched.spawn(
+                            # same fire-and-forget contract as the main
+                            # path above (unhandled-error ledger)
+                            self.sched.spawn(  # flowcheck: ignore[actor.fire-and-forget]
                                 self._drop_after(leaver, b, e, v_cede),
                                 name=f"dd-drop-{leaver}",
                             )

@@ -29,9 +29,20 @@ from foundationdb_tpu.utils.knobs import SERVER_KNOBS
 
 class EncryptKeyProxy:
     def __init__(self, kms, *, refresh_interval: float = None,
-                 expire_interval: float = None):
+                 expire_interval: float = None, clock=None, entropy=None):
         self.kms = kms
         self.cache = BlobCipherKeyCache()
+        # Injectable clock/entropy so a simulated cluster can pin both
+        # (flowcheck determinism scope): pass `clock=sched.now` and a
+        # seeded `entropy=rng.bytes` under the deterministic scheduler.
+        # The wall-clock/urandom DEFAULTS are for real deployments only
+        # (the in-cluster construction path is crypto/at_rest.
+        # default_encryption, called from the real-process worker side,
+        # cluster/multiprocess.py — outside the sim scope). flowcheck
+        # flags calls, not references, so holding these as defaults
+        # lints clean by design; sim-side callers must inject.
+        self._clock = clock if clock is not None else time.time
+        self._entropy = entropy if entropy is not None else os.urandom
         self.refresh_interval = (
             SERVER_KNOBS.ENCRYPT_KEY_REFRESH_INTERVAL
             if refresh_interval is None else refresh_interval
@@ -53,8 +64,8 @@ class EncryptKeyProxy:
             pass
         base_id, secret = self.kms.fetch_base_key(domain_id)
         self.fetches += 1
-        salt = os.urandom(16)
-        now = time.time()
+        salt = self._entropy(16)
+        now = self._clock()
         key = BlobCipherKey(
             domain_id=domain_id, base_id=base_id, salt=salt,
             key=derive_key(secret, domain_id, base_id, salt),
@@ -94,8 +105,17 @@ class EncryptKeyProxy:
             def refresh():
                 try:
                     self.get_latest_cipher(domain_id)
-                except Exception:
-                    pass  # keep sealing under the stale key; retry next call
+                except Exception as e:
+                    # keep sealing under the stale key; retry next call —
+                    # but a failing KMS must be visible, not silent
+                    from foundationdb_tpu.utils.trace import (
+                        SEV_WARN,
+                        TraceEvent,
+                    )
+
+                    TraceEvent("EKPRefreshFailed", severity=SEV_WARN) \
+                        .detail("Domain", domain_id) \
+                        .detail("Err", repr(e)).log()
                 finally:
                     with self._lock:
                         self._refreshing.discard(domain_id)
@@ -129,7 +149,7 @@ class EncryptKeyProxy:
                 refresh_at=0.0,  # by-id keys serve decryption only
                 expire_at=(
                     float("inf") if self.expire_interval is None
-                    else time.time() + self.expire_interval
+                    else self._clock() + self.expire_interval
                 ),
             )
             self.cache.insert(key, latest=False)

@@ -81,8 +81,13 @@ class LogRouter:
             self._task = None
         try:
             self.primary.unregister_consumer(self.name)
-        except Exception:
-            pass  # primary may be dead at failover time
+        except Exception as e:
+            # primary may be dead at failover time — expected then, but
+            # worth a trace line anywhere else
+            from foundationdb_tpu.utils.trace import SEV_WARN, TraceEvent
+
+            TraceEvent("RemoteUnregisterFailed", severity=SEV_WARN) \
+                .detail("Router", self.name).detail("Err", repr(e)).log()
 
     async def _pull(self) -> None:
         while True:

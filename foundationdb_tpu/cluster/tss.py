@@ -14,8 +14,10 @@ never delays a client read (the comparison is fire-and-forget).
 
 from __future__ import annotations
 
-from foundationdb_tpu.utils.probes import code_probe
+from foundationdb_tpu.utils.probes import code_probe, declare
 from foundationdb_tpu.utils.trace import SEV_ERROR, TraceEvent
+
+declare("tss.mismatch")
 
 #: every Nth eligible read is duplicated to the TSS pair (the
 #: reference's TSS_SAMPLE class of knobs; deterministic counter here —
@@ -63,4 +65,6 @@ class TssComparator:
                     "Server", server
                 ).log()
 
-        self.sched.spawn(compare(), name=f"tss-compare-{server}")
+        # fire-and-forget by contract (docstring): compare() contains its
+        # own errors — a dead TSS must never fail the client's read
+        self.sched.spawn(compare(), name=f"tss-compare-{server}")  # flowcheck: ignore[actor.fire-and-forget]

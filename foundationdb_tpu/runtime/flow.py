@@ -58,13 +58,29 @@ class TaskPriority:
 class Future:
     """Single-assignment future. Await it from an actor coroutine."""
 
-    __slots__ = ("_done", "_value", "_error", "_callbacks")
+    __slots__ = ("_done", "_value", "_error", "_callbacks",
+                 "_error_observed", "_consumed", "_members")
 
     def __init__(self):
         self._done = False
         self._value: Any = None
         self._error: Optional[BaseException] = None
         self._callbacks: list[Callable[[Future], None]] = []
+        #: set once SOMETHING consumed the error (get() raised it, or the
+        #: consumed aggregate of a combinator covered it) — the
+        #: scheduler's unhandled-error ledger filters on this, so a
+        #: fire-and-forget crash awaited later does not count as escaped
+        #: (the round-5 soak printed 264 tracebacks for exactly that
+        #: shape and still passed green)
+        self._error_observed = False
+        #: the outcome of this future was delivered to someone (get()
+        #: returned or raised) — combinator member observation keys off
+        #: THIS, so a dropped `any_of(...)` aggregate does not silently
+        #: consume its members' errors
+        self._consumed = False
+        #: set by all_of/any_of on the aggregate: member futures whose
+        #: errors are delegated to it once it is consumed
+        self._members: Optional[list["Future"]] = None
 
     # -- producer side ---------------------------------------------------
 
@@ -82,6 +98,10 @@ class Future:
             raise RuntimeError("future already set")
         self._done = True
         self._error = err
+        if self._consumed:
+            # consumed BEFORE the error arrived (abandoned by a
+            # cancelled awaiter): the error is covered by that consumer
+            self._error_observed = True
         cbs, self._callbacks = self._callbacks, []
         for cb in cbs:
             cb(self)
@@ -96,9 +116,27 @@ class Future:
     def is_error(self) -> bool:
         return self._done and self._error is not None
 
+    def _mark_consumed(self) -> None:
+        """This future's outcome reached a consumer. Member errors
+        (combinators) become observed HERE — racing/fanning futures and
+        consuming the aggregate is handling the losers too (two tlog
+        replicas raising on one epoch lock: first error wins the await,
+        the sibling's is delegated) — but only here: an aggregate nobody
+        ever consumes keeps its members' errors escaped."""
+        if self._consumed:
+            return
+        self._consumed = True
+        if self._error is not None:
+            self._error_observed = True
+        if self._members:
+            for m in self._members:
+                if m.is_error:
+                    m._error_observed = True
+
     def get(self) -> Any:
         if not self._done:
             raise RuntimeError("future not ready")
+        self._mark_consumed()
         if self._error is not None:
             raise self._error
         return self._value
@@ -247,7 +285,8 @@ class Trigger:
 class Task:
     """A spawned actor: drives a coroutine over Futures."""
 
-    __slots__ = ("_coro", "_sched", "_priority", "done", "_cancelled", "_name")
+    __slots__ = ("_coro", "_sched", "_priority", "done", "_cancelled",
+                 "_name", "_waiting")
 
     def __init__(self, coro, sched: "Scheduler", priority: int, name: str = ""):
         self._coro = coro
@@ -255,6 +294,12 @@ class Task:
         self._priority = priority
         self._cancelled = False
         self._name = name or getattr(coro, "__name__", "actor")
+        #: the future this actor is currently suspended on — cancelling
+        #: the actor ABANDONS it (the reference's drop-the-future
+        #: semantics), which counts as consumption for the unhandled
+        #: ledger: a tlog replica erroring after recovery cancelled the
+        #: batch actor awaiting it is not an "escaped" error
+        self._waiting: Optional[Future] = None
         self.done = Future()
 
     def cancel(self) -> None:
@@ -267,6 +312,11 @@ class Task:
     def _step_throw(self) -> None:
         if self.done.is_ready:
             return
+        if self._waiting is not None:
+            # cancellation abandons the pending await: its (possibly
+            # later) error is consumed by the cancel, not escaped
+            self._waiting._mark_consumed()
+            self._waiting = None
         try:
             self._coro.throw(ActorCancelled())
         except (StopIteration, ActorCancelled):
@@ -281,20 +331,24 @@ class Task:
     def _step(self, fut: Optional[Future]) -> None:
         if self.done.is_ready or self._cancelled:
             return
-        t0 = _time.perf_counter()
+        # slow-task profiling measures WALL time on purpose: it reports
+        # a step blocking the real run loop, not virtual time
+        t0 = _time.perf_counter()  # flowcheck: ignore[determinism]
         try:
             self._step_inner(fut)
         finally:
             sched = self._sched
-            elapsed = _time.perf_counter() - t0
+            elapsed = _time.perf_counter() - t0  # flowcheck: ignore[determinism]
             # fast path: two clock reads + one compare per step; the
             # full per-actor profile is opt-in (Scheduler(profile=True))
             if sched._profile or elapsed > sched.SLOW_TASK_THRESHOLD:
                 sched._note_step(self._name, elapsed)
 
     def _step_inner(self, fut: Optional[Future]) -> None:
+        self._waiting = None  # resumed: no longer suspended on `fut`
         try:
             if fut is not None and fut.is_error:
+                fut._mark_consumed()  # delivered into the actor
                 waited = self._coro.throw(fut._error)
             else:
                 # The awaited value is delivered by Future.__await__'s own
@@ -319,10 +373,29 @@ class Task:
                     file=sys.stderr,
                 )
                 traceback.print_exception(e, file=sys.stderr)
+            # ledger every non-cancel crash; entries whose done future is
+            # later consumed (awaited / get()) drop out of
+            # Scheduler.unhandled_errors() — what remains truly escaped.
+            # Amortized bound: once the ledger is large, shed entries
+            # already observed (routine handled chaos must not pin every
+            # exception+traceback for the scheduler's lifetime)
+            ledger = self._sched._maybe_unhandled
+            if len(ledger) >= 256:
+                ledger[:] = [
+                    ent for ent in ledger if not ent[2]._error_observed
+                ]
+                if len(ledger) >= 1024:
+                    # hard cap for long-lived real-mode schedulers where
+                    # nobody drains the ledger: shed the oldest escapes
+                    # (each pins an exception + traceback frames) — any
+                    # remaining entry still fails a soak seed
+                    del ledger[:512]
+            ledger.append((self._name, e, self.done))
             self.done._set_error(e)
             return
         if not isinstance(waited, Future):
             raise TypeError(f"actor awaited non-Future {waited!r}")
+        self._waiting = waited
         waited.add_done_callback(
             lambda f: self._sched._schedule(0.0, self._priority, lambda: self._step(f))
         )
@@ -349,8 +422,12 @@ class Scheduler:
                  profile: bool = False):
         self.sim = sim
         self._profile = profile
-        self._now = start_time if sim else _time.monotonic()
+        # real mode anchors the clock to the wall on purpose
+        self._now = start_time if sim else _time.monotonic()  # flowcheck: ignore[determinism]
         self._seq = 0
+        #: (actor name, error, done future) for every non-cancel actor
+        #: crash; see unhandled_errors()
+        self._maybe_unhandled: list[tuple[str, BaseException, Future]] = []
         self._heap: list[tuple[float, int, int, Callable[[], None]]] = []
         self._running = False
         #: per-actor-name step profile: [steps, total_wall_s, max_wall_s]
@@ -391,6 +468,23 @@ class Scheduler:
         ]
         rows.sort(key=lambda r: -r[2])
         return rows[:n]
+
+    # -- unhandled actor errors -------------------------------------------
+
+    def unhandled_errors(self) -> list[tuple[str, BaseException]]:
+        """Actor crashes nothing ever consumed: the error reached the
+        Task's done future and NO ONE awaited/get() it (directly or via
+        a combinator). The reference makes this class structurally loud
+        (an ACTOR error lands in its Future; the simulator crashes on
+        unhandled ones) — soak fails a seed on any entry here."""
+        return [
+            (name, err)
+            for name, err, fut in self._maybe_unhandled
+            if not fut._error_observed
+        ]
+
+    def clear_unhandled(self) -> None:
+        self._maybe_unhandled.clear()
 
     # -- time -------------------------------------------------------------
 
@@ -436,8 +530,9 @@ class Scheduler:
                     if self.sim:
                         self._now = due
                     else:
-                        _time.sleep(max(0.0, due - _time.monotonic()))
-                        self._now = _time.monotonic()
+                        # real mode: timers genuinely wait on the wall
+                        _time.sleep(max(0.0, due - _time.monotonic()))  # flowcheck: ignore[determinism]
+                        self._now = _time.monotonic()  # flowcheck: ignore[determinism]
                 fn()
             return fut.get()
         finally:
@@ -452,15 +547,24 @@ class Scheduler:
 
 
 def all_of(futures: Iterable[Future]) -> Future:
-    """waitForAll: resolves with the list of values (first error wins)."""
+    """waitForAll: resolves with the list of values (first error wins).
+
+    Member-error observation is delegated to the aggregate: once `out`
+    is consumed, every member error (including a sibling failing AFTER
+    the first error won — two tlog replicas raising on one epoch lock)
+    counts as handled. An aggregate nobody consumes delegates nothing:
+    its members' errors stay on the unhandled ledger."""
     futures = list(futures)
     out = Future()
+    out._members = futures
     remaining = [len(futures)]
     if not futures:
         out._set([])
         return out
 
     def on_done(f: Future) -> None:
+        if f.is_error and out._consumed:
+            f._error_observed = True  # late arrival, aggregate consumed
         if out.is_ready:
             return
         if f.is_error:
@@ -476,12 +580,18 @@ def all_of(futures: Iterable[Future]) -> Future:
 
 
 def any_of(futures: Iterable[Future]) -> Future:
-    """choose/when: resolves with (index, value) of the first ready future."""
+    """choose/when: resolves with (index, value) of the first ready
+    future. Same delegation contract as all_of: consuming the aggregate
+    handles the losers' errors (racing IS the error policy); a dropped
+    aggregate handles nothing."""
     futures = list(futures)
     out = Future()
+    out._members = futures
 
     def make_cb(i: int):
         def cb(f: Future) -> None:
+            if f.is_error and out._consumed:
+                f._error_observed = True  # loser after a consumed race
             if out.is_ready:
                 return
             if f.is_error:

@@ -22,7 +22,11 @@ import numpy as np
 
 from foundationdb_tpu.utils.probes import code_probe, declare
 
-declare("workload.sideband_checked")
+declare(
+    "workload.sideband_checked",
+    "workload.atomic_sum_checked",
+    "workload.backup_restored",
+)
 
 
 @dataclasses.dataclass
@@ -117,10 +121,21 @@ def plan_for_seed(seed: int) -> SeedPlan:
     )
 
 
-def run_seed(seed: int, collect_probes: bool = False):
+def run_seed(seed: int, collect_probes: bool = False, _inject_fault=None):
     """Run one ensemble seed; returns the deterministic signature (and,
     with collect_probes, the CODE_PROBE hit snapshot for ensemble
-    coverage accounting — the Joshua side of flow/CodeProbe.h)."""
+    coverage accounting — the Joshua side of flow/CodeProbe.h).
+
+    A seed FAILS on any unhandled actor error: an exception that
+    escaped its actor and was never consumed by an awaiter
+    (Scheduler.unhandled_errors). The round-5 re-run soak printed 264
+    such tracebacks and still passed green — that silent-green shape is
+    now structurally impossible.
+
+    `_inject_fault` is the gate's self-test hook (tests/test_soak.py):
+    an async callable(sched, cluster, db) spawned as a fire-and-forget
+    actor, so a deliberately crashing injection proves the seed fails.
+    """
     from foundationdb_tpu.cluster.commit_proxy import (
         CommitUnknownResult,
         NotCommitted,
@@ -435,8 +450,17 @@ def run_seed(seed: int, collect_probes: bool = False):
                     await cluster.data_distributor.move_shard(
                         b"s05", b"s15", int(rng.integers(0, plan.n_storage))
                     )
-                except Exception:
-                    pass
+                except Exception as e:
+                    # a move aborted by composed chaos unwinds cleanly
+                    # (move_shard's own contract) — but log it: a seed
+                    # where EVERY move fails is a signal worth seeing
+                    from foundationdb_tpu.utils.trace import (
+                        SEV_WARN,
+                        TraceEvent,
+                    )
+
+                    TraceEvent("SoakMoveShardAborted", severity=SEV_WARN) \
+                        .detail("Err", repr(e)).log()
             if plan.slow_storage:
                 # a slow storage pull loop: lag grows, the ratekeeper's
                 # control law must throttle admission and the cluster
@@ -556,6 +580,12 @@ def run_seed(seed: int, collect_probes: bool = False):
         c = sched.spawn(chaos(), name="soak-chaos")
         cc = sched.spawn(coordination_chaos(), name="soak-coord-chaos")
         tasks = [w.done, c.done, cc.done]
+        if _inject_fault is not None:
+            # deliberately unobserved: the unhandled-error gate below
+            # must catch whatever this actor lets escape
+            sched.spawn(  # flowcheck: ignore[actor.fire-and-forget]
+                _inject_fault(sched, cluster, db), name="injected-fault"
+            )
         if plan.laggard_txn:
             tasks.append(sched.spawn(laggard(), name="soak-laggard").done)
         if plan.sideband:
@@ -649,6 +679,15 @@ def run_seed(seed: int, collect_probes: bool = False):
                 cluster2.stop()
 
         check_cluster(cluster)
+        # the unhandled-actor-error gate: any exception that escaped an
+        # actor with no awaiter ever consuming it fails the seed
+        escaped = sched.unhandled_errors()
+        assert not escaped, (
+            f"seed {seed}: {len(escaped)} unhandled actor error(s): "
+            + "; ".join(
+                f"{name}: {err!r}" for name, err in escaped[:5]
+            )
+        )
         if plan.kill_proxy:
             assert cluster.controller.epoch >= 2, "recovery never happened"
         sig = (

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# The verify path: flowcheck gate first (cheap, seconds), then the
+# tier-1 pytest lane (-m 'not slow' — the ROADMAP verify contract;
+# note this INCLUDES the compile-heavy `kernel` tests, exactly like
+# tier-1). Extra args pass through to pytest:
+#
+#   scripts/check.sh                          # gate + tier-1 lane
+#   scripts/check.sh -m 'not slow and not kernel'  # skip compiles too
+#
+# flowcheck exits nonzero on any NEW violation (baselined findings in
+# foundationdb_tpu/analysis/baseline.json don't fail; see README).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== flowcheck (python -m foundationdb_tpu.analysis) =="
+JAX_PLATFORMS=cpu python -m foundationdb_tpu.analysis
+
+echo "== pytest (fast lane: -m 'not slow') =="
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider "$@"

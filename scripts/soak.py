@@ -50,6 +50,14 @@ def main():
 
     from foundationdb_tpu.utils import probes as _probes
 
+    # Pre-declare the ENTIRE static probe manifest (flowcheck's ledger):
+    # ensemble coverage accounting then spans every probe in the tree,
+    # including ones whose declaring module no seed happened to import —
+    # a probe only the manifest knows about shows up as NEVER HIT below.
+    from foundationdb_tpu.analysis.manifest import load_manifest
+
+    _probes.declare(*load_manifest())
+
     seeds = list(range(args.start, args.start + args.seeds))
     work = [(s, i % args.determinism_every == 0) for i, s in enumerate(seeds)]
     t0 = time.perf_counter()

@@ -130,3 +130,60 @@ def test_racing_knob_writers_converge():
 
     run(sched, body())
     cluster.stop()
+
+
+def test_knob_write_retries_through_quorum_outage():
+    """The round-5 crash shape, fixed: a coordinator MAJORITY dies
+    mid-`set`; instead of QuorumUnreachable escaping the actor (264
+    unhandled tracebacks across the r5 re-run soak), the store backs
+    off with capped delays and the write lands once quorum returns —
+    and the scheduler's unhandled-error ledger stays empty."""
+    from foundationdb_tpu.cluster.config_db import PaxosConfigStore
+    from foundationdb_tpu.utils import probes
+
+    sched, cluster, db = open_cluster(ClusterConfig())
+    store = PaxosConfigStore(sched, cluster.config_nodes, "outage-writer")
+
+    async def body():
+        # majority down BEFORE the write even reads: first snapshot
+        # already sees QuorumUnreachable
+        cluster.kill_coordinator(0)
+        cluster.kill_coordinator(1)
+        t = sched.spawn(store.set("MAX_THING", b"77"))
+        await sched.delay(0.4)  # write is backing off meanwhile
+        assert not t.done.is_ready  # genuinely blocked on the outage
+        cluster.revive_coordinator(0)
+        cluster.revive_coordinator(1)
+        gen, overrides = await t.done  # succeeds after quorum returns
+        assert overrides["MAX_THING"] == b"77"
+        fresh = PaxosConfigStore(sched, cluster.config_nodes, "reader")
+        _g, seen = await fresh.snapshot()
+        assert seen["MAX_THING"] == b"77"
+
+    run(sched, body())
+    assert probes.snapshot().get("config.quorum_write_retried", 0) >= 1
+    assert sched.unhandled_errors() == []
+    cluster.stop()
+
+
+def test_knob_write_fails_loudly_when_outage_outlives_budget():
+    """A PERMANENT quorum loss must still fail loudly (the retry is for
+    transient outages, not a license to hang forever)."""
+    import pytest as _pytest
+
+    from foundationdb_tpu.cluster.config_db import PaxosConfigStore
+    from foundationdb_tpu.cluster.coordination import QuorumUnreachable
+
+    sched, cluster, db = open_cluster(ClusterConfig())
+    store = PaxosConfigStore(sched, cluster.config_nodes, "doomed-writer")
+
+    async def body():
+        cluster.kill_coordinator(0)
+        cluster.kill_coordinator(1)
+        cluster.kill_coordinator(2)
+        with _pytest.raises(QuorumUnreachable):
+            await store.set("MAX_THING", b"88")
+        return True
+
+    assert run(sched, body())
+    cluster.stop()

@@ -1,0 +1,402 @@
+"""flowcheck: every rule family exercised on fixtures, plus the live
+tree self-check (zero non-baselined violations — the CI gate contract).
+
+Fixture snippets are linted through `analyze_source`, which runs the
+file-level rules as if the snippet lived at a chosen path — the path is
+what selects scope (sim-schedulable vs kernel vs out-of-scope), so the
+same snippet can assert both the positive and the scope-negative case.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from foundationdb_tpu.analysis import analyze_source, run_analysis
+from foundationdb_tpu.analysis.manifest import load_manifest
+from foundationdb_tpu.analysis.rules_probes import (
+    check_probe_ledger,
+    tree_manifest,
+)
+from foundationdb_tpu.analysis.walker import FileContext
+
+SIM = "foundationdb_tpu/cluster/_snippet.py"
+OPS = "foundationdb_tpu/ops/_snippet.py"
+OUT = "foundationdb_tpu/wire/_snippet.py"  # outside every scope
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# -- determinism family ----------------------------------------------------
+
+
+def test_wall_clock_flagged_in_sim_scope():
+    src = "import time\n\ndef f():\n    return time.time()\n"
+    assert rules_of(analyze_source(src, SIM)) == ["determinism.wall-clock"]
+    # aliased import still resolves
+    src2 = "import time as _t\n\ndef f():\n    _t.sleep(1)\n"
+    assert rules_of(analyze_source(src2, SIM)) == ["determinism.wall-clock"]
+    # from-import too
+    src3 = "from time import monotonic\n\ndef f():\n    return monotonic()\n"
+    assert rules_of(analyze_source(src3, SIM)) == ["determinism.wall-clock"]
+
+
+def test_wall_clock_out_of_scope_and_negative():
+    src = "import time\n\ndef f():\n    return time.time()\n"
+    assert analyze_source(src, OUT) == []  # wire/ is the real-I/O side
+    ok = "def f(sched):\n    return sched.now()\n"
+    assert analyze_source(ok, SIM) == []
+
+
+def test_datetime_now_flagged():
+    src = (
+        "import datetime\n\ndef f():\n"
+        "    return datetime.datetime.now()\n"
+    )
+    assert rules_of(analyze_source(src, SIM)) == ["determinism.wall-clock"]
+    # dot-boundary: a sim-clock wrapper merely NAMED *datetime is fine
+    ok = "def f(start_datetime):\n    return start_datetime.now()\n"
+    assert analyze_source(ok, SIM) == []
+
+
+def test_unseeded_random_flagged():
+    src = (
+        "import os, random\nimport numpy as np\n\ndef f():\n"
+        "    a = os.urandom(8)\n"
+        "    b = random.random()\n"
+        "    c = np.random.rand(3)\n"
+        "    d = np.random.default_rng(0)\n"  # seeded: NOT flagged
+        "    return a, b, c, d\n"
+    )
+    assert rules_of(analyze_source(src, SIM)) == [
+        "determinism.unseeded-random"
+    ] * 3
+
+
+def test_asyncio_flagged_in_sim_scope():
+    src = "import asyncio\n\nasync def f():\n    await asyncio.sleep(1)\n"
+    got = rules_of(analyze_source(src, SIM))
+    assert got == ["determinism.asyncio"] * 2  # import + call
+    assert analyze_source(src, OUT) == []
+
+
+def test_suppression_comment_absorbs_the_finding():
+    src = (
+        "import time\n\ndef f():\n"
+        "    return time.time()  # flowcheck: ignore[determinism.wall-clock]\n"
+    )
+    assert analyze_source(src, SIM) == []
+    # family-level and bare ignores work too
+    fam = (
+        "import time\n\ndef f():\n"
+        "    return time.time()  # flowcheck: ignore[determinism]\n"
+    )
+    assert analyze_source(fam, SIM) == []
+    bare = (
+        "import time\n\ndef f():\n"
+        "    return time.time()  # flowcheck: ignore\n"
+    )
+    assert analyze_source(bare, SIM) == []
+    # a suppression for a DIFFERENT rule does not absorb it
+    wrong = (
+        "import time\n\ndef f():\n"
+        "    return time.time()  # flowcheck: ignore[actor.swallow]\n"
+    )
+    assert rules_of(analyze_source(wrong, SIM)) == ["determinism.wall-clock"]
+
+
+def test_trailing_suppression_does_not_bleed_to_next_line():
+    """A justified trailing ignore on line N must not absorb an
+    unrelated violation on line N+1; a STANDALONE comment line
+    annotates the line below it."""
+    src = (
+        "import time\n\ndef f():\n"
+        "    a = time.time()  # flowcheck: ignore[determinism]\n"
+        "    time.sleep(1)\n"
+        "    return a\n"
+    )
+    got = analyze_source(src, SIM)
+    assert rules_of(got) == ["determinism.wall-clock"]
+    assert got[0].line == 5  # the sleep, not the suppressed time()
+    above = (
+        "import time\n\ndef f():\n"
+        "    # flowcheck: ignore[determinism]\n"
+        "    return time.time()\n"
+    )
+    assert analyze_source(above, SIM) == []
+
+
+def test_tuple_and_attribute_broad_excepts_flagged():
+    """`except (Exception, ValueError): pass` and
+    `except builtins.Exception: pass` must not evade actor.swallow."""
+    tup = (
+        "def f(x):\n    try:\n        x()\n"
+        "    except (Exception, ValueError):\n        pass\n"
+    )
+    assert rules_of(analyze_source(tup, SIM)) == ["actor.swallow"]
+    attr = (
+        "import builtins\n\ndef f(x):\n    try:\n        x()\n"
+        "    except builtins.Exception:\n        pass\n"
+    )
+    assert rules_of(analyze_source(attr, SIM)) == ["actor.swallow"]
+    # a narrow tuple stays fine
+    ok = (
+        "def f(x):\n    try:\n        x()\n"
+        "    except (KeyError, ValueError):\n        pass\n"
+    )
+    assert analyze_source(ok, SIM) == []
+
+
+def test_suppression_inside_string_literal_is_inert():
+    """Only REAL comments suppress: a string (or docstring) merely
+    mentioning the marker syntax must not blind the gate."""
+    src = (
+        "import time\n\ndef f():\n"
+        "    msg = 'add # flowcheck: ignore to silence'\n"
+        "    return time.time(), msg\n"
+    )
+    assert rules_of(analyze_source(src, SIM)) == ["determinism.wall-clock"]
+    # marker in a string ON the offending line: still inert
+    same_line = (
+        "import time\n\ndef f():\n"
+        "    return time.time(), '# flowcheck: ignore'\n"
+    )
+    assert rules_of(analyze_source(same_line, SIM)) == [
+        "determinism.wall-clock"
+    ]
+
+
+# -- actor-safety family ---------------------------------------------------
+
+
+def test_fire_and_forget_spawn_flagged():
+    src = "def f(sched, coro):\n    sched.spawn(coro)\n"
+    assert rules_of(analyze_source(src, SIM)) == ["actor.fire-and-forget"]
+    ok = "def f(sched, coro):\n    t = sched.spawn(coro)\n    return t\n"
+    assert analyze_source(ok, SIM) == []
+    sup = (
+        "def f(sched, coro):\n"
+        "    sched.spawn(coro)  # flowcheck: ignore[actor.fire-and-forget]\n"
+    )
+    assert analyze_source(sup, SIM) == []
+
+
+def test_unawaited_future_flagged():
+    src = "async def f(sched):\n    sched.delay(1.0)\n"
+    assert rules_of(analyze_source(src, SIM)) == ["actor.unawaited-future"]
+    ok = "async def f(sched):\n    await sched.delay(1.0)\n"
+    assert analyze_source(ok, SIM) == []
+
+
+def test_bare_local_coroutine_call_flagged():
+    src = (
+        "async def worker():\n    pass\n\n"
+        "def f():\n    worker()\n"
+    )
+    assert rules_of(analyze_source(src, SIM)) == ["actor.unawaited-future"]
+
+
+def test_broad_swallow_flagged():
+    src = (
+        "def f(x):\n    try:\n        x()\n"
+        "    except Exception:\n        pass\n"
+    )
+    assert rules_of(analyze_source(src, SIM)) == ["actor.swallow"]
+    bare = (
+        "def f(x):\n    try:\n        x()\n"
+        "    except:\n        pass\n"
+    )
+    assert rules_of(analyze_source(bare, SIM)) == ["actor.swallow"]
+    # narrow type or a body that DOES something: fine
+    ok = (
+        "def f(x, log):\n    try:\n        x()\n"
+        "    except KeyError:\n        pass\n"
+        "    try:\n        x()\n"
+        "    except Exception as e:\n        log(e)\n"
+    )
+    assert analyze_source(ok, SIM) == []
+
+
+# -- JAX hazard family -----------------------------------------------------
+
+
+def test_host_sync_flagged_in_kernel_scope():
+    src = "def f(x):\n    return float(x)\n"
+    assert rules_of(analyze_source(src, OPS)) == ["jax.host-sync"]
+    assert analyze_source(src, SIM) == []  # kernel scope only
+    ok = "def f():\n    return float(1.5)\n"  # literal: static
+    assert analyze_source(ok, OPS) == []
+    item = "def f(x):\n    return x.item()\n"
+    assert rules_of(analyze_source(item, OPS)) == ["jax.host-sync"]
+
+
+def test_host_numpy_flagged_in_kernel_scope():
+    src = (
+        "import numpy as np\n\ndef f(a, b):\n"
+        "    return np.maximum(a, b)\n"
+    )
+    assert rules_of(analyze_source(src, OPS)) == ["jax.host-numpy"]
+    # exactly ONE finding per call: np.nonzero is host-numpy, not also
+    # double-reported as data-dep-shape
+    dd = (
+        "import numpy as np\n\ndef f(x):\n"
+        "    return np.nonzero(x)\n"
+    )
+    assert rules_of(analyze_source(dd, OPS)) == ["jax.host-numpy"]
+    ok = (
+        "import jax.numpy as jnp\n\ndef f(a, b):\n"
+        "    return jnp.maximum(a, b)\n"
+    )
+    assert analyze_source(ok, OPS) == []
+
+
+def test_data_dependent_shape_flagged():
+    src = (
+        "import jax.numpy as jnp\n\ndef f(x):\n"
+        "    return jnp.nonzero(x)\n"
+    )
+    assert rules_of(analyze_source(src, OPS)) == ["jax.data-dep-shape"]
+    one_arg = (
+        "import jax.numpy as jnp\n\ndef f(x):\n"
+        "    return jnp.where(x)\n"
+    )
+    assert rules_of(analyze_source(one_arg, OPS)) == ["jax.data-dep-shape"]
+    ok = (
+        "import jax.numpy as jnp\n\ndef f(c, a, b):\n"
+        "    return jnp.where(c, a, b)\n"
+    )
+    assert analyze_source(ok, OPS) == []
+
+
+def test_block_until_ready_in_loop_flagged_everywhere():
+    src = (
+        "def f(outs):\n    for o in outs:\n"
+        "        o.block_until_ready()\n"
+    )
+    # package-wide rule: fires even outside kernel scope
+    assert rules_of(analyze_source(src, OUT)) == ["jax.block-in-loop"]
+    ok = (
+        "def f(outs):\n    outs[-1].block_until_ready()\n"
+    )
+    assert analyze_source(ok, OUT) == []
+
+
+# -- probe accounting family (tree checks) ---------------------------------
+
+
+def ctxs_from(*sources):
+    return [
+        FileContext(f"foundationdb_tpu/cluster/_fix{i}.py", src)
+        for i, src in enumerate(sources)
+    ]
+
+
+def test_undeclared_probe_flagged(tmp_path):
+    man = tmp_path / "m.json"
+    ctxs = ctxs_from(
+        "from foundationdb_tpu.utils.probes import code_probe\n"
+        "def f():\n    code_probe(True, 'x.y')\n"
+    )
+    got = [f.rule for f in check_probe_ledger(ctxs, manifest_path=man)]
+    assert "probe.undeclared" in got
+
+
+def test_duplicate_declare_flagged(tmp_path):
+    man = tmp_path / "m.json"
+    ctxs = ctxs_from(
+        "from foundationdb_tpu.utils.probes import declare\n"
+        "declare('dup.probe')\n",
+        "from foundationdb_tpu.utils.probes import declare\n"
+        "declare('dup.probe')\n",
+    )
+    got = [f.rule for f in check_probe_ledger(ctxs, manifest_path=man)]
+    assert "probe.duplicate" in got
+
+
+def test_dynamic_probe_name_flagged(tmp_path):
+    man = tmp_path / "m.json"
+    ctxs = ctxs_from(
+        "from foundationdb_tpu.utils.probes import code_probe\n"
+        "def f(name):\n    code_probe(True, name)\n"
+    )
+    got = [f.rule for f in check_probe_ledger(ctxs, manifest_path=man)]
+    assert "probe.dynamic-name" in got
+
+
+def test_keyword_probe_name_is_accounted(tmp_path):
+    """code_probe(cond, name='x.y') must not slip past the ledger."""
+    man = tmp_path / "m.json"
+    ctxs = ctxs_from(
+        "from foundationdb_tpu.utils.probes import code_probe\n"
+        "def f():\n    code_probe(True, name='kw.probe')\n"
+    )
+    got = [f.rule for f in check_probe_ledger(ctxs, manifest_path=man)]
+    assert "probe.undeclared" in got
+    # non-literal keyword name is dynamic, not invisible
+    ctxs2 = ctxs_from(
+        "from foundationdb_tpu.utils.probes import code_probe\n"
+        "def f(n):\n    code_probe(True, name=n)\n"
+    )
+    got2 = [f.rule for f in check_probe_ledger(ctxs2, manifest_path=man)]
+    assert "probe.dynamic-name" in got2
+
+
+def test_manifest_drift_flagged(tmp_path):
+    man = tmp_path / "m.json"  # missing file = empty manifest
+    ctxs = ctxs_from(
+        "from foundationdb_tpu.utils.probes import declare, code_probe\n"
+        "declare('a.b')\n"
+        "def f():\n    code_probe(True, 'a.b')\n"
+    )
+    got = [f.rule for f in check_probe_ledger(ctxs, manifest_path=man)]
+    assert got == ["probe.manifest-drift"]
+
+
+# -- the live tree: the actual gate ----------------------------------------
+
+
+def test_live_tree_has_zero_new_violations():
+    """`python -m foundationdb_tpu.analysis` exit-0 equivalent: the
+    tree, checked against the shipped baseline, is clean — and the
+    baseline itself has no stale (already-fixed) entries."""
+    result = run_analysis(root=REPO)
+    assert result.ok, "NEW flowcheck violations:\n" + "\n".join(
+        f.render() for f in result.new
+    )
+    assert not result.stale, (
+        "baseline entries no longer match any finding (fixed code? "
+        f"run --write-baseline): {dict(result.stale)}"
+    )
+
+
+def test_live_tree_manifest_is_current():
+    result = run_analysis(root=REPO)
+    assert tree_manifest(result.contexts) == load_manifest(), (
+        "probe_manifest.json is stale: run `python -m "
+        "foundationdb_tpu.analysis --write-manifest`"
+    )
+
+
+def test_rule_catalog_is_populated():
+    from foundationdb_tpu.analysis import registry
+
+    registry.load_rules()
+    families = {r.family for r in registry.RULES.values()}
+    assert {"determinism", "actor", "jax", "probe"} <= families
+    assert len(registry.RULES) >= 13
+
+
+def test_cli_entrypoint_exits_zero():
+    """The exact command scripts/check.sh and CI run."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "foundationdb_tpu.analysis"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 new" in proc.stdout

@@ -316,6 +316,14 @@ def test_every_declared_probe_fires():
         tb = sched5.spawn(wb.set("KB", b"2"))
         await ta.done
         await tb.done
+        # MAJORITY down mid-write: the store must back off through the
+        # transient QuorumUnreachable (config.quorum_write_retried) and
+        # land once the quorum returns — the round-5 crash shape
+        cluster5.kill_coordinator(1)
+        tc = sched5.spawn(wa.set("KD", b"4"))
+        await sched5.delay(0.3)
+        cluster5.revive_coordinator(1)
+        await tc.done
         cluster5.revive_coordinator(0)
         await set_knob(db5, "KC", 3)
         txn = db5.create_transaction()
@@ -329,6 +337,32 @@ def test_every_declared_probe_fires():
     sched5.run_until(t.done)
     assert t.done.get()
     cluster5.stop()
+
+    # -- TSS divergence: corrupt the mirror, sampled read flags it --------
+    from foundationdb_tpu.cluster.tss import TSS_SAMPLE_EVERY
+
+    sched_t, cluster_t, db_t = open_cluster(
+        ClusterConfig(n_commit_proxies=1, n_storage=2, n_tss=1)
+    )
+
+    async def tss_paths():
+        txn = db_t.create_transaction()
+        txn.set(b"div", b"truth")
+        await txn.commit()
+        await sched_t.delay(0.2)  # mirror converges
+        tss = cluster_t.tss_servers[0]
+        for hist in tss._hist.values():
+            hist[:] = [(v, b"LIES") for v, _val in hist]
+        txn = db_t.create_transaction()
+        for _ in range(4 * TSS_SAMPLE_EVERY):
+            assert await txn.get(b"div") == b"truth"
+        await sched_t.delay(0.2)  # comparisons drain
+        return db_t.tss.mismatches
+
+    t = sched_t.spawn(tss_paths(), name="drive")
+    sched_t.run_until(t.done)
+    assert t.done.get() >= 1
+    cluster_t.stop()
 
     # -- QueueModel load balancing: backup request / shun -----------------
     sched6, cluster6, db6 = open_cluster(
@@ -457,4 +491,18 @@ def test_every_declared_probe_fires():
     assert probes.missed() == [], (
         f"declared CODE_PROBEs never fired: {probes.missed()}\n"
         f"fired: { {k: v for k, v in probes.snapshot().items() if v} }"
+    )
+
+    # -- the canonical manifest pin (flowcheck probe accounting) ----------
+    # every probe this run touched must be statically declared, i.e.
+    # present in analysis/probe_manifest.json — a name outside it is
+    # invisible to the coveragetool-style ledger
+    from foundationdb_tpu.analysis.manifest import load_manifest
+
+    manifest = set(load_manifest())
+    runtime_names = set(probes.snapshot())
+    assert runtime_names <= manifest, (
+        f"probes fired at runtime but missing from the static manifest "
+        f"(run `python -m foundationdb_tpu.analysis --write-manifest`): "
+        f"{sorted(runtime_names - manifest)}"
     )

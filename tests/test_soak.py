@@ -177,3 +177,130 @@ def test_ensemble_seeds_and_determinism():
     # seed plans genuinely vary
     plans = {str(plan_for_seed(s)) for s in range(12)}
     assert len(plans) >= 8
+
+
+def test_unhandled_actor_error_fails_the_seed():
+    """The silent-green killer: an injected actor whose error escapes
+    the scheduler (nobody ever awaits it) must FAIL the seed — the
+    round-5 soak printed 264 such tracebacks and still passed."""
+    from foundationdb_tpu.testing.soak import run_seed
+
+    async def boom(sched, cluster, db):
+        await sched.delay(0.1)
+        raise RuntimeError("injected unhandled actor error")
+
+    with pytest.raises(AssertionError, match="unhandled actor error"):
+        run_seed(3, _inject_fault=boom)
+    # the same seed without the injection still passes
+    assert run_seed(3)
+
+
+def test_unhandled_error_ledger_semantics():
+    """Scheduler.unhandled_errors: an escaped error counts; the same
+    error consumed by a late awaiter does not (awaiting after the crash
+    IS handling — the round-5 false-positive tracebacks)."""
+    from foundationdb_tpu.runtime.flow import Scheduler
+
+    sched = Scheduler(sim=True)
+
+    async def dies():
+        await sched.delay(0.01)
+        raise ValueError("escaped")
+
+    # escaped: spawned, never observed
+    sched.spawn(dies(), name="fire-and-forget")  # flowcheck: ignore[actor.fire-and-forget]
+    sched.run_for(0.1)
+    assert [n for n, _e in sched.unhandled_errors()] == ["fire-and-forget"]
+    sched.clear_unhandled()
+
+    # observed late: the awaiter consumes the error after the crash
+    t = sched.spawn(dies(), name="awaited-late")
+
+    async def awaiter():
+        await sched.delay(0.05)  # crash happens first
+        try:
+            await t.done
+        except ValueError:
+            return True
+
+    a = sched.spawn(awaiter(), name="awaiter")
+    sched.run_until(a.done)
+    assert a.done.get() is True
+    assert sched.unhandled_errors() == []
+
+
+def test_combinator_delegation_consumes_sibling_errors():
+    """Seed 159's false escape, pinned: two parallel actors both fail
+    (two tlog replicas raising on the same epoch lock); all_of delivers
+    the first error to the awaiter — the sibling's later error is
+    DELEGATED to the aggregate, not 'unhandled'."""
+    from foundationdb_tpu.runtime.flow import Scheduler, all_of
+
+    sched = Scheduler(sim=True)
+
+    async def dies(after):
+        await sched.delay(after)
+        raise RuntimeError(f"replica failed at {after}")
+
+    t1 = sched.spawn(dies(0.01), name="commit")
+    t2 = sched.spawn(dies(0.02), name="commit")
+
+    async def caller():
+        try:
+            await all_of([t1.done, t2.done])
+        except RuntimeError:
+            return True
+
+    c = sched.spawn(caller(), name="caller")
+    sched.run_until(c.done)
+    sched.run_for(0.1)  # let the sibling's error land
+    assert c.done.get() is True
+    assert sched.unhandled_errors() == []
+
+
+def test_dropped_aggregate_does_not_consume_member_errors():
+    """Delegation requires CONSUMPTION: building any_of/all_of over
+    failing tasks and dropping the aggregate on the floor must leave
+    the member errors on the unhandled ledger (else a dropped race
+    would blind the gate)."""
+    from foundationdb_tpu.runtime.flow import Scheduler, any_of
+
+    sched = Scheduler(sim=True)
+
+    async def dies():
+        await sched.delay(0.01)
+        raise RuntimeError("nobody is watching")
+
+    t1 = sched.spawn(dies(), name="dropped-a")
+    t2 = sched.spawn(dies(), name="dropped-b")
+    any_of([t1.done, t2.done])  # aggregate built, never awaited
+    sched.run_for(0.1)
+    assert sorted(n for n, _e in sched.unhandled_errors()) == [
+        "dropped-a", "dropped-b",
+    ]
+
+
+def test_cancelled_awaiter_abandons_the_await():
+    """Recovery's shape: an actor cancelled while awaiting a fan-out
+    (proxy batch actor awaiting LogSystem.commit's all_of over tlog
+    replicas) abandons the pending future — replica errors delivered
+    BEFORE or AFTER the cancel are consumed by it, not 'escaped'."""
+    from foundationdb_tpu.runtime.flow import Scheduler, all_of
+
+    sched = Scheduler(sim=True)
+
+    async def replica(after):
+        await sched.delay(after)
+        raise RuntimeError("epoch locked")
+
+    r1 = sched.spawn(replica(0.20), name="commit")
+    r2 = sched.spawn(replica(0.50), name="commit")
+
+    async def batch_actor():
+        await all_of([r1.done, r2.done])
+
+    b = sched.spawn(batch_actor(), name="batch")
+    sched.run_for(0.1)   # batch is suspended on the fan-out
+    b.cancel()           # recovery tears the batch actor down
+    sched.run_for(0.8)   # BOTH replica errors land after the cancel
+    assert sched.unhandled_errors() == []
