@@ -140,11 +140,25 @@ class Transaction:
             val = apply_atomic(op, val, param)
         return val
 
+    @staticmethod
+    def _clip_rows(rows, limit: int, reverse: bool):
+        """Apply limit+reverse to a fully-materialized row list: a
+        reverse scan walks from `end` downward, so the limit keeps the
+        HIGHEST keys and they return in descending order
+        (Transaction::getRange reverse semantics)."""
+        if reverse:
+            sel = rows[len(rows) - limit:] if limit < len(rows) else rows
+            return list(reversed(sel))
+        return rows[:limit]
+
     async def get_range(
         self, begin: bytes, end: bytes, *, limit: int = 1 << 30,
-        snapshot: bool = False,
+        snapshot: bool = False, reverse: bool = False,
     ) -> list[tuple[bytes, bytes]]:
         from foundationdb_tpu.cluster import system_data as SD
+
+        if limit <= 0:
+            return []
 
         for mod_b, mod_e in (
             (SD.KEY_SERVERS_PREFIX, SD.KEY_SERVERS_END),
@@ -172,24 +186,29 @@ class Transaction:
                 end[strip:] if end.startswith(SD.KEY_SERVERS_PREFIX)
                 else b"\xff",
             )
-            return rows[:limit]
+            return self._clip_rows(rows, limit, reverse)
         if begin.startswith(SD.SERVER_KEYS_PREFIX):
             rows = SD.materialize_all_server_keys(
                 self.db.cluster.key_servers
             )
-            return [r for r in rows if begin <= r[0] < end][:limit]
+            rows = [r for r in rows if begin <= r[0] < end]
+            return self._clip_rows(rows, limit, reverse)
         rv = await self.get_read_version()
         items = await self.db.read_range(begin, end, rv)
-        merged = self.writes.overlay(items, begin, end)[:limit]
+        full = self.writes.overlay(items, begin, end)
+        truncated = limit < len(full)
+        merged = self._clip_rows(full, limit, reverse)
         if not snapshot:
             # The reference narrows the conflict range to the keys actually
             # read when a limit stops the scan early; with a full scan it is
-            # [begin, end).
-            if limit < len(self.writes.overlay(items, begin, end)):
-                hi = key_after(merged[-1][0]) if merged else begin
-                self.read_conflicts.append((begin, hi))
-            else:
+            # [begin, end). A reverse scan walks from `end` downward, so
+            # its observed window is [lowest returned key, end).
+            if not truncated:
                 self.read_conflicts.append((begin, end))
+            elif reverse:
+                self.read_conflicts.append((merged[-1][0], end))
+            else:
+                self.read_conflicts.append((begin, key_after(merged[-1][0])))
         return merged
 
     async def watch(self, key: bytes):
@@ -268,11 +287,12 @@ class Transaction:
         """AUTOMATIC_IDEMPOTENCY (fdbclient/IdempotencyId.actor.cpp): the
         commit also records `\\xff/idmp/<id>`, so a retry after
         commit_unknown_result can detect that the first attempt really
-        committed instead of applying twice."""
+        committed instead of applying twice. The default id is the
+        Database's deterministic per-client nonce, never entropy — a
+        simulated run replays the exact same ids (the flowcheck
+        determinism contract)."""
         if ident is None:
-            import uuid
-
-            ident = uuid.uuid4().bytes
+            ident = self.db.next_idempotency_id()
         self.idempotency_id = ident
         return ident
 
@@ -461,6 +481,33 @@ class Database:
         from foundationdb_tpu.cluster.tss import TssComparator
 
         self.tss = TssComparator(cluster.sched, cluster)
+        # idempotency-id nonce state: (origin, client, seq) triples are
+        # unique across client handles AND client processes without a
+        # uuid4 (determinism.unseeded-random): the origin is the sim
+        # seed under simulation (replayable) and the OS pid outside it
+        self._client_id = cluster.next_client_id()
+        self._idemp_seq = 0
+
+    def next_idempotency_id(self) -> bytes:
+        """Deterministic idempotency id: 24 bytes of
+        (origin, client_id, sequence) — see _client_id above."""
+        import os
+        import struct
+
+        self._idemp_seq += 1
+        if self.sched.sim:
+            origin = self.cluster.config.sim_seed or 0
+        else:
+            # outside simulation, pids recycle: a fresh process handed a
+            # predecessor's pid must never replay its id sequence (stale
+            # \xff/idmp records would make run(idempotent=True) skip a
+            # commit that never happened here — a silently lost write),
+            # so fold real entropy under the pid. Sim runs never take
+            # this branch, so determinism is untouched.
+            origin = (os.getpid() << 32) | int.from_bytes(
+                os.urandom(4), "little"  # flowcheck: ignore[determinism.unseeded-random]
+            )
+        return struct.pack("<qqq", origin, self._client_id, self._idemp_seq)
 
     @property
     def grv_proxy(self):

@@ -131,16 +131,36 @@ class DataDistributor:
             # member and install it on every joiner. A fully-dead old
             # team means the data is unrecoverable — fail (and unwind)
             # rather than hang on a frozen server.
+            from foundationdb_tpu.cluster.storage import TransactionTooOld
+
             for b, e, team, joiners in moving:
-                src_id = next(
-                    (s for s in team if cluster.storage_live[s]), None
-                )
-                if src_id is None:
-                    raise RuntimeError(
-                        f"no live replica of [{b!r}, {e!r}) to fetch from"
+                for _attempt in range(8):
+                    src_id = next(
+                        (s for s in team if cluster.storage_live[s]), None
                     )
-                src = cluster.client_storages[src_id]
-                items = await src.get_key_values(b, e, vd)
+                    if src_id is None:
+                        raise RuntimeError(
+                            f"no live replica of [{b!r}, {e!r}) to fetch from"
+                        )
+                    src = cluster.client_storages[src_id]
+                    try:
+                        items = await src.get_key_values(b, e, vd)
+                        break
+                    except TransactionTooOld:
+                        # the source GC'd past Vd while we waited on it
+                        # (a lagging replica catches up a > MVCC-window
+                        # span in one pull batch): re-fence and fetch at
+                        # a fresher version — fetchKeys' retry-with-
+                        # higher-version loop (storageserver.actor.cpp
+                        # fetchKeys / fetch_keys_too_old). Dual-tagging
+                        # is already in force, so any newer fence stays
+                        # a consistent snapshot point for this segment.
+                        vd = await self._fence()
+                else:
+                    raise RuntimeError(
+                        f"fetch of [{b!r}, {e!r}) kept falling below the "
+                        f"source's MVCC window"
+                    )
                 for j in joiners:
                     cluster.storage_servers[j].install_shard(b, e, items, vd)
                     fetching.remove((b, e, j))

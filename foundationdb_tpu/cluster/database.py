@@ -246,6 +246,13 @@ class Cluster:
         self.controller = ClusterController(self)
         self.data_distributor = DataDistributor(self)
         self._started = False
+        self._next_client_id = 0
+
+    def next_client_id(self) -> int:
+        """Monotonic per-cluster client-handle id (the idempotency-id
+        nonce component — cluster/client.py Database)."""
+        self._next_client_id += 1
+        return self._next_client_id
 
     def _wrapped(self, src, dst, obj, methods):
         if self.net is None:

@@ -432,6 +432,17 @@ class StorageServer:
         if version < self.oldest_version:
             raise TransactionTooOld(version)
         await self.version.when_at_least(version)
+        if version < self.oldest_version:
+            # the MVCC floor can pass the request version DURING the
+            # wait: a lagging replica catching up applies a huge version
+            # span in one pull batch and GCs history the waiter was
+            # about to read — serving now would return a silently
+            # PARTIAL state at `version` (keys whose surviving floor
+            # entry sits above it vanish). The reference re-validates
+            # after waitForVersion for the same reason
+            # (storageserver.actor.cpp transaction_too_old). Found by
+            # the api workload's model check (soak seeds 1122/1171).
+            raise TransactionTooOld(version)
 
     def _check_shard_floor(self, begin: bytes, end: bytes, version: int) -> None:
         from foundationdb_tpu.cluster.failure_monitor import ProcessFailedError

@@ -76,7 +76,13 @@ def materialize_key_servers(shard_map, begin: bytes = b"",
     """The keyServers rows for shards intersecting [begin, end): one
     row per shard boundary, exactly the reference's layout (a row's
     key is the shard's begin key; its value names the owning team and
-    any in-flight destination)."""
+    any in-flight destination).
+
+    Range-read contract: every returned schema key lies inside the
+    requested [begin, end) — the shard STRADDLING `begin` is clamped to
+    a row AT `begin` (krmGetRanges' alignment discipline,
+    fdbclient/KeyRangeMap) rather than leaking a key below the bound,
+    which would hand `get_range` callers rows outside their scan."""
     rows = []
     bounds = [b""] + list(shard_map.boundaries)
     for i, b in enumerate(bounds):
@@ -86,6 +92,7 @@ def materialize_key_servers(shard_map, begin: bytes = b"",
         )
         if shard_end <= begin or b >= end:
             continue
+        b = max(b, begin)
         src = sorted(shard_map.owners[i])
         # in-flight destinations: the dual-tag window MoveKeys opens
         # while a shard streams to its new team (ShardMap.

@@ -76,12 +76,30 @@ class StorageEncryption:
             hdr = EncryptHeader.unpack(blob)
         except AuthTokenError:
             return blob  # magic collision, not our header version
+        # The header is unauthenticated until the token verifies, so
+        # its cipher details must be validated BEFORE they drive a KMS
+        # fetch (BlobCipher.cpp:256's discipline): the auth identity
+        # must be the system domain and the text identity must be THIS
+        # store's configured domain — a forger must not get to choose
+        # which keys authenticate their record.
+        if hdr.header_domain_id != SYSTEM_DOMAIN_ID:
+            raise AuthTokenError(
+                f"sealed record names auth domain {hdr.header_domain_id}; "
+                f"header-auth keys live only in the system domain"
+            )
+        if hdr.domain_id != self.domain_id:
+            raise AuthTokenError(
+                f"sealed record names text domain {hdr.domain_id}; this "
+                f"store is configured for domain {self.domain_id}"
+            )
         # ensure both named generations are cached (restart: fresh cache)
         self.proxy.get_cipher_by_id(hdr.domain_id, hdr.base_id, hdr.salt)
         self.proxy.get_cipher_by_id(
             hdr.header_domain_id, hdr.header_base_id, hdr.header_salt
         )
-        return decrypt(blob, self.proxy.cache)
+        return decrypt(
+            blob, self.proxy.cache, expected_domain_id=self.domain_id
+        )
 
 
 def default_encryption(domain_id: int = DEFAULT_DOMAIN_ID,

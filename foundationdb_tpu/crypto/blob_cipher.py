@@ -214,17 +214,40 @@ def encrypt(plaintext: bytes, text_key: BlobCipherKey,
 
 
 def decrypt(blob: bytes, cache: BlobCipherKeyCache,
-            auth_key: BlobCipherKey = None) -> bytes:
+            auth_key: BlobCipherKey = None, *,
+            expected_domain_id: int = None) -> bytes:
     """Verify the auth token, then decrypt. The text cipher is located
     in the cache by the header's (domain, baseId, salt); the auth key
-    defaults to the cache's key for the header's auth identity."""
+    defaults to the cache's key for the header's auth identity.
+
+    The header is UNAUTHENTICATED until the token verifies, so its
+    cipher details are attacker-controlled: a forger who holds ANY
+    domain's key could name that domain as the header-auth identity and
+    mint a token that verifies. The reference pins the header cipher to
+    the system encryption domain before using it
+    (BlobCipher.cpp:256 validateEncryptHeaderDetails) — same here: a
+    header naming a non-system auth domain is rejected outright, and a
+    caller that knows which domain its record belongs to passes
+    `expected_domain_id` so a valid record relocated across domains is
+    rejected too."""
     if len(blob) < HEADER_BYTES:
         raise AuthTokenError("truncated encrypted record")
     header_bytes = blob[: _HEADER.size]
     token = blob[_HEADER.size : HEADER_BYTES]
     ciphertext = blob[HEADER_BYTES:]
     header = EncryptHeader.unpack(header_bytes)
+    if expected_domain_id is not None and header.domain_id != expected_domain_id:
+        raise AuthTokenError(
+            f"header names text domain {header.domain_id}, store is "
+            f"configured for domain {expected_domain_id}"
+        )
     if auth_key is None:
+        if header.header_domain_id != SYSTEM_DOMAIN_ID:
+            raise AuthTokenError(
+                f"header names auth domain {header.header_domain_id}; "
+                f"only the system domain ({SYSTEM_DOMAIN_ID}) may hold "
+                f"header-auth keys"
+            )
         auth_key = cache.lookup(
             header.header_domain_id, header.header_base_id,
             header.header_salt,

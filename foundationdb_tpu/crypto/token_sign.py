@@ -65,6 +65,27 @@ class TokenVerifier:
         self._cache: dict[bytes, dict] = {}
         self.verifies = 0  # actual ECDSA verifications (observability)
 
+    @staticmethod
+    def _validate_claims(claims) -> None:
+        """Shape-check the decoded payload BEFORE any field is used: a
+        validly-signed but malformed token (hostile or buggy identity
+        provider) must surface as permission_denied, never as a
+        TypeError/KeyError escaping into the request path (the
+        reference's TokenSign parse errors all map to
+        error_code_permission_denied)."""
+        if not isinstance(claims, dict):
+            raise ValueError(f"claims must be an object, got {type(claims).__name__}")
+        if not isinstance(claims.get("kid"), str):
+            raise ValueError("claim 'kid' missing or not a string")
+        exp = claims.get("exp")
+        if isinstance(exp, bool) or not isinstance(exp, (int, float)):
+            raise ValueError("claim 'exp' missing or not a number")
+        tenants = claims.get("tenants")
+        if not isinstance(tenants, list) or not all(
+            isinstance(t, str) for t in tenants
+        ):
+            raise ValueError("claim 'tenants' missing or not a string list")
+
     def _verify(self, token: bytes) -> dict:
         cached = self._cache.get(token)
         if cached is not None:
@@ -74,10 +95,11 @@ class TokenVerifier:
             payload = base64.b64decode(payload_b64)
             sig = base64.b64decode(sig_b64)
             claims = json.loads(payload)
+            self._validate_claims(claims)
             pub = self._keys[claims["kid"]]
             self.verifies += 1
             pub.verify(sig, payload, ec.ECDSA(hashes.SHA256()))
-        except (KeyError, ValueError, InvalidSignature) as e:
+        except (KeyError, TypeError, ValueError, InvalidSignature) as e:
             raise PermissionDeniedError(f"invalid token: {e!r}")
         self._cache[token] = claims
         if len(self._cache) > 4096:  # bound like TokenCache

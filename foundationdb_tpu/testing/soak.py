@@ -1,17 +1,23 @@
 """Seed-sweeping soak ensemble: Joshua in miniature (VERDICT r1 task 9).
 
-One seed = one deterministic simulated-cluster run with a seed-derived
+One seed = one deterministic simulated-cluster run with a SPEC-derived
 cluster shape, seed-randomized knobs (the reference's `randomize &&
-BUGGIFY` discipline, fdbclient/ServerKnobs.cpp), and a seed-derived fault
+BUGGIFY` discipline, fdbclient/ServerKnobs.cpp), and a spec-derived fault
 mix (clogging, storage reboots, shard moves, tlog kills, coordinator
 kills, proxy kills forcing quorum-gated recovery) running under a
-ConflictRange-style model-checked workload. The signature of a run —
-outcome counts, virtual end time, epoch, final keys — is deterministic
-per seed; `run_seed` executed twice must return identical signatures
-(the unseed-determinism check, contrib/debug_determinism/).
+ConflictRange-style model-checked workload — plus, spec-gated, the
+full-client ApiCorrectness workload (testing/api_workload.py) whose
+sequential-model cross-check fails the seed on ANY read or commit/abort
+divergence. The signature of a run — outcome counts, virtual end time,
+epoch, final keys, api check counts — is deterministic per seed;
+`run_seed` executed twice must return identical signatures (the
+unseed-determinism check, contrib/debug_determinism/).
 
-Driven by scripts/soak.py (`--seeds N`), the CI ensemble runner
-(contrib/TestHarness2/test_harness/run.py's role).
+Every probability and topology range lives in a named spec file
+(testing/specs/*.toml — the reference's TOML-driven tester,
+fdbserver/tester.actor.cpp readTOMLTests_impl), never in this module:
+`plan_for_seed(seed, spec)` derives the plan from the spec, and
+`scripts/soak.py --spec <name>` sweeps seeds through it.
 """
 
 from __future__ import annotations
@@ -31,7 +37,8 @@ declare(
 
 @dataclasses.dataclass
 class SeedPlan:
-    """Everything a seed decides, derived before the run starts."""
+    """Everything a seed decides, derived from (seed, spec) before the
+    run starts (testing/spec.derive_plan_fields)."""
 
     n_commit_proxies: int
     n_resolvers: int
@@ -82,59 +89,51 @@ class SeedPlan:
     #                            + log backup THROUGH the chaos (worker
     #                            displacement on recoveries), restored
     #                            into a fresh cluster and compared
+    # PR-2: the full-client randomized-correctness layer
+    api: bool                  # ApiCorrectness analog: the full client
+    #                            API (RYW, reverse/limited ranges,
+    #                            atomics, versionstamps, explicit
+    #                            conflict ranges, snapshot reads)
+    #                            cross-checked against a sequential
+    #                            model (testing/api_workload.py)
+    api_actors: int            # concurrent api workload actors
+    api_rounds: int            # transactions per api actor
+    resolver_backend: str      # "cpu" | "tpu" | "tpu-force": the spec
+    #                            alternates backends so the TPU kernel
+    #                            runs inside the fault ensemble
+    spec_name: str             # which spec derived this plan
 
 
-def plan_for_seed(seed: int) -> SeedPlan:
-    r = np.random.default_rng(seed ^ 0x5EED)
-    n_storage = int(r.integers(2, 4))
-    replication = int(r.integers(1, min(n_storage, 2) + 1))
-    return SeedPlan(
-        n_commit_proxies=int(r.integers(1, 3)),
-        n_resolvers=int(r.integers(1, 3)),
-        n_storage=n_storage,
-        replication=replication,
-        n_tlogs=int(r.integers(1, 3)),
-        rounds=int(r.integers(20, 45)),
-        kill_proxy=bool(r.random() < 0.5),
-        kill_tlog=bool(r.random() < 0.3),
-        kill_coordinator=bool(r.random() < 0.4),
-        clog=bool(r.random() < 0.6),
-        reboot_storage=bool(r.random() < 0.5),
-        move_shard=bool(r.random() < 0.5),
-        randomize_knobs=bool(r.random() < 0.5),
-        duplicate_resolve=bool(r.random() < 0.45),
-        coordinator_outage=bool(r.random() < 0.3),
-        usurper=bool(r.random() < 0.35),
-        laggard_txn=bool(r.random() < 0.4),
-        state_squeeze=bool(r.random() < 0.3),
-        small_window=bool(r.random() < 0.5),
-        crash_tlog=bool(r.random() < 0.4),
-        slow_storage=bool(r.random() < 0.3),
-        tag_quota=bool(r.random() < 0.3),
-        silent_kill=bool(r.random() < 0.35),
-        tlog_spill=bool(r.random() < 0.35),
-        knob_quorum=bool(r.random() < 0.35),
-        sideband=bool(r.random() < 0.5),
-        random_clogging=bool(r.random() < 0.4),
-        atomic_ops=bool(r.random() < 0.4),
-        backup_restore=bool(r.random() < 0.3),
-    )
+def plan_for_seed(seed: int, spec=None) -> SeedPlan:
+    """Derive a seed's plan from a spec (name, SoakSpec, or None for
+    the checked-in default). The probabilities live in
+    testing/specs/*.toml — there are none here."""
+    from foundationdb_tpu.testing.spec import derive_plan_fields, load_spec
+
+    spec = load_spec(spec if spec is not None else "default")
+    return SeedPlan(**derive_plan_fields(seed, spec))
 
 
-def run_seed(seed: int, collect_probes: bool = False, _inject_fault=None):
-    """Run one ensemble seed; returns the deterministic signature (and,
-    with collect_probes, the CODE_PROBE hit snapshot for ensemble
-    coverage accounting — the Joshua side of flow/CodeProbe.h).
+def run_seed(seed: int, spec=None, collect_probes: bool = False,
+             _inject_fault=None, _corrupt_api: bool = False):
+    """Run one ensemble seed under a named spec; returns the
+    deterministic signature (and, with collect_probes, the CODE_PROBE
+    hit snapshot for ensemble coverage accounting — the Joshua side of
+    flow/CodeProbe.h).
 
-    A seed FAILS on any unhandled actor error: an exception that
-    escaped its actor and was never consumed by an awaiter
-    (Scheduler.unhandled_errors). The round-5 re-run soak printed 264
-    such tracebacks and still passed green — that silent-green shape is
-    now structurally impossible.
+    A seed FAILS on any unhandled actor error (an exception that
+    escaped its actor and was never consumed by an awaiter,
+    Scheduler.unhandled_errors), on any workload model-check mismatch,
+    and — when the plan runs the api workload — on any divergence
+    between the real client's reads/commit decisions and the
+    sequential model (testing/api_workload.py).
 
     `_inject_fault` is the gate's self-test hook (tests/test_soak.py):
     an async callable(sched, cluster, db) spawned as a fire-and-forget
     actor, so a deliberately crashing injection proves the seed fails.
+    `_corrupt_api` is the api checker's self-test hook: it corrupts
+    committed api keys on every replica behind the transaction
+    system's back, so the model cross-check must fail the seed.
     """
     from foundationdb_tpu.cluster.commit_proxy import (
         CommitUnknownResult,
@@ -160,7 +159,10 @@ def run_seed(seed: int, collect_probes: bool = False, _inject_fault=None):
         # retries exactly like any other retryable transaction error
         ProcessFailedError,
     )
-    plan = plan_for_seed(seed)
+    from foundationdb_tpu.testing.spec import load_spec
+
+    spec = load_spec(spec if spec is not None else "default")
+    plan = plan_for_seed(seed, spec)
     if collect_probes:
         # per-seed accounting: pooled ensemble workers reuse processes,
         # so the global counters must start clean for THIS seed (plain
@@ -173,9 +175,10 @@ def run_seed(seed: int, collect_probes: bool = False, _inject_fault=None):
     knob_rng = np.random.default_rng(seed ^ 0xBADC0DE)
     if plan.randomize_knobs:
         SERVER_KNOBS.randomize_under_test(knob_rng)
-    # the ensemble always runs the host conflict model: deterministic and
-    # device-free (the TPU kernel has its own parity suites)
-    SERVER_KNOBS.set("RESOLVER_BACKEND", "cpu")
+    # the spec decides the conflict backend per seed: "cpu" is the host
+    # model, "tpu-force" the JAX kernel — running the device path INSIDE
+    # the fault ensemble, not just in packed-batch parity suites
+    SERVER_KNOBS.set("RESOLVER_BACKEND", plan.resolver_backend)
     if plan.duplicate_resolve:
         SERVER_KNOBS.set("BUGGIFY_DUPLICATE_RESOLVE", True)
     if plan.state_squeeze:
@@ -576,10 +579,36 @@ def run_seed(seed: int, collect_probes: bool = False, _inject_fault=None):
                 p.failed = RuntimeError("soak kill")
                 p.stop()
 
+        api = None
+        if plan.api:
+            from foundationdb_tpu.testing.api_workload import ApiWorkload
+
+            # phantom resolver state from killed proxies/logs (resolved-
+            # committed batches the log never made durable) makes
+            # "every NotCommitted has a visible conflicting writer"
+            # unsound, so the stronger abort audit only arms on plans
+            # without those fault classes
+            strict = not (
+                plan.kill_proxy or plan.kill_tlog or plan.crash_tlog
+                or plan.coordinator_outage or plan.usurper
+                or plan.duplicate_resolve or plan.knob_quorum
+                or plan.silent_kill
+            )
+            api = ApiWorkload(
+                sched, db, seed,
+                actors=plan.api_actors, rounds=plan.api_rounds,
+                strict_aborts=strict,
+            )
+
         w = sched.spawn(workload(), name="soak-load")
         c = sched.spawn(chaos(), name="soak-chaos")
         cc = sched.spawn(coordination_chaos(), name="soak-coord-chaos")
         tasks = [w.done, c.done, cc.done]
+        if api is not None:
+            tasks.extend(
+                sched.spawn(coro, name=f"soak-api-{i}").done
+                for i, coro in enumerate(api.actor_coros())
+            )
         if _inject_fault is not None:
             # deliberately unobserved: the unhandled-error gate below
             # must catch whatever this actor lets escape
@@ -678,6 +707,13 @@ def run_seed(seed: int, collect_probes: bool = False, _inject_fault=None):
             finally:
                 cluster2.stop()
 
+        if api is not None:
+            if _corrupt_api:
+                # the divergence self-test: values flipped behind the
+                # transaction system's back MUST fail the model check
+                api.corrupt_for_selftest(cluster)
+            sched.run_until(sched.spawn(api.verify()).done)
+
         check_cluster(cluster)
         # the unhandled-actor-error gate: any exception that escaped an
         # actor with no awaiter ever consuming it fails the seed
@@ -698,6 +734,7 @@ def run_seed(seed: int, collect_probes: bool = False, _inject_fault=None):
             round(sched.now(), 6),
             cluster.controller.epoch,
             tuple(sorted(got)),
+            api.signature() if api is not None else None,
         )
         cluster.stop()
         if collect_probes:

@@ -15,6 +15,9 @@ cd "$(dirname "$0")/.."
 echo "== flowcheck (python -m foundationdb_tpu.analysis) =="
 JAX_PLATFORMS=cpu python -m foundationdb_tpu.analysis
 
+echo "== spec smoke (1 short seed per checked-in spec, api workload on) =="
+JAX_PLATFORMS=cpu python scripts/soak.py --smoke
+
 echo "== pytest (fast lane: -m 'not slow') =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider "$@"

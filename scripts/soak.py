@@ -1,13 +1,23 @@
 #!/usr/bin/env python
-"""Seed-sweeping soak runner: `python scripts/soak.py --seeds 100`.
+"""Spec-driven seed-sweeping soak runner.
+
+    python scripts/soak.py --seeds 100                  # the default spec
+    python scripts/soak.py --spec api_correctness --seeds 300
+    python scripts/soak.py --smoke                      # 1 short seed per spec
 
 The Joshua-ensemble driver (contrib/TestHarness2/test_harness/run.py's
-role): N seeds, each a deterministic simulated-cluster run with
-seed-randomized knobs + fault mix (foundationdb_tpu/testing/soak.py),
-executed across worker processes. Every K-th seed is run TWICE and the
-signatures compared — the unseed determinism check
-(contrib/debug_determinism/). Any assertion failure reports the seed for
-exact reproduction.
+role): N seeds, each a deterministic simulated-cluster run whose shape,
+knobs, fault mix and workload set come from a NAMED SPEC
+(foundationdb_tpu/testing/specs/*.toml — the reference's TOML-driven
+tester), executed across worker processes. Every K-th seed (the spec's
+determinism_every) is run TWICE and the signatures compared — the
+unseed determinism check (contrib/debug_determinism/). Any assertion
+failure reports the seed and spec for exact reproduction.
+
+Probe accounting: the whole static manifest is declared up front; after
+the sweep the spec's `[probes].expected` list is reported, and with
+`--probe-gate` an expected-but-never-hit probe fails the run (the
+coveragetool contract, applied per spec).
 """
 
 import argparse
@@ -22,29 +32,124 @@ os.environ["JAX_PLATFORMS"] = "cpu"  # force off any device tunnel (sim is CPU-o
 
 
 def _one(args):
-    seed, check_determinism = args
+    seed, spec_name, check_determinism = args
     from foundationdb_tpu.testing import soak
-    from foundationdb_tpu.utils import probes
 
     t0 = time.perf_counter()
-    sig, hits = soak.run_seed(seed, collect_probes=True)
+    sig, hits = soak.run_seed(seed, spec=spec_name, collect_probes=True)
     if check_determinism:
-        sig2 = soak.run_seed(seed)
+        sig2 = soak.run_seed(seed, spec=spec_name)
         if sig != sig2:
             raise AssertionError(
-                f"seed {seed}: NONDETERMINISTIC\n  run1: {sig}\n  run2: {sig2}"
+                f"seed {seed} (spec {spec_name}): NONDETERMINISTIC\n"
+                f"  run1: {sig}\n  run2: {sig2}"
             )
     return seed, sig, time.perf_counter() - t0, check_determinism, hits
 
 
+def sweep(spec_name: str, seeds: list, jobs: int, probe_gate: bool) -> int:
+    """Run one spec's seed sweep; returns the number of failures."""
+    from foundationdb_tpu.testing.spec import load_spec
+    from foundationdb_tpu.utils import probes as _probes
+
+    spec = load_spec(spec_name)
+    det_every = spec.policy["determinism_every"]
+    work = [(s, spec_name, i % det_every == 0) for i, s in enumerate(seeds)]
+    t0 = time.perf_counter()
+    failures = []
+    done = 0
+    committed = aborted = rechecks = det_checked = 0
+    api_acked = api_reads = 0
+    # Worker RSS grows across seeds (~20GB by seed ~2000 once the
+    # backup workload added a second cluster per seed), so workers must
+    # recycle. max_tasks_per_child forces the SPAWN context, whose
+    # worker respawn wedges under this environment's shell — recycle by
+    # CHUNK instead: a fresh fork-context pool every 400 seeds bounds
+    # worker lifetime with no start-method change.
+    CHUNK = 400
+    for lo in range(0, len(work), CHUNK):
+        with ProcessPoolExecutor(max_workers=jobs) as pool:
+            futs = {pool.submit(_one, w): w[0] for w in work[lo:lo + CHUNK]}
+            for fut in as_completed(futs):
+                seed = futs[fut]
+                try:
+                    s, sig, dt, det, hits = fut.result()
+                    _probes.merge(hits)
+                    done += 1
+                    committed += sig[1]
+                    aborted += sig[2]
+                    rechecks += sig[3]
+                    det_checked += int(det)
+                    api_sig = sig[7]
+                    if api_sig is not None:
+                        api_acked += api_sig[0]
+                        api_reads += api_sig[7]
+                    print(
+                        f"seed {s:5d} ok in {dt:5.1f}s  "
+                        f"committed={sig[1]:3d} "
+                        f"aborted={sig[2]:3d} epoch={sig[5]}"
+                        + (
+                            f"  api(acked={api_sig[0]},"
+                            f"checked={api_sig[7]})"
+                            if api_sig is not None else ""
+                        )
+                        + ("  [determinism OK]" if det else ""),
+                        flush=True,
+                    )
+                except Exception as e:
+                    failures.append((seed, repr(e)))
+                    print(f"seed {seed:5d} FAILED: {e!r}", flush=True)
+    wall = time.perf_counter() - t0
+    print(
+        f"\n[{spec_name}] {done}/{len(seeds)} seeds passed in {wall:.0f}s "
+        f"({jobs} jobs); committed={committed} aborted={aborted} "
+        f"read_checks={rechecks} api_acked={api_acked} "
+        f"api_reads_checked={api_reads} determinism_checked={det_checked}"
+    )
+    # ensemble CODE_PROBE coverage (the Joshua probe-accounting role):
+    # a declared probe no seed hit means our randomization never reaches
+    # that rare path — widen the ensemble or fix the path.
+    fired = {k: v for k, v in _probes.snapshot().items() if v}
+    print(f"CODE_PROBEs fired ({len(fired)}):")
+    for k in sorted(fired):
+        print(f"  {k}: {fired[k]}")
+    missed = _probes.missed()
+    if missed:
+        print(f"CODE_PROBEs NEVER HIT ({len(missed)}): {missed}")
+    expected_missed = sorted(set(spec.expected_probes) & set(missed))
+    if expected_missed:
+        print(
+            f"[{spec_name}] spec-EXPECTED probes never hit: "
+            f"{expected_missed}"
+        )
+        if probe_gate:
+            failures.append(("probe-gate", repr(expected_missed)))
+    if failures:
+        print(f"[{spec_name}] FAILURES:")
+        for s, e in failures:
+            tag = f"seed {s}" if isinstance(s, int) else s
+            print(f"  {tag}: {e}")
+    return len(failures)
+
+
 def main():
+    from foundationdb_tpu.testing.spec import list_specs
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--seeds", type=int, default=20)
     ap.add_argument("--start", type=int, default=0)
     ap.add_argument("--jobs", type=int, default=os.cpu_count() or 4)
     ap.add_argument(
-        "--determinism-every", type=int, default=5,
-        help="every K-th seed runs twice and must match exactly",
+        "--spec", default="default", choices=list_specs(),
+        help="named ensemble spec (foundationdb_tpu/testing/specs/)",
+    )
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="CI lane: run ONE seed per checked-in spec, in process",
+    )
+    ap.add_argument(
+        "--probe-gate", action="store_true",
+        help="fail the sweep if a spec-expected probe never fires",
     )
     args = ap.parse_args()
 
@@ -58,62 +163,40 @@ def main():
 
     _probes.declare(*load_manifest())
 
+    if args.smoke:
+        # one short deterministic seed per spec, in this process: the
+        # scripts/check.sh lane that proves every checked-in spec loads,
+        # plans, runs and verifies (api workload included) — not a
+        # coverage sweep, so no probe gate.
+        from foundationdb_tpu.testing import soak
+        from foundationdb_tpu.testing.spec import load_spec
+
+        failures = []
+        for name in list_specs():
+            # api=1.0: the lane's contract is that EVERY spec's smoke
+            # seed exercises the api model check, whatever the spec's
+            # own ensemble probability
+            spec = load_spec(name).with_overrides(
+                rounds=(6, 9), api_rounds=6, api=1.0
+            )
+            t0 = time.perf_counter()
+            try:
+                sig = soak.run_seed(args.start, spec=spec)
+                print(
+                    f"spec {name:16s} seed {args.start} ok in "
+                    f"{time.perf_counter() - t0:4.1f}s  "
+                    f"committed={sig[1]} api={sig[7]}",
+                    flush=True,
+                )
+            except Exception as e:
+                failures.append((name, repr(e)))
+                print(f"spec {name:16s} FAILED: {e!r}", flush=True)
+        if failures:
+            sys.exit(1)
+        return
+
     seeds = list(range(args.start, args.start + args.seeds))
-    work = [(s, i % args.determinism_every == 0) for i, s in enumerate(seeds)]
-    t0 = time.perf_counter()
-    failures = []
-    done = 0
-    committed = aborted = rechecks = det_checked = 0
-    # Worker RSS grows across seeds (~20GB by seed ~2000 once the
-    # backup workload added a second cluster per seed), so workers must
-    # recycle. max_tasks_per_child forces the SPAWN context, whose
-    # worker respawn wedges under this environment's shell — recycle by
-    # CHUNK instead: a fresh fork-context pool every 400 seeds bounds
-    # worker lifetime with no start-method change.
-    CHUNK = 400
-    for lo in range(0, len(work), CHUNK):
-        with ProcessPoolExecutor(max_workers=args.jobs) as pool:
-            futs = {pool.submit(_one, w): w[0] for w in work[lo:lo + CHUNK]}
-            for fut in as_completed(futs):
-                seed = futs[fut]
-                try:
-                    s, sig, dt, det, hits = fut.result()
-                    _probes.merge(hits)
-                    done += 1
-                    committed += sig[1]
-                    aborted += sig[2]
-                    rechecks += sig[3]
-                    det_checked += int(det)
-                    print(
-                        f"seed {s:5d} ok in {dt:5.1f}s  "
-                        f"committed={sig[1]:3d} "
-                        f"aborted={sig[2]:3d} epoch={sig[5]}"
-                        + ("  [determinism OK]" if det else ""),
-                        flush=True,
-                    )
-                except Exception as e:
-                    failures.append((seed, repr(e)))
-                    print(f"seed {seed:5d} FAILED: {e!r}", flush=True)
-    wall = time.perf_counter() - t0
-    print(
-        f"\n{done}/{len(seeds)} seeds passed in {wall:.0f}s "
-        f"({args.jobs} jobs); committed={committed} aborted={aborted} "
-        f"read_checks={rechecks} determinism_checked={det_checked}"
-    )
-    # ensemble CODE_PROBE coverage (the Joshua probe-accounting role):
-    # a declared probe no seed hit means our randomization never reaches
-    # that rare path — widen the ensemble or fix the path.
-    fired = {k: v for k, v in _probes.snapshot().items() if v}
-    print(f"CODE_PROBEs fired ({len(fired)}):")
-    for k in sorted(fired):
-        print(f"  {k}: {fired[k]}")
-    missed = _probes.missed()
-    if missed:
-        print(f"CODE_PROBEs NEVER HIT ({len(missed)}): {missed}")
-    if failures:
-        print("FAILURES:")
-        for s, e in failures:
-            print(f"  seed {s}: {e}")
+    if sweep(args.spec, seeds, args.jobs, args.probe_gate):
         sys.exit(1)
 
 

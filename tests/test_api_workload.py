@@ -1,0 +1,294 @@
+"""The full-client ApiCorrectness workload + sequential-model checker
+(testing/api_workload.py): clean-cluster runs on both resolver
+backends, the client's reverse/limited range-read contract, and the
+self-tests proving every checker direction actually fails a seed."""
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+from foundationdb_tpu.runtime.flow import all_of
+from foundationdb_tpu.testing.api_workload import (
+    DATA,
+    ApiWorkload,
+    TxnRecord,
+)
+from foundationdb_tpu.testing.oracle import SequentialModel
+
+
+def _stamp(version: int, order: int = 0) -> bytes:
+    return version.to_bytes(8, "big") + order.to_bytes(2, "big")
+
+
+def run_api(seed=5, backend="cpu", *, actors=3, rounds=10, corrupt=False,
+            sabotage_first_commit=False):
+    sched, cluster, db = open_cluster(
+        ClusterConfig(
+            n_commit_proxies=2, n_resolvers=2, n_storage=2,
+            sim_seed=seed, resolver_backend=backend,
+        )
+    )
+    try:
+        if sabotage_first_commit:
+            proxy = cluster.commit_proxies[0]
+            real_commit = proxy.commit
+            fired = []
+
+            def sabotaged_commit(ctr):
+                from foundationdb_tpu.cluster.commit_proxy import (
+                    CommitUnknownResult,
+                )
+                from foundationdb_tpu.runtime.flow import Promise
+
+                p = real_commit(ctr)
+                if not fired:
+                    fired.append(True)
+                    broken = Promise()
+
+                    def relay(f):
+                        if not broken.is_set:
+                            broken.send_error(CommitUnknownResult())
+
+                    p.future.add_done_callback(relay)
+                    return broken
+                return p
+
+            proxy.commit = sabotaged_commit
+        # no fault injection on this cluster -> the strict abort audit
+        # is sound (phantom resolver state needs kill faults); sabotage
+        # produces an unknown outcome, which disarms it internally
+        api = ApiWorkload(
+            sched, db, seed, actors=actors, rounds=rounds,
+            strict_aborts=True,
+        )
+        tasks = [
+            sched.spawn(c, name=f"api-{i}").done
+            for i, c in enumerate(api.actor_coros())
+        ]
+        sched.run_until(all_of(tasks))
+        sched.run_for(1.0)
+        if corrupt:
+            api.corrupt_for_selftest(cluster)
+        sched.run_until(sched.spawn(api.verify()).done)
+        return api
+    finally:
+        cluster.stop()
+
+
+def test_api_workload_clean_cluster_cpu():
+    api = run_api(seed=5)
+    s = api.stats
+    assert s["acked"] > 0 and s["reads_checked"] > 0
+    # rerun-identical (the unseed determinism contract)
+    assert run_api(seed=5).signature() == api.signature()
+
+
+def test_api_workload_exercises_the_surface():
+    """Across a few clean seeds the workload must genuinely reach the
+    API surface it claims to check: conflicts, snapshot reads, reverse
+    scans, atomics, versionstamps, explicit conflict ranges."""
+    kinds = set()
+    conflicts = 0
+    for seed in (5, 6, 7, 8):
+        api = run_api(seed=seed)
+        conflicts += api.stats["conflict"]
+        for rec in api.records:
+            for op, _obs in rec.ops:
+                k = op[0]
+                if k == "range" and op[4]:
+                    k = "range.reverse"
+                elif k == "range" and op[3] < (1 << 30):
+                    k = "range.limited"
+                elif k == "get" and op[2]:
+                    k = "get.snapshot"
+                kinds.add(k)
+    assert conflicts > 0, "no transaction ever conflicted"
+    for needed in ("get", "get.snapshot", "range", "range.reverse",
+                   "range.limited", "set", "clear_range", "atomic",
+                   "rcr", "wcr", "vs_value", "vs_key", "sysread"):
+        assert needed in kinds, f"workload never generated {needed}"
+
+
+@pytest.mark.kernel
+def test_api_workload_clean_cluster_tpu_kernel():
+    """The same workload through the JAX conflict kernel (tpu-force
+    routes unconditionally; JAX_PLATFORMS=cpu compiles it on host)."""
+    api = run_api(seed=5, backend="tpu-force", rounds=8)
+    assert api.stats["acked"] > 0 and api.stats["reads_checked"] > 0
+
+
+def test_injected_divergence_fails_the_run():
+    """The divergence self-test: values corrupted on every replica
+    BEHIND the transaction system must fail the model cross-check."""
+    with pytest.raises(AssertionError, match="api model divergence"):
+        run_api(seed=5, corrupt=True)
+
+
+def test_injected_divergence_fails_the_ensemble_seed():
+    """Same self-test through the soak ensemble: run_seed's _corrupt_api
+    hook must fail the seed (the smoke spec runs the api workload on
+    every seed), and the identical seed passes without it."""
+    from foundationdb_tpu.testing import soak
+
+    assert soak.run_seed(1, spec="smoke")
+    with pytest.raises(AssertionError, match="api model divergence"):
+        soak.run_seed(1, spec="smoke", _corrupt_api=True)
+
+
+def test_unknown_result_resolved_by_marker():
+    """A commit the client saw as commit_unknown_result but that really
+    landed is resolved to COMMITTED by its versionstamped marker and
+    enters the model (no possible-value ambiguity)."""
+    api = run_api(seed=11, sabotage_first_commit=True)
+    assert api.stats["unknown"] >= 1
+    assert api.stats["unknown_resolved"] >= 1
+
+
+def test_false_commit_audit_fires():
+    """Checker self-test: a fabricated committed pair where the later
+    transaction read a range an earlier commit (above its read
+    version) wrote must be flagged as a serializability violation."""
+    api = ApiWorkload(None, None, 0)
+    writer = TxnRecord(actor=0, n=0)
+    writer.outcome = "acked"
+    writer.read_version = 1
+    writer.write_conflicts = [(DATA + b"05", DATA + b"05\x00")]
+    reader = TxnRecord(actor=1, n=0)
+    reader.outcome = "acked"
+    reader.read_version = 5  # BELOW the writer's commit version
+    reader.read_conflicts = [(DATA + b"00", DATA + b"09")]
+    reader.write_conflicts = [(DATA + b"20", DATA + b"20\x00")]
+    committed = [(_stamp(8), writer), (_stamp(12), reader)]
+    with pytest.raises(AssertionError, match="FALSE COMMIT"):
+        api._check_decisions(committed)
+    # with the writer BELOW the reader's snapshot there is no violation
+    reader.read_version = 9
+    api._check_decisions(committed)
+
+
+def test_false_abort_audit_fires():
+    """Checker self-test: under strict mode a NotCommitted with no
+    conflicting committed writer anywhere is a false abort."""
+    api = ApiWorkload(None, None, 0, strict_aborts=True)
+    aborted = TxnRecord(actor=0, n=0)
+    aborted.outcome = "conflict"
+    aborted.read_version = 5
+    aborted.read_conflicts = [(DATA + b"00", DATA + b"01")]
+    api.records = [aborted]
+    with pytest.raises(AssertionError, match="FALSE ABORT"):
+        api._check_decisions([])
+    # a conflicting committed writer explains the abort
+    writer = TxnRecord(actor=1, n=0)
+    writer.outcome = "acked"
+    writer.read_version = 1
+    writer.write_conflicts = [(DATA + b"00", DATA + b"00\x00")]
+    api._check_decisions([(_stamp(9), writer)])
+
+
+def test_read_divergence_detected_against_model():
+    """Checker self-test: a recorded read that disagrees with the
+    sequential model at its read version is flagged."""
+    api = ApiWorkload(None, None, 0)
+    model = SequentialModel()
+    model.apply(_stamp(5), [("set", DATA + b"00", b"truth")])
+    rec = TxnRecord(actor=0, n=0)
+    rec.outcome = "conflict"  # even failed txns' reads are checked
+    rec.read_version = 7
+    rec.ops = [(("get", DATA + b"00", False), b"LIES")]
+    rec.read_conflicts = [(DATA + b"00", DATA + b"00\x00")]
+    with pytest.raises(AssertionError, match="model says"):
+        api._check_txn(rec, model)
+    rec.ops = [(("get", DATA + b"00", False), b"truth")]
+    api._check_txn(rec, model)
+    # ...and at a snapshot BELOW the commit the key must be absent
+    rec.read_version = 4
+    rec.ops = [(("get", DATA + b"00", False), None)]
+    api._check_txn(rec, model)
+
+
+def test_conflict_range_contract_detected():
+    """Checker self-test: a transaction whose sent conflict ranges
+    disagree with what its ops imply (e.g. a wrongly narrowed range)
+    is flagged even when every read value matches."""
+    api = ApiWorkload(None, None, 0)
+    model = SequentialModel()
+    rec = TxnRecord(actor=0, n=0)
+    rec.outcome = "acked"
+    rec.read_version = 7
+    rec.ops = [(("get", DATA + b"00", False), None)]
+    rec.read_conflicts = []  # client "forgot" the implicit point range
+    with pytest.raises(AssertionError, match="read-conflict contract"):
+        api._check_txn(rec, model)
+
+
+def test_sequential_model_versionstamps_and_ordering():
+    m = SequentialModel()
+    # inserted out of order; replay is stamp-ordered
+    m.apply(_stamp(20, 1), [("set", b"api/k/a", b"late")])
+    m.apply(_stamp(10), [
+        ("set", b"api/k/a", b"early"),
+        ("vs_key", b"api/vs/p", b"/sfx", b"vk"),
+        ("vs_value", b"api/k/b", b"pre-"),
+    ])
+    m.apply(_stamp(20, 0), [("atomic", "add", b"api/k/c", b"\x05")])
+    s = m.final_state()
+    assert s[b"api/k/a"] == b"late"
+    assert s[b"api/vs/p" + _stamp(10) + b"/sfx"] == b"vk"
+    assert s[b"api/k/b"] == b"pre-" + _stamp(10)
+    assert s[b"api/k/c"] == b"\x05"
+    # visibility boundary: a commit at version v is visible AT v
+    assert m.state_at(9) == {}
+    assert m.state_at(10)[b"api/k/a"] == b"early"
+    # same-version batch order applies in order
+    m.apply(_stamp(30, 0), [("set", b"api/k/a", b"first")])
+    m.apply(_stamp(30, 2), [("set", b"api/k/a", b"second")])
+    assert m.state_at(30)[b"api/k/a"] == b"second"
+    with pytest.raises(ValueError):
+        m.apply(_stamp(10), [])  # duplicate stamp
+
+
+def test_reverse_and_limited_range_reads():
+    """The client reverse/limit contract directly: result order, limit
+    selection from the END, RYW overlay, and conflict-range narrowing
+    ([lowest returned, end) for a truncated reverse scan)."""
+    sched, cluster, db = open_cluster(
+        ClusterConfig(n_commit_proxies=1, n_storage=2, sim_seed=7)
+    )
+    try:
+        async def body():
+            txn = db.create_transaction()
+            for i in range(8):
+                txn.set(b"rv%02d" % i, b"v%d" % i)
+            await txn.commit()
+
+            t = db.create_transaction()
+            full = await t.get_range(b"rv", b"rw")
+            assert [k for k, _v in full] == [b"rv%02d" % i for i in range(8)]
+            rev = await t.get_range(b"rv", b"rw", reverse=True)
+            assert rev == list(reversed(full))
+            fwd3 = await t.get_range(b"rv", b"rw", limit=3)
+            assert [k for k, _ in fwd3] == [b"rv00", b"rv01", b"rv02"]
+            rev3 = await t.get_range(b"rv", b"rw", limit=3, reverse=True)
+            assert [k for k, _ in rev3] == [b"rv07", b"rv06", b"rv05"]
+            # conflict narrowing: forward [begin, after(last)); reverse
+            # [lowest returned, end); full scans take [begin, end)
+            assert (b"rv", b"rv02\x00") in t.read_conflicts
+            assert (b"rv05", b"rw") in t.read_conflicts
+            assert (b"rv", b"rw") in t.read_conflicts
+            # RYW: an uncommitted write and a clear merge into the scan
+            t.set(b"rv03\x01", b"ryw")
+            t.clear_range(b"rv06", b"rv08")
+            rev4 = await t.get_range(
+                b"rv", b"rw", limit=4, reverse=True, snapshot=True
+            )
+            assert [k for k, _ in rev4] == [
+                b"rv05", b"rv04", b"rv03\x01", b"rv03",
+            ]
+            assert await t.get_range(b"rv", b"rw", limit=0) == []
+            return True
+
+        t = sched.spawn(body(), name="drive")
+        sched.run_until(t.done)
+        assert t.done.get()
+    finally:
+        cluster.stop()

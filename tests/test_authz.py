@@ -119,3 +119,37 @@ def test_no_verifier_means_open_cluster(world):
         return True
 
     assert drive(sched, body())
+
+
+def _sign_raw(private_key, payload: bytes) -> bytes:
+    """Sign an ARBITRARY payload — the hostile/buggy identity-provider
+    case: the signature is valid, the claims are garbage."""
+    import base64
+
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    sig = private_key.sign(payload, ec.ECDSA(hashes.SHA256()))
+    return base64.b64encode(payload) + b"." + base64.b64encode(sig)
+
+
+@pytest.mark.parametrize("payload", [
+    b'[1, 2, 3]',                                            # non-dict JSON
+    b'"just a string"',
+    b'{}',                                                   # no claims at all
+    b'{"kid": "default"}',                                   # missing exp/tenants
+    b'{"kid": "default", "exp": "soon", "tenants": ["t"]}',  # string exp
+    b'{"kid": "default", "exp": true, "tenants": ["t"]}',    # bool exp
+    b'{"kid": 5, "exp": 1e18, "tenants": ["t"]}',            # non-string kid
+    b'{"kid": "default", "exp": 1e18, "tenants": "t"}',      # tenants not a list
+    b'{"kid": "default", "exp": 1e18, "tenants": [1, 2]}',   # non-string tenant
+])
+def test_validly_signed_malformed_claims_denied(payload):
+    """A signature from a TRUSTED key over malformed claims must raise
+    PermissionDeniedError — never a TypeError/KeyError escaping into
+    the request path (ADVICE: token_sign malformed-claims hardening)."""
+    key, pub = generate_keypair()
+    verifier = TokenVerifier({"default": pub})
+    token = _sign_raw(key, payload)
+    with pytest.raises(PermissionDeniedError):
+        verifier.check(token, b"t")

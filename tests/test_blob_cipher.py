@@ -23,6 +23,7 @@ from foundationdb_tpu.crypto import (
     encrypt,
 )
 from foundationdb_tpu.crypto.blob_cipher import (
+    SYSTEM_DOMAIN_ID,
     CipherKeyNotFoundError,
     is_encrypted,
 )
@@ -32,10 +33,17 @@ def make_proxy(**kw):
     return EncryptKeyProxy(SimKmsConnector(), refresh_interval=600, **kw)
 
 
+def seal(proxy, payload, key):
+    """Encrypt under the hardened discipline: the header-auth cipher
+    is always the SYSTEM domain's (BlobCipher.cpp:256 — decrypt refuses
+    any other auth identity, see test_forged_header_* below)."""
+    return encrypt(payload, key, proxy.get_latest_cipher(SYSTEM_DOMAIN_ID))
+
+
 def test_roundtrip_and_header_identity():
     proxy = make_proxy()
     key = proxy.get_latest_cipher(7)
-    blob = encrypt(b"hello at rest", key, key)
+    blob = seal(proxy, b"hello at rest", key)
     assert is_encrypted(blob)
     assert b"hello at rest" not in blob
     assert decrypt(blob, proxy.cache) == b"hello at rest"
@@ -44,12 +52,12 @@ def test_roundtrip_and_header_identity():
 def test_tamper_raises_auth_token_error():
     proxy = make_proxy()
     key = proxy.get_latest_cipher(1)
-    blob = bytearray(encrypt(b"payload" * 100, key, key))
+    blob = bytearray(seal(proxy, b"payload" * 100, key))
     blob[-1] ^= 0x40  # flip a ciphertext bit
     with pytest.raises(AuthTokenError):
         decrypt(bytes(blob), proxy.cache)
     # header tamper (different domain id) also refuses
-    blob2 = bytearray(encrypt(b"x", key, key))
+    blob2 = bytearray(seal(proxy, b"x", key))
     blob2[6] ^= 0x01
     with pytest.raises((AuthTokenError, CipherKeyNotFoundError)):
         decrypt(bytes(blob2), proxy.cache)
@@ -61,7 +69,7 @@ def test_wrong_key_refuses():
     )
     key_a = proxy_a.get_latest_cipher(1)
     proxy_b.get_latest_cipher(1)
-    blob = encrypt(b"secret", key_a, key_a)
+    blob = seal(proxy_a, b"secret", key_a)
     # proxy_b's cache has domain 1 but a DIFFERENT derived key identity
     # (different salt) -> not found; forcing its key as auth -> mismatch
     with pytest.raises((AuthTokenError, CipherKeyNotFoundError)):
@@ -72,11 +80,11 @@ def test_rotation_old_records_still_decrypt():
     kms = SimKmsConnector()
     proxy = EncryptKeyProxy(kms, refresh_interval=0)  # refresh every call
     k1 = proxy.get_latest_cipher(3)
-    old = encrypt(b"written under base 1", k1, k1)
+    old = seal(proxy, b"written under base 1", k1)
     kms.rotate(3)
     k2 = proxy.get_latest_cipher(3)
     assert k2.base_id == k1.base_id + 1
-    new = encrypt(b"written under base 2", k2, k2)
+    new = seal(proxy, b"written under base 2", k2)
     # both generations decrypt from the same cache
     assert decrypt(old, proxy.cache) == b"written under base 1"
     assert decrypt(new, proxy.cache) == b"written under base 2"
@@ -88,13 +96,16 @@ def test_by_id_fetch_after_cache_loss():
     kms = SimKmsConnector()
     proxy = EncryptKeyProxy(kms, refresh_interval=600)
     key = proxy.get_latest_cipher(5)
-    blob = encrypt(b"survives restart", key, key)
+    blob = seal(proxy, b"survives restart", key)
 
     fresh = EncryptKeyProxy(kms, refresh_interval=600)
     from foundationdb_tpu.crypto.blob_cipher import EncryptHeader
 
     hdr = EncryptHeader.unpack(blob)
     fresh.get_cipher_by_id(hdr.domain_id, hdr.base_id, hdr.salt)
+    fresh.get_cipher_by_id(
+        hdr.header_domain_id, hdr.header_base_id, hdr.header_salt
+    )
     assert decrypt(blob, fresh.cache) == b"survives restart"
 
 
@@ -122,7 +133,7 @@ def test_rest_kms_stub_server():
         rest = RestKmsConnector(f"127.0.0.1:{port}")
         proxy = EncryptKeyProxy(rest, refresh_interval=600)
         key = proxy.get_latest_cipher(11)
-        blob = encrypt(b"over REST", key, key)
+        blob = seal(proxy, b"over REST", key)
         assert decrypt(blob, proxy.cache) == b"over REST"
         # rotation via REST; by-id fetch of the old generation still works
         rest.rotate(11)
@@ -130,6 +141,12 @@ def test_rest_kms_stub_server():
         k2 = proxy2.get_latest_cipher(11)
         assert k2.base_id == key.base_id + 1
         proxy2.get_cipher_by_id(key.domain_id, key.base_id, key.salt)
+        from foundationdb_tpu.crypto.blob_cipher import EncryptHeader as _EH
+
+        hdr = _EH.unpack(blob)
+        proxy2.get_cipher_by_id(
+            hdr.header_domain_id, hdr.header_base_id, hdr.header_salt
+        )
         assert decrypt(blob, proxy2.cache) == b"over REST"
     finally:
         srv.shutdown()
@@ -139,7 +156,7 @@ def test_empty_and_large_payloads():
     proxy = make_proxy()
     key = proxy.get_latest_cipher(0)
     for payload in (b"", b"\x00" * 1024, bytes(range(256)) * 4096):
-        assert decrypt(encrypt(payload, key, key), proxy.cache) == payload
+        assert decrypt(seal(proxy, payload, key), proxy.cache) == payload
 
 
 def test_rotation_survives_fresh_kms_connector():
@@ -152,10 +169,16 @@ def test_rotation_survives_fresh_kms_connector():
     proxy = EncryptKeyProxy(kms, refresh_interval=600)
     key = proxy.get_latest_cipher(4)
     assert key.base_id == 2
-    blob = encrypt(b"post-rotation", key, key)
+    blob = seal(proxy, b"post-rotation", key)
 
     fresh = EncryptKeyProxy(SimKmsConnector(), refresh_interval=600)
     fresh.get_cipher_by_id(key.domain_id, key.base_id, key.salt)
+    from foundationdb_tpu.crypto.blob_cipher import EncryptHeader as _EH
+
+    hdr = _EH.unpack(blob)
+    fresh.get_cipher_by_id(
+        hdr.header_domain_id, hdr.header_base_id, hdr.header_salt
+    )
     assert decrypt(blob, fresh.cache) == b"post-rotation"
     # by-id serving must NOT mutate the rotation counter (unverified
     # on-disk ids steering KMS state — second review pass): the fresh
@@ -215,5 +238,54 @@ def test_expired_latest_forces_fresh_derivation():
     assert k2.salt != k1.salt             # re-derived, not the expired key
     k3 = proxy.get_latest_cipher_nonblocking(1)
     assert k3.salt != k1.salt
-    blob = encrypt(b"readable", k3, k3)
+    blob = seal(proxy, b"readable", k3)
     assert decrypt(blob, proxy.cache) == b"readable"
+
+
+def test_forged_header_auth_domain_rejected():
+    """The auth-key confusion regression (BlobCipher.cpp:256): the
+    header is unauthenticated until the token verifies, so a forger
+    holding any NON-system domain key must not get to name it as the
+    header-auth cipher — the forged record would otherwise verify
+    against the forger's own key."""
+    proxy = make_proxy()
+    attacker_key = proxy.get_latest_cipher(7)
+    forged = encrypt(b"evil payload", attacker_key, attacker_key)
+    with pytest.raises(AuthTokenError, match="auth domain"):
+        decrypt(forged, proxy.cache)
+    # an explicitly supplied auth key bypasses the cache lookup and
+    # stays the caller's responsibility — unchanged contract
+    assert decrypt(forged, proxy.cache, attacker_key) == b"evil payload"
+
+
+def test_cross_domain_record_rejected_by_expected_domain():
+    """A validly sealed record RELOCATED across domains must refuse to
+    open for a store configured with a different domain."""
+    proxy = make_proxy()
+    key7 = proxy.get_latest_cipher(7)
+    blob = seal(proxy, b"domain 7 data", key7)
+    ok = decrypt(blob, proxy.cache, expected_domain_id=7)
+    assert ok == b"domain 7 data"
+    with pytest.raises(AuthTokenError, match="text domain"):
+        decrypt(blob, proxy.cache, expected_domain_id=8)
+
+
+def test_storage_encryption_refuses_foreign_records():
+    """StorageEncryption.open validates the header's cipher details
+    BEFORE any KMS fetch: a forged auth identity and a cross-domain
+    text identity are both refused."""
+    from foundationdb_tpu.crypto.at_rest import StorageEncryption
+
+    proxy = make_proxy()
+    enc = StorageEncryption(proxy, domain_id=1)
+    sealed = enc.seal(b"mine")
+    assert enc.open(sealed) == b"mine"
+    # forged auth identity (attacker-controlled header cipher details)
+    attacker_key = proxy.get_latest_cipher(1)
+    forged = encrypt(b"evil", attacker_key, attacker_key)
+    with pytest.raises(AuthTokenError, match="auth domain"):
+        enc.open(forged)
+    # cross-domain relocation: sealed for domain 2, opened by domain 1
+    other = StorageEncryption(proxy, domain_id=2)
+    with pytest.raises(AuthTokenError, match="text domain"):
+        enc.open(other.seal(b"not yours"))

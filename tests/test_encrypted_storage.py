@@ -213,7 +213,10 @@ def test_expired_key_not_resurrected():
     could re-derive it (key retirement, code review r5)."""
     import time as _time
 
-    from foundationdb_tpu.crypto.blob_cipher import CipherKeyExpiredError
+    from foundationdb_tpu.crypto.blob_cipher import (
+        SYSTEM_DOMAIN_ID,
+        CipherKeyExpiredError,
+    )
     from foundationdb_tpu.crypto import encrypt as _encrypt
 
     proxy = EncryptKeyProxy(
@@ -221,7 +224,8 @@ def test_expired_key_not_resurrected():
     )
     enc = StorageEncryption(proxy)
     key = proxy.get_latest_cipher(enc.domain_id)
-    blob = _encrypt(SENTINEL, key, key)
+    auth = proxy.get_latest_cipher(SYSTEM_DOMAIN_ID)
+    blob = _encrypt(SENTINEL, key, auth)
     assert enc.open(blob) == SENTINEL
     _time.sleep(0.06)
     with pytest.raises(CipherKeyExpiredError):

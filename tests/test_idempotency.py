@@ -81,3 +81,21 @@ def test_run_idempotent_skips_reapply_after_unknown_result():
     # two logical increments -> exactly 2, despite the ambiguous retry
     assert int.from_bytes(run(sched, body()), "little") == 2
     cluster.stop()
+
+
+def test_default_idempotency_ids_deterministic_and_unique():
+    """The uuid4 default is gone (flowcheck baseline burn-down): ids
+    are per-client (origin, client, seq) nonces — unique within and
+    across client handles, and REPLAYABLE: the same sim seed yields the
+    same ids."""
+    sched, cluster, db = open_cluster(ClusterConfig(sim_seed=42))
+    ids = [db.create_transaction().set_idempotency_id() for _ in range(4)]
+    db2 = cluster.database()  # a second client handle on the same cluster
+    ids += [db2.create_transaction().set_idempotency_id() for _ in range(4)]
+    assert len(set(ids)) == len(ids)
+    cluster.stop()
+
+    sched_b, cluster_b, db_b = open_cluster(ClusterConfig(sim_seed=42))
+    replay = [db_b.create_transaction().set_idempotency_id() for _ in range(4)]
+    assert replay == ids[:4]
+    cluster_b.stop()

@@ -477,6 +477,15 @@ def test_every_declared_probe_fires():
     bwk.stop()
     cluster9.stop()
 
+    # -- api workload: an unknown-result commit resolved by marker --------
+    # (workload.api_unknown_resolved: a commit the client saw as
+    # commit_unknown_result but that really landed must be resolved to
+    # COMMITTED by its versionstamped marker)
+    from test_api_workload import run_api
+
+    api = run_api(seed=11, sabotage_first_commit=True)
+    assert api.stats["unknown_resolved"] >= 1
+
     # -- slow-task detection ----------------------------------------------
     import time as _t
 

@@ -304,3 +304,22 @@ def test_cancelled_awaiter_abandons_the_await():
     b.cancel()           # recovery tears the batch actor down
     sched.run_for(0.8)   # BOTH replica errors land after the cancel
     assert sched.unhandled_errors() == []
+
+
+def test_plans_are_spec_driven():
+    """plan_for_seed derives everything from a named spec file — the
+    same seed yields different plans under different specs, identical
+    plans under the same spec, and the spec name rides on the plan."""
+    from foundationdb_tpu.testing.soak import plan_for_seed
+
+    d = plan_for_seed(9)
+    assert d.spec_name == "default"
+    assert plan_for_seed(9, "default") == d
+    storm = plan_for_seed(9, "recovery_storm")
+    assert storm.spec_name == "recovery_storm"
+    assert storm != d
+    # api_correctness runs the api workload on EVERY seed and
+    # alternates resolver backends across seeds
+    api_plans = [plan_for_seed(s, "api_correctness") for s in range(8)]
+    assert all(p.api for p in api_plans)
+    assert {p.resolver_backend for p in api_plans} == {"cpu", "tpu-force"}

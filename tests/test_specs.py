@@ -1,0 +1,155 @@
+"""Named soak specs: every checked-in spec loads, round-trips, names
+only manifest probes, and actually runs (the TOML-driven tester
+contract, fdbserver/tester.actor.cpp readTOMLTests_impl)."""
+
+import dataclasses
+
+import pytest
+
+from foundationdb_tpu.analysis.manifest import load_manifest
+from foundationdb_tpu.testing.spec import (
+    FAULT_FIELDS,
+    SoakSpec,
+    SpecError,
+    derive_plan_fields,
+    list_specs,
+    load_spec,
+)
+
+REQUIRED_SPECS = {
+    "default", "api_correctness", "recovery_storm",
+    "network_chaos", "storage_stress", "smoke",
+}
+
+
+def test_spec_inventory():
+    names = set(list_specs())
+    assert REQUIRED_SPECS <= names, (
+        f"missing checked-in specs: {REQUIRED_SPECS - names}"
+    )
+    assert len(names) >= 5
+
+
+@pytest.mark.parametrize("name", sorted(REQUIRED_SPECS))
+def test_spec_loads_and_roundtrips(name):
+    spec = load_spec(name)
+    assert spec.name == name and spec.description
+    # dict round-trip is lossless
+    again = SoakSpec.from_dict(spec.to_dict())
+    assert again == spec
+    assert again.to_dict() == spec.to_dict()
+
+
+@pytest.mark.parametrize("name", sorted(REQUIRED_SPECS))
+def test_spec_expected_probes_are_declared(name):
+    """Per-spec probe expectations plug into the canonical manifest:
+    a spec naming a probe the tree never declares is a typo that would
+    silently never be accounted."""
+    manifest = set(load_manifest())
+    spec = load_spec(name)
+    unknown = set(spec.expected_probes) - manifest
+    assert not unknown, (
+        f"spec {name} expects probes missing from "
+        f"analysis/probe_manifest.json: {sorted(unknown)}"
+    )
+
+
+def test_every_fault_class_covered_by_some_spec():
+    """The union of checked-in specs keeps every fault class alive:
+    retiring a fault from ALL specs means the ensemble never exercises
+    it again — that must be a loud, reviewed decision."""
+    alive = set()
+    for name in list_specs():
+        spec = load_spec(name)
+        alive |= {f for f in FAULT_FIELDS if spec.faults[f] > 0}
+    assert alive == set(FAULT_FIELDS), (
+        f"fault classes no spec reaches: {set(FAULT_FIELDS) - alive}"
+    )
+
+
+def test_plan_derivation_is_deterministic_and_bounded():
+    spec = load_spec("default")
+    for seed in range(20):
+        a = derive_plan_fields(seed, spec)
+        b = derive_plan_fields(seed, spec)
+        assert a == b
+        t = spec.topology
+        assert t["storage"][0] <= a["n_storage"] <= t["storage"][1]
+        assert a["replication"] <= a["n_storage"]
+        assert t["rounds"][0] <= a["rounds"] <= t["rounds"][1]
+        assert a["resolver_backend"] in spec.policy["resolver_backends"]
+    # plans genuinely vary across seeds
+    assert len({str(derive_plan_fields(s, spec)) for s in range(12)}) >= 8
+
+
+def test_probability_extremes_are_honored():
+    spec = load_spec("default")
+    on = dataclasses.replace(
+        spec, faults={f: 1.0 for f in spec.faults}
+    ).validate()
+    off = dataclasses.replace(
+        spec, faults={f: 0.0 for f in spec.faults}
+    ).validate()
+    for seed in (0, 7, 33):
+        a = derive_plan_fields(seed, on)
+        b = derive_plan_fields(seed, off)
+        assert all(a[f] for f in FAULT_FIELDS)
+        assert not any(b[f] for f in FAULT_FIELDS)
+        # an edit to fault probabilities must not reshuffle unrelated
+        # draws (the canonical-order discipline)
+        assert a["n_storage"] == b["n_storage"]
+        assert a["rounds"] == b["rounds"]
+        assert a["resolver_backend"] == b["resolver_backend"]
+
+
+def test_malformed_specs_are_refused():
+    spec = load_spec("default")
+    with pytest.raises(SpecError):
+        load_spec("no_such_spec")
+    with pytest.raises(SpecError):
+        d = spec.to_dict()
+        d["faults"]["kill_proxy"] = 1.5  # not a probability
+        SoakSpec.from_dict(d)
+    with pytest.raises(SpecError):
+        d = spec.to_dict()
+        d["faults"]["warp_drive"] = 0.5  # unknown fault class
+        SoakSpec.from_dict(d)
+    with pytest.raises(SpecError):
+        d = spec.to_dict()
+        d["topology"]["storage"] = [3, 2]  # inverted range
+        SoakSpec.from_dict(d)
+    with pytest.raises(SpecError):
+        d = spec.to_dict()
+        d["policy"]["resolver_backends"] = ["gpu"]  # unknown backend
+        SoakSpec.from_dict(d)
+
+
+@pytest.mark.parametrize("name", sorted(REQUIRED_SPECS - {"api_correctness"}))
+def test_spec_smoke_one_short_seed(name):
+    """One short seed per checked-in spec: the spec loads, plans, runs
+    under its fault mix and passes every model check. (api_correctness
+    smokes in test_api_workload with the kernel marker — its seeds can
+    pick the tpu backend and compile.)"""
+    from foundationdb_tpu.testing import soak
+
+    spec = load_spec(name).with_overrides(rounds=(5, 8), api_rounds=5)
+    sig = soak.run_seed(1, spec=spec)
+    assert sig[1] > 0  # the seed committed work
+
+
+@pytest.mark.kernel
+def test_api_correctness_spec_smoke_tpu_seed():
+    """One api_correctness seed on the tpu-force backend: the JAX
+    conflict kernel inside the fault ensemble (compile-heavy)."""
+    from foundationdb_tpu.testing import soak
+    from foundationdb_tpu.testing.soak import plan_for_seed
+
+    spec = load_spec("api_correctness").with_overrides(
+        rounds=(5, 8), api_rounds=5
+    )
+    seed = next(
+        s for s in range(64)
+        if plan_for_seed(s, spec).resolver_backend == "tpu-force"
+    )
+    sig = soak.run_seed(seed, spec=spec)
+    assert sig[1] > 0 and sig[7] is not None

@@ -134,3 +134,42 @@ def test_cross_module_scan_refused():
         assert drive(sched, body())
     finally:
         cluster.stop()
+
+
+def test_key_servers_scan_stays_inside_bounds():
+    """Range-read contract regression (ADVICE: system_data range-read):
+    a keyServers scan whose begin falls INSIDE a shard must not leak
+    the straddling shard's row key below the requested bound — the row
+    is clamped to `begin` (krmGetRanges alignment), and every returned
+    key lies in [begin, end)."""
+    sched, cluster, db = open_cluster(
+        ClusterConfig(
+            n_commit_proxies=1, n_storage=4, replication_factor=2,
+            storage_boundaries=[b"g", b"n", b"t"],
+        )
+    )
+    try:
+        async def body():
+            txn = db.create_transaction()
+            # "hello" is inside the [g, n) shard: pre-clamp this scan
+            # returned the row keyed at "g" — OUTSIDE the bound
+            begin = SD.KEY_SERVERS_PREFIX + b"hello"
+            end = SD.KEY_SERVERS_PREFIX + b"u"
+            rows = await txn.get_range(begin, end)
+            assert rows, "scan lost the straddling shard entirely"
+            assert all(begin <= k < end for k, _v in rows), rows
+            # the clamped row still names the team that owns `hello`
+            src, _dest = SD.decode_key_servers_value(rows[0][1])
+            assert rows[0][0] == SD.key_servers_key(b"hello")
+            assert tuple(sorted(cluster.key_servers.team_of(b"hello"))) \
+                == tuple(src)
+            # reverse scan honors the same bounds and ordering
+            rev = await txn.get_range(begin, end, reverse=True, limit=2)
+            assert [k for k, _v in rev] == [
+                k for k, _v in rows[-2:]
+            ][::-1]
+            return True
+
+        assert drive(sched, body())
+    finally:
+        cluster.stop()

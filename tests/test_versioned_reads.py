@@ -134,3 +134,57 @@ def test_gc_raises_floor_and_rejects_ancient_reads(world):
     outcome, fresh = run(sched, body())
     assert outcome == "too_old"
     assert fresh == b"1"
+
+
+def test_gc_passing_waited_version_raises_too_old():
+    """Regression (soak seeds 1122/1171, found by the api workload's
+    model check): a reader whose version check passed BEFORE the wait
+    must re-validate after it — a lagging replica catching up applies a
+    huge version span in one pull batch, the MVCC floor passes the
+    waited-for version mid-wait, and serving anyway returns a silently
+    PARTIAL state at that version (keys whose surviving post-GC entry
+    sits above it vanish). The read must raise transaction_too_old so
+    the client retries at a fresh version."""
+    from foundationdb_tpu.cluster.storage import StorageServer, TransactionTooOld
+    from foundationdb_tpu.cluster.tlog import TLog, TLogCommitRequest
+    from foundationdb_tpu.runtime.flow import Scheduler
+
+    sched = Scheduler(sim=True)
+    tlog = TLog(sched)
+    ss = StorageServer(sched, tlog, tag=0, window_versions=1000)
+    ss.start()
+
+    async def body():
+        await tlog.commit(TLogCommitRequest(
+            prev_version=0, version=10,
+            messages={0: [("set", b"k1", b"v1"), ("set", b"k2", b"v2")]},
+        ))
+        await sched.delay(0.05)  # ss applies version 10
+        # wedge the pull loop (the lagging replica)
+        ss.slowdown = 5.0
+        await sched.delay(0.01)
+        # a read at a CURRENTLY-valid version starts waiting...
+        reader = sched.spawn(ss.get_key_values(b"k", b"l", 500))
+        # ...while commits race far past it: 500 ends up below the
+        # MVCC floor (2500 - window 1000) by the time ss catches up
+        prev = 10
+        for v in range(500, 2600, 100):
+            await tlog.commit(TLogCommitRequest(
+                prev_version=prev, version=v,
+                messages={0: [("set", b"k1", b"v@%d" % v)]},
+            ))
+            prev = v
+        ss.slowdown = 0.0
+        await sched.delay(6.0)  # catch-up: one pull batch spans it all
+        try:
+            got = await reader.done
+        except TransactionTooOld:
+            return "too_old"
+        return got
+
+    result = sched.run_until(sched.spawn(body()).done)
+    assert result == "too_old", (
+        f"read below the post-catch-up MVCC floor served a partial "
+        f"state instead of raising: {result!r}"
+    )
+    ss.stop()
