@@ -1,13 +1,19 @@
 """flowcheck: the static enforcement layer the reference gets from its
 build tooling (actor compiler + coveragetool), rebuilt as an AST linter.
 
-Four rule families over the whole package (stdlib `ast`, no imports of
+Five rule families over the whole package (stdlib `ast`, no imports of
 the scanned code): determinism (no wall clock / unseeded entropy / raw
 asyncio in sim-schedulable actors), actor safety (no silently escaping
 errors), JAX hazards (no recompiles or host syncs in the kernel path),
-and probe accounting (every CODE_PROBE declared exactly once, manifest
-pinned). Run the gate with `python -m foundationdb_tpu.analysis`; see
-the README's "flowcheck" section for baselining and suppressions.
+probe accounting (every CODE_PROBE declared exactly once, manifest
+pinned), and — v2 — the `flow.*` dataflow pass over per-`async def`
+control-flow graphs (cfg.py): stale reads across a wait(), RMWs split
+across yield points, and invariant checks never repeated after one
+(rules_flow.py). The gate also audits suppressions themselves: a
+`# flowcheck: ignore` that absorbs nothing is a finding. Run the gate
+with `python -m foundationdb_tpu.analysis`; see the README's
+"flowcheck" sections for baselining, suppressions, and the runtime
+counterpart (the scheduler's interleaving auditor).
 """
 
 from foundationdb_tpu.analysis.report import (  # noqa: F401

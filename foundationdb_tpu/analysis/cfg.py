@@ -1,0 +1,849 @@
+"""Per-`async def` control-flow graphs: flowcheck's dataflow substrate.
+
+The actor compiler's oldest lesson — *all state may change across a
+`wait()`* — is invisible to purely syntactic rules: whether a read is
+stale depends on what happens along the control-flow paths between the
+read, the yield point, and the use. This module builds the structure
+the `flow.*` rule family (rules_flow.py) needs:
+
+* `iter_async_functions(tree)` walks EVERY `async def` — module-level,
+  methods, nested actors inside functions (the soak workload shape),
+  decorated actors — none of them may escape the walk.
+* `build_cfg(fn, shared)` lowers one async function to a graph of
+  basic blocks whose contents are ordered *events*: yield points
+  (`await`, `async for` steps, `async with` enter/exit, awaits inside
+  comprehensions), reads/writes of shared mutable state, local
+  definitions with their shared-read taint, local uses, validation
+  guards, and invariant-check calls.
+* `SharedModel` decides what counts as *shared mutable state*: `self.X`
+  attributes a method outside `__init__` writes, module globals some
+  function mutates, and captured mutables — enclosing-function locals
+  (the nested-actor closure pattern) that any function in the closure
+  mutates in place or rebinds via `nonlocal`.
+
+Precision notes, deliberate:
+
+* Shared-object keys are `(base, sub)` pairs; `sub` is the dump of a
+  constant/Name subscript when present, `None` for whole-object access.
+  Two keys conflict when bases match and either sub is `None` or both
+  are equal — distinct constant subscripts are disjoint on purpose
+  (per-key dict slots are independent state).
+* An attribute only mutated in `__init__` (wiring, not state) is not
+  shared-mutable: staleness across a wait is impossible for it.
+* Calls to local helpers are opaque (no interprocedural dataflow); the
+  guard/check rules lean on the project's re-validate-after-wait idiom
+  instead.
+* `finally` bodies are lowered after the try/handler JOIN only: a
+  re-validation placed in a finally does not register on return paths.
+  Known conservative edge — put re-checks before the return (the
+  pattern the whole rule family teaches anyway).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator, Optional
+
+#: method leaves that mutate their receiver in place
+MUTATING_METHODS = {
+    "append", "add", "pop", "popitem", "remove", "discard", "clear",
+    "update", "extend", "insert", "setdefault", "sort", "reverse",
+}
+#: method leaves that read their receiver (first arg keys the slot)
+READING_METHODS = {"get", "index", "count", "copy"}
+
+#: leaf-name shape of an invariant-check call (guard-not-rechecked)
+CHECK_CALL_PREFIXES = ("check", "validate", "verify", "ensure", "assert")
+
+
+# -- events ----------------------------------------------------------------
+
+AWAIT = "await"      # ("await", node)
+READ = "read"        # ("read", key, node[, weak]) — weak = receiver of an
+#                      unknown method call (observes the object; not a
+#                      value read rules should anchor on)
+STMT = "stmt"        # ("stmt",) — statement boundary marker
+WRITE = "write"      # ("write", key, frozenset[RHS local names], node)
+DEF = "def"          # ("def", name, frozenset[RHS shared keys], node)
+USE = "use"          # ("use", name, in_test, node, deref) — deref: the
+#                      name is immediately dereferenced (attr/subscript
+#                      base): a live read THROUGH the alias, not a use
+#                      of a stale snapshotted value
+GUARD = "guard"      # ("guard", kind, frozenset[keys], node)
+CHECK = "check"      # ("check", calldump, node)
+RETURN = "return"    # ("return",)
+RAISE = "raise"      # ("raise",)
+
+
+def keys_conflict(a: tuple, b: tuple) -> bool:
+    """(base, sub) keys address the same state: same base and either
+    side is a whole-object access or the subscripts dump equal."""
+    return a[0] == b[0] and (a[1] is None or b[1] is None or a[1] == b[1])
+
+
+class Block:
+    """One basic block: an ordered event list plus successor edges."""
+
+    __slots__ = ("events", "succs", "exc_succs", "terminated")
+
+    def __init__(self):
+        self.events: list[tuple] = []
+        self.succs: list["Block"] = []
+        #: edges taken only when an exception diverts control into a
+        #: handler — rule path-walks treat these as abandonment (the
+        #: guarded action never happens), not as serving-stale paths
+        self.exc_succs: list["Block"] = []
+        self.terminated = False  # ends in return/raise/break/continue
+
+    def add_succ(self, b: "Block") -> None:
+        if b is not None and b not in self.succs:
+            self.succs.append(b)
+
+    def add_exc_succ(self, b: "Block") -> None:
+        if b is not None and b not in self.exc_succs:
+            self.exc_succs.append(b)
+
+
+# -- function discovery ----------------------------------------------------
+
+
+def annotate_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._fc_parent = node  # type: ignore[attr-defined]
+
+
+def _enclosing_chain(node: ast.AST) -> list[ast.AST]:
+    chain = []
+    cur = getattr(node, "_fc_parent", None)
+    while cur is not None:
+        chain.append(cur)
+        cur = getattr(cur, "_fc_parent", None)
+    return chain
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    node: ast.AsyncFunctionDef
+    qualname: str
+    #: nearest enclosing class whose method chain binds `self`, if any
+    class_node: Optional[ast.ClassDef]
+    self_name: Optional[str]
+    #: enclosing function nodes, innermost first (nested-actor closures)
+    enclosing: list
+
+
+def iter_async_functions(tree: ast.Module) -> Iterator[FuncInfo]:
+    """Every async def in the module — nested and decorated included.
+
+    This is the blind-spot contract (tests pin it): an actor defined
+    inside another function (the soak-workload shape), behind a
+    decorator, or inside a class inside a function is still walked.
+    """
+    annotate_parents(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.AsyncFunctionDef):
+            continue
+        chain = _enclosing_chain(node)
+        enclosing = [
+            n for n in chain
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        class_node = None
+        self_name = None
+        # the function that binds `self` is the nearest enclosing
+        # function whose direct parent is a ClassDef (a method); `node`
+        # itself may be that method
+        for fn in [node] + enclosing:
+            parent = getattr(fn, "_fc_parent", None)
+            if isinstance(parent, ast.ClassDef):
+                args = fn.args.posonlyargs + fn.args.args
+                if args and args[0].arg in ("self",):
+                    class_node = parent
+                    self_name = args[0].arg
+                break
+        parts = [
+            n.name for n in reversed([node] + enclosing)
+        ]
+        yield FuncInfo(
+            node=node,
+            qualname=".".join(parts),
+            class_node=class_node,
+            self_name=self_name,
+            enclosing=enclosing,
+        )
+
+
+# -- the shared-mutable-state model ----------------------------------------
+
+
+def _local_bindings(fn) -> set[str]:
+    """Names a function binds locally (params + every binding form),
+    NOT descending into nested function scopes."""
+    out = set()
+    a = fn.args
+    for arg in (
+        a.posonlyargs + a.args + a.kwonlyargs
+        + ([a.vararg] if a.vararg else [])
+        + ([a.kwarg] if a.kwarg else [])
+    ):
+        out.add(arg.arg)
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                out.add(child.name)
+                continue  # separate scope
+            if isinstance(child, ast.Lambda):
+                continue
+            if isinstance(child, ast.Name) and isinstance(
+                child.ctx, (ast.Store, ast.Del)
+            ):
+                out.add(child.id)
+            elif isinstance(child, ast.ExceptHandler) and child.name:
+                out.add(child.name)
+            elif isinstance(child, (ast.Import, ast.ImportFrom)):
+                for al in child.names:
+                    out.add((al.asname or al.name).split(".")[0])
+            visit(child)
+
+    for stmt in fn.body:
+        visit(stmt)
+    return out
+
+
+def _inplace_mutated_names(root) -> set[str]:
+    """Bare names whose object is mutated in place anywhere under
+    `root`: subscript stores (`d[k] = v`, `d[k] += v`, `del d[k]`),
+    mutating method calls (`d.update(...)`), or `nonlocal` rebinds."""
+    out = set()
+    for node in ast.walk(root):
+        if isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            if isinstance(node.value, ast.Name):
+                out.add(node.value.id)
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            if node.func.attr in MUTATING_METHODS and isinstance(
+                node.func.value, ast.Name
+            ):
+                out.add(node.func.value.id)
+        elif isinstance(node, ast.Nonlocal):
+            out.update(node.names)
+    return out
+
+
+def _class_mutable_attrs(cls: ast.ClassDef) -> set[str]:
+    """Attributes of `self` some method writes OUTSIDE __init__ —
+    the ones whose value can genuinely change across a wait()."""
+    out = set()
+    for fn in cls.body:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn.name == "__init__":
+            continue
+        args = fn.args.posonlyargs + fn.args.args
+        if not args or args[0].arg != "self":
+            continue
+        self_name = args[0].arg
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name
+            ) and node.value.id == self_name:
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    out.add(node.attr)
+                else:
+                    parent = getattr(node, "_fc_parent", None)
+                    if isinstance(parent, ast.Subscript) and isinstance(
+                        parent.ctx, (ast.Store, ast.Del)
+                    ) and parent.value is node:
+                        out.add(node.attr)
+                    elif isinstance(parent, ast.Attribute) and (
+                        parent.value is node
+                    ) and parent.attr in MUTATING_METHODS:
+                        grand = getattr(parent, "_fc_parent", None)
+                        if isinstance(grand, ast.Call) and (
+                            grand.func is parent
+                        ):
+                            out.add(node.attr)
+    return out
+
+
+def _memo(node: ast.AST, attr: str, compute):
+    """Per-AST-node memo: module/class/function facts are independent
+    of WHICH async def is being analyzed, so one SharedModel per async
+    def must not recompute them (quadratic on files with many actors —
+    check.sh prints the gate's wall time to keep this honest)."""
+    cached = getattr(node, attr, None)
+    if cached is None:
+        cached = compute(node)
+        setattr(node, attr, cached)
+    return cached
+
+
+def _module_globals_mut(tree: ast.Module) -> set[str]:
+    """Module-level names some function mutates in place or rebinds
+    via `global` — computed once per module."""
+    out: set[str] = set()
+    module_names = {
+        t.id
+        for stmt in tree.body
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign))
+        for t in ast.walk(stmt)
+        if isinstance(t, ast.Name) and isinstance(t.ctx, ast.Store)
+    }
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Global):
+                    out.update(sub.names)
+            out |= _memo(
+                node, "_fc_inplace", _inplace_mutated_names
+            ) & module_names
+    return out
+
+
+class SharedModel:
+    """Answers "is this expression a read/write of shared mutable
+    state, and under which key?" for one function's analysis."""
+
+    def __init__(self, tree: ast.Module, info: FuncInfo):
+        self.info = info
+        self.self_name = info.self_name
+        self.mutable_attrs = (
+            _memo(info.class_node, "_fc_mutable_attrs", _class_mutable_attrs)
+            if info.class_node is not None else set()
+        )
+        own = _memo(info.node, "_fc_bindings", _local_bindings)
+        # captured mutables: bound in an enclosing function's scope,
+        # mutated in place somewhere under the OUTERMOST enclosing
+        # function (any sibling actor counts — that's the race)
+        self.captured: set[str] = set()
+        if info.enclosing:
+            outermost = info.enclosing[-1]
+            mutated = _memo(
+                outermost, "_fc_inplace", _inplace_mutated_names
+            )
+            bound_up = set()
+            for fn in info.enclosing:
+                bound_up |= _memo(fn, "_fc_bindings", _local_bindings)
+            self.captured = (bound_up - own) & mutated
+        # module globals some function mutates — shadowed locals aside
+        self.globals_mut = _memo(
+            tree, "_fc_globals_mut", _module_globals_mut
+        ) - own
+
+    # -- key resolution ---------------------------------------------------
+
+    def base_key(self, node: ast.expr) -> Optional[str]:
+        """The shared base a bare expression addresses, if any:
+        `self.X` (mutable attr) or a captured/global mutable name."""
+        if isinstance(node, ast.Attribute) and isinstance(
+            node.value, ast.Name
+        ):
+            if (
+                node.value.id == self.self_name
+                and node.attr in self.mutable_attrs
+            ):
+                return f"{self.self_name}.{node.attr}"
+            return None
+        if isinstance(node, ast.Name):
+            if node.id in self.captured or node.id in self.globals_mut:
+                return node.id
+        return None
+
+    @staticmethod
+    def sub_key(slice_node: ast.expr) -> Optional[str]:
+        """Subscript identity when statically comparable: constants and
+        bare names dump stably; anything else is whole-object (None)."""
+        if isinstance(slice_node, (ast.Constant, ast.Name)):
+            return ast.dump(slice_node)
+        return None
+
+
+# -- CFG construction ------------------------------------------------------
+
+
+class _Builder:
+    def __init__(self, fn: ast.AsyncFunctionDef, shared: SharedModel):
+        self.fn = fn
+        self.shared = shared
+        self.blocks: list[Block] = []
+        self.params = {
+            a.arg
+            for a in (
+                fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+            )
+        }
+
+    def new_block(self) -> Block:
+        b = Block()
+        self.blocks.append(b)
+        return b
+
+    # -- expression lowering (evaluation order preserved) -----------------
+
+    def expr(self, node, out: list[tuple], in_test: bool = False) -> None:
+        if node is None:
+            return
+        sh = self.shared
+        if isinstance(node, ast.Await):
+            self.expr(node.value, out, in_test)
+            out.append((AWAIT, node))
+            return
+        if isinstance(node, ast.Attribute) and isinstance(
+            node.ctx, ast.Load
+        ):
+            base = sh.base_key(node)
+            if base is not None:
+                out.append((READ, (base, None), node))
+                return
+            self.expr(node.value, out, in_test)
+            return
+        if isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, ast.Load
+        ):
+            base = sh.base_key(node.value)
+            self.expr(node.slice, out, in_test)
+            if base is not None:
+                out.append((READ, (base, sh.sub_key(node.slice)), node))
+            else:
+                self.expr(node.value, out, in_test)
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            base = sh.base_key(node)
+            if base is not None:
+                out.append((READ, (base, None), node))
+            else:
+                parent = getattr(node, "_fc_parent", None)
+                deref = (
+                    isinstance(parent, (ast.Attribute, ast.Subscript))
+                    and parent.value is node
+                ) or (
+                    isinstance(parent, ast.Call) and parent.func is node
+                )
+                out.append((USE, node.id, in_test, node, deref))
+            return
+        if isinstance(node, ast.Call):
+            # receiver-method reads/writes on shared bases
+            if isinstance(node.func, ast.Attribute):
+                base = sh.base_key(node.func.value)
+                if base is not None:
+                    arg0 = node.args[0] if node.args else None
+                    sub = sh.sub_key(arg0) if arg0 is not None else None
+                    for a in node.args:
+                        self.expr(a, out, in_test)
+                    for k in node.keywords:
+                        self.expr(k.value, out, in_test)
+                    leaf = node.func.attr
+                    if leaf in READING_METHODS:
+                        out.append((READ, (base, sub), node))
+                    elif leaf == "setdefault":
+                        out.append((READ, (base, sub), node))
+                        out.append((WRITE, (base, sub), frozenset(), node))
+                    elif leaf in MUTATING_METHODS:
+                        out.append((WRITE, (base, None), frozenset(), node))
+                    else:
+                        # unknown method: conservatively a WEAK read
+                        # (it observes the object — enough to count as
+                        # a refresh — but not a value anchor for the
+                        # stale/rmw rules)
+                        out.append((READ, (base, None), node, True))
+                    return
+            self.expr(node.func, out, in_test)
+            for a in node.args:
+                self.expr(a, out, in_test)
+            for k in node.keywords:
+                self.expr(k.value, out, in_test)
+            return
+        if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # separate (later) execution scope
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            # comprehensions execute inline in the enclosing async
+            # function — an `await` inside one IS a yield point here
+            # (the classic walker blind spot; tests pin it)
+            for gen in node.generators:
+                self.expr(gen.iter, out, in_test)
+                if getattr(gen, "is_async", False):
+                    out.append((AWAIT, node))
+                for if_ in gen.ifs:
+                    self.expr(if_, out, in_test)
+            if isinstance(node, ast.DictComp):
+                self.expr(node.key, out, in_test)
+                self.expr(node.value, out, in_test)
+            else:
+                self.expr(node.elt, out, in_test)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.expr(child, out, in_test)
+            elif isinstance(child, ast.keyword):
+                self.expr(child.value, out, in_test)
+            elif isinstance(child, ast.comprehension):
+                self.expr(child.iter, out, in_test)
+
+    def _store_target(self, target, value_events, value_node, out) -> None:
+        sh = self.shared
+        if any(ev[0] == AWAIT for ev in value_events):
+            # the value was produced AT a yield point (await in the
+            # RHS): it is fresh as of that await, not a pre-wait
+            # snapshot — argument taint through an awaited call is not
+            # a live-state read
+            rhs_shared = frozenset()
+        else:
+            rhs_shared = frozenset(
+                ev[1] for ev in value_events
+                if ev[0] == READ and not (len(ev) > 3 and ev[3])
+            )
+        rhs_locals = frozenset(
+            ev[1] for ev in value_events if ev[0] == USE
+        )
+        node = value_node if value_node is not None else target
+        if isinstance(target, ast.Name):
+            base = sh.base_key(target)
+            if base is not None:
+                out.append((WRITE, (base, None), rhs_locals, node))
+            else:
+                out.append((DEF, target.id, rhs_shared, node))
+        elif isinstance(target, ast.Attribute):
+            base = sh.base_key(
+                ast.Attribute(
+                    value=target.value, attr=target.attr, ctx=ast.Load()
+                )
+            ) if isinstance(target.value, ast.Name) else None
+            if base is not None:
+                out.append((WRITE, (base, None), rhs_locals, node))
+            else:
+                self.expr(target.value, out)
+        elif isinstance(target, ast.Subscript):
+            base = sh.base_key(target.value)
+            self.expr(target.slice, out)
+            if base is not None:
+                out.append(
+                    (WRITE, (base, sh.sub_key(target.slice)), rhs_locals,
+                     node)
+                )
+            else:
+                self.expr(target.value, out)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._store_target(el, value_events, value_node, out)
+        elif isinstance(target, ast.Starred):
+            self._store_target(target.value, value_events, value_node, out)
+
+    # -- guards -----------------------------------------------------------
+
+    def _body_raises(self, body: list[ast.stmt]) -> bool:
+        """The body of a validation guard: ends in `raise`, diverts
+        nowhere else (a log line before the raise is still a guard)."""
+        if not body or not isinstance(body[-1], ast.Raise):
+            return False
+        for s in body:
+            for sub in ast.walk(s):
+                if isinstance(sub, (ast.Await, ast.Return)):
+                    return False
+        return True
+
+    def _guard_event(self, test, kind: str, node) -> Optional[tuple]:
+        """A validation guard: the test reads shared mutable state AND
+        some request-derived operand (a parameter or plain local) — the
+        `version < self.oldest_version` shape. Pure liveness flags
+        (`if self._stopped: raise`) are excluded: they carry no request
+        value whose validation could go stale in the same way."""
+        ev: list[tuple] = []
+        self.expr(test, ev, in_test=True)
+        keys = frozenset(e[1] for e in ev if e[0] == READ)
+        if not keys:
+            return None
+        if not any(e[0] == USE for e in ev):
+            return None
+        return (GUARD, kind, keys, node)
+
+    def _check_call_event(self, call: ast.Call, node) -> Optional[tuple]:
+        leaf = None
+        if isinstance(call.func, ast.Attribute):
+            leaf = call.func.attr
+        elif isinstance(call.func, ast.Name):
+            leaf = call.func.id
+        if leaf is None or not call.args:
+            return None
+        stem = leaf.lstrip("_")
+        if not stem.startswith(CHECK_CALL_PREFIXES):
+            return None
+        # at least one argument must be a parameter of THIS function:
+        # the request value whose validation the wait can invalidate
+        if not any(
+            isinstance(a, ast.Name) and a.id in self.params
+            for a in call.args
+        ):
+            return None
+        return (CHECK, ast.dump(call), node)
+
+    # -- statement lowering -----------------------------------------------
+
+    def build(self) -> Block:
+        entry = self.new_block()
+        exit_block = self.stmts(self.fn.body, entry, [])
+        return entry
+
+    def stmts(self, body, cur: Block,
+              loops: list[tuple[Block, Block]]) -> Optional[Block]:
+        """Lower a statement list starting in `cur`; returns the block
+        control falls out of (None if every path terminated)."""
+        for stmt in body:
+            if cur is None:
+                cur = self.new_block()  # unreachable tail: keep honest
+            cur = self.stmt(stmt, cur, loops)
+        return cur
+
+    def _lower_loop_else(self, stmt, header: Block, after: Block,
+                         loops, exits: bool) -> None:
+        """Loop exits: the else clause runs on EXHAUSTION only — break
+        jumps straight to `after`, skipping it (lowering the else into
+        `after` would run it on break paths and hide stale reads the
+        break path never refreshes). `exits` = the loop can exhaust
+        (False for `while True:`)."""
+        if stmt.orelse:
+            if not exits:
+                return  # while True ... else: unreachable
+            else_b = self.new_block()
+            header.add_succ(else_b)
+            else_out = self.stmts(stmt.orelse, else_b, loops)
+            if else_out is not None:
+                else_out.add_succ(after)
+        elif exits:
+            header.add_succ(after)
+
+    def stmt(self, stmt, cur: Block, loops) -> Optional[Block]:
+        ev = cur.events
+        ev.append((STMT,))
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            value = stmt.value
+            ve: list[tuple] = []
+            self.expr(value, ve)
+            ev.extend(ve)
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign)
+                else [stmt.target]
+            )
+            for t in targets:
+                self._store_target(t, ve, value, ev)
+            return cur
+        if isinstance(stmt, ast.AugAssign):
+            # load of the target first (the R of the RMW)...
+            loadish: list[tuple] = []
+            t = stmt.target
+            base = None
+            if isinstance(t, ast.Name):
+                base = self.shared.base_key(t)
+                if base is not None:
+                    loadish.append((READ, (base, None), t))
+                else:
+                    loadish.append((USE, t.id, False, t, False))
+            elif isinstance(t, ast.Attribute) and isinstance(
+                t.value, ast.Name
+            ):
+                probe = ast.Attribute(value=t.value, attr=t.attr,
+                                      ctx=ast.Load())
+                base = self.shared.base_key(probe)
+                if base is not None:
+                    loadish.append((READ, (base, None), t))
+            elif isinstance(t, ast.Subscript):
+                base = self.shared.base_key(t.value)
+                self.expr(t.slice, loadish)
+                if base is not None:
+                    loadish.append(
+                        (READ, (base, self.shared.sub_key(t.slice)), t)
+                    )
+            ev.extend(loadish)
+            ve: list[tuple] = []
+            self.expr(stmt.value, ve)
+            ev.extend(ve)
+            # ...then the store
+            self._store_target(stmt.target, loadish + ve, stmt.value, ev)
+            return cur
+        if isinstance(stmt, ast.Expr):
+            ve: list[tuple] = []
+            self.expr(stmt.value, ve)
+            ev.extend(ve)
+            if isinstance(stmt.value, ast.Call):
+                ce = self._check_call_event(stmt.value, stmt)
+                if ce is not None:
+                    ev.append(ce)
+            return cur
+        if isinstance(stmt, ast.Return):
+            ve: list[tuple] = []
+            self.expr(stmt.value, ve)
+            ev.extend(ve)
+            ev.append((RETURN,))
+            cur.terminated = True
+            return None
+        if isinstance(stmt, ast.Raise):
+            self.expr(stmt.exc, ev)
+            self.expr(stmt.cause, ev)
+            ev.append((RAISE,))
+            cur.terminated = True
+            return None
+        if isinstance(stmt, ast.If):
+            te: list[tuple] = []
+            self.expr(stmt.test, te, in_test=True)
+            ev.extend(te)
+            if self._body_raises(stmt.body) and not stmt.orelse:
+                ge = self._guard_event(stmt.test, "if", stmt)
+                if ge is not None:
+                    ev.append(ge)
+            body_b = self.new_block()
+            cur.add_succ(body_b)
+            body_out = self.stmts(stmt.body, body_b, loops)
+            if stmt.orelse:
+                else_b = self.new_block()
+                cur.add_succ(else_b)
+                else_out = self.stmts(stmt.orelse, else_b, loops)
+            else:
+                else_out = cur
+            join = self.new_block()
+            fell = False
+            for out in (body_out, else_out):
+                if out is not None:
+                    out.add_succ(join)
+                    fell = True
+            return join if fell else None
+        if isinstance(stmt, (ast.While,)):
+            header = self.new_block()
+            cur.add_succ(header)
+            self.expr(stmt.test, header.events, in_test=True)
+            after = self.new_block()
+            body_b = self.new_block()
+            header.add_succ(body_b)
+            # `while True:` never falls out — its only exits are
+            # break/return/raise; a synthetic exit edge would
+            # manufacture stale paths that cannot execute
+            exits = not (
+                isinstance(stmt.test, ast.Constant) and stmt.test.value
+            )
+            body_out = self.stmts(stmt.body, body_b, loops + [(header, after)])
+            if body_out is not None:
+                body_out.add_succ(header)
+            self._lower_loop_else(stmt, header, after, loops, exits)
+            return after
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.expr(stmt.iter, ev)
+            header = self.new_block()
+            cur.add_succ(header)
+            if isinstance(stmt, ast.AsyncFor):
+                header.events.append((AWAIT, stmt))  # each step yields
+            # the loop target binds fresh each iteration
+            self._store_target(stmt.target, [], stmt.iter, header.events)
+            after = self.new_block()
+            body_b = self.new_block()
+            header.add_succ(body_b)
+            body_out = self.stmts(stmt.body, body_b, loops + [(header, after)])
+            if body_out is not None:
+                body_out.add_succ(header)
+            self._lower_loop_else(stmt, header, after, loops, True)
+            return after
+        if isinstance(stmt, ast.Break):
+            cur.terminated = True
+            if loops:
+                cur.add_succ(loops[-1][1])
+            return None
+        if isinstance(stmt, ast.Continue):
+            cur.terminated = True
+            if loops:
+                cur.add_succ(loops[-1][0])
+            return None
+        if isinstance(stmt, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+            before = len(self.blocks)
+            body_out = self.stmts(stmt.body, cur, loops)
+            body_blocks = [cur] + self.blocks[before:]
+            join = self.new_block()
+            if stmt.handlers:
+                for h in stmt.handlers:
+                    h_b = self.new_block()
+                    # any point in the body may raise into the handler
+                    for b in body_blocks:
+                        b.add_exc_succ(h_b)
+                    h_out = self.stmts(h.body, h_b, loops)
+                    if h_out is not None:
+                        h_out.add_succ(join)
+            if stmt.orelse:
+                if body_out is not None:
+                    body_out = self.stmts(stmt.orelse, body_out, loops)
+            if body_out is not None:
+                body_out.add_succ(join)
+            if stmt.finalbody:
+                f_out = self.stmts(stmt.finalbody, join, loops)
+                return f_out
+            return join
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.expr(item.context_expr, ev)
+                if isinstance(stmt, ast.AsyncWith):
+                    ev.append((AWAIT, stmt))  # __aenter__
+                if item.optional_vars is not None:
+                    self._store_target(
+                        item.optional_vars, [], item.context_expr, ev
+                    )
+            out = self.stmts(stmt.body, cur, loops)
+            if out is not None and isinstance(stmt, ast.AsyncWith):
+                out.events.append((AWAIT, stmt))  # __aexit__
+            return out
+        if isinstance(stmt, ast.Assert):
+            te: list[tuple] = []
+            self.expr(stmt.test, te, in_test=True)
+            ev.extend(te)
+            keys = frozenset(e[1] for e in te if e[0] == READ)
+            if keys and any(e[0] == USE for e in te):
+                ev.append((GUARD, "assert", keys, stmt))
+            self.expr(stmt.msg, ev)
+            return cur
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return cur  # separate scope, walked separately
+        if isinstance(stmt, getattr(ast, "Match", ())):
+            self.expr(stmt.subject, ev)
+            join = self.new_block()
+            fell = False
+            irrefutable = False
+            for case in stmt.cases:
+                c_b = self.new_block()
+                cur.add_succ(c_b)
+                c_out = self.stmts(case.body, c_b, loops)
+                if c_out is not None:
+                    c_out.add_succ(join)
+                    fell = True
+                if isinstance(case.pattern, ast.MatchAs) and (
+                    case.pattern.pattern is None and not case.guard
+                ):
+                    irrefutable = True  # `case _:` — always matches
+            if not irrefutable:
+                cur.add_succ(join)  # no case may match
+            return join
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Subscript):
+                    base = self.shared.base_key(t.value)
+                    self.expr(t.slice, ev)
+                    if base is not None:
+                        ev.append(
+                            (WRITE, (base, self.shared.sub_key(t.slice)),
+                             frozenset(), t)
+                        )
+            return cur
+        # Pass / Global / Nonlocal / Import / anything else: no events
+        return cur
+
+
+def build_cfg(info: FuncInfo, tree: ast.Module) -> tuple[Block, SharedModel]:
+    """Lower one async function to (entry block, shared-state model)."""
+    shared = SharedModel(tree, info)
+    builder = _Builder(info.node, shared)
+    entry = builder.build()
+    return entry, shared

@@ -62,6 +62,7 @@ def load_rules() -> None:
     from foundationdb_tpu.analysis import (  # noqa: F401
         rules_actor,
         rules_determinism,
+        rules_flow,
         rules_jax,
         rules_probes,
     )

@@ -15,7 +15,13 @@ from pathlib import Path
 
 from foundationdb_tpu.analysis import baseline as baseline_mod
 from foundationdb_tpu.analysis import registry, walker
-from foundationdb_tpu.analysis.walker import FileContext, Finding
+from foundationdb_tpu.analysis.walker import FileContext, Finding, _matches
+
+R_STALE_IGNORE = registry.rule(
+    "flowcheck.stale-ignore",
+    "a '# flowcheck: ignore[...]' comment that suppresses nothing — "
+    "dead ignores must not accumulate",
+)
 
 
 @dataclasses.dataclass
@@ -71,12 +77,44 @@ def run_analysis(
     for tree_rule in registry.TREE_CHECKS:
         findings.extend(tree_rule(ctxs, manifest_path=manifest_path))
 
+    # the stale-suppression audit: after EVERY rule has run, an
+    # ignore[] pattern that absorbed no finding is dead weight — the
+    # violation it justified was fixed (or never existed), and leaving
+    # the marker would silently blind the gate to a future regression
+    # on that line. Not suppressible by construction (suppressing a
+    # stale ignore with another ignore is turtles all the way down).
+    for ctx in ctxs:
+        for line, pats in sorted(ctx.suppressions.items()):
+            absorbed = [f for f in ctx.suppressed if f.line == line]
+            for pat in sorted(pats):
+                if any(_matches(f.rule, pat) for f in absorbed):
+                    continue
+                marker = (
+                    "# flowcheck: ignore" if pat == "*"
+                    else f"# flowcheck: ignore[{pat}]"
+                )
+                findings.append(Finding(
+                    path=ctx.path, line=line, rule=R_STALE_IGNORE,
+                    message=(
+                        f"{marker!r} suppresses nothing here — remove "
+                        "the dead ignore"
+                    ),
+                ))
+
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     allowed = (
         baseline_mod.load_baseline(baseline_path) if use_baseline
         else Counter()
     )
-    new, baselined, stale = baseline_mod.split_findings(findings, allowed)
+    # stale-ignore findings never enter baseline matching: a
+    # --write-baseline run must not freeze a dead ignore into
+    # permanence (the accumulation this rule exists to prevent)
+    baselineable = [f for f in findings if f.rule != R_STALE_IGNORE]
+    new, baselined, stale = baseline_mod.split_findings(
+        baselineable, allowed
+    )
+    new.extend(f for f in findings if f.rule == R_STALE_IGNORE)
+    new.sort(key=lambda f: (f.path, f.line, f.rule))
     return AnalysisResult(
         contexts=ctxs,
         findings=findings,

@@ -67,30 +67,51 @@ def check_actor_safety(ctx: FileContext) -> None:
     if not ctx.in_sim_scope:
         return
     async_defs = _local_async_defs(ctx.tree)
+
+    def classify_call(node: ast.AST, call: ast.Call, where: str) -> None:
+        fname = ctx.dotted(call.func)
+        leaf = fname.rsplit(".", 1)[-1] if fname else None
+        if leaf == "spawn":
+            ctx.report(
+                node, R_FIRE_FORGET,
+                f"bare spawn(){where}: keep the Task and observe "
+                "task.done",
+            )
+        elif leaf == "delay":
+            ctx.report(
+                node, R_UNAWAITED,
+                f"bare delay(){where}: the returned Future is never "
+                "awaited",
+            )
+        elif (
+            isinstance(call.func, ast.Name)
+            and call.func.id in async_defs
+        ):
+            ctx.report(
+                node, R_UNAWAITED,
+                f"bare call to async def {call.func.id}{where}: "
+                "coroutine is never scheduled",
+            )
+
     for node in ast.walk(ctx.tree):
         if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
-            call = node.value
-            fname = ctx.dotted(call.func)
-            leaf = fname.rsplit(".", 1)[-1] if fname else None
-            if leaf == "spawn":
-                ctx.report(
-                    node, R_FIRE_FORGET,
-                    "bare spawn(): keep the Task and observe task.done",
-                )
-            elif leaf == "delay":
-                ctx.report(
-                    node, R_UNAWAITED,
-                    "bare delay(): the returned Future is never awaited",
-                )
-            elif (
-                isinstance(call.func, ast.Name)
-                and call.func.id in async_defs
-            ):
-                ctx.report(
-                    node, R_UNAWAITED,
-                    f"bare call to async def {call.func.id}: coroutine "
-                    "is never scheduled",
-                )
+            classify_call(node, node.value, "")
+        elif isinstance(node, ast.Expr) and isinstance(
+            node.value,
+            (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp),
+        ):
+            # the comprehension blind spot: a bare statement like
+            # `[worker() for w in ws]` builds a coroutine (or discards
+            # a Task/Future) per element with nobody ever awaiting —
+            # the same bug as the bare call, once per element
+            comp = node.value
+            elts = (
+                (comp.key, comp.value) if isinstance(comp, ast.DictComp)
+                else (comp.elt,)
+            )
+            for elt in elts:
+                if isinstance(elt, ast.Call):
+                    classify_call(node, elt, " inside a bare comprehension")
         elif isinstance(node, ast.ExceptHandler):
             broad = _broad_name(node.type)
             if broad is not None and _only_passes(node.body):

@@ -1,0 +1,411 @@
+"""The `flow.*` family: stale state across a wait(), found statically.
+
+PR 2's model checker caught the real thing — a GC floor validated
+before `_wait_for_version` was silently stale after it
+(cluster/storage.py, soak seeds 1122/1171/2036), and data distribution
+carried the same latent shape. The actor compiler's first lesson is
+that *all state may change across a wait()*; these rules turn that one
+hand-found bug into a machine-checked bug class over the CFGs cfg.py
+builds per `async def`:
+
+* flow.stale-read-across-wait — a validation guard (`if req <
+  self.shared: raise`) or a local snapshot of shared mutable state
+  taken before an `await` still governs behavior after it, with no
+  re-read of that state past the yield point. The exact
+  storage.py/_wait_for_version shape: the fix is to re-read (and
+  re-raise) after the last await, which is precisely what silences the
+  rule.
+* flow.rmw-across-wait — a read-modify-write of shared state split
+  across a yield point: `v = self.x` … `await …` … `self.x = f(v)`
+  (or the one-statement form `self.x = await f(self.x)`). The
+  interleaved writer's update is lost.
+* flow.guard-not-rechecked — an invariant-check call
+  (`self._check_*(…, request_arg, …)`) or a shared-state assert whose
+  subject is awaited past without an identical re-check afterwards —
+  the double-`_check_shard_floor` discipline in storage.py's read
+  path, enforced.
+
+Path semantics (first-await discipline): a finding needs a path from
+the read/guard/check through a yield point to a function exit on which
+the FIRST await crossed is never followed by the re-read/re-check.
+A path that re-validates after its first await is clean there — any
+LATER await it then crosses without re-validating is a separate
+finding anchored at the re-validation site, so each missing re-check
+reports exactly once. Paths that end in `raise` don't count (refusing
+to serve can't serve stale state).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from foundationdb_tpu.analysis import cfg
+from foundationdb_tpu.analysis.cfg import (
+    AWAIT,
+    CHECK,
+    DEF,
+    GUARD,
+    RAISE,
+    READ,
+    RETURN,
+    STMT,
+    USE,
+    WRITE,
+    Block,
+    keys_conflict,
+)
+from foundationdb_tpu.analysis.registry import file_check, rule
+from foundationdb_tpu.analysis.walker import FileContext
+
+R_STALE = rule(
+    "flow.stale-read-across-wait",
+    "shared state read before an await still governs behavior after "
+    "it; re-read/re-validate past the yield point",
+)
+R_RMW = rule(
+    "flow.rmw-across-wait",
+    "read-modify-write of shared state split across a yield point "
+    "(interleaved writers' updates are lost)",
+)
+R_GUARD = rule(
+    "flow.guard-not-rechecked",
+    "invariant check whose subject is awaited past without an "
+    "identical re-check after the wait",
+)
+
+#: paths explored per origin event before giving up (CFGs here are tiny;
+#: this is a safety valve, not a tuning knob)
+_MAX_STATES = 20000
+
+
+def _paths_reach_exit_stale(start: tuple, *, is_fresh, is_kill=None):
+    """Core DFS: from (block, idx) just past the origin event, does some
+    path cross an await (phase 1) and reach a non-raise exit without a
+    `fresh` event after that first await?
+
+    * is_fresh(event) — re-read/re-check that cleans the path once in
+      phase 1 (exploration of that branch stops: later awaits are the
+      fresh site's own problem).
+    * is_kill(event) — invalidates the tracked value entirely (a re-def
+      of the snapshot local); the path stops caring in ANY phase.
+
+    Returns True if a stale path exists.
+    """
+    block, idx = start
+    stack = [(block, idx, 0)]
+    seen: set[tuple[int, int, int]] = set()
+    states = 0
+    while stack:
+        b, i, phase = stack.pop()
+        key = (id(b), i, phase)
+        if key in seen:
+            continue
+        seen.add(key)
+        states += 1
+        if states > _MAX_STATES:
+            return False  # degenerate CFG: stay silent, never hang
+        events = b.events
+        stopped = False
+        while i < len(events):
+            ev = events[i]
+            kind = ev[0]
+            if is_kill is not None and is_kill(ev):
+                stopped = True
+                break
+            if kind == AWAIT and phase == 0:
+                phase = 1
+            elif phase == 1 and is_fresh(ev):
+                stopped = True  # revalidated after the first await
+                break
+            elif kind == RAISE:
+                stopped = True  # refusing to serve is not staleness
+                break
+            elif kind == RETURN:
+                if phase == 1:
+                    return True
+                stopped = True
+                break
+            i += 1
+        if stopped:
+            continue
+        if not b.succs:
+            if phase == 1 and not b.terminated:
+                return True  # fell off the end past an await
+            continue
+        for s in b.succs:
+            stack.append((s, 0, phase))
+    return False
+
+
+def _event_positions(entry: Block):
+    """(block, idx, event) for every event, blocks discovered once."""
+    seen = {id(entry)}
+    order = [entry]
+    out = []
+    qi = 0
+    while qi < len(order):
+        b = order[qi]
+        qi += 1
+        for i, ev in enumerate(b.events):
+            out.append((b, i, ev))
+        for s in list(b.succs) + list(b.exc_succs):
+            if id(s) not in seen:
+                seen.add(id(s))
+                order.append(s)
+    return out
+
+
+def _reads_key(ev, keys) -> bool:
+    return ev[0] == READ and any(keys_conflict(ev[1], k) for k in keys)
+
+
+def _analyze_function(ctx: FileContext, info: cfg.FuncInfo) -> None:
+    entry, shared = cfg.build_cfg(info, ctx.tree)
+    positions = _event_positions(entry)
+    reported: set[tuple[int, str]] = set()
+
+    def report(node, rule_id, message):
+        key = (getattr(node, "lineno", 0), rule_id)
+        if key in reported:
+            return
+        reported.add(key)
+        ctx.report(node, rule_id, message)
+
+    for b, i, ev in positions:
+        kind = ev[0]
+
+        if kind == GUARD:
+            _g, guard_kind, keys, node = ev
+            stale = _paths_reach_exit_stale(
+                (b, i + 1),
+                is_fresh=lambda e, keys=keys: _reads_key(e, keys),
+            )
+            if stale:
+                what = " / ".join(sorted(k[0] for k in keys))
+                if guard_kind == "assert":
+                    report(
+                        node, R_GUARD,
+                        f"{info.qualname}: assert on {what} is awaited "
+                        "past without re-checking it after the wait",
+                    )
+                else:
+                    report(
+                        node, R_STALE,
+                        f"{info.qualname}: guard on {what} validated "
+                        "before an await but not re-read after it — all "
+                        "state may change across a wait()",
+                    )
+
+        elif kind == DEF:
+            _d, name, sources, node = ev
+            if not sources:
+                continue
+            # a snapshot local: stale when a path crosses an await and
+            # the snapshot then GOVERNS control flow (a test use) with
+            # neither a re-def of the local nor a re-read of its source
+            def fresh(e, name=name, sources=sources):
+                return _reads_key(e, sources)
+
+            def kill(e, name=name):
+                return e[0] == DEF and e[1] == name
+
+            # find a phase-1 test-use first (cheap pre-filter): without
+            # one the def can't fire, whatever the paths do
+            has_test_use = any(
+                e[0] == USE and e[1] == name and e[2] and not e[4]
+                for _b2, _i2, e in positions
+            )
+            if not has_test_use:
+                continue
+            if _paths_reach_test_use_stale(
+                (b, i + 1), name, fresh, kill
+            ):
+                what = " / ".join(sorted(k[0] for k in sources))
+                report(
+                    node, R_STALE,
+                    f"{info.qualname}: local {name!r} snapshots {what} "
+                    "before an await and still guards behavior after "
+                    "it without a re-read",
+                )
+
+        elif kind == WRITE:
+            _w, wkey, uses, node = ev
+            # taint shape: some def of a local in `uses` sourced from a
+            # conflicting shared key, with an await between def and
+            # write and no re-def in between → lost update
+            for b2, i2, ev2 in positions:
+                if ev2[0] != DEF or ev2[1] not in uses:
+                    continue
+                sources = ev2[2]
+                if not any(keys_conflict(k, wkey) for k in sources):
+                    continue
+                name = ev2[1]
+                if _paths_cross_await_to(
+                    (b2, i2 + 1), target=(id(b), i),
+                    kill=lambda e, name=name: e[0] == DEF and e[1] == name,
+                ):
+                    report(
+                        node, R_RMW,
+                        f"{info.qualname}: write to {wkey[0]} uses "
+                        f"{name!r} read from it before an await — a "
+                        "read-modify-write split across a yield point",
+                    )
+
+    # one-statement RMW: read k … await … write k inside a SINGLE
+    # statement (`self.x = await f(self.x)`, `self.x += await f()`) —
+    # the statement-boundary markers bound the scan
+    for b, i, ev in positions:
+        if ev[0] != READ or (len(ev) > 3 and ev[3]):
+            continue  # weak receiver reads don't anchor an RMW
+        rkey = ev[1]
+        crossed = False
+        for j in range(i + 1, len(b.events)):
+            e2 = b.events[j]
+            if e2[0] == STMT:
+                break  # next statement: no longer "one statement"
+            if e2[0] == AWAIT:
+                crossed = True
+            elif e2[0] == READ and keys_conflict(e2[1], rkey):
+                break  # refreshed in-statement
+            elif crossed and e2[0] == WRITE and keys_conflict(e2[1], rkey):
+                report(
+                    e2[3], R_RMW,
+                    f"{info.qualname}: {rkey[0]} read, awaited past, "
+                    "then written in one statement — the await races "
+                    "the read-modify-write",
+                )
+                break
+
+    # guard-not-rechecked: invariant-check calls
+    for b, i, ev in positions:
+        if ev[0] != CHECK:
+            continue
+        _c, dump, node = ev
+        stale = _paths_reach_exit_stale(
+            (b, i + 1),
+            is_fresh=lambda e, dump=dump: e[0] == CHECK and e[1] == dump,
+        )
+        if stale:
+            leaf = node.value.func
+            leaf_name = (
+                leaf.attr if isinstance(leaf, ast.Attribute) else
+                getattr(leaf, "id", "check")
+            )
+            report(
+                node, R_GUARD,
+                f"{info.qualname}: {leaf_name}(...) validates a request "
+                "before an await but is not repeated after it — the "
+                "checked state may have changed across the wait",
+            )
+
+
+def _paths_reach_test_use_stale(start, name, fresh, kill) -> bool:
+    """Snapshot-local variant of the stale DFS: stale when a path
+    crosses its first await and then USES the local in a test (guard)
+    position, with no source re-read (fresh) or local re-def (kill)
+    since that await."""
+    block, idx = start
+    stack = [(block, idx, 0)]
+    seen = set()
+    states = 0
+    while stack:
+        b, i, phase = stack.pop()
+        key = (id(b), i, phase)
+        if key in seen:
+            continue
+        seen.add(key)
+        states += 1
+        if states > _MAX_STATES:
+            return False
+        stopped = False
+        while i < len(b.events):
+            ev = b.events[i]
+            if kill(ev):
+                stopped = True
+                break
+            if ev[0] == AWAIT and phase == 0:
+                phase = 1
+            elif phase == 1 and fresh(ev):
+                stopped = True
+                break
+            elif (
+                phase == 1 and ev[0] == USE and ev[1] == name
+                and ev[2] and not ev[4]
+            ):
+                # `if snapshot or self.x > v:` — a re-read of the
+                # source within the SAME statement (the test's own
+                # tail) is the refresh idiom; scan to the statement
+                # boundary before flagging
+                refreshed = False
+                for j in range(i + 1, len(b.events)):
+                    e2 = b.events[j]
+                    if e2[0] == STMT:
+                        break
+                    if fresh(e2):
+                        refreshed = True
+                        break
+                if refreshed:
+                    stopped = True
+                    break
+                return True
+            elif ev[0] == RAISE:
+                stopped = True
+                break
+            elif ev[0] == RETURN:
+                stopped = True
+                break
+            i += 1
+        if stopped:
+            continue
+        for s in b.succs:
+            stack.append((s, 0, phase))
+    return False
+
+
+def _paths_cross_await_to(start, *, target, kill) -> bool:
+    """Does a path from `start` reach the event at `target`
+    (id(block), idx) having crossed >= 1 await, without `kill` firing?"""
+    block, idx = start
+    stack = [(block, idx, 0)]
+    seen = set()
+    states = 0
+    while stack:
+        b, i, phase = stack.pop()
+        key = (id(b), i, phase)
+        if key in seen:
+            continue
+        seen.add(key)
+        states += 1
+        if states > _MAX_STATES:
+            return False
+        stopped = False
+        while i < len(b.events):
+            if (id(b), i) == target:
+                if phase == 1:
+                    return True
+                stopped = True  # reached it without an await: benign
+                break
+            ev = b.events[i]
+            if kill(ev):
+                stopped = True
+                break
+            if ev[0] == AWAIT:
+                phase = 1
+            elif ev[0] in (RAISE, RETURN):
+                stopped = True
+                break
+            i += 1
+        if stopped:
+            continue
+        for s in b.succs:
+            stack.append((s, 0, phase))
+    return False
+
+
+@file_check
+def check_flow(ctx: FileContext) -> None:
+    """Run the flow family over every async def in a sim-scope file."""
+    if not ctx.in_sim_scope:
+        return
+    for info in cfg.iter_async_functions(ctx.tree):
+        _analyze_function(ctx, info)

@@ -47,6 +47,24 @@ R_DRIFT = rule(
 )
 
 
+def probe_contexts(ctxs: list[FileContext]) -> list[FileContext]:
+    """The contexts probe accounting applies to — THE exclusion policy,
+    shared by the gate's tree check, --write-manifest, and the
+    scripts/probe_scan.py CLI (one copy or they drift): skip probes.py
+    itself (it defines declare/code_probe) and this package (rule docs
+    mention the callables by name)."""
+    return [
+        c for c in ctxs
+        if c.rel != "utils/probes.py"
+        and not c.rel.startswith("analysis/")
+    ]
+
+
+def manifest_of(declares: dict[str, list]) -> dict[str, str]:
+    """name -> declaring file, from a collect_probes declares map."""
+    return {name: sites[0][0].path for name, sites in declares.items()}
+
+
 def collect_probes(ctxs: list[FileContext]):
     """(declares, uses, dynamic): declares/uses map name -> [(ctx, node)],
     dynamic is [(ctx, node, kind)] for non-literal name args."""
@@ -98,14 +116,7 @@ def check_probe_ledger(ctxs: list[FileContext],
         if len(ctx.findings) > before:
             findings.append(ctx.findings.pop())
 
-    # skip probes.py itself (it defines declare/code_probe) and this
-    # package (rule docs mention the callables by name)
-    ctxs = [
-        c for c in ctxs
-        if c.rel != "utils/probes.py"
-        and not c.rel.startswith("analysis/")
-    ]
-    declares, uses, dynamic = collect_probes(ctxs)
+    declares, uses, dynamic = collect_probes(probe_contexts(ctxs))
 
     for name, sites in sorted(declares.items()):
         if len(sites) > 1:
@@ -129,9 +140,7 @@ def check_probe_ledger(ctxs: list[FileContext],
         )
 
     # manifest drift: compare the tree's ledger to the checked-in file
-    tree_manifest = {
-        name: sites[0][0].path for name, sites in declares.items()
-    }
+    tree_manifest = manifest_of(declares)
     stored = manifest_mod.load_manifest(manifest_path)
     if stored != tree_manifest:
         missing = sorted(set(tree_manifest) - set(stored))
@@ -152,10 +161,5 @@ def check_probe_ledger(ctxs: list[FileContext],
 
 def tree_manifest(ctxs: list[FileContext]) -> dict[str, str]:
     """name -> declaring file, for --write-manifest."""
-    ctxs = [
-        c for c in ctxs
-        if c.rel != "utils/probes.py"
-        and not c.rel.startswith("analysis/")
-    ]
-    declares, _uses, _dyn = collect_probes(ctxs)
-    return {name: sites[0][0].path for name, sites in declares.items()}
+    declares, _uses, _dyn = collect_probes(probe_contexts(ctxs))
+    return manifest_of(declares)

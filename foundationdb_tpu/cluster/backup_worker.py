@@ -145,7 +145,10 @@ class BackupWorkerManager:
                     consumer=self.CONSUMER, own_consumer=False,
                 )
                 self.worker.start()
-                prev = self.worker
+                # prev deliberately holds the PREVIOUS epoch's worker
+                # across the displacement wait — a stale handle is the
+                # point (the successor stops its predecessor)
+                prev = self.worker  # flowcheck: ignore[flow.stale-read-across-wait]
                 self.saved_version = await self.worker.displaced.future
         except ActorCancelled:
             raise

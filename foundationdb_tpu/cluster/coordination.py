@@ -208,7 +208,12 @@ class CoordinatedState:
         gen = self._next_gen()
         replies = await self._ask_all("lock", gen)
         for wgen, _val in replies:
-            if code_probe(wgen > self._read_wgen,
+            # the generation LOCK protects the wait below, not a
+            # re-read: once every coordinator holds our gen, a racing
+            # writer either lost (lower gen, rejected) or makes OUR
+            # write fail StaleGeneration — the reference's setExclusive
+            # atomicity argument
+            if code_probe(wgen > self._read_wgen,  # flowcheck: ignore[flow.stale-read-across-wait]
                           "coordination.racing_writer_detected"):
                 raise StaleGeneration(
                     f"value committed at {wgen} since our read at "

@@ -101,20 +101,30 @@ class GrvProxy:
     async def _starter(self) -> None:
         # Token bucket fed by the Ratekeeper budget (transactionStarter's
         # "transactionRate" accounting, GrvProxyServer.actor.cpp:824).
-        pending = self._pending
+        # Queue accesses go through self._pending directly: stop()
+        # REASSIGNS the list after failing the queued promises, and a
+        # pre-await alias here would keep feeding the dead list if a
+        # step ever interleaved with stop() (flow.stale-read-across-wait
+        # caught the alias; cancellation only masks it today).
         tokens = 0.0
         last = self.sched.now()
         while True:
-            if not pending:
+            if not self._pending:
                 self._armed = self.requests.stream.next()
-                pending.append(await self._armed)
+                # await FIRST, then touch the queue: in
+                # `self._pending.append(await ...)` the bound method
+                # holds the pre-await list object, which is exactly the
+                # stale alias this function no longer keeps (stop()
+                # reassigns the list while we are suspended here)
+                p = await self._armed
+                self._pending.append(p)
                 self._armed = None
             await self.sched.delay(self.batch_interval)
             while True:
                 ok, p = self.requests.stream.try_next()
                 if not ok:
                     break
-                pending.append(p)
+                self._pending.append(p)
 
             now = self.sched.now()
             if self.ratekeeper is not None:
@@ -123,15 +133,15 @@ class GrvProxy:
                     tokens + tps * (now - last), max(tps * 0.1, 1.0)
                 )
             else:
-                tokens = float(len(pending))
+                tokens = float(len(self._pending))
             dt = now - last
             last = now
-            n = min(len(pending), int(tokens))
+            n = min(len(self._pending), int(tokens))
             if n == 0:
                 continue
             tokens -= n
-            batch = pending[:n]
-            del pending[:n]
+            batch = self._pending[:n]
+            del self._pending[:n]
             # per-tag metering: requests over their tag's quota are
             # deferred back to the queue (the tag throttle delays, never
             # drops — GlobalTagThrottler semantics)
@@ -169,7 +179,7 @@ class GrvProxy:
                 # global tokens so a throttled tag flood cannot starve
                 # untagged traffic
                 tokens += len(defer)
-                pending.extend(defer)
+                self._pending.extend(defer)
                 batch = admit
                 if not batch:
                     continue

@@ -87,7 +87,10 @@ class ClusterController:
                         continue  # quorum down or another leader is live
                 elif self.lease.expires < self.cluster.sched.now() + \
                         10 * self.check_interval:
-                    self.lease = await self.elector.renew(self.lease)
+                    # _watch is self.lease's only writer: renew()
+                    # round-trips the current lease through the elector
+                    # with no concurrent mutator to lose an update to
+                    self.lease = await self.elector.renew(self.lease)  # flowcheck: ignore[flow.rmw-across-wait]
                     if self.lease is None:
                         code_probe(True, "recovery.leadership_lost")
                         continue  # deposed; must re-win before recovering

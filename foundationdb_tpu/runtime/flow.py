@@ -282,6 +282,211 @@ class Trigger:
                 f._set(None)
 
 
+class InterleavingAuditor:
+    """Runtime side of the `flow.*` rules: lost-update detection on
+    shared objects across actor yield points.
+
+    The static pass (analysis/rules_flow.py) proves shapes; this
+    auditor catches the *executions*: an actor reads a tracked
+    (object, key) slot in one step, a DIFFERENT actor writes that slot
+    in a later step, and the first actor then writes it based on the
+    stale read — the Eraser-lesson RMW interleaving, adapted to a
+    cooperative single-threaded scheduler where the only possible race
+    is across a wait(). Ordering discipline is re-reading: an actor
+    that re-reads the slot after the foreign write (the handoff idiom)
+    updates its pending read and is clean; an actor that writes from a
+    pre-wait value is flagged whether or not a future "ordered" its
+    resumption, because the value it wrote is stale either way.
+
+    Pure observation: tracking changes no behavior and no schedule, so
+    audited runs stay seed-deterministic. Objects opt in via
+    `AuditedDict` (or direct record_read/record_write calls); code that
+    never wraps anything pays nothing.
+    """
+
+    MAX_CONFLICTS = 64
+
+    def __init__(self):
+        self.step = 0              # global actor-step counter
+        self.current: Optional[str] = None  # actor name mid-step
+        #: (label, key) -> actor name -> step of last unconsumed read
+        self._reads: dict[tuple, dict[str, int]] = {}
+        #: (label, key) -> (actor name, step) of the last write
+        self._last_write: dict[tuple, tuple[str, int]] = {}
+        self.conflicts: list[dict] = []
+
+    # -- step boundaries (driven by Task._step) ---------------------------
+
+    def begin_step(self, name: str) -> None:
+        self.step += 1
+        self.current = name
+
+    def end_step(self) -> None:
+        self.current = None
+
+    # -- access recording --------------------------------------------------
+
+    def record_read(self, label: str, key) -> None:
+        if self.current is None:
+            return  # setup/verify code outside any actor step
+        self._reads.setdefault((label, key), {})[self.current] = self.step
+
+    def record_write(self, label: str, key) -> None:
+        me = self.current
+        if me is None:
+            return
+        # `key` and the whole-object wildcard "*" address the same
+        # slot; a wildcard WRITE (clear) addresses every slot of the
+        # label, so it probes all recorded keys — a stale scan followed
+        # by clear() loses foreign per-key writes just as surely as a
+        # per-key overwrite would
+        if key == "*":
+            # sorted: the first conflicting key wins the report, and
+            # "first" must not depend on PYTHONHASHSEED (each run's
+            # failure output is part of its reproducibility contract)
+            probe = tuple(sorted(
+                (k for (lb, k) in set(self._reads) | set(self._last_write)
+                 if lb == label),
+                key=repr,  # keys may mix str/bytes/ints with the "*"
+                #            sentinel: repr orders across types, so the
+                #            winning conflict stays hash-seed-independent
+            )) or ("*",)
+        else:
+            probe = (key, "*")
+        my_read = None
+        for k2 in probe:
+            r = self._reads.get((label, k2), {}).get(me)
+            if r is not None and (my_read is None or r > my_read):
+                my_read = r
+        if my_read is not None:
+            for k2 in probe:
+                lw = self._last_write.get((label, k2))
+                if lw is None:
+                    continue
+                w_actor, w_step = lw
+                if w_actor != me and my_read < w_step:
+                    if len(self.conflicts) < self.MAX_CONFLICTS:
+                        self.conflicts.append({
+                            "label": label, "key": key,
+                            "actor": me, "read_step": my_read,
+                            "writer": w_actor, "write_step": w_step,
+                            "step": self.step,
+                        })
+                    break
+        # this write consumes our pending read — BOTH probe slots: a
+        # wildcard scan that fed this write is consumed by it too, or a
+        # single stale scan would re-flag against every later write —
+        # and becomes the slot's latest write
+        for k2 in probe:
+            self._reads.get((label, k2), {}).pop(me, None)
+        self._last_write[(label, key)] = (me, self.step)
+
+
+class AuditedDict:
+    """A dict proxy reporting per-key access to the scheduler's
+    interleaving auditor. With no auditor installed the overhead is one
+    attribute check per operation — cheap enough to leave in soak
+    workloads permanently. Aggregate operations (iteration, len, bool,
+    items) read — and clear() writes — the wildcard slot "*", which
+    conflicts with every per-key access."""
+
+    __slots__ = ("_d", "_sched", "_label")
+
+    def __init__(self, sched: "Scheduler", label: str, initial=None):
+        self._d = dict(initial or {})
+        self._sched = sched
+        self._label = label
+
+    def _read(self, key) -> None:
+        a = self._sched.auditor
+        if a is not None:
+            a.record_read(self._label, key)
+
+    def _write(self, key) -> None:
+        a = self._sched.auditor
+        if a is not None:
+            a.record_write(self._label, key)
+
+    def __getitem__(self, key):
+        self._read(key)
+        return self._d[key]
+
+    def __setitem__(self, key, value) -> None:
+        self._write(key)
+        self._d[key] = value
+
+    def __delitem__(self, key) -> None:
+        self._read(key)  # presence check is an observation
+        if key in self._d:
+            self._write(key)  # only a real removal is a write
+        del self._d[key]
+
+    def __contains__(self, key) -> bool:
+        self._read(key)
+        return key in self._d
+
+    def get(self, key, default=None):
+        self._read(key)
+        return self._d.get(key, default)
+
+    def setdefault(self, key, default=None):
+        self._read(key)
+        if key not in self._d:
+            self._write(key)
+        return self._d.setdefault(key, default)
+
+    def pop(self, key, *default):
+        self._read(key)  # presence check is an observation
+        if key in self._d:
+            # only a real removal is a write: pop(absent, default)
+            # mutates nothing, and a phantom last_write here would
+            # frame this actor as the writer in a later conflict
+            self._write(key)
+        return self._d.pop(key, *default)
+
+    def update(self, other=(), **kw) -> None:
+        items = dict(other, **kw)
+        for k in items:
+            self._write(k)
+        self._d.update(items)
+
+    def clear(self) -> None:
+        self._write("*")
+        self._d.clear()
+
+    def keys(self):
+        self._read("*")
+        return self._d.keys()
+
+    def values(self):
+        self._read("*")
+        return self._d.values()
+
+    def items(self):
+        self._read("*")
+        return self._d.items()
+
+    def __iter__(self):
+        self._read("*")
+        return iter(self._d)
+
+    def __len__(self) -> int:
+        self._read("*")
+        return len(self._d)
+
+    def __bool__(self) -> bool:
+        self._read("*")
+        return bool(self._d)
+
+    def __eq__(self, other):
+        self._read("*")
+        return self._d == (other._d if isinstance(other, AuditedDict)
+                           else other)
+
+    def __repr__(self) -> str:
+        return f"AuditedDict({self._label!r}, {self._d!r})"
+
+
 class Task:
     """A spawned actor: drives a coroutine over Futures."""
 
@@ -317,6 +522,18 @@ class Task:
             # later) error is consumed by the cancel, not escaped
             self._waiting._mark_consumed()
             self._waiting = None
+        auditor = self._sched.auditor
+        if auditor is not None:
+            # the cancel throw still runs actor code (finally blocks
+            # may touch audited shared state): it is a step too
+            auditor.begin_step(self._name)
+        try:
+            self._step_throw_inner()
+        finally:
+            if auditor is not None:
+                auditor.end_step()
+
+    def _step_throw_inner(self) -> None:
         try:
             self._coro.throw(ActorCancelled())
         except (StopIteration, ActorCancelled):
@@ -334,9 +551,14 @@ class Task:
         # slow-task profiling measures WALL time on purpose: it reports
         # a step blocking the real run loop, not virtual time
         t0 = _time.perf_counter()  # flowcheck: ignore[determinism]
+        auditor = self._sched.auditor
+        if auditor is not None:
+            auditor.begin_step(self._name)
         try:
             self._step_inner(fut)
         finally:
+            if auditor is not None:
+                auditor.end_step()
             sched = self._sched
             elapsed = _time.perf_counter() - t0  # flowcheck: ignore[determinism]
             # fast path: two clock reads + one compare per step; the
@@ -419,16 +641,34 @@ class Scheduler:
     SLOW_TASK_THRESHOLD = 0.05
 
     def __init__(self, *, sim: bool = True, start_time: float = 0.0,
-                 profile: bool = False):
+                 profile: bool = False, audit: bool = False,
+                 perturb_seed: Optional[int] = None):
         self.sim = sim
         self._profile = profile
         # real mode anchors the clock to the wall on purpose
         self._now = start_time if sim else _time.monotonic()  # flowcheck: ignore[determinism]
         self._seq = 0
+        #: opt-in interleaving auditor (lost updates across yield
+        #: points on AuditedDict-tracked shared objects)
+        self.auditor: Optional[InterleavingAuditor] = (
+            InterleavingAuditor() if audit else None
+        )
+        #: schedule perturbation: a seeded tie-break among EQUALLY
+        #: RUNNABLE entries — same due time, same priority. Any such
+        #: order is a legal schedule; a correctness property that only
+        #: holds under FIFO tie order is a race. None = FIFO (the
+        #: historical order, byte-identical to pre-perturbation runs).
+        self._perturb_state: Optional[int] = (
+            None if perturb_seed is None
+            else (perturb_seed ^ 0x9E3779B97F4A7C15) & ((1 << 64) - 1)
+        )
         #: (actor name, error, done future) for every non-cancel actor
         #: crash; see unhandled_errors()
         self._maybe_unhandled: list[tuple[str, BaseException, Future]] = []
-        self._heap: list[tuple[float, int, int, Callable[[], None]]] = []
+        # (due, -priority, tie, seq, fn): `tie` is 0 under FIFO order
+        # and a seeded draw under perturbation; `seq` keeps comparisons
+        # off `fn` either way
+        self._heap: list[tuple[float, int, int, int, Callable[[], None]]] = []
         self._running = False
         #: per-actor-name step profile: [steps, total_wall_s, max_wall_s]
         #: — the ActorLineageProfiler collapsed to what a single-threaded
@@ -486,15 +726,40 @@ class Scheduler:
     def clear_unhandled(self) -> None:
         self._maybe_unhandled.clear()
 
+    # -- interleaving audit ------------------------------------------------
+
+    def audit_conflicts(self) -> list[dict]:
+        """Lost-update conflicts the interleaving auditor observed on
+        tracked shared objects (empty when auditing is off). Soak fails
+        a seed on any entry, like the unhandled-error ledger."""
+        return [] if self.auditor is None else list(self.auditor.conflicts)
+
     # -- time -------------------------------------------------------------
 
     def now(self) -> float:
         return self._now
 
+    def _tie(self) -> int:
+        """Next tie-break value: 0 (FIFO via seq) unless perturbing, in
+        which case a splitmix64 draw — deterministic per perturb_seed,
+        so a perturbed schedule is itself exactly reproducible."""
+        if self._perturb_state is None:
+            return 0
+        m = (1 << 64) - 1
+        self._perturb_state = (
+            self._perturb_state + 0x9E3779B97F4A7C15
+        ) & m
+        z = self._perturb_state
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & m
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & m
+        return z ^ (z >> 31)
+
     def _schedule(self, delay: float, priority: int, fn: Callable[[], None]) -> None:
         self._seq += 1
         due = self._now + max(0.0, delay)
-        heapq.heappush(self._heap, (due, -priority, self._seq, fn))
+        heapq.heappush(
+            self._heap, (due, -priority, self._tie(), self._seq, fn)
+        )
 
     def delay(self, seconds: float, priority: int = TaskPriority.DefaultDelay) -> Future:
         f = Future()
@@ -519,11 +784,11 @@ class Scheduler:
             while not fut.is_ready:
                 if not self._heap:
                     raise RuntimeError("deadlock: run queue drained, future unresolved")
-                due, negpri, seq, fn = heapq.heappop(self._heap)
+                due, negpri, tie, seq, fn = heapq.heappop(self._heap)
                 if due > self._now:
                     if due > max_time:
                         # Put the event back: a later run must still see it.
-                        heapq.heappush(self._heap, (due, negpri, seq, fn))
+                        heapq.heappush(self._heap, (due, negpri, tie, seq, fn))
                         raise TimeoutError(
                             f"virtual clock passed {max_time} awaiting future"
                         )

@@ -115,7 +115,8 @@ def plan_for_seed(seed: int, spec=None) -> SeedPlan:
 
 
 def run_seed(seed: int, spec=None, collect_probes: bool = False,
-             _inject_fault=None, _corrupt_api: bool = False):
+             _inject_fault=None, _corrupt_api: bool = False,
+             perturb: int = 0, _inject_race: bool = False):
     """Run one ensemble seed under a named spec; returns the
     deterministic signature (and, with collect_probes, the CODE_PROBE
     hit snapshot for ensemble coverage accounting — the Joshua side of
@@ -123,10 +124,18 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
 
     A seed FAILS on any unhandled actor error (an exception that
     escaped its actor and was never consumed by an awaiter,
-    Scheduler.unhandled_errors), on any workload model-check mismatch,
-    and — when the plan runs the api workload — on any divergence
-    between the real client's reads/commit decisions and the
-    sequential model (testing/api_workload.py).
+    Scheduler.unhandled_errors), on any interleaving conflict the
+    auditor observes on tracked shared objects (spec policy.audit), on
+    any workload model-check mismatch, and — when the plan runs the
+    api workload — on any divergence between the real client's
+    reads/commit decisions and the sequential model
+    (testing/api_workload.py).
+
+    `perturb` > 0 re-runs the SAME seed under seeded randomized
+    tie-breaking among equally-runnable actors (runtime/flow.py's
+    schedule perturbation): any such order is a legal schedule, so
+    every check above must still hold, and each (seed, perturb) pair
+    is itself exactly reproducible.
 
     `_inject_fault` is the gate's self-test hook (tests/test_soak.py):
     an async callable(sched, cluster, db) spawned as a fire-and-forget
@@ -134,6 +143,9 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
     `_corrupt_api` is the api checker's self-test hook: it corrupts
     committed api keys on every replica behind the transaction
     system's back, so the model cross-check must fail the seed.
+    `_inject_race` is the AUDITOR's self-test hook: two well-behaved-
+    looking actors RMW one shared audited key across an await — the
+    seed must fail iff the spec's auditor is on.
     """
     from foundationdb_tpu.cluster.commit_proxy import (
         CommitUnknownResult,
@@ -188,10 +200,22 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
 
     window = 1_000_000 if plan.small_window else 5_000_000
     from foundationdb_tpu.cluster.database import ClusterConfig as _CC
+    from foundationdb_tpu.runtime.flow import AuditedDict, Scheduler
 
     kernel_config = _CC.kernel_config.scaled(window_versions=window)
     try:
-        sched, cluster, db = open_cluster(
+        # the scheduler is built HERE (not by open_cluster) so the spec
+        # can arm the interleaving auditor and a perturbation id can
+        # reseed the tie-break; perturb=0 is byte-identical FIFO order
+        sched = Scheduler(
+            sim=True,
+            audit=bool(spec.policy.get("audit")),
+            perturb_seed=(
+                None if not perturb
+                else (seed * 1_000_003 + perturb) & ((1 << 63) - 1)
+            ),
+        )
+        _s, cluster, db = open_cluster(
             ClusterConfig(
                 n_commit_proxies=plan.n_commit_proxies,
                 n_resolvers=plan.n_resolvers,
@@ -200,11 +224,21 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
                 n_tlogs=plan.n_tlogs,
                 sim_seed=seed,
                 kernel_config=kernel_config,
-            )
+            ),
+            sched=sched,
         )
         rng = np.random.default_rng(seed)
+        # `possible` stays a PLAIN dict on purpose: the workload and the
+        # laggard deliberately overlap on s29 with carefully-widened
+        # allowed-value sets (commit-certainty overwrites are the
+        # model's semantics, not a lost update) — auditing it would
+        # flag that contract. The counters below have no such contract:
+        # any cross-actor RMW interleaving on them IS a bug.
         possible: dict[bytes, set] = {}
-        outcome = {"committed": 0, "aborted": 0, "read_checks": 0}
+        outcome = AuditedDict(
+            sched, "soak.outcome",
+            {"committed": 0, "aborted": 0, "read_checks": 0},
+        )
         if plan.tag_quota:
             # a "batch"-tagged workload slice throttled at the front door
             cluster.ratekeeper.set_tag_quota("batch", 12.0)
@@ -261,7 +295,9 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
                     outcome["aborted"] += 1
                     await sched.delay(0.01)
 
-        atomic_state = {"known": 0, "unknown": []}
+        atomic_state = AuditedDict(
+            sched, "soak.atomic", {"known": 0, "unknown": []}
+        )
 
         async def atomic_ops():
             """AtomicOps.actor.cpp in miniature: a stream of atomic
@@ -283,7 +319,9 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
                 if rng.random() < 0.3:
                     await sched.delay(0.02)
 
-        backup_state = {"agent": None, "container": None}
+        backup_state = AuditedDict(
+            sched, "soak.backup", {"agent": None, "container": None}
+        )
 
         async def backup_flow():
             """BackupToDBCorrectness in miniature: snapshot + log
@@ -587,8 +625,16 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
             # committed batches the log never made durable) makes
             # "every NotCommitted has a visible conflicting writer"
             # unsound, so the stronger abort audit only arms on plans
-            # without those fault classes
-            strict = not (
+            # without those fault classes — and only with ONE resolver:
+            # with more, the ResolutionBalancer's range moves inject
+            # synthetic conservative writes over the moved span (the
+            # receiving resolver's empty history must not miss stale
+            # reads, commit_proxy.conservative_writes), so a read below
+            # the transition version aborts with no client writer to
+            # explain it. Found by the PR-3 perturbation sweep at
+            # api_correctness seed 60 (pre-existing; pinned in
+            # test_soak).
+            strict = plan.n_resolvers == 1 and not (
                 plan.kill_proxy or plan.kill_tlog or plan.crash_tlog
                 or plan.coordinator_outage or plan.usurper
                 or plan.duplicate_resolve or plan.knob_quorum
@@ -609,6 +655,22 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
                 sched.spawn(coro, name=f"soak-api-{i}").done
                 for i, coro in enumerate(api.actor_coros())
             )
+        if _inject_race:
+            # the auditor's divergence self-test: two actors RMW one
+            # audited key across an await — both complete cleanly, so
+            # ONLY the interleaving auditor can catch the lost update
+            race_d = AuditedDict(sched, "selftest.race", {"n": 0})
+
+            async def racer():
+                await sched.delay(0.02)
+                v = race_d["n"]
+                await sched.delay(0.013)
+                # the race is the POINT (the rule and the auditor both
+                # catching this same fixture is the layers agreeing)
+                race_d["n"] = v + 1  # flowcheck: ignore[flow.rmw-across-wait]
+
+            tasks.append(sched.spawn(racer(), name="race-a").done)
+            tasks.append(sched.spawn(racer(), name="race-b").done)
         if _inject_fault is not None:
             # deliberately unobserved: the unhandled-error gate below
             # must catch whatever this actor lets escape
@@ -715,6 +777,18 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
             sched.run_until(sched.spawn(api.verify()).done)
 
         check_cluster(cluster)
+        # the interleaving-audit gate: a lost-update conflict on a
+        # tracked shared object fails the seed like an unhandled error
+        conflicts = sched.audit_conflicts()
+        assert not conflicts, (
+            f"seed {seed}: {len(conflicts)} interleaving conflict(s): "
+            + "; ".join(
+                f"{c['label']}[{c['key']!r}]: {c['actor']} wrote from a "
+                f"step-{c['read_step']} read over {c['writer']}'s "
+                f"step-{c['write_step']} write"
+                for c in conflicts[:3]
+            )
+        )
         # the unhandled-actor-error gate: any exception that escaped an
         # actor with no awaiter ever consuming it fails the seed
         escaped = sched.unhandled_errors()

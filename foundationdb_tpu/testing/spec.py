@@ -183,9 +183,15 @@ class SoakSpec:
                 f"spec {self.name!r}: policy.determinism_every must be a "
                 f"positive int, got {de!r}"
             )
+        audit = self.policy.get("audit")
+        if not isinstance(audit, bool):
+            raise SpecError(
+                f"spec {self.name!r}: policy.audit must be a bool (the "
+                f"interleaving-auditor knob), got {audit!r}"
+            )
         unknown = set(self.policy) - {
             "randomize_knobs", "small_window", "resolver_backends",
-            "determinism_every",
+            "determinism_every", "audit",
         }
         if unknown:
             raise SpecError(

@@ -1,22 +1,32 @@
 #!/usr/bin/env bash
 # The verify path: flowcheck gate first (cheap, seconds), then the
-# tier-1 pytest lane (-m 'not slow' — the ROADMAP verify contract;
-# note this INCLUDES the compile-heavy `kernel` tests, exactly like
-# tier-1). Extra args pass through to pytest:
+# spec smoke lanes, then the tier-1 pytest lane (-m 'not slow' — the
+# ROADMAP verify contract; note this INCLUDES the compile-heavy
+# `kernel` tests, exactly like tier-1). Extra args pass through to
+# pytest:
 #
-#   scripts/check.sh                          # gate + tier-1 lane
+#   scripts/check.sh                          # gate + smoke + tier-1 lane
 #   scripts/check.sh -m 'not slow and not kernel'  # skip compiles too
 #
 # flowcheck exits nonzero on any NEW violation (baselined findings in
-# foundationdb_tpu/analysis/baseline.json don't fail; see README).
+# foundationdb_tpu/analysis/baseline.json don't fail; the baseline is
+# EMPTY and stays that way) and on stale `# flowcheck: ignore` comments.
+# The gate's wall time is printed so cost regressions in the static
+# pass (it now includes the flow.* dataflow rules) are visible in CI
+# output, not discovered by feel.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== flowcheck (python -m foundationdb_tpu.analysis) =="
+t0=$(date +%s.%N)
 JAX_PLATFORMS=cpu python -m foundationdb_tpu.analysis
+t1=$(date +%s.%N)
+awk -v a="$t0" -v b="$t1" 'BEGIN {printf "flowcheck wall time: %.1fs\n", b - a}'
 
-echo "== spec smoke (1 short seed per checked-in spec, api workload on) =="
-JAX_PLATFORMS=cpu python scripts/soak.py --smoke
+echo "== spec + perturbation smoke (1 short seed per spec, then the same =="
+echo "== seed x 3 schedule perturbations, api workload + auditor on)    =="
+# --perturb runs the unperturbed base seed first, so one lane covers both
+JAX_PLATFORMS=cpu python scripts/soak.py --smoke --perturb 3
 
 echo "== pytest (fast lane: -m 'not slow') =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -1,71 +1,93 @@
 #!/usr/bin/env python
-"""Scan-fused vs sequential dispatch at bench shapes, on the real device."""
+"""CODE_PROBE accounting CLI — a thin shell over the analysis module.
 
-import time
+    python scripts/probe_scan.py            # probe -> declaring file + use sites
+    python scripts/probe_scan.py --uses     # per-probe code_probe() call sites
+    python scripts/probe_scan.py --check    # exit 1 on manifest drift
 
-import jax
-import numpy as np
+Everything here is derived from ONE source of truth: the walker's
+parsed tree and `analysis/probe_manifest.json`
+(`foundationdb_tpu/analysis/rules_probes.py` + `manifest.py`). This
+script adds no scanning logic of its own — if the numbers here and the
+flowcheck gate ever disagree, that is a bug in the analysis module,
+not two scanners drifting apart.
+"""
 
-from foundationdb_tpu.utils import compile_cache
+import argparse
+import os
+import sys
 
-compile_cache.enable()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from foundationdb_tpu.config import KernelConfig
-from foundationdb_tpu.models.conflict_set import TpuConflictSet
-from foundationdb_tpu.testing.benchgen import skiplist_style_batch
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-N = 65536
-cap = N
-config = KernelConfig(
-    max_key_bytes=8, max_txns=cap, max_reads=cap, max_writes=cap,
-    history_capacity=12 * cap, window_versions=1_000_000,
-)
-rng = np.random.default_rng(0)
-batches = [
-    skiplist_style_batch(
-        rng, config, N, version=(i + 1) * 200_000, keyspace=1_000_000,
-        key_bytes=8, snapshot_lag=400_000,
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--uses", action="store_true",
+        help="list every code_probe() call site per probe name",
     )
-    for i in range(8)
-]
-print("generated", flush=True)
+    ap.add_argument(
+        "--check", action="store_true",
+        help="verify probe_manifest.json matches the tree (exit 1 on "
+             "drift; the same comparison the flowcheck gate makes)",
+    )
+    args = ap.parse_args()
 
-dev = [jax.device_put(b.device_args()) for b in batches]
-jax.block_until_ready(dev)
+    from pathlib import Path
 
-# sequential
-cs = TpuConflictSet(config)
-outs = [cs.resolve_args(d) for d in dev[:2]]  # warm
-jax.block_until_ready(outs[-1].verdict)
-cs = TpuConflictSet(config)
-t0 = time.perf_counter()
-outs = [cs.resolve_args(d) for d in dev]
-jax.block_until_ready(outs[-1].verdict)
-seq = time.perf_counter() - t0
-print(f"sequential: {seq*1e3:.0f}ms total, {seq/8*1e3:.0f}ms/batch, "
-      f"{N*8/seq:,.0f} txn/s", flush=True)
+    from foundationdb_tpu.analysis import walker
+    from foundationdb_tpu.analysis.manifest import load_manifest
+    from foundationdb_tpu.analysis.rules_probes import (
+        collect_probes,
+        manifest_of,
+        probe_contexts,
+    )
 
-# fused groups of 4
-from foundationdb_tpu.utils.packing import stack_device_args
+    # parse contexts directly — probe accounting needs the walker's
+    # trees, not the whole rule suite (the flowcheck gate runs that)
+    root = Path(__file__).resolve().parents[1]
+    ctxs = []
+    for path in walker.discover(root):
+        try:
+            ctxs.append(walker.parse_file(root, path))
+        except SyntaxError as e:
+            print(f"parse error: {path}: {e}", file=sys.stderr)
+            return 1
+    declares, uses, dynamic = collect_probes(probe_contexts(ctxs))
+    stored = load_manifest()
+    derived = manifest_of(declares)
 
-groups = [
-    jax.device_put(stack_device_args(batches[g:g + 4]))
-    for g in range(0, 8, 4)
-]
-jax.block_until_ready(groups)
-warm = TpuConflictSet(config)
-warm.resolve_args_scan(groups[0])
-jax.block_until_ready(warm.state)
-cs2 = TpuConflictSet(config)
-t0 = time.perf_counter()
-fouts = [cs2.resolve_args_scan(g) for g in groups]
-jax.block_until_ready(fouts[-1].verdict)
-fus = time.perf_counter() - t0
-print(f"fused x4:   {fus*1e3:.0f}ms total, {fus/8*1e3:.0f}ms/batch, "
-      f"{N*8/fus:,.0f} txn/s", flush=True)
+    if args.check:
+        if stored == derived:
+            print(f"probe manifest current: {len(stored)} probes")
+            return 0
+        missing = sorted(set(derived) - set(stored))
+        stale = sorted(set(stored) - set(derived))
+        if missing:
+            print(f"not in manifest: {missing}")
+        if stale:
+            print(f"stale in manifest: {stale}")
+        print("run: python -m foundationdb_tpu.analysis --write-manifest")
+        return 1
 
-for i in (0, 3, 7):
-    a = np.asarray(outs[i].verdict)
-    b = np.asarray(fouts[i // 4].verdict[i % 4])
-    assert (a == b).all(), i
-print("parity ok", flush=True)
+    for name in sorted(derived):
+        sites = uses.get(name, [])
+        print(f"{name:44s} {derived[name]}  ({len(sites)} use site(s))")
+        if args.uses:
+            for ctx, node in sites:
+                print(f"    {ctx.path}:{node.lineno}")
+    undeclared = sorted(set(uses) - set(declares))
+    if undeclared:
+        print(f"\nused but never declared ({len(undeclared)}): {undeclared}")
+    if dynamic:
+        print(f"dynamic-name call sites: {len(dynamic)}")
+    if stored != derived:
+        print("\nWARNING: probe_manifest.json is stale (--check for detail)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

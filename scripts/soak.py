@@ -31,8 +31,23 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ["JAX_PLATFORMS"] = "cpu"  # force off any device tunnel (sim is CPU-only)
 
 
+def _perturbed_rerun(seed, spec, pid, spec_label):
+    """One perturbed re-run with the (seed, perturb) pair named in any
+    failure — run_seed's own asserts only know the seed, and a report
+    that can't be reproduced is no report (both sweep and smoke lanes
+    share this)."""
+    from foundationdb_tpu.testing import soak
+
+    try:
+        return soak.run_seed(seed, spec=spec, perturb=pid)
+    except Exception as e:
+        raise AssertionError(
+            f"seed {seed} perturb {pid} (spec {spec_label}): {e}"
+        ) from e
+
+
 def _one(args):
-    seed, spec_name, check_determinism = args
+    seed, spec_name, check_determinism, perturb = args
     from foundationdb_tpu.testing import soak
 
     t0 = time.perf_counter()
@@ -44,17 +59,39 @@ def _one(args):
                 f"seed {seed} (spec {spec_name}): NONDETERMINISTIC\n"
                 f"  run1: {sig}\n  run2: {sig2}"
             )
+    # Schedule perturbation: each perturbation id reruns the seed under
+    # seeded randomized tie-breaking among equally-runnable actors. A
+    # perturbed order is a LEGAL schedule, so every gate must still
+    # pass (model checks, interleaving auditor, unhandled-error gate);
+    # outcome COUNTS may legitimately differ (different conflict
+    # winners are different legal executions). What must be identical
+    # is each perturbed schedule with itself: on determinism-cadence
+    # seeds every (seed, perturb) pair runs twice and must match —
+    # the unseed-determinism contract extended to perturbed schedules.
+    for pid in range(1, perturb + 1):
+        psig = _perturbed_rerun(seed, spec_name, pid, spec_name)
+        if check_determinism:
+            psig2 = soak.run_seed(seed, spec=spec_name, perturb=pid)
+            if psig != psig2:
+                raise AssertionError(
+                    f"seed {seed} perturb {pid} (spec {spec_name}): "
+                    f"NONDETERMINISTIC\n  run1: {psig}\n  run2: {psig2}"
+                )
     return seed, sig, time.perf_counter() - t0, check_determinism, hits
 
 
-def sweep(spec_name: str, seeds: list, jobs: int, probe_gate: bool) -> int:
+def sweep(spec_name: str, seeds: list, jobs: int, probe_gate: bool,
+          perturb: int = 0) -> int:
     """Run one spec's seed sweep; returns the number of failures."""
     from foundationdb_tpu.testing.spec import load_spec
     from foundationdb_tpu.utils import probes as _probes
 
     spec = load_spec(spec_name)
     det_every = spec.policy["determinism_every"]
-    work = [(s, spec_name, i % det_every == 0) for i, s in enumerate(seeds)]
+    work = [
+        (s, spec_name, i % det_every == 0, perturb)
+        for i, s in enumerate(seeds)
+    ]
     t0 = time.perf_counter()
     failures = []
     done = 0
@@ -102,7 +139,8 @@ def sweep(spec_name: str, seeds: list, jobs: int, probe_gate: bool) -> int:
     wall = time.perf_counter() - t0
     print(
         f"\n[{spec_name}] {done}/{len(seeds)} seeds passed in {wall:.0f}s "
-        f"({jobs} jobs); committed={committed} aborted={aborted} "
+        f"({jobs} jobs, {perturb} perturbation(s)/seed); "
+        f"committed={committed} aborted={aborted} "
         f"read_checks={rechecks} api_acked={api_acked} "
         f"api_reads_checked={api_reads} determinism_checked={det_checked}"
     )
@@ -151,6 +189,13 @@ def main():
         "--probe-gate", action="store_true",
         help="fail the sweep if a spec-expected probe never fires",
     )
+    ap.add_argument(
+        "--perturb", type=int, default=0, metavar="K",
+        help="re-run each seed K extra times under seeded randomized "
+             "tie-breaking among equally-runnable actors; every gate "
+             "must still pass and each (seed, perturbation) must be "
+             "exactly reproducible",
+    )
     args = ap.parse_args()
 
     from foundationdb_tpu.utils import probes as _probes
@@ -182,10 +227,16 @@ def main():
             t0 = time.perf_counter()
             try:
                 sig = soak.run_seed(args.start, spec=spec)
+                # the perturbation smoke lane: K reorderings of the
+                # same smoke seed must all pass every gate
+                for pid in range(1, args.perturb + 1):
+                    _perturbed_rerun(args.start, spec, pid, name)
                 print(
                     f"spec {name:16s} seed {args.start} ok in "
                     f"{time.perf_counter() - t0:4.1f}s  "
-                    f"committed={sig[1]} api={sig[7]}",
+                    f"committed={sig[1]} api={sig[7]}"
+                    + (f"  [perturb x{args.perturb} OK]"
+                       if args.perturb else ""),
                     flush=True,
                 )
             except Exception as e:
@@ -196,7 +247,7 @@ def main():
         return
 
     seeds = list(range(args.start, args.start + args.seeds))
-    if sweep(args.spec, seeds, args.jobs, args.probe_gate):
+    if sweep(args.spec, seeds, args.jobs, args.probe_gate, args.perturb):
         sys.exit(1)
 
 

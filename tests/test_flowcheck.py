@@ -400,3 +400,469 @@ def test_cli_entrypoint_exits_zero():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "0 new" in proc.stdout
+
+
+# -- flow family (stale state across a wait; analysis/cfg.py dataflow) ------
+
+
+def test_stale_guard_across_wait_flagged_and_fixed_shape_clean():
+    """The storage.py bug class: a request validated against mutable
+    shared state, awaited past, never re-validated. The PR-2 fix shape
+    (re-read + re-raise after the wait) must be clean."""
+    old = (
+        "class SS:\n"
+        "    def _gc(self, floor):\n"
+        "        self.oldest_version = floor\n"
+        "    async def _wait_for_version(self, version):\n"
+        "        if version < self.oldest_version:\n"
+        "            raise ValueError(version)\n"
+        "        await self.version.when_at_least(version)\n"
+    )
+    got = analyze_source(old, SIM)
+    assert rules_of(got) == ["flow.stale-read-across-wait"]
+    assert got[0].line == 5  # the guard, where the fix belongs
+    fixed = old + (
+        "        if version < self.oldest_version:\n"
+        "            raise ValueError(version)\n"
+    )
+    assert analyze_source(fixed, SIM) == []
+
+
+def test_reintroducing_storage_stale_floor_read_is_caught():
+    """THE acceptance pin: surgically revert the PR-2 fix in the REAL
+    cluster/storage.py (drop the post-wait floor re-validation) and the
+    gate must catch it as flow.stale-read-across-wait; the shipped file
+    stays clean. If storage.py's read path is ever refactored out from
+    under this surgery, fail loudly rather than silently un-pin."""
+    src = (REPO / "foundationdb_tpu/cluster/storage.py").read_text()
+    marker = "await self.version.when_at_least(version)"
+    assert marker in src, "storage.py _wait_for_version moved: re-pin"
+    tail = src.index(marker) + len(marker)
+    recheck_end = src.index("raise TransactionTooOld(version)", tail)
+    recheck_end = src.index("\n", recheck_end)
+    reverted = src[:tail] + src[recheck_end:]
+    path = "foundationdb_tpu/cluster/storage.py"
+    assert analyze_source(src, path) == []  # shipped file: clean
+    got = analyze_source(reverted, path)
+    assert "flow.stale-read-across-wait" in rules_of(got), (
+        "the reverted stale-floor read escaped the gate:\n"
+        + "\n".join(f.render() for f in got)
+    )
+
+
+def test_rmw_across_wait_flagged():
+    src = (
+        "class C:\n"
+        "    def bump(self):\n"
+        "        self.n = 1\n"
+        "    async def racy(self, sched):\n"
+        "        v = self.n\n"
+        "        await sched.delay(0.1)\n"
+        "        self.n = v + 1\n"
+    )
+    got = analyze_source(src, SIM)
+    assert rules_of(got) == ["flow.rmw-across-wait"]
+    assert got[0].line == 7  # the lossy write
+    # re-reading after the wait is the fix
+    ok = src.replace(
+        "        self.n = v + 1\n",
+        "        v = self.n\n        self.n = v + 1\n",
+    )
+    assert analyze_source(ok, SIM) == []
+
+
+def test_one_statement_rmw_forms_flagged():
+    """`self.x = await f(self.x)` and `self.x += await f()` both split
+    a read-modify-write across a yield point inside one statement."""
+    a = (
+        "class C:\n"
+        "    def bump(self):\n"
+        "        self.x = 1\n"
+        "    async def f(self, svc):\n"
+        "        self.x = await svc.next(self.x)\n"
+    )
+    assert rules_of(analyze_source(a, SIM)) == ["flow.rmw-across-wait"]
+    b = (
+        "class C:\n"
+        "    def bump(self):\n"
+        "        self.x = 1\n"
+        "    async def f(self, svc):\n"
+        "        self.x += await svc.next()\n"
+    )
+    assert rules_of(analyze_source(b, SIM)) == ["flow.rmw-across-wait"]
+    # consecutive statements are NOT one statement: read for logging,
+    # then an unrelated fresh write, is not an RMW
+    c = (
+        "class C:\n"
+        "    def bump(self):\n"
+        "        self.x = 1\n"
+        "    async def f(self, svc, log):\n"
+        "        log(self.x)\n"
+        "        await svc.pause()\n"
+        "        self.x = 0\n"
+    )
+    assert analyze_source(c, SIM) == []
+
+
+def test_guard_not_rechecked_check_calls():
+    """The double-_check_shard_floor discipline: an invariant-check
+    call taking a request parameter, awaited past, must repeat."""
+    bad = (
+        "class C:\n"
+        "    def poke(self):\n"
+        "        self.floor = 1\n"
+        "    def _check_bounds(self, lo, hi, version):\n"
+        "        pass\n"
+        "    async def read(self, lo, hi, version, sched):\n"
+        "        self._check_bounds(lo, hi, version)\n"
+        "        await sched.delay(0.1)\n"
+        "        return self.data\n"
+    )
+    got = analyze_source(bad, SIM)
+    assert rules_of(got) == ["flow.guard-not-rechecked"]
+    ok = bad.replace(
+        "        return self.data\n",
+        "        self._check_bounds(lo, hi, version)\n"
+        "        return self.data\n",
+    )
+    assert analyze_source(ok, SIM) == []
+    # a check over pure locals (not request parameters) is out of scope
+    local_only = (
+        "class C:\n"
+        "    def poke(self):\n"
+        "        self.floor = 1\n"
+        "    def _check_rows(self, rows):\n"
+        "        pass\n"
+        "    async def read(self, sched):\n"
+        "        rows = [1]\n"
+        "        self._check_rows(rows)\n"
+        "        await sched.delay(0.1)\n"
+        "        return rows\n"
+    )
+    assert analyze_source(local_only, SIM) == []
+
+
+def test_assert_subject_awaited_past():
+    src = (
+        "class C:\n"
+        "    def poke(self):\n"
+        "        self.hi = 9\n"
+        "    async def f(self, v, sched):\n"
+        "        assert v < self.hi\n"
+        "        await sched.delay(0.1)\n"
+        "        return v\n"
+    )
+    got = analyze_source(src, SIM)
+    assert rules_of(got) == ["flow.guard-not-rechecked"]
+    ok = src.replace(
+        "        return v\n",
+        "        assert v < self.hi\n        return v\n",
+    )
+    assert analyze_source(ok, SIM) == []
+
+
+def test_snapshot_local_guarding_after_wait():
+    """A local snapshot of shared state used as a guard after a wait is
+    stale; dereferencing an ALIAS (attr access through it) is a live
+    read and stays clean, as does a snapshot taken FROM an awaited call
+    (fresh as of its own yield point)."""
+    bad = (
+        "class C:\n"
+        "    def poke(self):\n"
+        "        self.live = 1\n"
+        "    async def f(self, sched, act):\n"
+        "        up = self.live\n"
+        "        await sched.delay(0.1)\n"
+        "        if up:\n"
+        "            act()\n"
+    )
+    assert rules_of(analyze_source(bad, SIM)) == [
+        "flow.stale-read-across-wait"
+    ]
+    # re-reading the source after the wait clears it
+    ok = bad.replace(
+        "        if up:\n",
+        "        up = self.live\n        if up:\n",
+    )
+    assert analyze_source(ok, SIM) == []
+    # `if stale or self.live:` — the same-test re-read idiom is clean
+    same_test = bad.replace("        if up:\n", "        if up or self.live:\n")
+    assert analyze_source(same_test, SIM) == []
+    # alias deref: reads THROUGH the local are live, not snapshots
+    alias = (
+        "class C:\n"
+        "    def poke(self):\n"
+        "        self.slots = {}\n"
+        "    async def f(self, pid, sched, act):\n"
+        "        st = self.slots.setdefault(pid, object())\n"
+        "        await sched.delay(0.1)\n"
+        "        if st.ready:\n"
+        "            act()\n"
+    )
+    assert analyze_source(alias, SIM) == []
+    # value born AT a yield point (await in the RHS) is not a pre-wait
+    # snapshot
+    fresh = (
+        "class C:\n"
+        "    def poke(self):\n"
+        "        self.v = 1\n"
+        "    async def f(self, src, sched, act):\n"
+        "        items = await src.peek(self.v)\n"
+        "        await sched.delay(0.1)\n"
+        "        if items:\n"
+        "            act()\n"
+    )
+    assert analyze_source(fresh, SIM) == []
+
+
+def test_flow_rules_scope_and_suppression():
+    src = (
+        "class C:\n"
+        "    def bump(self):\n"
+        "        self.n = 1\n"
+        "    async def racy(self, sched):\n"
+        "        v = self.n\n"
+        "        await sched.delay(0.1)\n"
+        "        self.n = v + 1\n"
+    )
+    assert analyze_source(src, OUT) == []  # real-I/O side: out of scope
+    sup = src.replace(
+        "        self.n = v + 1\n",
+        "        self.n = v + 1  # flowcheck: ignore[flow.rmw-across-wait]\n",
+    )
+    assert analyze_source(sup, SIM) == []
+
+
+# -- walker blind spots (nested/decorated actors, comprehension awaits) ----
+
+
+def test_nested_async_defs_are_walked():
+    """The soak-workload shape: actors nested inside a driver function,
+    racing on a captured mutable dict — the classic blind spot."""
+    src = (
+        "def run(sched):\n"
+        "    state = {'n': 0}\n"
+        "    async def racer():\n"
+        "        v = state['n']\n"
+        "        await sched.delay(0.1)\n"
+        "        state['n'] = v + 1\n"
+        "    return racer\n"
+    )
+    assert rules_of(analyze_source(src, SIM)) == ["flow.rmw-across-wait"]
+
+
+def test_decorated_actors_are_walked():
+    src = (
+        "def actor(fn):\n"
+        "    return fn\n"
+        "class C:\n"
+        "    def bump(self):\n"
+        "        self.n = 1\n"
+        "    @actor\n"
+        "    async def racy(self, sched):\n"
+        "        v = self.n\n"
+        "        await sched.delay(0.1)\n"
+        "        self.n = v + 1\n"
+    )
+    assert rules_of(analyze_source(src, SIM)) == ["flow.rmw-across-wait"]
+
+
+def test_await_inside_comprehension_is_a_yield_point():
+    """`[await f() ...]` suspends the enclosing actor per element: a
+    comprehension await between read and write is still an RMW split."""
+    src = (
+        "class C:\n"
+        "    def bump(self):\n"
+        "        self.n = 1\n"
+        "    async def racy(self, jobs):\n"
+        "        v = self.n\n"
+        "        outs = [await j.run() for j in jobs]\n"
+        "        self.n = v + len(outs)\n"
+    )
+    assert rules_of(analyze_source(src, SIM)) == ["flow.rmw-across-wait"]
+
+
+def test_async_for_and_async_with_are_yield_points():
+    base = (
+        "class C:\n"
+        "    def bump(self):\n"
+        "        self.n = 1\n"
+        "    async def racy(self, stream):\n"
+        "        v = self.n\n"
+        "        async for _item in stream:\n"
+        "            pass\n"
+        "        self.n = v + 1\n"
+    )
+    assert "flow.rmw-across-wait" in rules_of(analyze_source(base, SIM))
+    ctx = (
+        "class C:\n"
+        "    def bump(self):\n"
+        "        self.n = 1\n"
+        "    async def racy(self, lock):\n"
+        "        v = self.n\n"
+        "        async with lock:\n"
+        "            self.n = v + 1\n"
+    )
+    assert "flow.rmw-across-wait" in rules_of(analyze_source(ctx, SIM))
+
+
+# -- the stale-suppression audit -------------------------------------------
+
+
+def test_stale_ignore_comments_are_findings(tmp_path):
+    """A '# flowcheck: ignore[...]' that suppresses nothing is itself a
+    finding (dead ignores must not accumulate); a LIVE ignore is not."""
+    pkg = tmp_path / "foundationdb_tpu" / "cluster"
+    pkg.mkdir(parents=True)
+    (pkg / "fix.py").write_text(
+        "import time\n"
+        "def live():\n"
+        "    return time.time()  # flowcheck: ignore[determinism.wall-clock]\n"
+        "def dead(x):\n"
+        "    return x  # flowcheck: ignore[actor.swallow]\n"
+    )
+    result = run_analysis(
+        root=tmp_path,
+        baseline_path=tmp_path / "baseline.json",
+        manifest_path=tmp_path / "manifest.json",
+    )
+    stale = [f for f in result.new if f.rule == "flowcheck.stale-ignore"]
+    assert len(stale) == 1 and stale[0].line == 5, [
+        f.render() for f in result.new
+    ]
+    assert "actor.swallow" in stale[0].message
+    # the live ignore on line 3 produced no stale finding
+    assert not any(f.line == 3 for f in stale)
+    # and a stale ignore FAILS the gate (it lands in result.new)
+    assert not result.ok
+
+
+def test_live_tree_has_no_stale_ignores():
+    """Every suppression currently in the tree absorbs a real finding —
+    the audit that keeps PR-era justifications from outliving their
+    violations. (Subsumed by test_live_tree_has_zero_new_violations,
+    pinned separately so a failure names the right contract.)"""
+    result = run_analysis(root=REPO)
+    stale = [
+        f for f in result.findings if f.rule == "flowcheck.stale-ignore"
+    ]
+    assert stale == [], "\n".join(f.render() for f in stale)
+
+
+def test_flow_family_in_catalog():
+    from foundationdb_tpu.analysis import registry
+
+    registry.load_rules()
+    families = {r.family for r in registry.RULES.values()}
+    assert "flow" in families and "flowcheck" in families
+    assert {
+        "flow.stale-read-across-wait", "flow.rmw-across-wait",
+        "flow.guard-not-rechecked", "flowcheck.stale-ignore",
+    } <= set(registry.RULES)
+
+
+def test_bare_comprehension_of_coroutines_flagged():
+    """`[worker() for w in ws]` as a statement builds coroutines nobody
+    awaits — the comprehension variant of the bare-call blind spot."""
+    src = (
+        "async def worker(w):\n    pass\n\n"
+        "def f(ws):\n    [worker(w) for w in ws]\n"
+    )
+    assert rules_of(analyze_source(src, SIM)) == ["actor.unawaited-future"]
+    spawned = (
+        "def f(sched, coros):\n    [sched.spawn(c) for c in coros]\n"
+    )
+    assert rules_of(analyze_source(spawned, SIM)) == [
+        "actor.fire-and-forget"
+    ]
+    # keeping the results is fine
+    ok = (
+        "def f(sched, coros):\n"
+        "    return [sched.spawn(c) for c in coros]\n"
+    )
+    assert analyze_source(ok, SIM) == []
+
+
+def test_loop_else_runs_on_exhaustion_not_break():
+    """Loop `else` lowering: the else body belongs to the EXHAUSTION
+    edge only — a break path never executes it, so an else-clause
+    re-read must not launder the break path's stale snapshot."""
+    src = (
+        "class C:\n"
+        "    def poke(self):\n"
+        "        self.live = 1\n"
+        "    async def f(self, sched, act, cond):\n"
+        "        up = self.live\n"
+        "        await sched.delay(0.1)\n"
+        "        while cond():\n"
+        "            break\n"
+        "        else:\n"
+        "            up = self.live\n"
+        "        if up:\n"
+        "            act()\n"
+    )
+    assert rules_of(analyze_source(src, SIM)) == [
+        "flow.stale-read-across-wait"
+    ]
+    # without the break, exhaustion DOES run the else: clean
+    no_break = src.replace("            break\n", "            pass\n")
+    assert analyze_source(no_break, SIM) == []
+
+
+def test_bare_dict_comprehension_of_coroutines_flagged():
+    src = (
+        "async def worker(w):\n    pass\n\n"
+        "def f(ws):\n    {worker(w): 1 for w in ws}\n"
+    )
+    assert rules_of(analyze_source(src, SIM)) == ["actor.unawaited-future"]
+
+
+def test_exhaustive_match_has_no_phantom_fallthrough():
+    """`case _:` always matches: the CFG must not add a no-case edge
+    that bypasses every arm's re-read."""
+    src = (
+        "class C:\n"
+        "    def poke(self):\n"
+        "        self.live = 1\n"
+        "    async def f(self, sched, act, x):\n"
+        "        up = self.live\n"
+        "        await sched.delay(0.1)\n"
+        "        match x:\n"
+        "            case 1:\n"
+        "                up = self.live\n"
+        "            case _:\n"
+        "                up = self.live\n"
+        "        if up:\n"
+        "            act()\n"
+    )
+    assert analyze_source(src, SIM) == []
+    # drop the wildcard arm: the no-match path is real again
+    refutable = src.replace(
+        "            case _:\n                up = self.live\n", ""
+    )
+    assert rules_of(analyze_source(refutable, SIM)) == [
+        "flow.stale-read-across-wait"
+    ]
+
+
+def test_stale_ignores_cannot_be_baselined(tmp_path):
+    """--write-baseline must not grandfather a dead ignore: the
+    stale-ignore finding stays NEW even when the baseline froze it."""
+    from foundationdb_tpu.analysis import baseline as baseline_mod
+
+    pkg = tmp_path / "foundationdb_tpu" / "cluster"
+    pkg.mkdir(parents=True)
+    (pkg / "fix.py").write_text(
+        "def dead(x):\n"
+        "    return x  # flowcheck: ignore[actor.swallow]\n"
+    )
+    bl = tmp_path / "baseline.json"
+    man = tmp_path / "manifest.json"
+    result = run_analysis(root=tmp_path, baseline_path=bl, manifest_path=man)
+    assert [f.rule for f in result.new] == ["flowcheck.stale-ignore"]
+    # freeze the baseline the way --write-baseline does...
+    baseline_mod.save_baseline(result.findings, bl)
+    # ...and the dead ignore STILL fails the gate
+    again = run_analysis(root=tmp_path, baseline_path=bl, manifest_path=man)
+    assert [f.rule for f in again.new] == ["flowcheck.stale-ignore"]
+    assert not again.stale  # and it left no phantom baseline entry

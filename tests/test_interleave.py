@@ -1,0 +1,295 @@
+"""The interleaving auditor and schedule perturbation (runtime side of
+flowcheck v2): lost-update detection on audited shared objects across
+yield points, and seeded tie-break randomization among equally-runnable
+actors — both pure additions that leave unaudited, unperturbed runs
+byte-identical."""
+
+import pytest
+
+from foundationdb_tpu.runtime.flow import AuditedDict, Scheduler
+
+
+def _spawn_rmw(sched, d, name, *, reread=False):
+    async def actor():
+        v = d["n"]
+        await sched.delay(0.01)
+        if reread:
+            v = d["n"]
+        # racy on purpose when reread=False: the auditor must flag it
+        d["n"] = v + 1  # flowcheck: ignore[flow.rmw-across-wait]
+
+    return sched.spawn(actor(), name=name)
+
+
+# -- the auditor: both directions, asserted --------------------------------
+
+
+def test_racy_rmw_across_await_is_flagged():
+    """Two actors snapshot one audited key, yield, then write from the
+    snapshot: the second writer lost the first's update — exactly one
+    conflict, naming both actors."""
+    sched = Scheduler(sim=True, audit=True)
+    d = AuditedDict(sched, "shared", {"n": 0})
+    _spawn_rmw(sched, d, "actor-a")
+    _spawn_rmw(sched, d, "actor-b")
+    sched.run_for(0.1)
+    conflicts = sched.audit_conflicts()
+    assert len(conflicts) == 1, conflicts
+    c = conflicts[0]
+    assert c["label"] == "shared" and c["key"] == "n"
+    assert {c["actor"], c["writer"]} == {"actor-a", "actor-b"}
+    assert c["read_step"] < c["write_step"] <= c["step"]
+    # and the race really lost an update
+    assert d._d["n"] == 1
+
+
+def test_single_step_rmw_is_clean():
+    """`d[k] = d[k] + 1` with no yield between read and write is atomic
+    on a cooperative scheduler: never flagged."""
+    sched = Scheduler(sim=True, audit=True)
+    d = AuditedDict(sched, "shared", {"n": 0})
+
+    async def atomic():
+        await sched.delay(0.01)
+        d["n"] = d["n"] + 1
+
+    sched.spawn(atomic(), name="a")
+    sched.spawn(atomic(), name="b")
+    sched.run_for(0.1)
+    assert sched.audit_conflicts() == []
+    assert d._d["n"] == 2
+
+
+def test_reread_after_wait_is_the_ordering_discipline():
+    """Re-reading the slot after resuming (the handoff idiom — and the
+    exact fix the static rule demands) clears the pending read: no
+    conflict, no lost update."""
+    sched = Scheduler(sim=True, audit=True)
+    d = AuditedDict(sched, "shared", {"n": 0})
+    _spawn_rmw(sched, d, "a", reread=True)
+    _spawn_rmw(sched, d, "b", reread=True)
+    sched.run_for(0.1)
+    assert sched.audit_conflicts() == []
+    assert d._d["n"] == 2
+
+
+def test_auditor_off_records_nothing():
+    sched = Scheduler(sim=True)  # audit defaults off
+    d = AuditedDict(sched, "shared", {"n": 0})
+    _spawn_rmw(sched, d, "a")
+    _spawn_rmw(sched, d, "b")
+    sched.run_for(0.1)
+    assert sched.auditor is None
+    assert sched.audit_conflicts() == []
+
+
+def test_wildcard_iteration_conflicts_with_key_writes():
+    """Aggregate reads (iteration) land on the '*' slot, which
+    conflicts with per-key writes: iterate, yield, then write a key a
+    peer wrote meanwhile -> flagged."""
+    sched = Scheduler(sim=True, audit=True)
+    d = AuditedDict(sched, "shared", {"x": 1})
+
+    async def scanner():
+        total = sum(1 for _ in d)  # wildcard read
+        await sched.delay(0.02)
+        d["x"] = total  # writes from the stale scan
+
+    async def writer():
+        await sched.delay(0.01)
+        d["x"] = 99
+
+    sched.spawn(scanner(), name="scanner")
+    sched.spawn(writer(), name="writer")
+    sched.run_for(0.1)
+    conflicts = sched.audit_conflicts()
+    assert [c["actor"] for c in conflicts] == ["scanner"]
+
+
+def test_stale_clear_conflicts_with_foreign_key_writes():
+    """The other wildcard direction: clear() from a stale scan wipes a
+    peer's per-key write — a wildcard WRITE probes every recorded key
+    of the label, so this lost update is flagged too."""
+    sched = Scheduler(sim=True, audit=True)
+    d = AuditedDict(sched, "shared", {"x": 1})
+
+    async def sweeper():
+        n = len(d)  # wildcard read
+        await sched.delay(0.02)
+        if n:
+            d.clear()  # acts on the stale scan, wiping the peer's write
+
+    async def writer():
+        await sched.delay(0.01)
+        d["x"] = 99
+
+    sched.spawn(sweeper(), name="sweeper")
+    sched.spawn(writer(), name="writer")
+    sched.run_for(0.1)
+    conflicts = sched.audit_conflicts()
+    assert [c["actor"] for c in conflicts] == ["sweeper"], conflicts
+    assert conflicts[0]["writer"] == "writer"
+
+
+def test_stale_scan_flags_once_not_per_write():
+    """A write consumes BOTH pending-read slots (exact key and the
+    wildcard): one stale scan produces one conflict, not a duplicate
+    against every later write the actor makes."""
+    sched = Scheduler(sim=True, audit=True)
+    d = AuditedDict(sched, "shared", {"x": 1, "y": 2})
+
+    async def scanner():
+        n = len(d)  # wildcard read
+        await sched.delay(0.02)
+        d["x"] = n      # first write: conflicts, consumes the scan
+        await sched.delay(0.01)
+        d["y"] = n      # later blind write: no pending read, no flag
+
+    async def writer():
+        await sched.delay(0.01)
+        d["x"] = 9
+        d["y"] = 9
+
+    sched.spawn(scanner(), name="scanner")
+    sched.spawn(writer(), name="writer")
+    sched.run_for(0.1)
+    assert len(sched.audit_conflicts()) == 1, sched.audit_conflicts()
+
+
+def test_audited_dict_is_a_faithful_dict():
+    sched = Scheduler(sim=True, audit=True)
+    d = AuditedDict(sched, "x", {"a": 1})
+    d["b"] = 2
+    assert d["a"] == 1 and d.get("c") is None and "b" in d
+    assert d.setdefault("c", 3) == 3 and d.pop("c") == 3
+    d.update({"e": 5}, f=6)
+    assert sorted(d.keys()) == ["a", "b", "e", "f"]
+    assert len(d) == 4 and bool(d) and dict(d.items())["e"] == 5
+    del d["f"]
+    assert sorted(d) == ["a", "b", "e"]
+    assert d == {"a": 1, "b": 2, "e": 5}
+    d.clear()
+    assert not d
+
+
+# -- schedule perturbation -------------------------------------------------
+
+
+def _tie_order(perturb_seed, n=6):
+    sched = Scheduler(sim=True, perturb_seed=perturb_seed)
+    log = []
+
+    async def actor(i):
+        await sched.delay(0.01)  # identical due + priority: a pure tie
+        log.append(i)
+
+    for i in range(n):
+        sched.spawn(actor(i), name=f"t{i}")
+    sched.run_for(0.1)
+    return tuple(log)
+
+
+def test_fifo_default_preserves_program_order():
+    """perturb_seed=None is the historical order: ties resolve FIFO by
+    sequence number, byte-identical to pre-perturbation schedulers."""
+    assert _tie_order(None) == tuple(range(6))
+
+
+def test_perturbation_reorders_ties_deterministically():
+    orders = {k: _tie_order(k) for k in range(1, 6)}
+    # each perturbed schedule is exactly reproducible...
+    for k, o in orders.items():
+        assert _tie_order(k) == o
+    # ...permutes the same work...
+    for o in orders.values():
+        assert sorted(o) == list(range(6))
+    # ...and at least one genuinely differs from FIFO (5 draws of a
+    # 720-permutation space: astronomically certain)
+    assert any(o != tuple(range(6)) for o in orders.values())
+
+
+def test_perturbation_respects_time_and_priority():
+    """Only EQUALLY-RUNNABLE entries reorder: different due times or
+    priorities stay strictly ordered under any perturbation."""
+    for k in (None, 1, 2, 3):
+        sched = Scheduler(sim=True, perturb_seed=k)
+        log = []
+
+        async def late():
+            await sched.delay(0.02)
+            log.append("late")
+
+        async def early():
+            await sched.delay(0.01)
+            log.append("early")
+
+        sched.spawn(late(), name="late")
+        sched.spawn(early(), name="early")
+        sched.run_for(0.1)
+        assert log == ["early", "late"], f"perturb={k}"
+
+
+def test_perturbed_run_seed_is_reproducible_and_passes():
+    """run_seed under a perturbation id: a legal schedule, so every
+    gate holds, and the (seed, perturb) pair reproduces exactly."""
+    from foundationdb_tpu.testing.soak import run_seed
+
+    a = run_seed(7, perturb=1)
+    assert a == run_seed(7, perturb=1)
+    assert a[1] > 0  # committed work under the perturbed schedule
+
+
+def test_race_selftest_fails_iff_auditor_armed():
+    """The _corrupt_api-style divergence discipline for the auditor:
+    the injected race fails the seed with the spec's auditor ON and
+    passes with it OFF — both directions asserted."""
+    import dataclasses
+
+    from foundationdb_tpu.testing.soak import run_seed
+    from foundationdb_tpu.testing.spec import load_spec
+
+    with pytest.raises(AssertionError, match="interleaving conflict"):
+        run_seed(3, _inject_race=True)  # default spec: audit = true
+    off = load_spec("default")
+    off = dataclasses.replace(
+        off, policy={**off.policy, "audit": False}
+    ).validate()
+    assert run_seed(3, spec=off, _inject_race=True)
+
+
+def test_pop_of_absent_key_is_not_a_phantom_write():
+    """pop(absent, default) mutates nothing: it must not plant a
+    last_write that frames this actor as the writer in a later
+    conflict on a clean peer."""
+    sched = Scheduler(sim=True, audit=True)
+    d = AuditedDict(sched, "shared", {})
+
+    async def popper():
+        d.pop("k", None)  # absent: observation, not mutation
+
+    async def rmw():
+        v = d.get("k")
+        await sched.delay(0.02)
+        d["k"] = (v or 0) + 1  # flowcheck: ignore[flow.rmw-across-wait] (single writer; the test is about pop)
+
+    sched.spawn(rmw(), name="rmw")
+    sched.spawn(popper(), name="popper")
+    sched.run_for(0.1)
+    assert sched.audit_conflicts() == []
+    # a REAL pop is still a write: the same shape with the key present
+    sched2 = Scheduler(sim=True, audit=True)
+    d2 = AuditedDict(sched2, "shared", {"k": 1})
+
+    async def rmw2():
+        v = d2.get("k")
+        await sched2.delay(0.02)
+        d2["k"] = (v or 0) + 1  # flowcheck: ignore[flow.rmw-across-wait] (the race IS the fixture)
+
+    async def popper2():
+        await sched2.delay(0.01)
+        d2.pop("k", None)
+
+    sched2.spawn(rmw2(), name="rmw")
+    sched2.spawn(popper2(), name="popper")
+    sched2.run_for(0.1)
+    assert [c["writer"] for c in sched2.audit_conflicts()] == ["popper"]

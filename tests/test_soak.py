@@ -323,3 +323,17 @@ def test_plans_are_spec_driven():
     api_plans = [plan_for_seed(s, "api_correctness") for s in range(8)]
     assert all(p.api for p in api_plans)
     assert {p.resolver_backend for p in api_plans} == {"cpu", "tpu-force"}
+
+
+def test_balancer_conservative_aborts_do_not_arm_strict_audit():
+    """api_correctness seed 60, pinned: with two resolvers the
+    ResolutionBalancer's range moves inject synthetic conservative
+    writes (commit_proxy.conservative_writes) — a read below the
+    transition version aborts with NO client writer to explain it, so
+    the strict false-abort audit must not arm on multi-resolver plans.
+    (Pre-existing escape, found by the PR-3 perturbation sweep.)"""
+    from foundationdb_tpu.testing.soak import plan_for_seed, run_seed
+
+    plan = plan_for_seed(60, "api_correctness")
+    assert plan.n_resolvers == 2 and plan.api  # the shape that bit
+    assert run_seed(60, spec="api_correctness")[1] > 0

@@ -122,6 +122,21 @@ def test_malformed_specs_are_refused():
         d = spec.to_dict()
         d["policy"]["resolver_backends"] = ["gpu"]  # unknown backend
         SoakSpec.from_dict(d)
+    with pytest.raises(SpecError):
+        d = spec.to_dict()
+        del d["policy"]["audit"]  # the auditor knob is mandatory
+        SoakSpec.from_dict(d)
+    with pytest.raises(SpecError):
+        d = spec.to_dict()
+        d["policy"]["audit"] = "yes"  # must be a real bool
+        SoakSpec.from_dict(d)
+
+
+def test_every_spec_arms_the_interleaving_auditor():
+    """All checked-in ensembles audit by default: turning the auditor
+    off is a per-spec decision that must be visible in a diff."""
+    for name in list_specs():
+        assert load_spec(name).policy["audit"] is True, name
 
 
 @pytest.mark.parametrize("name", sorted(REQUIRED_SPECS - {"api_correctness"}))
