@@ -1,32 +1,35 @@
 #!/usr/bin/env python
 """Headline bench: resolver throughput at 64K-txn batches.
 
-The TPU conflict kernel (foundationdb_tpu.ops.conflict.resolve_batch,
-replacing fdbserver/SkipList.cpp detectConflicts) versus the measured CPU
-baseline (foundationdb_tpu/native — the stand-in for the reference's
+The TPU conflict kernel versus the measured CPU baseline
+(foundationdb_tpu/native — the stand-in for the reference's
 `fdbserver -r skiplisttest` microbench, fdbserver/SkipList.cpp:1082-1177:
 uniform 1M keyspace, one read + one write range per txn; snapshots lag up
-to two batch-versions so reads really contend with history).
+to two batch-versions so reads really contend with history). Since r6
+the default device path is the DELTA-TIERED kernel
+(foundationdb_tpu.ops.delta — G-independent compile, delta-tier merges,
+periodic compaction, optional read dedup); BENCH_KERNEL=classic runs the
+r3-r5 single-tier mega-sort group kernel.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": txns/s on device, "unit": "txn/s",
-   "vs_baseline": device_rate / cpu_baseline_rate}
+Prints ONE JSON line whose PRIMARY `value` is the TRANSFER-INCLUSIVE
+pipelined rate (pack -> host->device copy -> kernel, overlapped by
+TpuConflictSet.resolve_stream_pipelined) — the operative number a live
+resolver fed by a proxy would see (VERDICT r5 task 2; the r3-r5 primary
+was device-resident and is now the secondary `device_resident_txn_s`).
 
 Phases: (1) CPU baseline timing + verdicts; (2) parity phase — the TPU
 kernel resolves the same stream and decisions are asserted identical;
-(3) pipelined throughput — a fresh kernel instance re-runs the stream
-with async dispatch (state donation chains batches on-device), inputs
-pre-staged on device (see the phase-3 comment for why that is the honest
-framing in this environment; the JSON line carries
-"staging": "device" so runs before/after this methodology are not
-conflated); (4) per-batch latency probe with blocking calls, reported
-both with device-resident inputs (kernel latency) and with the
-host->device transfer included (tunnel-inclusive latency).
+(3) device-resident pipelined throughput (kernel-only, inputs pre-staged
+— the ablation ledger's "kernel" stage); (3b) PRIMARY transfer-inclusive
+pipelined throughput + the per-stage ablation ledger
+(pack / transfer / kernel / fence); (4) per-batch latency probe with
+blocking calls, device-resident and transfer-inclusive.
 
-Env overrides: BENCH_TXNS (default 65536), BENCH_BATCHES (default 16),
+Env overrides: BENCH_TXNS (default 65536), BENCH_BATCHES (default 32),
 BENCH_CPU_BATCHES (default 4), BENCH_MODE (uniform | zipf | range —
-BASELINE.json configs 1-3: uniform 1M keyspace; Zipf-0.99-style hot-key
-contention; wide range reads vs point writes).
+BASELINE.json configs 1-3), BENCH_KERNEL (tiered | classic),
+BENCH_FUSE (group size; tiered compiles ONCE for any value),
+BENCH_DELTA_CAP, BENCH_COMPACT_INTERVAL, BENCH_REPS.
 """
 
 import json
@@ -78,6 +81,7 @@ def main():
     # and prewarm_exact makes the swap compile-free).
     unroll = {"uniform": 3, "zipf": 8, "range": 14}[mode]
     latch = mode != "uniform"
+    kernel = os.environ.get("BENCH_KERNEL", "tiered")
 
     import jax
 
@@ -92,6 +96,23 @@ def main():
 
     log(f"devices: {jax.devices()}")
     cap = 1 << (n_txns - 1).bit_length()
+    # hard bound on live boundaries: a range contributes its begin
+    # (live) plus its end (carrier of the prior value), and the GC
+    # floor trails one batch behind the newest — so
+    # 2*writes/batch x (window/step + 1) = 12*cap live rows worst
+    # case (coalescing only shrinks it; overflow raises, never lies —
+    # 10*cap overflowed at BENCH_TXNS=16384 where uniform ranges
+    # barely coalesce)
+    hist_cap = 12 * cap
+    # delta tier sized for the same window-worst-case (compaction every
+    # group trims it back; occupancy scales with DISTINCT written
+    # boundaries, so zipf keeps it tiny — the ledger reports both)
+    delta_cap = int(os.environ.get("BENCH_DELTA_CAP", hist_cap))
+    # group size for fused dispatch (also the default compaction
+    # cadence: compact_interval counts BATCHES, so one compaction per
+    # fused group). The tiered kernel compiles once for ANY value.
+    fuse = max(1, int(os.environ.get("BENCH_FUSE", 8)))
+    compact_interval = int(os.environ.get("BENCH_COMPACT_INTERVAL", fuse))
     config = KernelConfig(
         max_key_bytes=8,
         max_txns=cap,
@@ -101,21 +122,14 @@ def main():
         # measured SLOWER than the doubling tables at these shapes
         # (scripts/profile_group.py ablations) — the option remains for
         # other shapes/platforms, latched and parity-tested.
-        # hard bound on live boundaries: a range contributes its begin
-        # (live) plus its end (carrier of the prior value), and the GC
-        # floor trails one batch behind the newest — so
-        # 2*writes/batch x (window/step + 1) = 12*cap live rows worst
-        # case (coalescing only shrinks it; overflow raises, never lies —
-        # 10*cap overflowed at BENCH_TXNS=16384 where uniform ranges
-        # barely coalesce)
-        history_capacity=12 * cap,
+        history_capacity=hist_cap,
         window_versions=window,
         fixpoint_unroll=unroll,
         fixpoint_latch=latch,
+        delta_capacity=delta_cap if kernel == "tiered" else 0,
+        compact_interval=compact_interval,
     )
     import dataclasses as _dc
-
-    exact_config = _dc.replace(config, fixpoint_latch=False)
 
     rng = np.random.default_rng(0)
     batches = []
@@ -129,6 +143,27 @@ def main():
             )
         )
     log(f"generated {n_batches} batches of {n_txns} txns")
+
+    # Device-side read dedup (tiered only): size the distinct-range cap
+    # from the ACTUAL stream — the max per-batch distinct (begin, end)
+    # count, next power of two. Worth compiling only when duplicates are
+    # common (zipf); a uniform stream's distinct count ~= its point
+    # count, so dedup would add sorts for nothing and stays off.
+    dedup = 0
+    if kernel == "tiered":
+        max_uniq = 0
+        for b in batches:
+            pairs = np.concatenate(
+                [b.read_begin[: b.n_reads], b.read_end[: b.n_reads]], axis=1
+            )
+            max_uniq = max(max_uniq, len(np.unique(pairs, axis=0)))
+        if max_uniq <= cap // 2:
+            dedup = 1 << (max_uniq - 1).bit_length()
+            config = _dc.replace(config, dedup_reads=dedup)
+        log(f"read dedup: max distinct ranges/batch {max_uniq} of {n_txns} "
+            f"-> dedup_reads={dedup}")
+
+    exact_config = _dc.replace(config, fixpoint_latch=False, dedup_reads=0)
 
     # ---- CPU baselines (native C++ ConflictBatch-equivalents) -----------
     # Two independent implementations (VERDICT r1 task 3): the ordered-map
@@ -214,11 +249,11 @@ def main():
     # the history merge amortized across the group. A loaded resolver
     # coalescing its queue is exactly how the reference behaves under
     # backpressure (fdbserver/Resolver.actor.cpp resolveBatch queueing).
-    # Per-batch latency is still reported un-fused (phase 4).
-    # 8 batches per group: G=16 amortizes fixed costs further but its
-    # XLA compile exceeds 35 minutes on a single-core host — not worth
-    # the cold-start risk for ~10% throughput.
-    fuse = max(1, int(os.environ.get("BENCH_FUSE", 8)))
+    # Per-batch latency is still reported un-fused (phase 4). Classic
+    # kernel: 8 batches per group — G=16 amortizes fixed costs further
+    # but its XLA compile exceeds 35 minutes on a single-core host. The
+    # tiered kernel has no such wall (G-independent body; BENCH_FUSE up
+    # to MAX_GROUP_TIERED=64, compile probe logs the flat curve).
     from foundationdb_tpu.utils.packing import stack_device_args
 
     dev_groups = [
@@ -230,12 +265,42 @@ def main():
     # compiles separately) so compilation stays out of the timed window
     warm = TpuConflictSet(config)
     for dg in {g["version"].shape[0]: g for g in dev_groups}.values():
+        t0 = time.perf_counter()
         warm.resolve_group_args(dg, check_latch=False)
+        jax.block_until_ready(warm.state)
+        log(f"warm compile G={dg['version'].shape[0]}: "
+            f"{time.perf_counter() - t0:.1f}s")
         # latch mode: pre-warm the exact while-loop program for the same
         # shape so a mid-stream latch trip swaps programs instead of
         # paying an XLA compile inside a timed rep (VERDICT r4 task 5)
         warm.prewarm_exact(dg)
     jax.block_until_ready(warm.state)
+
+    # G-independence probe (opt-in: BENCH_COMPILE_PROBE=1): compile the
+    # SAME kernel at extra group sizes and log the wall time per G. The
+    # tiered kernel's scan body is G-independent, so the curve is ~flat
+    # where the classic skeleton's grew with G to a >35min wall at G=16
+    # (ops/group.py MAX_GROUP note).
+    if os.environ.get("BENCH_COMPILE_PROBE") and kernel == "tiered":
+        # tiered only: probing the classic kernel at 2*fuse would pay
+        # the exact >35-minute G-scaling compile wall the probe exists
+        # to show is gone. Sizes clamp to the kernel's group cap.
+        from foundationdb_tpu.ops.delta import MAX_GROUP_TIERED
+
+        probe_cap = min(n_batches, MAX_GROUP_TIERED)
+        for g_probe in sorted({2, fuse // 2, min(2 * fuse, probe_cap)}):
+            if g_probe < 1 or g_probe == fuse or g_probe > probe_cap:
+                continue
+            probe_args = jax.device_put(
+                stack_device_args(batches[:g_probe])
+            )
+            warm_p = TpuConflictSet(config)
+            t0 = time.perf_counter()
+            warm_p.resolve_group_args(probe_args, check_latch=False)
+            jax.block_until_ready(warm_p.state)
+            log(f"compile probe G={g_probe}: "
+                f"{time.perf_counter() - t0:.1f}s wall (kernel={kernel})")
+            del warm_p, probe_args
 
     def device_pass(check_parity=False, cfg_=None):
         cs2 = TpuConflictSet(cfg_ or config)
@@ -251,8 +316,12 @@ def main():
         total = time.perf_counter() - t0
         cs2.check_overflow()
         # the latch-mode kernel REFUSES (does not mis-answer) chains
-        # deeper than the unroll: check after timing, fall back loudly
-        if (cfg_ or config).fixpoint_latch and any(
+        # deeper than the unroll — and the tiered dedup latch refuses
+        # batches with more distinct ranges than compiled for: check
+        # after timing, fall back loudly
+        if (
+            (cfg_ or config).fixpoint_latch or (cfg_ or config).dedup_reads
+        ) and any(
             bool(np.asarray(o.unconverged).any()) for o in outs
         ):
             return None
@@ -308,38 +377,117 @@ def main():
         f"{dev_rate:,.0f} (spread {min(dev_samples):,.0f}-"
         f"{max(dev_samples):,.0f})")
 
-    # ---- phase 3b: TRANSFER-INCLUSIVE pipelined throughput --------------
-    # The r4 verdict's task 4: the timed phase-3 path pre-stages inputs;
-    # a live resolver pays the host->device copy per group. Double-
-    # buffered staging (TpuConflictSet.resolve_group_stream) overlaps
-    # group g+1's copy with group g's compute, so the transfer-inclusive
-    # stream rate should approach the device-resident rate. Measured
-    # with the groups starting HOST-side every rep.
-    host_groups = [
-        stack_device_args(batches[g : g + fuse])
-        for g in range(0, n_batches, fuse)
-    ]
+    # ---- phase 3b: PRIMARY — transfer-inclusive pipelined throughput ----
+    # The operative number (VERDICT r5 task 2): batches start HOST-side
+    # as packed tensors every rep, and the timed region covers the full
+    # pack (group stacking) -> host->device copy -> kernel pipeline.
+    # TpuConflictSet.resolve_stream_pipelined stages at sub-group depth
+    # on a separate thread: the pack+copy of chunk k+1 overlaps the
+    # compute of chunk k, so packing is off the critical thread and the
+    # stream rate should approach the device-resident rate.
+    latchy = config.fixpoint_latch or config.dedup_reads
     incl_samples = []
-    for _rep in range(min(3, reps)):
+    for _rep in range(reps):
         cs_s = TpuConflictSet(config)
         t0 = time.perf_counter()
-        outs_s = cs_s.resolve_group_stream(host_groups, check_latch=False)
+        outs_s = cs_s.resolve_stream_pipelined(batches, chunk=fuse)
         np.asarray(outs_s[-1].verdict)  # honest fence
         total = time.perf_counter() - t0
-        if config.fixpoint_latch and any(
+        if latchy and any(
             bool(np.asarray(o.unconverged).any()) for o in outs_s
         ):
             log("phase 3b: latch tripped; skipping incl-transfer sample")
             continue
         incl_samples.append(n_txns * n_batches / total)
     incl_rate = med(incl_samples) if incl_samples else 0.0
-    log(f"incl-transfer pipelined (double-buffered staging): "
-        f"{incl_rate:,.0f} txn/s ({len(incl_samples)} reps)")
+    log(f"PRIMARY incl-transfer pipelined (pack->copy->compute overlap): "
+        f"{incl_rate:,.0f} txn/s ({len(incl_samples)} reps, "
+        f"spread {min(incl_samples):,.0f}-{max(incl_samples):,.0f})"
+        if incl_samples else "PRIMARY incl-transfer pipelined: NO SAMPLES")
+
+    # ---- phase 3c: per-stage ablation ledger ---------------------------
+    # pack: stacking all groups serially on the host (the staging
+    #   thread's work); transfer: device_put of pre-stacked groups,
+    #   fenced; kernel: the phase-3 device-resident rate; fence: the
+    #   per-group sync penalty (serialized pass minus async pass).
+    t0 = time.perf_counter()
+    host_groups = [
+        stack_device_args(batches[g : g + fuse])
+        for g in range(0, n_batches, fuse)
+    ]
+    pack_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    staged = [jax.device_put(hg) for hg in host_groups]
+    jax.block_until_ready(staged)
+    transfer_s = time.perf_counter() - t0
+    kernel_s = n_txns * n_batches / dev_rate
+    # fenced pass runs the SAME program mix as the phase-3 async pass
+    # (identical config, incl. compaction cadence) so the subtraction
+    # isolates the per-group sync penalty and nothing else
+    cs_f = TpuConflictSet(config)
+    t0 = time.perf_counter()
+    for dg in staged:
+        out_f = cs_f.resolve_group_args(dg, check_latch=False)
+        np.asarray(out_f.verdict)  # per-group fence
+    fenced_s = time.perf_counter() - t0
+    n_groups = len(host_groups)
+    ledger = {
+        "pack_ms_per_group": round(pack_s / n_groups * 1e3, 1),
+        "transfer_ms_per_group": round(transfer_s / n_groups * 1e3, 1),
+        "kernel_ms_per_group": round(kernel_s / n_groups * 1e3, 1),
+        "fence_ms_per_group": round(
+            max(0.0, fenced_s - kernel_s) / n_groups * 1e3, 1
+        ),
+        "pipelined_ms_per_group": round(
+            (n_txns * n_batches / incl_rate if incl_rate else 0.0)
+            / n_groups * 1e3, 1
+        ),
+    }
+    # merge-row accounting: what one group's history machinery touches.
+    # classic: one skeleton of M + 2G(NR+NW) rows (+ a full-width cross
+    # table build PER BATCH); tiered: per-batch delta skeleton of
+    # D_live + 2(NR+NW) rows, no cross build, main probed by binary
+    # search against an immutable table built once per group.
+    classic_rows = config.history_capacity + 2 * fuse * (cap + cap)
+    if kernel == "tiered":
+        from foundationdb_tpu.ops import delta as _D
+
+        # separate UNTIMED pass with compaction disabled: the delta
+        # tier's true end-of-stream occupancy (what a batch's skeleton
+        # actually co-sorts when compaction is deferred). Delta sized to
+        # the window worst case for THIS pass: a BENCH_DELTA_CAP sized
+        # for the compaction cadence would overflow (or silently cap
+        # the reported occupancy) with compaction off.
+        cs_occ = TpuConflictSet(
+            _dc.replace(config, compact_interval=0, delta_capacity=hist_cap)
+        )
+        for dg in staged:
+            cs_occ.resolve_group_args(dg, check_latch=False)
+        m_cnt, d_cnt = _D.boundary_counts(cs_occ.state)
+        d_live = int(np.asarray(d_cnt))
+        m_live = int(np.asarray(m_cnt))
+        del cs_occ
+        ledger["merge_rows_classic_per_group"] = classic_rows
+        ledger["merge_rows_tiered_per_batch_cap"] = (
+            config.delta_capacity + 2 * (cap + cap)
+        )
+        # measured: delta occupancy at end-of-stream with compaction
+        # deferred (what a batch's skeleton actually co-sorts) + the
+        # main tier's live window
+        ledger["merge_rows_tiered_per_batch_live"] = d_live + 2 * (cap + cap)
+        ledger["delta_live_boundaries"] = d_live
+        ledger["main_live_boundaries"] = m_live
+    else:
+        ledger["merge_rows_classic_per_group"] = classic_rows
+    del staged
+    log(f"ablation ledger: {json.dumps(ledger)}")
 
     # ---- phase 4: per-batch latency probe -------------------------------
     del dev_groups  # release phase-3 staging before re-staging
     dev_batches = [jax.device_put(b.device_args()) for b in batches]
     jax.block_until_ready(dev_batches)
+    # compact_interval counts batches, so these per-batch dispatches
+    # already pay compaction at the same cadence as the fused stream
     cs3 = TpuConflictSet(config)
     lat = []
     for db in dev_batches:
@@ -431,9 +579,14 @@ def main():
         json.dumps(
             {
                 "metric": f"resolver_txns_per_sec_{n_txns // 1024}k_batch{suffix}",
-                "value": round(dev_rate, 1),
+                # PRIMARY (r6, VERDICT r5 task 2): the transfer-inclusive
+                # pipelined rate — pack + host->device copy + kernel,
+                # overlapped. The r3-r5 primary (device-resident) ships
+                # as device_resident_txn_s; "staging": "pipelined" marks
+                # the methodology switch (BASELINE.md note).
+                "value": round(incl_rate, 1),
                 "unit": "txn/s",
-                "vs_baseline": round(dev_rate / cpu_rate, 3),
+                "vs_baseline": round(incl_rate / cpu_rate, 3),
                 "baseline": cpu_name,
                 "baseline_txns_per_sec": round(cpu_rate, 1),
                 "reps": reps,
@@ -441,17 +594,28 @@ def main():
                     round(min(cpu_samples[cpu_name]), 1),
                     round(max(cpu_samples[cpu_name]), 1),
                 ],
+                "device_resident_txn_s": round(dev_rate, 1),
+                "device_resident_vs_baseline": round(dev_rate / cpu_rate, 3),
                 "device_spread": [
                     round(min(dev_samples), 1),
                     round(max(dev_samples), 1),
                 ],
-                "staging": "device",
+                "incl_spread": [
+                    round(min(incl_samples), 1),
+                    round(max(incl_samples), 1),
+                ] if incl_samples else [],
+                "staging": "pipelined",
+                "backend": jax.default_backend(),
+                "kernel": kernel,
+                "delta_capacity": config.delta_capacity,
+                "dedup_reads": config.dedup_reads,
+                "compact_interval": config.compact_interval,
                 "fused_dispatch": fuse,
                 "batches": n_batches,
                 "p50_ms": round(p50 * 1e3, 1),
                 "p99_ms": round(p99 * 1e3, 1),
                 "p50_incl_transfer_ms": round(p50_h * 1e3, 1),
-                "incl_transfer_pipelined_txn_s": round(incl_rate, 1),
+                "ablation": ledger,
                 **({"small_batch": small} if small else {}),
             }
         )

@@ -250,7 +250,7 @@ class ResolverRole:
             from foundationdb_tpu.native import NativeSkipListConflictSet
 
             self._cs = NativeSkipListConflictSet(window=window)
-        elif backend in ("cpu", "tpu"):
+        elif backend in ("cpu", "tpu", "tpu-force"):
             from foundationdb_tpu.config import KernelConfig
             from foundationdb_tpu.models.conflict_set import make_conflict_set
 
@@ -957,7 +957,7 @@ def spawn_role(
     repo_root = os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
-    if backend != "tpu":
+    if backend not in ("tpu", "tpu-force"):
         env["PYTHONPATH"] = repo_root
         env["JAX_PLATFORMS"] = "cpu"
     else:

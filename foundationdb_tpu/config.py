@@ -66,6 +66,35 @@ class KernelConfig:
     #: state returns unchanged, and the caller re-dispatches on the
     #: exact kernel. Loud refusal, never a silent wrong answer.
     fixpoint_latch: bool = False
+    #: >0 enables the DELTA-TIERED history path (ops/delta.py): new
+    #: per-group writes land in a delta tier of this boundary capacity,
+    #: queried alongside the immutable main tier and folded into main by
+    #: a periodic device-side compaction. Every per-batch shape in the
+    #: tiered kernel is independent of the group size G (one lax.scan
+    #: body), so XLA compiles once regardless of G — the r6 answer to
+    #: the MAX_GROUP=16 compile wall. Sizing: must hold the boundaries
+    #: written between compactions (<= 2*max_writes per batch, window-
+    #: trimmed); overflow raises, never truncates. 0 = classic
+    #: single-tier kernel (ops/group.py mega-sort over main).
+    delta_capacity: int = 0
+    #: >0 compiles device-side HOT-KEY DEDUP of read conflict ranges
+    #: before the main-tier probe: identical (begin, end) ranges are
+    #: sort+unique'd and only this many DISTINCT ranges are binary-
+    #: searched against main, so probe work scales with distinct keys,
+    #: not points (the kernel-side attack on zipf contention). A batch
+    #: with more distinct live read ranges than this trips the
+    #: unconverged latch — state unchanged, host re-dispatches the exact
+    #: kernel — never a silent wrong answer. Tiered path only.
+    dedup_reads: int = 0
+    #: Tiered path: host folds delta into main after at least this many
+    #: BATCHES have resolved since the last compaction (TpuConflictSet
+    #: auto-compaction; a fused group of G batches counts G). Counting
+    #: batches — not dispatches — keeps the per-batch resolve() hot
+    #: path off the main-sized compaction pass at the same cadence the
+    #: fused bench pays. 0 = only explicit compaction. Size
+    #: delta_capacity for at least this many batches' boundaries
+    #: (<= 2*max_writes each, window-trimmed).
+    compact_interval: int = 8
 
     def __post_init__(self):
         if self.max_key_bytes % 4 != 0:
@@ -77,6 +106,11 @@ class KernelConfig:
             v = getattr(self, name)
             if v & (v - 1):
                 raise ValueError(f"{name} must be a power of two, got {v}")
+        if self.dedup_reads > self.max_reads:
+            raise ValueError("dedup_reads cannot exceed max_reads")
+        if self.dedup_reads and not self.delta_capacity:
+            raise ValueError("dedup_reads requires the tiered path "
+                             "(delta_capacity > 0)")
 
     # ---- derived shapes -------------------------------------------------
 

@@ -133,6 +133,7 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
                   short_span_limit: int = 0,
                   fixpoint_unroll: int = 3,
                   fixpoint_latch: bool = False,
+                  extra_stale=None,
                   _ablate: frozenset = frozenset()):
     """Resolve G stacked batches in one program.
 
@@ -151,6 +152,14 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
     the overflow flag trips and the host refuses the results (the same
     static-capacity discipline as history overflow) — never a silent
     wrong answer. Leave 0 for arbitrary workloads (range scans).
+
+    `extra_stale` ([G, NR] bool or None): per-read-range conflict hits
+    computed OUTSIDE this kernel against history this call's `state`
+    does not hold — the tiered path (ops/delta.py) resolves against the
+    delta tier here and injects its main-tier probe results through
+    this. Hits are OR'd into the phase-1 stale set (masked by
+    read_live), so verdicts, reports and the fixpoint treat them
+    exactly like segment hits on `state` itself.
 
     `_ablate` (static, diagnostic only — scripts/profile_group.py):
     stage names whose work is stubbed out to attribute in-kernel cost;
@@ -370,6 +379,11 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
         vmax = rangemax.query(main_tab, jnp.maximum(il, 0), ir + 1, op="max")
         stale_hit = (vmax > read_snap) & read_live
 
+    if extra_stale is not None:
+        # externally-probed history hits (tiered path): same standing as
+        # phase-1 segment hits on this call's own state
+        stale_hit = stale_hit | (fl(extra_stale) & read_live)
+
     # ---- per-txn read windows (replaces scatter segment-reductions) ----
     # LAYOUT CONTRACT (utils/packing.pack_batch): within a batch, reads
     # are grouped by txn in nondecreasing txn order, and padded rows
@@ -443,13 +457,16 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
             ])
             return (cs[twh] - cs[twl]) > 0
 
-        if short_span_limit:
+        if short_span_limit and gn > 1:
             # the cross-batch query walks GLOBAL block ranks — its span
             # must be latched too, or wide reads would silently miss
-            # earlier in-group writes
+            # earlier in-group writes. At G=1 the cross query itself is
+            # statically dead (skipped below), so latching its span
+            # would be a spurious refusal.
             span_ok &= jnp.max(
                 jnp.where(rlive, rre - rrb, 0)
             ) <= short_span_limit
+        if short_span_limit:
             span_ok &= jnp.max(
                 jnp.where(wlive, whi - wlo, 0)
             ) <= short_span_limit
@@ -457,7 +474,13 @@ def resolve_group(state: H.VersionHistory, g: dict, *,
                 jnp.where(rlive, lqhi - lqlo, 0)
             ) <= short_span_limit
 
-        if "cross" in _ablate:
+        if "cross" in _ablate or gn == 1:
+            # G=1: the cross query runs BEFORE this batch's writes fold
+            # into seg_ver, and with a single batch seg_ver is still the
+            # all-NEG initial carry — the query is statically dead, so
+            # skip its table build entirely (the biggest in-kernel cost
+            # of the per-batch tiered path, and a free win for the
+            # classic resolve_batch G=1 specialization).
             cross_g = jnp.zeros((nr,), bool)
         elif short_span_limit:
             gmax = direct_range_op(

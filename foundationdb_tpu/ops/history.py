@@ -70,6 +70,20 @@ def query_reads(
 ) -> jnp.ndarray:
     """conflict[q] = (max version over history segments intersecting
     [rb, re)) > snap — the CheckMax contract (SkipList.cpp:695-759).
+    """
+    return query_reads_vmax(state, rb, re, main_tab) > snap
+
+
+def query_reads_vmax(
+    state: VersionHistory,
+    rb: jnp.ndarray,    # [Q, W] read-range begins
+    re: jnp.ndarray,    # [Q, W] read-range ends
+    main_tab: jnp.ndarray = None,  # [L, M] prebuilt range-max table
+) -> jnp.ndarray:
+    """[Q] int32: max version over history segments intersecting
+    [rb, re) — the raw CheckMax value, before the snapshot compare (the
+    tiered path's dedup probe shares one vmax across duplicate ranges
+    whose snapshots differ, ops/delta.py).
 
     One searchsorted for the begin keys; the end position is found by
     geometric expansion from il (reads usually span few segments, so the
@@ -94,8 +108,7 @@ def query_reads(
     )
     if main_tab is None:
         main_tab = rangemax.build(state.main_ver, op="max")
-    vmax = rangemax.query(main_tab, jnp.maximum(il, 0), ir + 1, op="max")
-    return vmax > snap
+    return rangemax.query(main_tab, jnp.maximum(il, 0), ir + 1, op="max")
 
 
 def merge_writes(

@@ -241,7 +241,10 @@ class Resolver:
         from foundationdb_tpu.utils.trace import TraceEvent
 
         self._profile = profile_transactions(transactions)
-        chosen = backend_for_profile(self._profile)
+        # config-aware: with the tiered+dedup kernel configured the
+        # hot_key profile routes to the device too (the r6 narrowed
+        # router — see backend_for_profile)
+        chosen = backend_for_profile(self._profile, self._config)
         self.conflict_set = make_conflict_set(
             self._config, chosen if chosen == "cpu" else "tpu"
         )

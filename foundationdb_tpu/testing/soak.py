@@ -203,6 +203,23 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
     from foundationdb_tpu.runtime.flow import AuditedDict, Scheduler
 
     kernel_config = _CC.kernel_config.scaled(window_versions=window)
+    if plan.resolver_backend == "tpu-force" and seed % 2 == 0:
+        # alternate the r6 TIERED kernel (ops/delta.py: delta tier +
+        # device-side read dedup + per-group compaction) through the
+        # fault ensemble on even tpu-force seeds — decisions are
+        # parity-identical to the classic kernel, so every model check
+        # applies unchanged while the new path (incl. the dedup-latch
+        # exact-kernel fallback) runs INSIDE the fault mix. Odd seeds
+        # keep the classic kernel covered. Deterministic per seed; the
+        # spec draw order is untouched.
+        kernel_config = kernel_config.scaled(
+            delta_capacity=4 * kernel_config.max_writes,
+            dedup_reads=kernel_config.max_reads // 4,
+            # compact every 2 batches: delta_capacity holds 2 batches'
+            # worst-case boundaries, and frequent compaction exercises
+            # the compaction boundaries inside the fault ensemble
+            compact_interval=2,
+        )
     try:
         # the scheduler is built HERE (not by open_cluster) so the spec
         # can arm the interleaving auditor and a perturbation id can

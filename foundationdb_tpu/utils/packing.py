@@ -52,7 +52,47 @@ def pack_keys(
     keys: list[bytes], max_key_bytes: int, *, round_up: bool = False
 ) -> np.ndarray:
     """[n, W] uint32; vectorized over a list of byte keys (see pack_key
-    for the conservative long-key handling)."""
+    for the conservative long-key handling).
+
+    Bulk-numpy formulation (r6): ONE joined byte blob scattered into the
+    padded matrix through cumsum offsets, instead of a per-key
+    frombuffer loop — the loop dominated host packing at bench batch
+    sizes (tests/test_packing.py pins byte-identical output against the
+    loop version, _pack_keys_reference).
+    """
+    n = len(keys)
+    w = max_key_bytes // 4 + 1
+    out = np.zeros((n, w), np.uint32)
+    if n == 0:
+        return out
+    lens_raw = np.fromiter((len(k) for k in keys), np.int64, count=n)
+    over = lens_raw > max_key_bytes
+    kept = np.minimum(lens_raw, max_key_bytes)
+    lens = np.where(
+        over, max_key_bytes + 1 if round_up else max_key_bytes, lens_raw
+    )
+    if over.any():
+        blob = b"".join(
+            k if len(k) <= max_key_bytes else k[:max_key_bytes] for k in keys
+        )
+    else:
+        blob = b"".join(keys)
+    cat = np.frombuffer(blob, np.uint8)
+    buf = np.zeros((n, max_key_bytes), np.uint8)
+    rows = np.repeat(np.arange(n), kept)
+    offs = np.concatenate([[0], np.cumsum(kept)[:-1]])
+    cols = np.arange(cat.shape[0]) - np.repeat(offs, kept)
+    buf[rows, cols] = cat
+    out[:, :-1] = buf.view(">u4").astype(np.uint32).reshape(n, w - 1)
+    out[:, -1] = lens.astype(np.uint32)
+    return out
+
+
+def _pack_keys_reference(
+    keys: list[bytes], max_key_bytes: int, *, round_up: bool = False
+) -> np.ndarray:
+    """The pre-r6 per-key loop packer, kept as the byte-identical
+    regression oracle for the vectorized pack_keys (tests/test_packing)."""
     n = len(keys)
     w = max_key_bytes // 4 + 1
     out = np.zeros((n, w), np.uint32)
@@ -150,7 +190,111 @@ def pack_batch(
     `.write_conflict_ranges` (lists of (begin, end) byte pairs) and
     `.read_snapshot` (int) — the shape of the reference's
     CommitTransactionRef (fdbclient/include/fdbclient/CommitTransaction.h).
+
+    Bulk-numpy formulation (r6): per-txn columns come from
+    repeat/cumsum over pre-flattened range lists instead of the pre-r6
+    append loops, so host packing stops dominating the pipelined stream
+    (the pack stage of TpuConflictSet.resolve_stream_pipelined).
+    Byte-identical to pack_batch_reference (tests/test_packing.py).
     """
+    cfg = config
+    b, nr, nw, w = cfg.max_txns, cfg.max_reads, cfg.max_writes, cfg.key_words
+    n = len(transactions)
+    if n > b:
+        raise ValueError(f"{n} txns > max_txns {b}")
+
+    txn_valid = np.zeros((b,), bool)
+    snapshot = np.full((b,), VERSION_NEG, np.int32)
+    has_reads = np.zeros((b,), bool)
+    r_lists = [tr.read_conflict_ranges for tr in transactions]
+    w_lists = [tr.write_conflict_ranges for tr in transactions]
+    if n:
+        txn_valid[:n] = True
+        off = np.fromiter(
+            (tr.read_snapshot for tr in transactions), np.int64, count=n
+        ) - base_version
+        high = off >= 2**31
+        if high.any():
+            bad = int(off[high][0])
+            raise OverflowError(f"version offset {bad} overflows int32; rebase")
+        snapshot[:n] = np.where(
+            off <= int(VERSION_NEG), int(VERSION_NEG), off
+        ).astype(np.int32)
+        r_counts = np.fromiter((len(x) for x in r_lists), np.int64, count=n)
+        w_counts = np.fromiter((len(x) for x in w_lists), np.int64, count=n)
+        has_reads[:n] = r_counts > 0
+    else:
+        r_counts = w_counts = np.zeros((0,), np.int64)
+
+    nread = int(r_counts.sum())
+    nwrite = int(w_counts.sum())
+    if nread > nr:
+        raise ValueError(f"{nread} read ranges > max_reads {nr}")
+    if nwrite > nw:
+        raise ValueError(f"{nwrite} write ranges > max_writes {nw}")
+
+    r_flat = [rg for lst in r_lists for rg in lst]
+    w_flat = [rg for lst in w_lists for rg in lst]
+    ids = np.arange(n, dtype=np.int32)
+    r_txn = np.repeat(ids, r_counts)
+    w_txn = np.repeat(ids, w_counts)
+    r_starts = np.concatenate([[0], np.cumsum(r_counts)[:-1]]) if n else r_counts
+    r_idx = (np.arange(nread) - np.repeat(r_starts, r_counts)).astype(np.int32)
+
+    def _flat_keys(pairs, cap):
+        kb = np.zeros((cap, w), np.uint32)
+        ke = np.zeros((cap, w), np.uint32)
+        m = len(pairs)
+        if m:
+            kb[:m] = pack_keys([p[0] for p in pairs], cfg.max_key_bytes)
+            ke[:m] = pack_keys(
+                [p[1] for p in pairs], cfg.max_key_bytes, round_up=True
+            )
+        return kb, ke
+
+    rb, re = _flat_keys(r_flat, nr)
+    wb, we = _flat_keys(w_flat, nw)
+
+    def _col(vals, cap, dtype=np.int32, fill=0):
+        out = np.full((cap,), fill, dtype)
+        out[: len(vals)] = vals
+        return out
+    return PackedBatch(
+        version=_clamp_version(version, base_version),
+        new_oldest=_clamp_version(version - cfg.window_versions, base_version),
+        n_txns=len(transactions),
+        n_reads=nread,
+        n_writes=nwrite,
+        txn_valid=txn_valid,
+        snapshot=snapshot,
+        has_reads=has_reads,
+        read_begin=rb,
+        read_end=re,
+        # KERNEL LAYOUT CONTRACT (ops/group.py per-txn windows): rows are
+        # grouped by txn in nondecreasing txn order with ranges in
+        # declaration order, and PADDING rows carry txn id == max_txns —
+        # the flat (batch, txn) segment id is then monotone, which lets
+        # the kernel do per-txn reductions with cumsum windows instead
+        # of scatters.
+        read_txn=_col(r_txn, nr, fill=b),
+        read_index=_col(r_idx, nr),
+        read_valid=_col([True] * nread, nr, bool),
+        write_begin=wb,
+        write_end=we,
+        write_txn=_col(w_txn, nw, fill=b),
+        write_valid=_col([True] * nwrite, nw, bool),
+    )
+
+
+def pack_batch_reference(
+    transactions,
+    version: int,
+    base_version: int,
+    config: KernelConfig,
+) -> PackedBatch:
+    """The pre-r6 per-txn append-loop packer, kept verbatim as the
+    byte-identical regression oracle for the vectorized pack_batch
+    (tests/test_packing.py). Not on any hot path."""
     cfg = config
     b, nr, nw, w = cfg.max_txns, cfg.max_reads, cfg.max_writes, cfg.key_words
     if len(transactions) > b:
@@ -186,8 +330,10 @@ def pack_batch(
         ke = np.zeros((cap, w), np.uint32)
         n = len(begins)
         if n:
-            kb[:n] = pack_keys(begins, cfg.max_key_bytes)
-            ke[:n] = pack_keys(ends, cfg.max_key_bytes, round_up=True)
+            kb[:n] = _pack_keys_reference(begins, cfg.max_key_bytes)
+            ke[:n] = _pack_keys_reference(
+                ends, cfg.max_key_bytes, round_up=True
+            )
         return kb, ke
 
     rb, re = _flat(r_begin, r_end, nr)
@@ -210,12 +356,6 @@ def pack_batch(
         has_reads=has_reads,
         read_begin=rb,
         read_end=re,
-        # KERNEL LAYOUT CONTRACT (ops/group.py per-txn windows): rows are
-        # grouped by txn in nondecreasing txn order with ranges in
-        # declaration order, and PADDING rows carry txn id == max_txns —
-        # the flat (batch, txn) segment id is then monotone, which lets
-        # the kernel do per-txn reductions with cumsum windows instead
-        # of scatters.
         read_txn=_col(r_txn, nr, fill=b),
         read_index=_col(r_idx, nr),
         read_valid=_col([True] * nread, nr, bool),

@@ -1,54 +1,101 @@
 #!/usr/bin/env python
-"""End-to-end commit-pipeline bench: YCSB-A-style load through the full
-cluster (GRV -> proxy batching -> TPU resolver -> tlog -> storage).
+"""End-to-end commit-pipeline bench at BASELINE.json config-5 shapes.
 
-BASELINE.json config 5 shape: many in-flight client transactions doing
-50% read-modify-write / 50% read over a hot record set, measuring
-committed transactions per second of virtual time and the wall-clock
-cost of the whole simulation (the Python roles are the harness; the
-conflict kernel is the device-bound stage).
+YCSB-A (50% read-modify-write / 50% read over a zipf-hot record set)
+through the full commit pipeline, BOTH resolver backends, measuring
+committed transactions per second and commit-latency percentiles:
 
-Usage: python scripts/bench_pipeline.py [n_clients] [n_ops]
+* --mode cluster (default): GRV -> proxy batching -> resolver -> tlog ->
+  storage inside one deterministic simulation (open_cluster). Fast to
+  drive at high client counts; virtual-time rates.
+* --mode wire: client + proxy in this process; resolver, tlog and
+  storage as SEPARATE OS PROCESSES over the serialized UDS wire
+  (cluster/multiprocess.py) — the CommitProxy->Resolver hop pays real
+  serialization, framing and scheduling. Wall-clock rates.
+
+The config-5 spec point (BASELINE.md:36) is --spec5: 256K in-flight
+client transactions, wire mode, both backends. In-flight = concurrent
+client tasks, each with at most one outstanding transaction. On hosts
+where 256K tasks are impractical, pass --clients explicitly and say so
+next to the committed log — the JSON row records the shapes it ran.
+
+Prints one JSON row (and appends it to --json-out if given):
+  {"metric": "pipeline_commit_txn_s", "spec": ..., "backends":
+   {"<backend>": {"txn_s": ..., "commit_p99_ms": ..., ...}}}
+
+Usage:
+  python scripts/bench_pipeline.py                         # legacy quick run
+  python scripts/bench_pipeline.py --clients 4096 --ops 4 --mode wire \
+      --backends native,tpu-force --json-out PIPELINE_r06.json
+  python scripts/bench_pipeline.py --spec5
 """
 
+import argparse
+import asyncio
+import json
+import os
 import sys
+import tempfile
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-from foundationdb_tpu.cluster.commit_proxy import NotCommitted
-from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
-from foundationdb_tpu.config import KernelConfig
-from foundationdb_tpu.runtime.flow import all_of
+
+def _pctl(samples, q):
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(len(s) * q))]
 
 
-def main():
-    n_clients = int(sys.argv[1]) if len(sys.argv) > 1 else 64
-    n_ops = int(sys.argv[2]) if len(sys.argv) > 2 else 40
-    kcfg = KernelConfig(
-        max_key_bytes=16, max_txns=256, max_reads=1024, max_writes=1024,
-        history_capacity=1 << 14, window_versions=5_000_000,
+def kernel_config(kernel_txns: int, tiered: bool):
+    from foundationdb_tpu.config import KernelConfig
+
+    kt = 1 << (kernel_txns - 1).bit_length()
+    return KernelConfig(
+        max_key_bytes=16,
+        max_txns=kt,
+        max_reads=4 * kt,
+        max_writes=4 * kt,
+        history_capacity=1 << max(17, (12 * kt).bit_length()),
+        window_versions=5_000_000,
+        delta_capacity=(1 << max(16, (4 * kt).bit_length())) if tiered else 0,
     )
+
+
+def run_cluster(backend: str, args) -> dict:
+    """In-process simulated cluster (virtual-time rates)."""
+    from foundationdb_tpu.cluster.commit_proxy import NotCommitted
+    from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+    from foundationdb_tpu.runtime.flow import all_of
+
+    kcfg = kernel_config(args.kernel_txns, tiered=not args.classic_kernel)
     sched, cluster, db = open_cluster(
         ClusterConfig(
             n_commit_proxies=2, n_resolvers=2, n_storage=2,
-            kernel_config=kcfg,
+            kernel_config=kcfg, resolver_backend=backend,
         )
     )
 
     stats = {"committed": 0, "conflicted": 0, "reads": 0}
+    lat: list[float] = []
 
     async def client(cid: int):
         rng = np.random.default_rng(cid)
-        for _ in range(n_ops):
-            key = b"ycsb%05d" % int(rng.zipf(1.2) % 1000)
+        for _ in range(args.ops):
+            key = b"ycsb%06d" % int(rng.zipf(1.2) % args.records)
             txn = db.create_transaction()
             try:
                 if rng.random() < 0.5:  # read-modify-write
+                    t0 = sched.now()
                     v = await txn.get(key)
                     n = int.from_bytes(v or b"\0" * 8, "little")
                     txn.set(key, (n + 1).to_bytes(8, "little"))
                     await txn.commit()
+                    if len(lat) < 100_000:
+                        lat.append(sched.now() - t0)
                     stats["committed"] += 1
                 else:
                     await txn.get(key)
@@ -57,22 +104,210 @@ def main():
                 stats["conflicted"] += 1
 
     t0 = time.perf_counter()
-    tasks = [sched.spawn(client(i), name=f"ycsb{i}") for i in range(n_clients)]
+    tasks = [
+        sched.spawn(client(i), name=f"ycsb{i}") for i in range(args.clients)
+    ]
     sched.run_until(all_of([t.done for t in tasks]))
     wall = time.perf_counter() - t0
     virtual = sched.now()
 
-    total = stats["committed"] + stats["reads"] + stats["conflicted"]
-    print(f"clients={n_clients} ops={total} committed={stats['committed']} "
-          f"reads={stats['reads']} conflicted={stats['conflicted']}")
-    print(f"virtual time {virtual:.2f}s -> "
-          f"{total / virtual:,.0f} txn/s virtual | wall {wall:.1f}s "
-          f"-> {total / wall:,.0f} txn/s wall")
+    # ops / txn_s count SUCCESSFUL client operations (committed RMWs +
+    # reads) in BOTH modes, so cluster-mode and wire-mode rows are
+    # comparable; conflicted attempts ship as their own counter
+    ops = stats["committed"] + stats["reads"]
     from foundationdb_tpu.cluster.consistency import check_cluster
 
     check_cluster(cluster)
-    print("consistency check: OK")
     cluster.stop()
+    return {
+        **stats,
+        "ops": ops,
+        "virtual_s": round(virtual, 3),
+        "wall_s": round(wall, 2),
+        "txn_s": round(ops / virtual, 1),
+        "txn_s_wall": round(ops / wall, 1),
+        "commit_p50_ms": round(_pctl(lat, 0.50) * 1e3, 2),
+        "commit_p99_ms": round(_pctl(lat, 0.99) * 1e3, 2),
+        "consistency": "ok",
+    }
+
+
+async def _run_wire(backend: str, args) -> dict:
+    """Real-wire mode: resolver/tlog/storage as OS processes over UDS."""
+    from foundationdb_tpu.cluster import multiprocess as mp
+    from foundationdb_tpu.models.types import CommitTransaction
+    from foundationdb_tpu.wire.codec import Mutation
+
+    if backend in ("cpu", "tpu", "tpu-force"):
+        kcfg = kernel_config(args.kernel_txns, tiered=not args.classic_kernel)
+        os.environ["RESOLVER_KERNEL"] = (
+            "KernelConfig("
+            f"max_key_bytes={kcfg.max_key_bytes}, max_txns={kcfg.max_txns}, "
+            f"max_reads={kcfg.max_reads}, max_writes={kcfg.max_writes}, "
+            f"history_capacity={kcfg.history_capacity}, "
+            f"window_versions={kcfg.window_versions}, "
+            f"delta_capacity={kcfg.delta_capacity})"
+        )
+    with tempfile.TemporaryDirectory() as sock_dir:
+        procs = [
+            mp.spawn_role("resolver", sock_dir, backend=backend),
+            mp.spawn_role("tlog", sock_dir),
+            mp.spawn_role("storage", sock_dir),
+        ]
+        try:
+            resolver = await mp.connect(procs[0].address)
+            tlog = await mp.connect(procs[1].address)
+            storage = await mp.connect(procs[2].address)
+            pipe = mp.ProxyPipeline(
+                [resolver], tlog, storage,
+                batch_interval=0.001, max_batch=args.batch,
+            )
+            pipe.start()
+
+            stats = {"committed": 0, "conflicted": 0, "reads": 0}
+            committed_by_key: dict[bytes, int] = {}
+            lat: list[float] = []
+
+            async def client(cid: int):
+                rng = np.random.default_rng(cid)
+                for _ in range(args.ops):
+                    key = b"ycsb%06d" % int(rng.zipf(1.2) % args.records)
+                    kr = (key, key + b"\x00")
+                    if rng.random() < 0.5:  # RMW with bounded retries
+                        # t0 spans the WHOLE retry loop: the client-
+                        # observed commit latency includes every
+                        # conflicted attempt's GRV+read+commit round
+                        t0 = time.perf_counter()
+                        for _attempt in range(8):
+                            rv = await pipe.get_read_version()
+                            cur = await pipe.read(key, rv)
+                            n = int.from_bytes(cur or b"\0" * 8, "little")
+                            try:
+                                await pipe.commit(
+                                    CommitTransaction(
+                                        read_conflict_ranges=[kr],
+                                        write_conflict_ranges=[kr],
+                                        read_snapshot=rv,
+                                        mutations=[Mutation(
+                                            0, key,
+                                            (n + 1).to_bytes(8, "little"),
+                                        )],
+                                    )
+                                )
+                                if len(lat) < 100_000:
+                                    lat.append(time.perf_counter() - t0)
+                                stats["committed"] += 1
+                                committed_by_key[key] = (
+                                    committed_by_key.get(key, 0) + 1
+                                )
+                                break
+                            except mp.NotCommittedError:
+                                stats["conflicted"] += 1
+                    else:
+                        rv = await pipe.get_read_version()
+                        await pipe.read(key, rv)
+                        stats["reads"] += 1
+
+            t0 = time.perf_counter()
+            await asyncio.gather(*(client(c) for c in range(args.clients)))
+            wall = time.perf_counter() - t0
+
+            # exact-count consistency check across the process boundary
+            rv = await pipe.get_read_version()
+            snap = await storage.call(
+                mp.TOKEN_STORAGE_SNAPSHOT, mp.StorageSnapshotReq(version=rv)
+            )
+            got = {k: int.from_bytes(v, "little") for k, v in snap.kvs}
+            for key, cnt in committed_by_key.items():
+                assert got.get(key, 0) == cnt, (
+                    f"{key}: storage={got.get(key, 0)} committed={cnt}"
+                )
+            await pipe.stop()
+            for c in (resolver, tlog, storage):
+                await c.close()
+        finally:
+            for p in procs:
+                p.stop()
+            os.environ.pop("RESOLVER_KERNEL", None)
+    # same successful-ops definition as cluster mode (cross-mode
+    # comparable); "conflicted" counts retried attempts
+    ops = stats["committed"] + stats["reads"]
+    return {
+        **stats,
+        "ops": ops,
+        "wall_s": round(wall, 2),
+        "txn_s": round(ops / wall, 1),
+        "commit_p50_ms": round(_pctl(lat, 0.50) * 1e3, 2),
+        "commit_p99_ms": round(_pctl(lat, 0.99) * 1e3, 2),
+        "consistency": "ok",
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("legacy", nargs="*", type=int,
+                    help="legacy positional [n_clients] [n_ops]")
+    ap.add_argument("--mode", choices=("cluster", "wire"), default="cluster")
+    ap.add_argument("--clients", type=int, default=64,
+                    help="in-flight client transactions (concurrent tasks)")
+    ap.add_argument("--ops", type=int, default=40, help="ops per client")
+    ap.add_argument("--records", type=int, default=1000,
+                    help="YCSB record-set size")
+    ap.add_argument("--batch", type=int, default=4096,
+                    help="proxy max batch (wire mode)")
+    ap.add_argument("--kernel-txns", type=int, default=4096,
+                    help="resolver kernel max_txns for tpu backends")
+    ap.add_argument("--backends", default=None,
+                    help="comma list; default cpu,tpu-force (cluster) / "
+                         "native,tpu-force (wire)")
+    ap.add_argument("--classic-kernel", action="store_true",
+                    help="tpu backends use the classic (non-tiered) kernel")
+    ap.add_argument("--spec5", action="store_true",
+                    help="BASELINE.md:36 config-5 preset: wire mode, 256K "
+                         "in-flight, both backends")
+    ap.add_argument("--json-out", default=None)
+    args = ap.parse_args()
+    if args.legacy:
+        args.clients = args.legacy[0]
+        if len(args.legacy) > 1:
+            args.ops = args.legacy[1]
+    if args.spec5:
+        args.mode = "wire"
+        args.clients = 256 * 1024
+        args.ops = 1
+    backends = (
+        args.backends.split(",") if args.backends
+        else (["native", "tpu-force"] if args.mode == "wire"
+              else ["cpu", "tpu-force"])
+    )
+
+    results = {}
+    for backend in backends:
+        print(f"== backend {backend} ({args.mode}, {args.clients} in-flight, "
+              f"{args.ops} ops/client) ==", flush=True)
+        if args.mode == "wire":
+            res = asyncio.run(_run_wire(backend, args))
+        else:
+            res = run_cluster(backend, args)
+        results[backend] = res
+        print(json.dumps({backend: res}), flush=True)
+
+    row = {
+        "metric": "pipeline_commit_txn_s",
+        "spec": "config5_ycsb_a",
+        "mode": args.mode,
+        "inflight": args.clients,
+        "ops_per_client": args.ops,
+        "records": args.records,
+        "batch": args.batch,
+        "kernel_txns": args.kernel_txns,
+        "kernel": "classic" if args.classic_kernel else "tiered",
+        "backends": results,
+    }
+    print(json.dumps(row))
+    if args.json_out:
+        with open(args.json_out, "a") as f:
+            f.write(json.dumps(row) + "\n")
 
 
 if __name__ == "__main__":

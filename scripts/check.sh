@@ -23,6 +23,13 @@ JAX_PLATFORMS=cpu python -m foundationdb_tpu.analysis
 t1=$(date +%s.%N)
 awk -v a="$t0" -v b="$t1" 'BEGIN {printf "flowcheck wall time: %.1fs\n", b - a}'
 
+echo "== kernel-parity smoke (tiny shapes: classic + tiered + dedup    =="
+echo "== fallback vs the Python oracle — seconds, compile-bound)       =="
+t0=$(date +%s.%N)
+JAX_PLATFORMS=cpu python scripts/kernel_smoke.py
+t1=$(date +%s.%N)
+awk -v a="$t0" -v b="$t1" 'BEGIN {printf "kernel smoke wall time: %.1fs\n", b - a}'
+
 echo "== spec + perturbation smoke (1 short seed per spec, then the same =="
 echo "== seed x 3 schedule perturbations, api workload + auditor on)    =="
 # --perturb runs the unperturbed base seed first, so one lane covers both

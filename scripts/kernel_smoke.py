@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Tiny-shape kernel-parity smoke for the fast CI lane (seconds).
+
+Drives BOTH device kernel paths — classic single-tier (ops/group.py via
+resolve_batch) and r6 tiered (ops/delta.py with dedup + per-group
+compaction) — against the Python oracle (CpuConflictSet) on a seeded
+random stream, plus one dedup-latch trip with the exact-kernel
+fallback. Shapes are tiny so the whole run is XLA-compile-bound at a
+few seconds on JAX_PLATFORMS=cpu: kernel refactors cannot silently
+change commit/abort decisions in the fast lane (scripts/check.sh);
+the deep adversarial coverage lives in the kernel parity lane
+(pytest -m kernel).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def main() -> int:
+    t_start = time.perf_counter()
+    from foundationdb_tpu.config import KernelConfig
+    from foundationdb_tpu.models.conflict_set import (
+        CpuConflictSet,
+        TpuConflictSet,
+    )
+    from foundationdb_tpu.models.types import CommitTransaction
+
+    base_cfg = dict(
+        max_key_bytes=8, max_txns=8, max_reads=16, max_writes=16,
+        history_capacity=128, window_versions=500,
+    )
+    classic = KernelConfig(**base_cfg)
+    tiered = KernelConfig(
+        **base_cfg, delta_capacity=64, dedup_reads=8, compact_interval=1
+    )
+    tripwire = KernelConfig(
+        **base_cfg, delta_capacity=64, dedup_reads=2, compact_interval=1
+    )
+
+    rng = np.random.default_rng(0x52)
+
+    def key():
+        return bytes(rng.integers(0, 8, size=int(rng.integers(1, 4)),
+                                  dtype=np.uint8))
+
+    def rrange():
+        a, b = sorted([key(), key()])
+        return (a, b) if a != b else (a, a + b"\x00")
+
+    def txn(lo, hi):
+        return CommitTransaction(
+            read_conflict_ranges=[
+                rrange() for _ in range(int(rng.integers(0, 3)))
+            ],
+            write_conflict_ranges=[
+                rrange() for _ in range(1 + int(rng.integers(0, 2)))
+            ],
+            read_snapshot=int(rng.integers(lo, hi)),
+            report_conflicting_keys=bool(rng.random() < 0.5),
+        )
+
+    base, step = 1000, 100
+    stream = []
+    for i in range(6):
+        v = base + (i + 1) * step
+        stream.append(([txn(base - 150, v) for _ in range(6)], v))
+
+    oracle = CpuConflictSet(classic)
+    sets = {
+        "classic": TpuConflictSet(classic),
+        "tiered+dedup": TpuConflictSet(tiered),
+        "tiered(dedup-latch-fallback)": TpuConflictSet(tripwire),
+    }
+    want = [oracle.resolve(txns, v) for txns, v in stream]
+    failures = 0
+    for name, cs in sets.items():
+        for i, (txns, v) in enumerate(stream):
+            got = cs.resolve(txns, v)
+            if got.verdicts != want[i].verdicts:
+                print(f"FAIL {name} batch {i}: verdicts "
+                      f"{got.verdicts} != {want[i].verdicts}")
+                failures += 1
+            if got.conflicting_key_ranges != want[i].conflicting_key_ranges:
+                print(f"FAIL {name} batch {i}: conflicting ranges "
+                      f"{got.conflicting_key_ranges} != "
+                      f"{want[i].conflicting_key_ranges}")
+                failures += 1
+    n = len(stream)
+    if failures:
+        print(f"kernel smoke: {failures} FAILURES")
+        return 1
+    print(f"kernel smoke: OK — {len(sets)} kernel paths x {n} batches "
+          f"decision-identical to the oracle "
+          f"({time.perf_counter() - t_start:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,146 @@
+"""Vectorized packer regression: byte-identical to the loop packer.
+
+The r6 bulk-numpy pack_batch/pack_keys (repeat/cumsum over pre-flattened
+range lists, one joined key blob) must produce EXACTLY the tensors of
+the pre-r6 per-txn append-loop packer, kept verbatim as
+pack_batch_reference / _pack_keys_reference — any drift here is a silent
+kernel-input change, which is a decision change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.config import KernelConfig
+from foundationdb_tpu.models.types import CommitTransaction
+from foundationdb_tpu.utils import packing
+
+
+def small_config(**kw):
+    d = dict(
+        max_key_bytes=8,
+        max_txns=64,
+        max_reads=256,
+        max_writes=256,
+        history_capacity=1 << 10,
+        window_versions=1000,
+    )
+    d.update(kw)
+    return KernelConfig(**d)
+
+
+def random_key(rng, max_len=12):
+    # deliberately past max_key_bytes sometimes: the conservative
+    # truncation path must match too
+    n = int(rng.integers(0, max_len + 1))
+    return bytes(rng.integers(0, 256, size=n, dtype=np.uint8))
+
+
+def random_range(rng, max_len=12):
+    a, b = sorted([random_key(rng, max_len), random_key(rng, max_len)])
+    if a == b:
+        b = a + b"\x00"
+    return (a, b)
+
+
+def random_txn(rng, snap_lo=-2000, snap_hi=5000):
+    reads = [random_range(rng) for _ in range(int(rng.integers(0, 4)))]
+    writes = [random_range(rng) for _ in range(int(rng.integers(0, 4)))]
+    return CommitTransaction(
+        read_conflict_ranges=reads,
+        write_conflict_ranges=writes,
+        read_snapshot=int(rng.integers(snap_lo, snap_hi)),
+    )
+
+
+def assert_batches_identical(a, b):
+    for f in dataclasses.fields(packing.PackedBatch):
+        va, vb = getattr(a, f.name), getattr(b, f.name)
+        if isinstance(va, np.ndarray):
+            np.testing.assert_array_equal(va, vb, err_msg=f.name)
+            assert va.dtype == vb.dtype, f.name
+        else:
+            assert va == vb, f.name
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_pack_batch_byte_identical_random(seed):
+    rng = np.random.default_rng(seed)
+    config = small_config()
+    n = int(rng.integers(0, config.max_txns + 1))
+    txns = [random_txn(rng) for _ in range(n)]
+    version = int(rng.integers(1000, 100000))
+    base = int(rng.integers(0, 900))
+    got = packing.pack_batch(txns, version, base, config)
+    want = packing.pack_batch_reference(txns, version, base, config)
+    assert_batches_identical(got, want)
+
+
+def test_pack_batch_empty():
+    config = small_config()
+    assert_batches_identical(
+        packing.pack_batch([], 100, 0, config),
+        packing.pack_batch_reference([], 100, 0, config),
+    )
+
+
+def test_pack_batch_edge_shapes():
+    """Blind writes, read-only txns, empty ranges lists, stale
+    snapshots clamped at VERSION_NEG, keys exactly at/over the cap."""
+    config = small_config()
+    k8 = bytes(range(8))          # exactly max_key_bytes
+    k9 = bytes(range(9))          # one over: conservative truncation
+    txns = [
+        CommitTransaction([], [(k8, k9)], read_snapshot=50),
+        CommitTransaction([(k8, k8 + b"\x00")], [], read_snapshot=-(2**40)),
+        CommitTransaction([], [], read_snapshot=70),
+        CommitTransaction(
+            [(b"", b"\x00"), (k9, k9 + b"\xff")], [(b"a", b"b")],
+            read_snapshot=90,
+        ),
+    ]
+    assert_batches_identical(
+        packing.pack_batch(txns, 100, 0, config),
+        packing.pack_batch_reference(txns, 100, 0, config),
+    )
+
+
+@pytest.mark.parametrize("round_up", [False, True])
+def test_pack_keys_byte_identical(round_up):
+    rng = np.random.default_rng(7)
+    keys = [random_key(rng, max_len=20) for _ in range(200)] + [b"", b"\xff" * 8]
+    got = packing.pack_keys(keys, 8, round_up=round_up)
+    want = packing._pack_keys_reference(keys, 8, round_up=round_up)
+    np.testing.assert_array_equal(got, want)
+    assert got.dtype == want.dtype
+
+
+def test_pack_batch_error_parity():
+    config = small_config(max_txns=4, max_reads=4, max_writes=4)
+    too_many = [random_txn(np.random.default_rng(0)) for _ in range(5)]
+    for fn in (packing.pack_batch, packing.pack_batch_reference):
+        with pytest.raises(ValueError, match="max_txns"):
+            fn(too_many, 100, 0, config)
+    crowded = [
+        CommitTransaction(
+            [(b"a", b"b")] * 3, [(b"a", b"b")], read_snapshot=1
+        )
+        for _ in range(2)
+    ]
+    for fn in (packing.pack_batch, packing.pack_batch_reference):
+        with pytest.raises(ValueError, match="max_reads"):
+            fn(crowded, 100, 0, config)
+    writes_heavy = [
+        CommitTransaction([], [(b"a", b"b")] * 3, read_snapshot=1)
+        for _ in range(2)
+    ]
+    for fn in (packing.pack_batch, packing.pack_batch_reference):
+        with pytest.raises(ValueError, match="max_writes"):
+            fn(writes_heavy, 100, 0, config)
+    overflow = [CommitTransaction([], [], read_snapshot=2**40)]
+    for fn in (packing.pack_batch, packing.pack_batch_reference):
+        with pytest.raises(OverflowError, match="rebase"):
+            fn(overflow, 100, 0, config)
