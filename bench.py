@@ -406,80 +406,22 @@ def main():
         if incl_samples else "PRIMARY incl-transfer pipelined: NO SAMPLES")
 
     # ---- phase 3c: per-stage ablation ledger ---------------------------
-    # pack: stacking all groups serially on the host (the staging
-    #   thread's work); transfer: device_put of pre-stacked groups,
-    #   fenced; kernel: the phase-3 device-resident rate; fence: the
-    #   per-group sync penalty (serialized pass minus async pass).
-    t0 = time.perf_counter()
-    host_groups = [
-        stack_device_args(batches[g : g + fuse])
-        for g in range(0, n_batches, fuse)
-    ]
-    pack_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    staged = [jax.device_put(hg) for hg in host_groups]
-    jax.block_until_ready(staged)
-    transfer_s = time.perf_counter() - t0
-    kernel_s = n_txns * n_batches / dev_rate
-    # fenced pass runs the SAME program mix as the phase-3 async pass
-    # (identical config, incl. compaction cadence) so the subtraction
-    # isolates the per-group sync penalty and nothing else
-    cs_f = TpuConflictSet(config)
-    t0 = time.perf_counter()
-    for dg in staged:
-        out_f = cs_f.resolve_group_args(dg, check_latch=False)
-        np.asarray(out_f.verdict)  # per-group fence
-    fenced_s = time.perf_counter() - t0
-    n_groups = len(host_groups)
-    ledger = {
-        "pack_ms_per_group": round(pack_s / n_groups * 1e3, 1),
-        "transfer_ms_per_group": round(transfer_s / n_groups * 1e3, 1),
-        "kernel_ms_per_group": round(kernel_s / n_groups * 1e3, 1),
-        "fence_ms_per_group": round(
-            max(0.0, fenced_s - kernel_s) / n_groups * 1e3, 1
-        ),
-        "pipelined_ms_per_group": round(
-            (n_txns * n_batches / incl_rate if incl_rate else 0.0)
-            / n_groups * 1e3, 1
-        ),
-    }
-    # merge-row accounting: what one group's history machinery touches.
-    # classic: one skeleton of M + 2G(NR+NW) rows (+ a full-width cross
-    # table build PER BATCH); tiered: per-batch delta skeleton of
-    # D_live + 2(NR+NW) rows, no cross build, main probed by binary
-    # search against an immutable table built once per group.
-    classic_rows = config.history_capacity + 2 * fuse * (cap + cap)
-    if kernel == "tiered":
-        from foundationdb_tpu.ops import delta as _D
+    # READER of the shared instrumentation (ISSUE 5): the stage timers,
+    # merge-row accounting and tier-occupancy pass all live in
+    # models/conflict_set.py (KernelStageMetrics + stage_ledger) — the
+    # same metrics a live resolver emits continuously; this script owns
+    # no private timers. kernel_s is the phase-3 device-resident
+    # measurement; pipelined_s the phase-3b transfer-inclusive one.
+    from foundationdb_tpu.models.conflict_set import stage_ledger
 
-        # separate UNTIMED pass with compaction disabled: the delta
-        # tier's true end-of-stream occupancy (what a batch's skeleton
-        # actually co-sorts when compaction is deferred). Delta sized to
-        # the window worst case for THIS pass: a BENCH_DELTA_CAP sized
-        # for the compaction cadence would overflow (or silently cap
-        # the reported occupancy) with compaction off.
-        cs_occ = TpuConflictSet(
-            _dc.replace(config, compact_interval=0, delta_capacity=hist_cap)
-        )
-        for dg in staged:
-            cs_occ.resolve_group_args(dg, check_latch=False)
-        m_cnt, d_cnt = _D.boundary_counts(cs_occ.state)
-        d_live = int(np.asarray(d_cnt))
-        m_live = int(np.asarray(m_cnt))
-        del cs_occ
-        ledger["merge_rows_classic_per_group"] = classic_rows
-        ledger["merge_rows_tiered_per_batch_cap"] = (
-            config.delta_capacity + 2 * (cap + cap)
-        )
-        # measured: delta occupancy at end-of-stream with compaction
-        # deferred (what a batch's skeleton actually co-sorts) + the
-        # main tier's live window
-        ledger["merge_rows_tiered_per_batch_live"] = d_live + 2 * (cap + cap)
-        ledger["delta_live_boundaries"] = d_live
-        ledger["main_live_boundaries"] = m_live
-    else:
-        ledger["merge_rows_classic_per_group"] = classic_rows
-    del staged
+    ledger = stage_ledger(
+        config,
+        batches,
+        fuse=fuse,
+        kernel_s=n_txns * n_batches / dev_rate,
+        pipelined_s=(n_txns * n_batches / incl_rate) if incl_rate else 0.0,
+        occupancy_delta_capacity=hist_cap,
+    )
     log(f"ablation ledger: {json.dumps(ledger)}")
 
     # ---- phase 4: per-batch latency probe -------------------------------

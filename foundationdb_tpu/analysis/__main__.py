@@ -16,6 +16,7 @@ from foundationdb_tpu.analysis import manifest as manifest_mod
 from foundationdb_tpu.analysis import registry
 from foundationdb_tpu.analysis.report import render, run_analysis
 from foundationdb_tpu.analysis.rules_probes import tree_manifest
+from foundationdb_tpu.analysis.rules_trace import tree_trace_manifest
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -47,6 +48,10 @@ def main(argv: list[str] | None = None) -> int:
         help="regenerate analysis/probe_manifest.json from the tree",
     )
     ap.add_argument(
+        "--write-trace-manifest", action="store_true",
+        help="regenerate analysis/trace_manifest.json from the tree",
+    )
+    ap.add_argument(
         "--rules", action="store_true", help="print the rule catalog",
     )
     args = ap.parse_args(argv)
@@ -65,6 +70,14 @@ def main(argv: list[str] | None = None) -> int:
         manifest_mod.save_manifest(tree_manifest(result.contexts))
         print(f"wrote {manifest_mod.manifest_path()}")
         # manifest drift findings are now stale: re-run for a clean view
+        result = run_analysis(
+            root=args.root, use_baseline=not args.no_baseline
+        )
+    if args.write_trace_manifest:
+        manifest_mod.save_trace_manifest(
+            tree_trace_manifest(result.contexts)
+        )
+        print(f"wrote {manifest_mod.trace_manifest_path()}")
         result = run_analysis(
             root=args.root, use_baseline=not args.no_baseline
         )

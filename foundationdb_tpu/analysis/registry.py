@@ -65,4 +65,5 @@ def load_rules() -> None:
         rules_flow,
         rules_jax,
         rules_probes,
+        rules_trace,
     )

@@ -1,0 +1,217 @@
+"""Trace-event accounting: the reference's trace schema, statically.
+
+The reference's TraceEvent types are a de-facto schema — `fdbcli
+status`, monitoring pipelines and the contrib debugging tools
+(commit_debug.py among them) key on the exact UpperCamelCase strings,
+and `.detail()` keys are CamelCase throughout flow/Trace.cpp call
+sites. These tree-wide rules hold this repo to the same contract:
+
+* trace.lowercase-event — a TraceEvent type (or a trace_batch event
+  NAME) that is not UpperCamelCase: the reference's renderers and
+  parsers assume the casing.
+* trace.dynamic-name — a non-literal event type: statically
+  unaccountable, invisible to the manifest (same reasoning as
+  probe.dynamic-name).
+* trace.detail-case — a literal `.detail()` key that is not CamelCase:
+  mixed-case keys fracture downstream queries ("Version" vs "version").
+* trace.manifest-drift — `analysis/trace_manifest.json` out of date
+  with the tree (run `--write-trace-manifest`): a new event type is a
+  schema change and must be a reviewed, deliberate addition.
+
+Exclusions mirror the probe ledger's: `utils/trace.py` (it IS the
+machinery — TraceBatch renders caller-supplied names, trace_counters
+loops counter keys) and `analysis/` (rule docs name events).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from foundationdb_tpu.analysis import manifest as manifest_mod
+from foundationdb_tpu.analysis.registry import rule, tree_check
+from foundationdb_tpu.analysis.walker import FileContext, Finding
+
+R_LOWERCASE = rule(
+    "trace.lowercase-event",
+    "TraceEvent type / trace_batch name is not UpperCamelCase",
+)
+R_DYNAMIC = rule(
+    "trace.dynamic-name",
+    "TraceEvent type is not a string literal: statically unaccountable",
+)
+R_DETAIL = rule(
+    "trace.detail-case",
+    ".detail() key is not CamelCase like the reference's",
+)
+R_DRIFT = rule(
+    "trace.manifest-drift",
+    "trace_manifest.json does not match the tree (--write-trace-manifest)",
+)
+
+_CAMEL = re.compile(r"^[A-Z][A-Za-z0-9]*$")
+
+
+def trace_contexts(ctxs: list[FileContext]) -> list[FileContext]:
+    """THE exclusion policy (one copy, like rules_probes'): skip the
+    trace machinery itself and this package."""
+    return [
+        c for c in ctxs
+        if c.rel != "utils/trace.py"
+        and not c.rel.startswith("analysis/")
+    ]
+
+
+def _chain_root(node: ast.AST) -> ast.AST:
+    """Descend a TraceEvent method chain (`.detail(...).log()`) to the
+    expression it is rooted at."""
+    while (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in ("detail", "log")
+    ):
+        node = node.func.value
+    return node
+
+
+def _is_trace_event_call(ctx: FileContext, node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fname = ctx.dotted(node.func)
+    return bool(fname) and fname.rsplit(".", 1)[-1] == "TraceEvent"
+
+
+def _trace_event_names(ctx: FileContext) -> set[str]:
+    """Local names bound to a TraceEvent chain (`ev = TraceEvent(...)`,
+    `with TraceEvent(...) as e:`) — the anchors for .detail() calls
+    that are not chained directly off the construction."""
+    names: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign):
+            if _is_trace_event_call(ctx, _chain_root(node.value)):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if _is_trace_event_call(
+                    ctx, _chain_root(item.context_expr)
+                ) and isinstance(item.optional_vars, ast.Name):
+                    names.add(item.optional_vars.id)
+    return names
+
+
+def collect_trace_events(ctxs: list[FileContext]):
+    """(events, dynamic, details): events maps type -> [(ctx, node)]
+    from TraceEvent(...) constructions and add_event/add_attach NAME
+    args; dynamic is [(ctx, node)] for non-literal types; details is
+    [(ctx, node, key)] for literal .detail keys on TraceEvent chains
+    (an unrelated object's .detail() API is not the trace schema's
+    business and must not gate-fail the tree)."""
+    events: dict[str, list] = {}
+    dynamic: list = []
+    details: list = []
+    for ctx in ctxs:
+        ev_names = _trace_event_names(ctx)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = ctx.dotted(node.func)
+            leaf = fname.rsplit(".", 1)[-1] if fname else None
+            if leaf == "TraceEvent":
+                a = node.args[0] if node.args else next(
+                    (k.value for k in node.keywords
+                     if k.arg == "event_type"),
+                    None,
+                )
+                if a is None:
+                    continue  # not a construction shape (re-export)
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    events.setdefault(a.value, []).append((ctx, a))
+                else:
+                    dynamic.append((ctx, node))
+            elif leaf in ("add_event", "add_attach") and node.args:
+                # the global trace-batch sink's API; the NAME argument
+                # becomes a TraceLog Type when a logger is attached, so
+                # it is part of the event schema too
+                if "g_trace_batch" not in fname:
+                    continue
+                a = node.args[0]
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    events.setdefault(a.value, []).append((ctx, a))
+                else:
+                    dynamic.append((ctx, node))
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "detail"
+                and node.args
+            ):
+                root = _chain_root(node.func.value)
+                anchored = _is_trace_event_call(ctx, root) or (
+                    isinstance(root, ast.Name) and root.id in ev_names
+                )
+                if not anchored:
+                    continue
+                a = node.args[0]
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    details.append((ctx, a, a.value))
+    return events, dynamic, details
+
+
+@tree_check
+def check_trace_ledger(ctxs: list[FileContext],
+                       manifest_path: Path | None = None) -> list[Finding]:
+    findings: list[Finding] = []
+
+    def report(ctx: FileContext, node: ast.AST, rule_id: str,
+               message: str) -> None:
+        before = len(ctx.findings)
+        ctx.report(node, rule_id, message)
+        if len(ctx.findings) > before:
+            findings.append(ctx.findings.pop())
+
+    events, dynamic, details = collect_trace_events(trace_contexts(ctxs))
+
+    for name, sites in sorted(events.items()):
+        if not _CAMEL.match(name):
+            ctx, node = sites[0]
+            report(
+                ctx, node, R_LOWERCASE,
+                f"trace event {name!r} is not UpperCamelCase",
+            )
+    for ctx, node in dynamic:
+        report(ctx, node, R_DYNAMIC, "non-literal trace event type")
+    for ctx, node, key in details:
+        if not _CAMEL.match(key):
+            report(
+                ctx, node, R_DETAIL,
+                f"detail key {key!r} is not CamelCase",
+            )
+
+    tree_events = {
+        name: sites[0][0].path for name, sites in events.items()
+    }
+    stored = manifest_mod.load_trace_manifest(manifest_path)
+    if stored != tree_events:
+        missing = sorted(set(tree_events) - set(stored))
+        stale = sorted(set(stored) - set(tree_events))
+        detail = []
+        if missing:
+            detail.append(f"not in manifest: {missing[:4]}")
+        if stale:
+            detail.append(f"stale in manifest: {stale[:4]}")
+        findings.append(Finding(
+            path="foundationdb_tpu/analysis/"
+                 + manifest_mod.TRACE_MANIFEST_NAME,
+            line=1,
+            rule=R_DRIFT,
+            message="; ".join(detail) or "emitting files moved",
+        ))
+    return findings
+
+
+def tree_trace_manifest(ctxs: list[FileContext]) -> dict[str, str]:
+    """event type -> first emitting file, for --write-trace-manifest."""
+    events, _dyn, _det = collect_trace_events(trace_contexts(ctxs))
+    return {name: sites[0][0].path for name, sites in events.items()}

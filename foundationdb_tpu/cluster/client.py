@@ -28,6 +28,8 @@ from foundationdb_tpu.cluster.commit_proxy import (
 )
 from foundationdb_tpu.cluster.grv_proxy import GrvProxyFailedError
 from foundationdb_tpu.models.types import CommitTransaction
+from foundationdb_tpu.utils import commit_debug as _cd
+from foundationdb_tpu.utils import trace as _trace
 
 
 def key_after(k: bytes) -> bytes:
@@ -111,14 +113,39 @@ class Transaction:
         # set by the DR agent: its own applies may write while the
         # database is DR-locked (cluster/dr.py)
         self.dr_bypass = False
+        # Commit-path telemetry (the reference's debugTransaction): with
+        # db.tracing on, every transaction carries a DETERMINISTIC debug
+        # id — (origin, client, seq), the idempotency-nonce discipline —
+        # and emits the NativeAPI.* trace_batch micro-events the
+        # commit_debug reconstructor joins on.
+        self.debug_id: Optional[str] = db.next_debug_id() if db.tracing else None
 
     # -- reads ------------------------------------------------------------
 
     async def get_read_version(self) -> int:
         if self._read_version is None:
-            self._read_version = await self.db.grv_proxy.get_read_version(
-                self.tag
-            ).future
+            if self.debug_id is None:
+                self._read_version = await self.db.grv_proxy \
+                    .get_read_version(self.tag).future
+            else:
+                # span-threaded GRV: the request carries this span's
+                # context and the GRV proxy's batch span chains to it
+                from foundationdb_tpu.utils.spans import Span
+
+                with Span(
+                    "NativeAPI.getConsistentReadVersion",
+                    clock=self.db.sched.now,
+                ) as gspan:
+                    _trace.g_trace_batch.add_event(
+                        "TransactionDebug", self.debug_id, _cd.GRV_BEFORE
+                    )
+                    p = self.db.grv_proxy.get_read_version(self.tag)
+                    p.debug_id = self.debug_id  # rides to the batcher
+                    p.span_ctx = gspan.context
+                    self._read_version = await p.future
+                    _trace.g_trace_batch.add_event(
+                        "TransactionDebug", self.debug_id, _cd.GRV_AFTER
+                    )
         return self._read_version
 
     async def get(self, key: bytes, *, snapshot: bool = False) -> Optional[bytes]:
@@ -331,7 +358,26 @@ class Transaction:
         # hit a SPECIFIC proxy — round-robin adjacency is not a
         # guarantee under concurrent traffic
         proxy = getattr(self, "_pin_proxy", None) or self.db.commit_proxy()
-        commit_id = await proxy.commit(ctr).future
+        if self.debug_id is None:
+            commit_id = await proxy.commit(ctr).future
+        else:
+            # span-threaded commit (Tracing.actor.cpp): the client span
+            # context rides the request; the proxy's commitBatch span
+            # parents on it, the resolvers' on the batch span — one
+            # trace from transaction origin to resolution
+            from foundationdb_tpu.utils.spans import Span
+
+            ctr.debug_id = self.debug_id
+            with Span("NativeAPI.commit", clock=self.db.sched.now) as span:
+                ctr.span = span.context.as_tuple()
+                _trace.g_trace_batch.add_event(
+                    "CommitDebug", self.debug_id, _cd.COMMIT_BEFORE
+                )
+                commit_id = await proxy.commit(ctr).future
+                _trace.g_trace_batch.add_event(
+                    "CommitDebug", self.debug_id, _cd.COMMIT_AFTER
+                )
+                span.attribute("Version", commit_id.version)
         self.committed_version = commit_id.version
         self._versionstamp = commit_id.versionstamp
         return commit_id.version
@@ -487,6 +533,25 @@ class Database:
         # seed under simulation (replayable) and the OS pid outside it
         self._client_id = cluster.next_client_id()
         self._idemp_seq = 0
+        # commit-path tracing (debugTransaction): off by default; the
+        # soak trace gate / tools flip it, and every transaction then
+        # carries a deterministic (origin, client, seq) debug id
+        self.tracing = False
+        self._debug_seq = 0
+
+    def next_debug_id(self) -> str:
+        """Deterministic transaction debug id (the debugTransaction
+        identity): sim-seed origin under simulation, pid outside — same
+        discipline as the idempotency nonce, so traced runs replay
+        bit-identically."""
+        import os
+
+        self._debug_seq += 1
+        origin = (
+            (self.cluster.config.sim_seed or 0) if self.sched.sim
+            else os.getpid()
+        )
+        return f"{origin}-{self._client_id}-{self._debug_seq}"
 
     def next_idempotency_id(self) -> bytes:
         """Deterministic idempotency id: 24 bytes of

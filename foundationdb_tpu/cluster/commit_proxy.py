@@ -45,7 +45,14 @@ from foundationdb_tpu.runtime.flow import (
     Scheduler,
     all_of,
 )
-from foundationdb_tpu.utils.metrics import CounterCollection
+from foundationdb_tpu.utils import commit_debug as _cd
+from foundationdb_tpu.utils import trace as _trace
+from foundationdb_tpu.utils.metrics import (
+    COMMIT_LATENCY_BANDS,
+    CounterCollection,
+    LatencyBands,
+    LatencySample,
+)
 from foundationdb_tpu.utils.probes import code_probe, declare
 
 declare("proxy.conservative_write_injected", "proxy.min_combine_abort")
@@ -97,6 +104,9 @@ class CommitID:
 class CommitRequest:
     transaction: CommitTransaction
     reply: Promise  # -> CommitID, or error
+    # arrival time (virtual) — commit latency bands; None for synthetic
+    # requests (conservative writes) that never came from a client
+    start: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -179,6 +189,13 @@ class CommitProxy:
             "ProxyMetrics",
             ["txnCommitIn", "txnCommitOut", "txnConflicts", "commitBatchIn"],
         )
+        # commit latency distribution + reference-style bands
+        # (CommitProxyServer.actor.cpp commitLatencyBands): request
+        # arrival -> reply, in virtual time
+        self.commit_latency = LatencySample("commitLatency")
+        self.latency_bands = LatencyBands(
+            "CommitLatencyMetrics", COMMIT_LATENCY_BANDS
+        )
         self.failed: Optional[BaseException] = None
         # Ranges recently moved between resolvers (ResolutionBalancer):
         # the next batch injects a synthetic blind write over each so the
@@ -249,7 +266,7 @@ class CommitProxy:
             # generation (fdbserver/ClusterRecovery.actor.cpp).
             p.send_error(CommitUnknownResult())
             return p
-        self.requests.send(CommitRequest(txn, p))
+        self.requests.send(CommitRequest(txn, p, start=self.sched.now()))
         return p
 
     # -- phase 0: batching (commitBatcher :361) ----------------------------
@@ -338,19 +355,45 @@ class CommitProxy:
     ) -> None:
         self.counters.add("commitBatchIn")
         # span per commit batch (the reference's commitBatch span,
-        # Tracing.actor.cpp); children: the resolution requests
-        from foundationdb_tpu.utils.spans import Span
+        # Tracing.actor.cpp); children: the resolution requests. The
+        # span parents on the first traced transaction's client span
+        # (the reference's multi-parent span collapsed to one edge), so
+        # a trace runs client -> proxy -> resolver.
+        from foundationdb_tpu.utils.spans import Span, SpanContext
 
+        parent = next(
+            (
+                SpanContext(*r.transaction.span)
+                for r in batch
+                if r.transaction.span is not None
+            ),
+            None,
+        )
         batch_span = Span(
-            f"{self.proxy_id}.commitBatch", clock=self.sched.now
+            f"{self.proxy_id}.commitBatch", parent=parent,
+            clock=self.sched.now,
         ).attribute("txns", len(batch))
+        # batch debug id (deterministic — the reference draws one at
+        # random and attaches every member txn's id to it): emitted only
+        # when some member is traced
+        dbg = None
+        if any(r.transaction.debug_id is not None for r in batch):
+            dbg = f"{self.proxy_id}-b{batch_num}"
+            for r in batch:
+                if r.transaction.debug_id is not None:
+                    _trace.g_trace_batch.add_attach(
+                        "CommitAttachID", r.transaction.debug_id, dbg
+                    )
+            _trace.g_trace_batch.add_event(
+                "CommitDebug", dbg, _cd.BATCH_BEFORE
+            )
         try:
-            await self._commit_batch_spanned(batch, batch_num, batch_span)
+            await self._commit_batch_spanned(batch, batch_num, batch_span, dbg)
         finally:
             # failure paths (dead resolver, recovery kill) still export
             batch_span.finish()
 
-    async def _commit_batch_spanned(self, batch, batch_num, batch_span):
+    async def _commit_batch_spanned(self, batch, batch_num, batch_span, dbg):
         # databaseLocked (NativeAPI's commit check against \xff/dbLocked,
         # here proxy-side via the materialized txn-state store so no
         # client handle can bypass it): non-lock-aware txns fail fast.
@@ -375,11 +418,19 @@ class CommitProxy:
         txns = [r.transaction for r in batch]
         # Phase 1: order batches, get the version pair.
         await self.latest_batch_resolving.when_at_least(batch_num - 1)
+        if dbg is not None:
+            _trace.g_trace_batch.add_event(
+                "CommitDebug", dbg, _cd.BATCH_GETTING_VERSION
+            )
         self._request_num += 1
         vreply = await self.sequencer.get_commit_version(
             self.proxy_id, self._request_num, self._request_num
         )
         prev_version, version = vreply.prev_version, vreply.version
+        if dbg is not None:
+            _trace.g_trace_batch.add_event(
+                "CommitDebug", dbg, _cd.BATCH_GOT_VERSION
+            )
 
         # Phase 2: resolution.
         if self.conservative_writes:
@@ -402,6 +453,7 @@ class CommitProxy:
         )
         for rq in reqs:
             rq.span = batch_span.context.as_tuple()
+            rq.debug_id = dbg
         self.latest_batch_resolving.set(batch_num)
         replies = await all_of(
             [
@@ -410,6 +462,10 @@ class CommitProxy:
             ]
         )
         self.last_received_version = version
+        if dbg is not None:
+            _trace.g_trace_batch.add_event(
+                "CommitDebug", dbg, _cd.BATCH_AFTER_RESOLUTION
+            )
         from foundationdb_tpu.utils.knobs import SERVER_KNOBS
 
         if SERVER_KNOBS.BUGGIFY_DUPLICATE_RESOLVE:
@@ -477,22 +533,41 @@ class CommitProxy:
         messages = self._assign_mutations(txns, verdicts, version)
 
         # Phase 4: push to the log system.
-        from foundationdb_tpu.cluster.tlog import TLogCommitRequest
+        from foundationdb_tpu.cluster.tlog import LOG_STREAM_TAG, TLogCommitRequest
 
+        if dbg is not None:
+            # the batch-id -> commit-version join record: storage applies
+            # are keyed by version, this is how commit_debug ties them in
+            _trace.TraceEvent(
+                "CommitDebugVersion", severity=_trace.SEV_DEBUG
+            ).detail("ID", dbg).detail("Version", version).detail(
+                "Messages",
+                sum(1 for tag in messages if tag != LOG_STREAM_TAG),
+            ).log()
         await self.tlog.commit(
             TLogCommitRequest(
                 prev_version=prev_version, version=version, messages=messages,
-                epoch=self.epoch,
+                epoch=self.epoch, debug_id=dbg,
+                span=batch_span.context.as_tuple(),
             )
         )
         self.latest_batch_logging.set(batch_num)
+        if dbg is not None:
+            _trace.g_trace_batch.add_event(
+                "CommitDebug", dbg, _cd.BATCH_AFTER_LOG_PUSH
+            )
 
         # Phase 5: reply.
         batch_span.attribute("version", version)
         self.sequencer.report_live_committed_version(version)
         self.committed_version.set(version)
+        now = self.sched.now()
         for t, req in enumerate(batch):
             v = verdicts[t]
+            if req.start is not None:
+                dt = now - req.start
+                self.commit_latency.sample(dt)
+                self.latency_bands.add(dt)
             if v == TransactionResult.COMMITTED:
                 self.counters.add("txnCommitOut")
                 req.reply.send(CommitID(version, _stamp(version, t)))

@@ -75,6 +75,10 @@ class ClusterConfig:
     resolver_backend: str = None
     commit_batch_interval: float = 0.005
     window_versions: int = None      # default: kernel_config.window_versions
+    # periodic per-role trace_counters flush cadence (virtual seconds) —
+    # the reference's CounterCollection::traceCounters loop, scaled to
+    # sim-seed time horizons (the reference default is 5s wall)
+    counter_flush_interval: float = 1.0
 
     def __post_init__(self):
         if self.replication_policy is not None:
@@ -247,6 +251,40 @@ class Cluster:
         self.data_distributor = DataDistributor(self)
         self._started = False
         self._next_client_id = 0
+        self._metrics_task = None
+
+    async def _trace_counters_loop(self) -> None:
+        """Periodic per-role counter flush on the VIRTUAL clock
+        (CounterCollection::traceCounters): every role's counters land
+        in the active TraceLog as structured events, so a soak or
+        wire-pipeline run carries continuous per-role telemetry —
+        not just bench.py's end-of-run ledger. Counter values are
+        deterministic per (seed, perturb), so traced output stays
+        bit-reproducible; wall-clock stage samples deliberately stay
+        out of these events (see KernelStageMetrics)."""
+        from foundationdb_tpu.utils import trace as _trace
+
+        while True:
+            await self.sched.delay(self.config.counter_flush_interval)
+            _trace.trace_counters(
+                _trace.g_trace, "GrvProxyMetrics", "grv_proxy0",
+                self.grv_proxy.counters,
+            )
+            for p in self.commit_proxies:
+                _trace.trace_counters(
+                    _trace.g_trace, "ProxyMetrics", p.proxy_id, p.counters
+                )
+            for r in self.resolvers:
+                _trace.trace_counters(
+                    _trace.g_trace, "ResolverMetrics",
+                    f"resolver{r.resolver_id}", r.counters,
+                )
+                cs = r.conflict_set
+                if cs is not None and getattr(cs, "metrics", None) is not None:
+                    _trace.trace_counters(
+                        _trace.g_trace, "ResolverKernelMetrics",
+                        f"resolver{r.resolver_id}", cs.metrics.counters,
+                    )
 
     def next_client_id(self) -> int:
         """Monotonic per-cluster client-handle id (the idempotency-id
@@ -387,8 +425,14 @@ class Cluster:
         self.controller.start()
         self.data_distributor.start()
         self.failure_monitor.start()
+        self._metrics_task = self.sched.spawn(
+            self._trace_counters_loop(), name="metrics-flush"
+        )
 
     def stop(self) -> None:
+        if self._metrics_task is not None:
+            self._metrics_task.cancel()
+            self._metrics_task = None
         self.failure_monitor.stop()
         self.data_distributor.stop()
         self.controller.stop()

@@ -16,7 +16,14 @@ Behavioral mirror of `fdbserver/GrvProxyServer.actor.cpp`:
 from __future__ import annotations
 
 from foundationdb_tpu.runtime.flow import Promise, PromiseStream, Scheduler
-from foundationdb_tpu.utils.metrics import CounterCollection
+from foundationdb_tpu.utils import commit_debug as _cd
+from foundationdb_tpu.utils import trace as _trace
+from foundationdb_tpu.utils.metrics import (
+    GRV_LATENCY_BANDS,
+    CounterCollection,
+    LatencyBands,
+    LatencySample,
+)
 from foundationdb_tpu.utils.probes import declare
 
 declare("ratekeeper.tag_throttled")
@@ -43,6 +50,12 @@ class GrvProxy:
         self.requests = PromiseStream()
         self.counters = CounterCollection(
             "GrvProxyMetrics", ["txnRequestIn", "txnRequestOut", "grvBatches"]
+        )
+        # GRV latency distribution + reference-style latency bands
+        # (GrvProxyServer.actor.cpp grvLatencyBands), in virtual time
+        self.grv_latency = LatencySample("grvLatency")
+        self.latency_bands = LatencyBands(
+            "GRVLatencyMetrics", GRV_LATENCY_BANDS
         )
         self._pending: list[Promise] = []
         self._task = None
@@ -87,6 +100,8 @@ class GrvProxy:
         # refill set must agree on what counts as "tagged", or an
         # empty-string tag reaches the bucket dict without a bucket
         p.tag = tag or None
+        p.debug_id = None  # the client sets it before yielding (tracing)
+        p.grv_start = self.sched.now()
         self.counters.add("txnRequestIn")
         if self._task is None:
             # Stopped proxy (the recovery window between the old
@@ -185,6 +200,28 @@ class GrvProxy:
                     continue
             version = self.sequencer.get_live_committed_version()
             self.counters.add("grvBatches")
+            ctx = next(
+                (p.span_ctx for p in batch
+                 if getattr(p, "span_ctx", None) is not None),
+                None,
+            )
+            if ctx is not None:
+                # one span per GRV batch, parented on the first traced
+                # request's client span (the commitBatch discipline)
+                from foundationdb_tpu.utils.spans import Span
+
+                with Span(
+                    "GrvProxy.transactionStarter", parent=ctx,
+                    clock=self.sched.now,
+                ) as s:
+                    s.attribute("Txns", len(batch))
             for p in batch:
                 self.counters.add("txnRequestOut")
+                dt = now - getattr(p, "grv_start", now)
+                self.grv_latency.sample(dt)
+                self.latency_bands.add(dt)
+                if getattr(p, "debug_id", None) is not None:
+                    _trace.g_trace_batch.add_event(
+                        "TransactionDebug", p.debug_id, _cd.GRV_REPLY
+                    )
                 p.send(version)

@@ -122,6 +122,24 @@ class LogSystem:
     # -- the TLog-compatible surface --------------------------------------
 
     async def commit(self, req: TLogCommitRequest) -> int:
+        # span-threaded push: one child of the proxy's commitBatch span
+        # per log-system push (not per replica — the replicas share the
+        # ack barrier below)
+        span = None
+        if req.span is not None:
+            from foundationdb_tpu.utils.spans import Span, SpanContext
+
+            span = Span(
+                "tlog.push", parent=SpanContext(*req.span),
+                clock=self.sched.now,
+            ).attribute("Version", req.version)
+        try:
+            return await self._commit_spanned(req)
+        finally:
+            if span is not None:
+                span.finish()
+
+    async def _commit_spanned(self, req: TLogCommitRequest) -> int:
         logs = self._live_logs()
         tasks = [self.sched.spawn(t.commit(req)).done for t in logs]
         if self.satellites:

@@ -273,6 +273,35 @@ class ResolverRole:
         return self._cond
 
     async def resolve(self, req: ResolveTransactionBatchRequest):
+        # span context propagated ACROSS the process boundary: the
+        # request's (trace_id, span_id) pair arrived over the UDS wire
+        # (wire/codec.py), and this role's resolveBatch span chains to
+        # it — one trace spanning proxy and resolver OS processes.
+        span = None
+        if req.span is not None:
+            from foundationdb_tpu.utils.spans import Span, SpanContext
+
+            span = Span(
+                "Resolver.resolveBatch", parent=SpanContext(*req.span)
+            ).attribute("Version", req.version)
+        if req.debug_id is not None:
+            from foundationdb_tpu.utils import commit_debug as _cdbg
+            from foundationdb_tpu.utils import trace as _tr
+
+            _tr.g_trace_batch.add_event(
+                "CommitDebug", req.debug_id, _cdbg.RESOLVER_BEFORE
+            )
+        try:
+            return await self._resolve_ordered(req)
+        finally:
+            if req.debug_id is not None:
+                _tr.g_trace_batch.add_event(
+                    "CommitDebug", req.debug_id, _cdbg.RESOLVER_AFTER
+                )
+            if span is not None:
+                span.finish()
+
+    async def _resolve_ordered(self, req: ResolveTransactionBatchRequest):
         cond = self._cond_lazy()
         async with cond:
             await cond.wait_for(
@@ -867,7 +896,25 @@ async def _serve_role(
     tlog_address: str | None = None,
     storage_engine: str = "memory",
     encrypt: bool = False,
+    trace_file: str | None = None,
 ) -> None:
+    if trace_file:
+        # per-process trace sink (the reference's one-trace-file-per-
+        # fdbserver): micro-events and spans land in a JSONL file that
+        # scripts/commit_debug.py merges with the other roles' files —
+        # cross-process timelines from a wire-mode run
+        import time as _time
+
+        from foundationdb_tpu.utils import spans as _spans
+        from foundationdb_tpu.utils import trace as _tr
+
+        sink = _tr.TraceLog(
+            min_severity=_tr.SEV_DEBUG, clock=_time.time, path=trace_file
+        )
+        _tr.install(
+            sink, _tr.TraceBatch(clock=_time.time, logger=sink, enabled=True)
+        )
+        _spans.set_exporter(_spans.SpanExporter(trace_log=sink))
     server = transport.RpcServer(address, tls=_tls_from_env())
 
     async def ping(msg: Ping) -> Pong:
@@ -946,6 +993,7 @@ def spawn_role(
     tlog_address: str | None = None,
     storage_engine: str = "memory",
     encrypt: bool = False,
+    trace_file: str | None = None,
 ) -> RoleProcess:
     """Start one role as a child OS process serving a UDS in socket_dir.
 
@@ -977,6 +1025,8 @@ def spawn_role(
     ]
     if data_dir:
         cmd += ["--data-dir", data_dir]
+    if trace_file:
+        cmd += ["--trace-file", trace_file]
     if tlog_address:
         cmd += ["--tlog-address", tlog_address]
     if storage_engine != "memory":
@@ -1024,6 +1074,7 @@ class ProxyPipeline:
         batch_interval: float = 0.002,
         max_batch: int = 512,
         start_version: int = 0,
+        trace: bool = False,
     ):
         self.resolvers = resolvers
         self.tlog = tlog
@@ -1031,6 +1082,12 @@ class ProxyPipeline:
         self.version_step = version_step
         self.batch_interval = batch_interval
         self.max_batch = max_batch
+        #: commit-path tracing: batches carry span contexts + debug ids
+        #: over the wire to the resolver processes, and this process
+        #: emits the CommitProxy.* micro-events (enable the global
+        #: trace sinks — e.g. a TraceLog file — to persist them)
+        self.trace = trace
+        self._batch_seq = 0
         # a recovering proxy passes start_version = max(tlog version,
         # resolver version) so allocation resumes strictly above anything
         # any role has seen (the reference's recovery version semantics)
@@ -1087,7 +1144,30 @@ class ProxyPipeline:
                         )
 
     async def _commit_batch(self, batch) -> None:
+        if not self.trace:
+            await self._commit_batch_inner(batch, None, None)
+            return
+        from foundationdb_tpu.utils import commit_debug as _cdbg
+        from foundationdb_tpu.utils import trace as _tr
+        from foundationdb_tpu.utils.spans import Span
+
+        self._batch_seq += 1
+        dbg = f"pipe-b{self._batch_seq}"
+        for t, _f in batch:
+            if t.debug_id is not None:
+                _tr.g_trace_batch.add_attach(
+                    "CommitAttachID", t.debug_id, dbg
+                )
+        _tr.g_trace_batch.add_event("CommitDebug", dbg, _cdbg.BATCH_BEFORE)
+        with Span("ProxyPipeline.commitBatch") as span:
+            span.attribute("Txns", len(batch))
+            await self._commit_batch_inner(batch, dbg, span)
+
+    async def _commit_batch_inner(self, batch, dbg, span) -> None:
         txns = [t for t, _f in batch]
+        if dbg is not None:
+            from foundationdb_tpu.utils import commit_debug as _cdbg
+            from foundationdb_tpu.utils import trace as _tr
         async with self._commit_lock:
             # phase 1: version allocation (sequencer). Monotonic across
             # FAILED attempts too: a batch that died after resolution
@@ -1105,15 +1185,25 @@ class ProxyPipeline:
             # owns a key partition in multi-resolver configs — here every
             # resolver sees everything and verdicts min-combine,
             # CommitProxyServer.actor.cpp:1551-1567)
+            if dbg is not None:
+                _tr.g_trace_batch.add_event(
+                    "CommitDebug", dbg, _cdbg.BATCH_GOT_VERSION
+                )
             req = ResolveTransactionBatchRequest(
                 prev_version=self.prev_version,
                 version=version,
                 last_received_version=self.prev_version,
                 transactions=txns,
+                debug_id=dbg,
+                span=span.context.as_tuple() if span is not None else None,
             )
             replies = await asyncio.gather(
                 *(r.call(TOKEN_RESOLVE, req) for r in self.resolvers)
             )
+            if dbg is not None:
+                _tr.g_trace_batch.add_event(
+                    "CommitDebug", dbg, _cdbg.BATCH_AFTER_RESOLUTION
+                )
             verdicts = [
                 min(int(rep.committed[i]) for rep in replies)
                 for i in range(len(txns))
@@ -1124,6 +1214,12 @@ class ProxyPipeline:
                 if v == TransactionResult.COMMITTED:
                     mutations.extend(t.mutations)
             # phase 4: log
+            if dbg is not None:
+                _tr.TraceEvent(
+                    "CommitDebugVersion", severity=_tr.SEV_DEBUG
+                ).detail("ID", dbg).detail("Version", version).detail(
+                    "Messages", 1 if mutations else 0
+                ).log()
             await self.tlog.call(
                 TOKEN_TLOG_PUSH,
                 TLogPush(
@@ -1132,12 +1228,24 @@ class ProxyPipeline:
                     mutations=mutations,
                 ),
             )
+            if dbg is not None:
+                _tr.g_trace_batch.add_event(
+                    "CommitDebug", dbg, _cdbg.TLOG_AFTER_COMMIT
+                )
+                _tr.g_trace_batch.add_event(
+                    "CommitDebug", dbg, _cdbg.BATCH_AFTER_LOG_PUSH
+                )
             # phase 4b: apply to storage (the storage pull loop collapsed
             # into a push for this pipeline; versioned reads still hold)
             await self.storage.call(
                 TOKEN_STORAGE_APPLY,
                 StorageApply(version=version, mutations=mutations),
             )
+            if dbg is not None and mutations:
+                _tr.g_trace_batch.add_event(
+                    "CommitDebug", _cdbg.version_id(version),
+                    _cdbg.STORAGE_APPLIED,
+                )
             self.prev_version = version
             self.committed_version = version
         # phase 5: replies
@@ -1184,6 +1292,7 @@ def main() -> None:
     ap.add_argument("--storage-engine", default="memory",
                     choices=("memory", "lsm"))
     ap.add_argument("--encrypt", action="store_true")
+    ap.add_argument("--trace-file", default=None)
     args = ap.parse_args()
     asyncio.run(
         _serve_role(
@@ -1194,6 +1303,7 @@ def main() -> None:
             tlog_address=args.tlog_address,
             storage_engine=args.storage_engine,
             encrypt=args.encrypt,
+            trace_file=args.trace_file,
         )
     )
 

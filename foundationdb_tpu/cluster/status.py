@@ -3,24 +3,52 @@
 Behavioral mirror of `fdbserver/Status.actor.cpp` (schema shape from
 fdbclient/Schemas.cpp): one JSON-able dict aggregating every role's
 counters, versions, latencies, and configuration — what `fdbcli status`
-and monitoring consume.
-"""
+and monitoring consume. The `processes` section carries one entry per
+role instance (role kind, version, counters, latency distributions);
+`cluster.latency_bands` rolls the reference-style commit/GRV/read bands
+up across role instances; `cluster.resolver_kernel` surfaces the TPU
+resolver's always-on kernel stage metrics (models/conflict_set.py
+KernelStageMetrics)."""
 
 from __future__ import annotations
 
 from typing import Any
 
 
+def _merge_bands(bands_list) -> dict[str, int]:
+    """Sum LatencyBands dicts across role instances (identical edges by
+    construction — the thresholds are module constants)."""
+    out: dict[str, int] = {}
+    for b in bands_list:
+        for k, v in b.as_dict().items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+def _kernel_section(resolver) -> dict[str, Any]:
+    cs = resolver.conflict_set
+    metrics = getattr(cs, "metrics", None)
+    if metrics is None:
+        return {"backend": "unrouted"}
+    return {
+        "backend": type(cs).__name__,
+        **metrics.as_dict(),
+    }
+
+
 def cluster_status(cluster) -> dict[str, Any]:
     seq = cluster.sequencer
+    cfg = cluster.config
     data = {
         "cluster": {
             "configuration": {
                 "commit_proxies": len(cluster.commit_proxies),
-                "grv_proxies": 1,
+                "grv_proxies": cfg.n_grv_proxies,
                 "resolvers": len(cluster.resolvers),
                 "storage_servers": len(cluster.storage_servers),
-                "resolver_backend": "tpu",
+                "logs": cfg.n_tlogs,
+                "coordinators": cfg.n_coordinators,
+                "resolver_backend": cfg.resolver_backend or "tpu",
             },
             "datacenter_lag": {"versions": 0},
             "latest_version": seq.version,
@@ -46,6 +74,25 @@ def cluster_status(cluster) -> dict[str, Any]:
                 },
                 "grv": cluster.grv_proxy.counters.as_dict(),
             },
+            # reference-style latency bands (fdbrpc/Stats.h LatencyBands
+            # -> the status schema's latency_statistics buckets), rolled
+            # up across role instances
+            "latency_bands": {
+                "commit": _merge_bands(
+                    p.latency_bands for p in cluster.commit_proxies
+                ),
+                "grv": _merge_bands([cluster.grv_proxy.latency_bands]),
+                "read": _merge_bands(
+                    ss.read_latency_bands for ss in cluster.storage_servers
+                ),
+            },
+            # the TPU resolver's always-on kernel stage metrics
+            # (pack/transfer/kernel/fence, tier occupancy, compactions,
+            # latch/fallback counts, overflow events)
+            "resolver_kernel": {
+                f"resolver{r.resolver_id}": _kernel_section(r)
+                for r in cluster.resolvers
+            },
             "processes": {},
         }
     }
@@ -60,6 +107,7 @@ def cluster_status(cluster) -> dict[str, Any]:
                 "queue_wait": r.queue_wait_latency.as_dict(),
                 "compute": r.compute_time.as_dict(),
             },
+            "kernel": _kernel_section(r),
             "total_state_bytes": r.total_state_bytes,
         }
     for i, p in enumerate(cluster.commit_proxies):
@@ -67,15 +115,31 @@ def cluster_status(cluster) -> dict[str, Any]:
             "role": "commit_proxy",
             "committed_version": p.committed_version.get(),
             "counters": p.counters.as_dict(),
+            "latency": {"commit": p.commit_latency.as_dict()},
+            "latency_bands": p.latency_bands.as_dict(),
             "failed": p.failed is not None,
         }
+    procs["grv_proxy0"] = {
+        "role": "grv_proxy",
+        "counters": cluster.grv_proxy.counters.as_dict(),
+        "latency": {"grv": cluster.grv_proxy.grv_latency.as_dict()},
+        "latency_bands": cluster.grv_proxy.latency_bands.as_dict(),
+    }
     for i, ss in enumerate(cluster.storage_servers):
         procs[f"storage{i}"] = {
             "role": "storage",
             "version": ss.version.get(),
             "durable_version": ss.durable_version,
             "keys": len(ss._keys),
+            "latency": {"read": ss.read_latency.as_dict()},
+            "latency_bands": ss.read_latency_bands.as_dict(),
+            "live": cluster.storage_live[i],
         }
-    procs["tlog0"] = {"role": "log", "version": cluster.tlog.version.get()}
+    for i in range(cfg.n_tlogs):
+        procs[f"tlog{i}"] = {
+            "role": "log",
+            "version": cluster.tlog.tlogs[i].version.get(),
+            "live": bool(cluster.tlog.live[i]),
+        }
     procs["sequencer"] = {"role": "master", "version": seq.version}
     return data

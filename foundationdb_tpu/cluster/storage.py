@@ -29,6 +29,13 @@ from typing import Any, Optional
 
 from foundationdb_tpu.cluster.tlog import TLog
 from foundationdb_tpu.runtime.flow import ActorCancelled, Notified, Scheduler
+from foundationdb_tpu.utils import commit_debug as _cd
+from foundationdb_tpu.utils import trace as _trace
+from foundationdb_tpu.utils.metrics import (
+    READ_LATENCY_BANDS,
+    LatencyBands,
+    LatencySample,
+)
 
 
 class TransactionTooOld(Exception):
@@ -103,6 +110,12 @@ class StorageServer:
         #: a slow-but-alive replica; the client QueueModel (not the
         #: failure monitor) is what must shed load off it
         self.read_slowdown = 0.0
+        # read latency distribution + reference-style bands
+        # (storageserver.actor.cpp readLatencyBands), in virtual time
+        self.read_latency = LatencySample("readLatency")
+        self.read_latency_bands = LatencyBands(
+            "ReadLatencyMetrics", READ_LATENCY_BANDS
+        )
 
     def start(self) -> None:
         self.stopped = False
@@ -137,6 +150,14 @@ class StorageServer:
                     for m in msgs:
                         self._ingest(v, m)
                     self.version.set(v)
+                    if _trace.g_trace_batch.enabled:
+                        # version-keyed (storage sits below the debug-id
+                        # horizon); CommitDebugVersion joins it back to
+                        # the committing batch
+                        _trace.g_trace_batch.add_event(
+                            "CommitDebug", _cd.version_id(v),
+                            _cd.STORAGE_APPLIED,
+                        )
                 # Version leveling: advance to the log's version even when
                 # no mutations touched this tag (peek cursor contract).
                 if log_version > self.version.get():
@@ -464,21 +485,29 @@ class StorageServer:
                 raise TransactionTooOld(version)
 
     async def get_value(self, key: bytes, version: int) -> Optional[bytes]:
+        t0 = self.sched.now()
         self._check_shard_floor(key, key + b"\x00", version)  # fail fast
         if self.read_slowdown:
             await self.sched.delay(self.read_slowdown)
         await self._wait_for_version(version)
         self._check_shard_floor(key, key + b"\x00", version)
+        dt = self.sched.now() - t0
+        self.read_latency.sample(dt)
+        self.read_latency_bands.add(dt)
         return self._value_at(key, version)
 
     async def get_key_values(
         self, begin: bytes, end: bytes, version: int, *, limit: int = 1 << 30
     ) -> list[tuple[bytes, bytes]]:
+        t0 = self.sched.now()
         self._check_shard_floor(begin, end, version)  # fail fast
         if self.read_slowdown:
             await self.sched.delay(self.read_slowdown)
         await self._wait_for_version(version)
         self._check_shard_floor(begin, end, version)
+        dt = self.sched.now() - t0
+        self.read_latency.sample(dt)
+        self.read_latency_bands.add(dt)
         lo = bisect.bisect_left(self._keys, begin)
         hi = bisect.bisect_left(self._keys, end)
         out = []

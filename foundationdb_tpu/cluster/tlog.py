@@ -38,6 +38,10 @@ class TLogCommitRequest:
     messages: dict[Tag, list[Any]]
     known_committed_version: int = 0
     epoch: int = 1  # generation of the pushing proxy
+    # commit-path telemetry: the pushing batch's debug id + span context
+    # (TLogCommitRequest.debugID / spanContext in the reference)
+    debug_id: Any = None
+    span: Any = None
 
 
 #: The full-stream tag: carries each version's COMPLETE ordered mutation
@@ -107,8 +111,15 @@ class TLog:
 
     async def commit(self, req: TLogCommitRequest) -> int:
         """Append one version's messages; returns the durable version."""
+        from foundationdb_tpu.utils import commit_debug as _cd
+        from foundationdb_tpu.utils import trace as _trace
+
         if req.epoch < self.epoch:
             raise TLogStoppedError(f"epoch {req.epoch} < locked {self.epoch}")
+        if req.debug_id is not None:
+            _trace.g_trace_batch.add_event(
+                "CommitDebug", req.debug_id, _cd.TLOG_BEFORE_WAIT
+            )
         await self.version.when_at_least(req.prev_version)
         if req.epoch < self.epoch:  # may have been locked while waiting
             raise TLogStoppedError(f"epoch {req.epoch} < locked {self.epoch}")
@@ -129,6 +140,10 @@ class TLog:
             self._messages.setdefault(tag, []).append((req.version, msgs))
             self._mem_mutations += len(msgs)
         self.version.set(req.version)
+        if req.debug_id is not None:
+            _trace.g_trace_batch.add_event(
+                "CommitDebug", req.debug_id, _cd.TLOG_AFTER_COMMIT
+            )
         self._maybe_spill()
         return req.version
 

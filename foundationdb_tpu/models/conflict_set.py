@@ -27,6 +27,7 @@ for txns the history phase didn't already condemn (:880-899).
 from __future__ import annotations
 
 import dataclasses
+import time
 
 import jax
 import jax.numpy as jnp
@@ -37,9 +38,59 @@ from foundationdb_tpu.models.types import CommitTransaction, TransactionResult
 from foundationdb_tpu.ops import conflict as C
 from foundationdb_tpu.ops import history as H
 from foundationdb_tpu.utils import packing
+from foundationdb_tpu.utils.metrics import CounterCollection, LatencySample
 
 # Rebase when offsets pass 2**30 (window is ~5e6; huge safety margin).
 REBASE_THRESHOLD = 1 << 30
+
+
+class KernelStageMetrics:
+    """Always-on per-stage telemetry for the resolver kernel.
+
+    First-class `LatencySample`/`CounterCollection` metrics emitted
+    continuously from the resolve paths — pack / transfer / kernel /
+    fence stage timings, tier occupancy, compaction cadence, dedup
+    latch and exact-kernel fallback counts, overflow events. bench.py's
+    ablation ledger and `cluster_status()`'s `resolver.kernel` section
+    are READERS of this object; neither carries private timers.
+
+    Timing semantics: stage samples are host wall-clock seconds.
+    "kernel" covers the jitted dispatch call (on asynchronous backends
+    that is issue time; the fenced remainder lands in "fence" when the
+    caller syncs through this module). Counters are event counts and
+    deterministic per run; the periodic trace_counters flush ships only
+    those, so traced simulation output stays bit-reproducible.
+    """
+
+    def __init__(self):
+        self.counters = CounterCollection(
+            "ResolverKernelMetrics",
+            [
+                "resolveBatches",
+                "groupDispatches",
+                "stagedChunks",
+                "compactions",
+                "latchTrips",
+                "exactFallbacks",
+                "rebases",
+                "overflowRaised",
+            ],
+        )
+        self.pack = LatencySample("packSeconds")
+        self.transfer = LatencySample("transferSeconds")
+        self.kernel = LatencySample("kernelSeconds")
+        self.fence = LatencySample("fenceSeconds")
+        # tier occupancy (tiered kernel): live boundary rows per tier,
+        # sampled at the overflow-check syncs (no extra device fences)
+        self.delta_occupancy = LatencySample("deltaLiveBoundaries")
+        self.main_occupancy = LatencySample("mainLiveBoundaries")
+
+    def as_dict(self) -> dict:
+        out: dict = dict(self.counters.as_dict())
+        for s in (self.pack, self.transfer, self.kernel, self.fence,
+                  self.delta_occupancy, self.main_occupancy):
+            out[s.name] = s.as_dict()
+        return out
 
 
 class HistoryOverflowError(RuntimeError):
@@ -205,6 +256,8 @@ class TpuConflictSet:
         self._prewarmed_exact: set = set()
         self._resolve = _RESOLVE
         self._rebase = _REBASE
+        #: always-on stage telemetry (see KernelStageMetrics)
+        self.metrics = KernelStageMetrics()
 
     # -- ConflictBatch-equivalent API -----------------------------------
 
@@ -225,18 +278,28 @@ class TpuConflictSet:
             else:
                 self.state = self._rebase(self.state, np.int32(delta))
             self.base_version += delta
+            self.metrics.counters.add("rebases")
 
+        t0 = time.perf_counter()
         batch = packing.pack_batch(
             transactions, version, self.base_version, self.config
         )
+        t1 = time.perf_counter()
+        self.metrics.pack.sample(t1 - t0)
+        self.metrics.counters.add("resolveBatches")
         if self.tiered:
             out = self._resolve_args_tiered(batch.device_args())
         else:
             self.state, out = self._resolve(self.state, batch.device_args())
-        return self._build_result(transactions, batch, out)
+            self.metrics.kernel.sample(time.perf_counter() - t1)
+        t2 = time.perf_counter()
+        result = self._build_result(transactions, batch, out)
+        self.metrics.fence.sample(time.perf_counter() - t2)
+        return result
 
     def _raise_overflow(self) -> None:
         self._batches_since_check = 0
+        self.metrics.counters.add("overflowRaised")
         cap = f"history_capacity={self.config.history_capacity}"
         if self.tiered:
             cap += f" / delta_capacity={self.config.delta_capacity}"
@@ -260,7 +323,10 @@ class TpuConflictSet:
             out = self._resolve_args_tiered(args)
             # _dispatch_tiered already advanced the overflow interval
             return out
+        t0 = time.perf_counter()
         self.state, out = self._resolve(self.state, args)
+        self.metrics.kernel.sample(time.perf_counter() - t0)
+        self.metrics.counters.add("resolveBatches")
         self._maybe_check_overflow()
         return out
 
@@ -275,7 +341,10 @@ class TpuConflictSet:
         """
         if self.tiered:
             return self._dispatch_tiered(stacked_args)
+        t0 = time.perf_counter()
         self.state, outs = _RESOLVE_SCAN(self.state, stacked_args)
+        self.metrics.kernel.sample(time.perf_counter() - t0)
+        self.metrics.counters.add("groupDispatches")
         self._batches_since_check += int(
             outs.verdict.shape[0]) - 1
         self._maybe_check_overflow()
@@ -325,15 +394,20 @@ class TpuConflictSet:
                 _resolve_tiered_jit(ssl, unroll, False, 0)(
                     self.state, stacked_args
                 )
+        t0 = time.perf_counter()
         state2, outs = _resolve_tiered_jit(ssl, unroll, latch, dedup)(
             self.state, stacked_args
         )
+        self.metrics.counters.add("groupDispatches")
         if (latch or dedup) and check_latch and bool(
             np.asarray(outs.unconverged).any()
         ):
+            self.metrics.counters.add("latchTrips")
+            self.metrics.counters.add("exactFallbacks")
             state2, outs = _resolve_tiered_jit(ssl, unroll, False, 0)(
                 self.state, stacked_args
             )
+        self.metrics.kernel.sample(time.perf_counter() - t0)
         self.state = state2
         k = int(outs.verdict.shape[0])
         self._batches_since_check += k - 1
@@ -355,6 +429,7 @@ class TpuConflictSet:
         if not self.tiered:
             return
         self._batches_since_compact = 0
+        self.metrics.counters.add("compactions")
         self.state = _COMPACT(self.state)
 
     def resolve_group_args(self, stacked_args, check_latch: bool = True):
@@ -465,7 +540,18 @@ class TpuConflictSet:
         def _stage():
             try:
                 for item in items:
-                    if not _put(jax.device_put(pack_fn(item))):
+                    t0 = time.perf_counter()
+                    host = pack_fn(item)
+                    t1 = time.perf_counter()
+                    staged = jax.device_put(host)
+                    # pack + copy-issue stage timings, off the compute
+                    # thread (the copy itself overlaps compute; its true
+                    # cost shows up in the fenced transfer metric of
+                    # stage_ledger passes)
+                    self.metrics.pack.sample(t1 - t0)
+                    self.metrics.transfer.sample(time.perf_counter() - t1)
+                    self.metrics.counters.add("stagedChunks")
+                    if not _put(staged):
                         return
             except BaseException as e:  # surfaced on the consumer thread
                 _put(e)
@@ -539,6 +625,11 @@ class TpuConflictSet:
             tripped = bool(np.asarray(self.state.main.overflow)) or bool(
                 np.asarray(self.state.delta.overflow)
             )
+            # tier-occupancy sampling rides the sync this check already
+            # paid — two more scalar pulls, no extra fence
+            m_cnt, d_cnt = _D.boundary_counts(self.state)
+            self.metrics.main_occupancy.sample(float(np.asarray(m_cnt)))
+            self.metrics.delta_occupancy.sample(float(np.asarray(d_cnt)))
         else:
             tripped = bool(np.asarray(self.state.overflow))
         if tripped:
@@ -580,6 +671,103 @@ class TpuConflictSet:
         return BatchResult(verdicts=verdicts, conflicting_key_ranges=conflicting)
 
 
+def stage_ledger(config: KernelConfig, batches, *, fuse: int,
+                 kernel_s: float, pipelined_s: float = 0.0,
+                 occupancy_delta_capacity: int = None) -> dict:
+    """The per-stage ablation ledger: pack / transfer / kernel / fence
+    ms per fused group + merge-row accounting, measured through the SAME
+    `KernelStageMetrics` instrumentation the live resolve paths emit —
+    bench.py is a reader of this function, not an owner of private
+    timers.
+
+    * pack: stacking all groups serially on the host (the staging
+      thread's work), from the instrumented pack stage.
+    * transfer: fenced device_put of the pre-stacked groups (the true
+      copy cost; the async pipeline overlaps it with compute).
+    * kernel: `kernel_s` — the caller's device-resident measurement for
+      the whole stream (the phase-3 number of record).
+    * fence: a fenced pass of the same program mix minus `kernel_s` —
+      the per-group sync penalty and nothing else.
+    * merge rows: what one group's history machinery touches; on the
+      tiered kernel the delta tier's true end-of-stream occupancy comes
+      from a separate compaction-disabled pass read via
+      `KernelStageMetrics` occupancy samples.
+    """
+    import dataclasses as _dc
+
+    from foundationdb_tpu.utils.packing import stack_device_args
+
+    n_batches = len(batches)
+    groups = [batches[g: g + fuse] for g in range(0, n_batches, fuse)]
+    n_groups = len(groups)
+    tiered = getattr(config, "delta_capacity", 0) > 0
+
+    # pack + fenced transfer, through the instrumented stages
+    cs = TpuConflictSet(config)
+    host_groups = []
+    for grp in groups:
+        t0 = time.perf_counter()
+        host_groups.append(stack_device_args(grp))
+        cs.metrics.pack.sample(time.perf_counter() - t0)
+    staged = []
+    for hg in host_groups:
+        t0 = time.perf_counter()
+        dev = jax.device_put(hg)
+        # fencing per group IS the measurement here: the ledger reports
+        # the true per-group copy cost the async pipeline overlaps
+        jax.block_until_ready(dev)  # flowcheck: ignore[jax.block-in-loop]
+        cs.metrics.transfer.sample(time.perf_counter() - t0)
+        staged.append(dev)
+    pack_s = cs.metrics.pack.total
+    transfer_s = cs.metrics.transfer.total
+
+    # fenced pass: same program mix as the async measurement pass
+    # (identical config incl. compaction cadence), per-group sync
+    t0 = time.perf_counter()
+    for dg in staged:
+        out_f = cs.resolve_group_args(dg, check_latch=False)
+        np.asarray(out_f.verdict)  # per-group fence
+    fenced_s = time.perf_counter() - t0
+
+    nrw = config.max_reads + config.max_writes
+    ledger = {
+        "pack_ms_per_group": round(pack_s / n_groups * 1e3, 1),
+        "transfer_ms_per_group": round(transfer_s / n_groups * 1e3, 1),
+        "kernel_ms_per_group": round(kernel_s / n_groups * 1e3, 1),
+        "fence_ms_per_group": round(
+            max(0.0, fenced_s - kernel_s) / n_groups * 1e3, 1
+        ),
+        "pipelined_ms_per_group": round(pipelined_s / n_groups * 1e3, 1),
+        "merge_rows_classic_per_group": (
+            config.history_capacity + 2 * fuse * nrw
+        ),
+    }
+    if tiered:
+        # separate UNTIMED pass with compaction disabled: the delta
+        # tier's true end-of-stream occupancy (what a batch's skeleton
+        # actually co-sorts when compaction is deferred). Delta sized
+        # for the window worst case — a capacity sized for the
+        # compaction cadence would overflow with compaction off.
+        occ_cap = occupancy_delta_capacity or config.history_capacity
+        cs_occ = TpuConflictSet(
+            _dc.replace(config, compact_interval=0, delta_capacity=occ_cap)
+        )
+        for dg in staged:
+            cs_occ.resolve_group_args(dg, check_latch=False)
+        m_cnt, d_cnt = _D.boundary_counts(cs_occ.state)
+        d_live = int(np.asarray(d_cnt))
+        m_live = int(np.asarray(m_cnt))
+        cs_occ.metrics.delta_occupancy.sample(float(d_live))
+        cs_occ.metrics.main_occupancy.sample(float(m_live))
+        ledger["merge_rows_tiered_per_batch_cap"] = (
+            config.delta_capacity + 2 * nrw
+        )
+        ledger["merge_rows_tiered_per_batch_live"] = d_live + 2 * nrw
+        ledger["delta_live_boundaries"] = d_live
+        ledger["main_live_boundaries"] = m_live
+    return ledger
+
+
 class CpuConflictSet:
     """CPU fallback behind the resolver_backend knob: the same
     ConflictBatch interface served by the exact host-side semantic model
@@ -594,10 +782,15 @@ class CpuConflictSet:
         self.config = config
         self._oracle_txn = OracleTxn
         self._oracle = ConflictOracle(window=config.window_versions)
+        # same metrics surface as TpuConflictSet so status readers never
+        # special-case the backend (stage samples stay empty: the CPU
+        # path has no pack/transfer/kernel split)
+        self.metrics = KernelStageMetrics()
 
     def resolve(
         self, transactions: list[CommitTransaction], version: int
     ) -> BatchResult:
+        self.metrics.counters.add("resolveBatches")
         res = self._oracle.resolve(
             [
                 self._oracle_txn(

@@ -46,6 +46,11 @@ class CommitTransaction:
     # commit is allowed while the database is locked (the reference's
     # lock_aware transaction option; DR agents use it)
     lock_aware: bool = False
+    # commit-path telemetry (CommitTransactionRequest's debugID +
+    # spanContext): the per-transaction trace id the proxy attaches to
+    # its batch id, and the client span context the batch span parents
+    debug_id: Optional[str] = None
+    span: Optional[tuple] = None
 
     def validate(self) -> None:
         for b, e in self.read_conflict_ranges + self.write_conflict_ranges:

@@ -46,6 +46,7 @@ from foundationdb_tpu.models.types import (
 )
 from foundationdb_tpu.runtime.flow import Notified, Scheduler, Trigger, any_of
 from foundationdb_tpu.utils.metrics import CounterCollection, LatencySample
+from foundationdb_tpu.utils import commit_debug as _cd
 from foundationdb_tpu.utils import trace
 from foundationdb_tpu.utils.probes import code_probe, declare
 
@@ -279,10 +280,12 @@ class Resolver:
         proxy_info = self.proxy_info.setdefault(proxy_key, _ProxyRequestsInfo())
         self.counters.add("resolveBatchIn")
         # Same micro-event locations as the reference, for commit-path
-        # latency debugging (Resolver.actor.cpp:244,266,320,509).
+        # latency debugging (Resolver.actor.cpp:244,266,320,509); the
+        # strings live in utils/commit_debug.py — the reconstructor and
+        # this emitter must never drift.
         if req.debug_id is not None:
             trace.g_trace_batch.add_event(
-                "CommitDebug", req.debug_id, "Resolver.resolveBatch.Before"
+                "CommitDebug", req.debug_id, _cd.RESOLVER_BEFORE
             )
 
         # Memory backpressure (Resolver.actor.cpp:254-268): wait for
@@ -298,6 +301,10 @@ class Resolver:
             and req.version > self.needed_version.get()
         ):
             await self._state_changed.on_trigger()
+        if req.debug_id is not None:
+            trace.g_trace_batch.add_event(
+                "CommitDebug", req.debug_id, _cd.RESOLVER_AFTER_QUEUE
+            )
 
         # Version chain (:271-293). The loop re-evaluates needed_version on
         # every check_needed_version trigger (the reference's choose/when),
@@ -326,7 +333,7 @@ class Resolver:
         self.queue_wait_latency.sample(self.sched.now() - request_time)
         if req.debug_id is not None:
             trace.g_trace_batch.add_event(
-                "CommitDebug", req.debug_id, "Resolver.resolveBatch.AfterOrderer"
+                "CommitDebug", req.debug_id, _cd.RESOLVER_AFTER_ORDERER
             )
 
         if self.version.get() == req.prev_version:
@@ -516,7 +523,7 @@ class Resolver:
         self.resolver_latency.sample(self.sched.now() - request_time)
         if req.debug_id is not None:
             trace.g_trace_batch.add_event(
-                "CommitDebug", req.debug_id, "Resolver.resolveBatch.After"
+                "CommitDebug", req.debug_id, _cd.RESOLVER_AFTER
             )
         out = proxy_info.outstanding_batches.get(req.version)
         code_probe(out is None, "resolver.unknown_duplicate_never")

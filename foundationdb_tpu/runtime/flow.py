@@ -156,11 +156,14 @@ class Future:
 class Promise:
     """Producer handle for a Future (reference Promise<T>)."""
 
-    __slots__ = ("future", "tag")
+    __slots__ = ("future", "tag", "debug_id", "span_ctx", "grv_start")
 
     def __init__(self):
         self.future = Future()
         self.tag = None  # optional transaction tag (GRV throttling)
+        self.debug_id = None  # commit-path tracing (GRV micro-events)
+        self.span_ctx = None  # client span context (GRV batch span parent)
+        self.grv_start = 0.0  # enqueue time for the GRV latency bands
 
     def send(self, value: Any = None) -> None:
         self.future._set(value)

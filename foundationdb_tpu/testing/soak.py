@@ -116,7 +116,8 @@ def plan_for_seed(seed: int, spec=None) -> SeedPlan:
 
 def run_seed(seed: int, spec=None, collect_probes: bool = False,
              _inject_fault=None, _corrupt_api: bool = False,
-             perturb: int = 0, _inject_race: bool = False):
+             perturb: int = 0, _inject_race: bool = False,
+             trace: bool = False, _corrupt_trace: bool = False):
     """Run one ensemble seed under a named spec; returns the
     deterministic signature (and, with collect_probes, the CODE_PROBE
     hit snapshot for ensemble coverage accounting — the Joshua side of
@@ -146,6 +147,19 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
     `_inject_race` is the AUDITOR's self-test hook: two well-behaved-
     looking actors RMW one shared audited key across an await — the
     seed must fail iff the spec's auditor is on.
+
+    `trace=True` runs the seed with commit-path telemetry on: fresh
+    TraceLog/TraceBatch/SpanExporter sinks bound to the virtual clock,
+    client transactions carrying deterministic debug ids, and the
+    SPAN-CHAIN GATE armed — the seed FAILS if any committed transaction
+    is missing a pipeline stage (GRV -> commit -> resolve -> tlog ->
+    storage), any exported span is an orphan, or any span ends before
+    it starts in virtual time (utils/commit_debug.check_chains). The
+    returned signature gains a trace digest, so the unseed-determinism
+    re-run also proves trace output is bit-identical per
+    (seed, perturb). `_corrupt_trace` is the gate's divergence
+    self-test: it deletes one pipeline stage's events before the check,
+    which must then fail the seed.
     """
     from foundationdb_tpu.cluster.commit_proxy import (
         CommitUnknownResult,
@@ -220,6 +234,7 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
             # the compaction boundaries inside the fault ensemble
             compact_interval=2,
         )
+    prev_sinks = prev_exporter = None
     try:
         # the scheduler is built HERE (not by open_cluster) so the spec
         # can arm the interleaving auditor and a perturbation id can
@@ -244,6 +259,29 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
             ),
             sched=sched,
         )
+        trace_sinks = None
+        if trace:
+            from foundationdb_tpu.utils import spans as _spans
+            from foundationdb_tpu.utils import trace as _tr
+
+            # fresh per-run sinks on the VIRTUAL clock: ids and times
+            # are deterministic, so trace output is bit-reproducible
+            # per (seed, perturb) — the unseed-determinism contract
+            # extended to telemetry
+            tlog_sink = _tr.TraceLog(
+                min_severity=_tr.SEV_DEBUG, clock=sched.now,
+                max_events=2_000_000,
+            )
+            tbatch = _tr.TraceBatch(
+                clock=sched.now, logger=tlog_sink, enabled=True
+            )
+            prev_sinks = _tr.install(tlog_sink, tbatch)
+            prev_exporter = _spans.set_exporter(
+                _spans.SpanExporter(trace_log=tlog_sink,
+                                    max_finished=1_000_000)
+            )
+            db.tracing = True
+            trace_sinks = tlog_sink
         rng = np.random.default_rng(seed)
         # `possible` stays a PLAIN dict on purpose: the workload and the
         # laggard deliberately overlap on s29 with carefully-widened
@@ -817,6 +855,54 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
         )
         if plan.kill_proxy:
             assert cluster.controller.epoch >= 2, "recovery never happened"
+        trace_extra = ()
+        stopped = False
+        if trace:
+            import hashlib
+            import json as _json
+
+            from foundationdb_tpu.utils import commit_debug as _cdbg
+            from foundationdb_tpu.utils.trace import _jsonable
+
+            # teardown BEFORE the span gate: stop() cancels every
+            # in-flight actor and the pump below delivers the cancels,
+            # so their finally blocks export spans IN-RUN (an in-flight
+            # commit batch's span would otherwise stay open while its
+            # resolver children exported — a false "orphan" — and the
+            # abandoned coroutine's GC-time finalization would leak the
+            # span into a LATER run's trace)
+            cluster.stop()
+            sched.run_for(0.1)
+            stopped = True
+            events = list(trace_sinks.events)
+            if _corrupt_trace:
+                # divergence self-test: drop one pipeline stage's
+                # events — the chain gate below must fail the seed
+                events = [
+                    e for e in events
+                    if e.get("Location") != _cdbg.RESOLVER_AFTER
+                ]
+            idx = _cdbg.TraceIndex(events)
+            violations = _cdbg.check_chains(idx)
+            assert not violations, (
+                f"seed {seed} perturb {perturb}: "
+                f"{len(violations)} span-chain violation(s): "
+                + "; ".join(violations[:5])
+            )
+            # the trace digest joins the signature: the determinism
+            # re-run then proves trace output is BIT-IDENTICAL per
+            # (seed, perturb), not merely gate-clean
+            # SlowTask is the runtime's WALL-clock watchdog (a host
+            # hiccup, not simulation behavior) — the only event class
+            # excluded from the bit-reproducibility contract
+            digest = hashlib.sha256(
+                "\n".join(
+                    _json.dumps(_jsonable(e), sort_keys=True)
+                    for e in events
+                    if e.get("Type") != "SlowTask"
+                ).encode()
+            ).hexdigest()
+            trace_extra = (digest, len(idx.committed_ids()))
         sig = (
             seed,
             outcome["committed"],
@@ -826,8 +912,9 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
             cluster.controller.epoch,
             tuple(sorted(got)),
             api.signature() if api is not None else None,
-        )
-        cluster.stop()
+        ) + trace_extra
+        if not stopped:
+            cluster.stop()
         if collect_probes:
             from foundationdb_tpu.utils import probes
 
@@ -835,3 +922,11 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
         return sig
     finally:
         SERVER_KNOBS.reset()
+        if prev_sinks is not None:
+            from foundationdb_tpu.utils import trace as _tr
+
+            _tr.install(*prev_sinks)
+        if prev_exporter is not None:
+            from foundationdb_tpu.utils import spans as _spans
+
+            _spans.set_exporter(prev_exporter)

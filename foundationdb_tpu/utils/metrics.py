@@ -14,6 +14,10 @@ from __future__ import annotations
 import math
 from typing import Optional
 
+from foundationdb_tpu.utils.probes import code_probe, declare
+
+declare("metrics.latency_band_overflow")
+
 
 class Counter:
     __slots__ = ("name", "value")
@@ -103,3 +107,46 @@ class LatencySample:
             "p99": self.quantile(0.99),
             "max": self.max or 0.0,
         }
+
+
+#: the reference's default commit/GRV/read latency band thresholds
+#: (seconds) — fdbclient/ServerKnobs.cpp *_LATENCY_BANDS; status readers
+#: expect stable bucket edges, so these are module constants, not knobs.
+COMMIT_LATENCY_BANDS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 1.0)
+GRV_LATENCY_BANDS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.1, 1.0)
+READ_LATENCY_BANDS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.1, 1.0)
+
+
+class LatencyBands:
+    """Fixed-threshold latency histogram (fdbrpc/Stats.h LatencyBands).
+
+    Each sample lands in the first band whose upper threshold covers it;
+    samples above every threshold land in the `inf` overflow bucket —
+    the band the reference's status schema renders as the catch-all
+    (and the one worth a CODE_PROBE: an overflow hit means the
+    operation blew past every budget the bands encode).
+    """
+
+    def __init__(self, name: str, bands=COMMIT_LATENCY_BANDS):
+        self.name = name
+        self.bands = tuple(sorted(bands))
+        self.counts = [0] * (len(self.bands) + 1)  # +1: overflow bucket
+        self.total = 0
+
+    def add(self, latency: float) -> None:
+        self.total += 1
+        for i, ub in enumerate(self.bands):
+            if latency <= ub:
+                self.counts[i] += 1
+                return
+        code_probe(True, "metrics.latency_band_overflow")
+        self.counts[-1] += 1
+
+    def as_dict(self) -> dict[str, int]:
+        """Band upper-bound -> count, the status-schema shape
+        (`latency_statistics` buckets in Schemas.cpp)."""
+        out: dict[str, int] = {"total": self.total}
+        for ub, c in zip(self.bands, self.counts):
+            out[f"{ub:g}"] = c
+        out["inf"] = self.counts[-1]
+        return out

@@ -72,11 +72,16 @@ class SpanExporter:
         if self.trace_log is not None:
             from foundationdb_tpu.utils.trace import SEV_DEBUG, TraceEvent
 
-            ev = TraceEvent("Span", severity=SEV_DEBUG, logger=self.trace_log)
-            for k, v in rec.items():
-                if k != "attributes":
-                    ev.detail(k, v)
-            ev.log()
+            # detail keys are CamelCase like every reference TraceEvent
+            # (the trace.detail-case flowcheck rule); the in-memory
+            # `finished` records keep their snake_case shape for tools
+            TraceEvent("Span", severity=SEV_DEBUG, logger=self.trace_log) \
+                .detail("Location", rec["location"]) \
+                .detail("TraceID", rec["trace_id"]) \
+                .detail("SpanID", rec["span_id"]) \
+                .detail("ParentID", rec["parent_id"]) \
+                .detail("Begin", rec["begin"]) \
+                .detail("End", rec["end"]).log()
 
     def traces(self, trace_id: int) -> list[dict]:
         return [s for s in self.finished if s["trace_id"] == trace_id]
@@ -118,6 +123,12 @@ class Span:
         self.end: Optional[float] = None
         self.attributes: dict = {}
         self._finished = False
+        # Bound at CREATION, not finish: a span owned by an abandoned
+        # coroutine may only finish when the GC finalizes the generator
+        # — inside some LATER run with a different active exporter.
+        # Exporting there would pollute that run's (deterministic,
+        # digested) trace with this run's leftovers.
+        self._exporter = _exporter
 
     def attribute(self, key: str, value) -> "Span":
         self.attributes[key] = value
@@ -127,7 +138,7 @@ class Span:
         if not self._finished:
             self._finished = True
             self.end = self._clock()
-            _exporter.export(self)
+            self._exporter.export(self)
 
     def __enter__(self) -> "Span":
         return self

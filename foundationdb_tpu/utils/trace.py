@@ -9,14 +9,25 @@ Behavioral mirror of `flow/Trace.cpp`:
   commit/GRV-path micro-events with Location strings
   ("Resolver.resolveBatch.Before"...) used for latency debugging — the
   TPU resolver emits the same locations so the reference's
-  commit-debugging methodology (contrib/commit_debug.py) transfers.
+  commit-debugging methodology (contrib/commit_debug.py; here
+  scripts/commit_debug.py) transfers.
 * `trace_counters` (fdbrpc/Stats.h:93): periodic counter snapshot events.
+
+The process-global sinks (`g_trace`, `g_trace_batch`) are swappable per
+run via `install()` — a simulation seed installs fresh sinks bound to
+the virtual clock so trace output is deterministic and bit-reproducible
+per (seed, perturb), then restores the previous ones.
 """
 
 from __future__ import annotations
 
 import json
+import os
 from typing import Any, Callable, Optional
+
+from foundationdb_tpu.utils.probes import code_probe, declare
+
+declare("metrics.counters_flushed")
 
 SEV_DEBUG = 5
 SEV_INFO = 10
@@ -50,7 +61,15 @@ class TraceEvent:
 
 
 class TraceLog:
-    """In-memory + optional JSONL-file sink with severity filtering."""
+    """In-memory + optional JSONL-file sink with severity filtering.
+
+    Both sinks roll at `max_events`: the in-memory list drops its oldest
+    half, and the file sink rotates `path` -> `path + ".1"` (one
+    generation retained, the reference's rolled-file discipline) so a
+    long run's trace is bounded on disk too. Tools that want a complete
+    trace (scripts/commit_debug.py) read `path.1` + `path`, or raise
+    max_events for the run.
+    """
 
     def __init__(self, *, min_severity: int = SEV_INFO,
                  clock: Optional[Callable[[], float]] = None,
@@ -59,11 +78,16 @@ class TraceLog:
         self.clock = clock or (lambda: 0.0)
         self.events: list[dict] = []
         self.max_events = max_events
+        self.path = path
+        self.rolls = 0
         self._fh = open(path, "a") if path else None
+        self._file_events = 0
 
     def emit(self, ev: TraceEvent) -> None:
         if ev.severity < self.min_severity:
             return
+        # an explicit "Time" detail wins over the sink clock: batched
+        # micro-events (TraceBatch) carry their own capture time
         rec = {"Type": ev.type, "Severity": ev.severity,
                "Time": round(self.clock(), 6), **ev.fields}
         self.events.append(rec)
@@ -71,9 +95,28 @@ class TraceLog:
             del self.events[: self.max_events // 2]
         if self._fh:
             self._fh.write(json.dumps(_jsonable(rec)) + "\n")
+            # flushed per event: file sinks live in role processes that
+            # die by SIGTERM (cluster/multiprocess.py), and a buffered
+            # tail lost on kill would hole the cross-process timeline
+            self._fh.flush()
+            self._file_events += 1
+            if self._file_events >= self.max_events:
+                self._roll_file()
+
+    def _roll_file(self) -> None:
+        """Rotate the file sink: current -> .1 (previous .1 dropped)."""
+        self.rolls += 1
+        self._file_events = 0
+        self._fh.close()
+        os.replace(self.path, self.path + ".1")
+        self._fh = open(self.path, "a")
 
     def find(self, event_type: str) -> list[dict]:
         return [e for e in self.events if e["Type"] == event_type]
+
+    def flush(self) -> None:
+        if self._fh:
+            self._fh.flush()
 
     def close(self) -> None:
         if self._fh:
@@ -89,20 +132,40 @@ def _jsonable(rec):
 
 
 class TraceBatch:
-    """g_traceBatch: (name, id, location) micro-events on the hot path."""
+    """g_traceBatch: (name, id, location) micro-events on the hot path.
 
-    def __init__(self, clock: Optional[Callable[[], float]] = None):
+    With a `logger`, every event lands in that TraceLog as a structured
+    record (Type=name, ID, Location, Time) — the shape the reference's
+    batched events take in the trace file, and what
+    scripts/commit_debug.py ingests. The in-process buffer (`dump()`)
+    is only kept WITHOUT a logger: the TraceLog is the bounded sink of
+    record, and duplicating every micro-event into an unbounded list
+    nothing drains would grow without limit on long traced runs.
+    """
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None,
+                 *, logger: Optional[TraceLog] = None, enabled: bool = True):
         self.clock = clock or (lambda: 0.0)
         self.events: list[tuple[float, str, str, str]] = []
-        self.enabled = True
+        self.enabled = enabled
+        self.logger = logger
+
+    def _record(self, name: str, ident: str, location: str) -> None:
+        t = self.clock()
+        if self.logger is not None:
+            TraceEvent(name, severity=SEV_DEBUG, logger=self.logger) \
+                .detail("ID", ident).detail("Location", location) \
+                .detail("Time", round(t, 6)).log()
+        else:
+            self.events.append((t, name, ident, location))
 
     def add_event(self, name: str, ident: str, location: str) -> None:
         if self.enabled:
-            self.events.append((self.clock(), name, ident, location))
+            self._record(name, ident, location)
 
     def add_attach(self, name: str, ident: str, to: str) -> None:
         if self.enabled:
-            self.events.append((self.clock(), name, ident, f"attach:{to}"))
+            self._record(name, ident, f"attach:{to}")
 
     def dump(self) -> list[tuple[float, str, str, str]]:
         out, self.events = self.events, []
@@ -111,12 +174,22 @@ class TraceBatch:
 
 def trace_counters(logger: TraceLog, name: str, ident: str, counters) -> None:
     """Periodic counter snapshot (CounterCollection::traceCounters)."""
+    code_probe(True, "metrics.counters_flushed")
     ev = TraceEvent(name, logger=logger).detail("ID", ident)
     for k, v in counters.as_dict().items():
         ev.detail(k, v)
     ev.log()
 
 
-#: process-global default sinks (swappable in tests / roles)
+#: process-global default sinks (swappable in tests / roles / seeds)
 g_trace = TraceLog()
-g_trace_batch = TraceBatch()
+g_trace_batch = TraceBatch(enabled=False)  # enabled per run via install()
+
+
+def install(log: TraceLog, batch: TraceBatch):
+    """Install per-run sinks; returns the previous (log, batch) pair so
+    callers can restore them (the spans.set_exporter discipline)."""
+    global g_trace, g_trace_batch
+    old = (g_trace, g_trace_batch)
+    g_trace, g_trace_batch = log, batch
+    return old

@@ -28,7 +28,7 @@ from foundationdb_tpu.models.types import (
 )
 
 #: Bumped whenever any wire layout changes; checked at connect time.
-PROTOCOL_VERSION = 0x0FDB_7E50_0005  # 0003: private_mutations; 0004: span context; 0005: lock_aware txn flag
+PROTOCOL_VERSION = 0x0FDB_7E50_0006  # 0004: span context; 0005: lock_aware txn flag; 0006: per-txn debug_id + span
 
 
 class CodecError(ValueError):
@@ -173,6 +173,10 @@ def w_commit_transaction(out: list, t: CommitTransaction) -> None:
     w_i64(out, t.read_snapshot)
     w_bool(out, t.report_conflicting_keys)
     w_bool(out, t.lock_aware)
+    w_str(out, t.debug_id)
+    tid, sid = t.span if t.span else (0, 0)
+    w_u64(out, tid)
+    w_u64(out, sid)
     w_u32(out, len(t.mutations))
     for m in t.mutations:
         w_mutation(out, m)
@@ -194,6 +198,9 @@ def r_commit_transaction(buf: memoryview, off: int) -> tuple[CommitTransaction, 
     snap, off = r_i64(buf, off)
     rck, off = r_bool(buf, off)
     lock_aware, off = r_bool(buf, off)
+    debug_id, off = r_str(buf, off)
+    tid, off = r_u64(buf, off)
+    sid, off = r_u64(buf, off)
     n, off = r_u32(buf, off)
     muts = []
     for _ in range(n):
@@ -206,6 +213,8 @@ def r_commit_transaction(buf: memoryview, off: int) -> tuple[CommitTransaction, 
             read_snapshot=snap,
             report_conflicting_keys=rck,
             lock_aware=lock_aware,
+            debug_id=debug_id,
+            span=(tid, sid) if (tid or sid) else None,
             mutations=muts,
         ),
         off,

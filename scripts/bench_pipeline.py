@@ -138,6 +138,29 @@ async def _run_wire(backend: str, args) -> dict:
     from foundationdb_tpu.models.types import CommitTransaction
     from foundationdb_tpu.wire.codec import Mutation
 
+    trace_dir = getattr(args, "trace_dir", None)
+    if trace_dir:
+        # span-threaded wire run: the proxy process emits CommitProxy.*
+        # micro-events + batch spans to its own JSONL file, resolve
+        # requests carry (trace_id, span_id) + debug ids over the UDS
+        # wire, and the resolver PROCESS writes child spans to ITS file
+        # — scripts/commit_debug.py merges them into one cross-process
+        # timeline per committed transaction.
+        import time as _time
+
+        from foundationdb_tpu.utils import spans as _spans
+        from foundationdb_tpu.utils import trace as _tr
+
+        os.makedirs(trace_dir, exist_ok=True)
+        sink = _tr.TraceLog(
+            min_severity=_tr.SEV_DEBUG, clock=_time.time,
+            path=os.path.join(trace_dir, f"proxy-{backend}.jsonl"),
+        )
+        _tr.install(
+            sink, _tr.TraceBatch(clock=_time.time, logger=sink, enabled=True)
+        )
+        _spans.set_exporter(_spans.SpanExporter(trace_log=sink))
+
     if backend in ("cpu", "tpu", "tpu-force"):
         kcfg = kernel_config(args.kernel_txns, tiered=not args.classic_kernel)
         os.environ["RESOLVER_KERNEL"] = (
@@ -149,8 +172,14 @@ async def _run_wire(backend: str, args) -> dict:
             f"delta_capacity={kcfg.delta_capacity})"
         )
     with tempfile.TemporaryDirectory() as sock_dir:
+        def role_trace(name):
+            if not trace_dir:
+                return None
+            return os.path.join(trace_dir, f"{name}-{backend}.jsonl")
+
         procs = [
-            mp.spawn_role("resolver", sock_dir, backend=backend),
+            mp.spawn_role("resolver", sock_dir, backend=backend,
+                          trace_file=role_trace("resolver")),
             mp.spawn_role("tlog", sock_dir),
             mp.spawn_role("storage", sock_dir),
         ]
@@ -161,6 +190,7 @@ async def _run_wire(backend: str, args) -> dict:
             pipe = mp.ProxyPipeline(
                 [resolver], tlog, storage,
                 batch_interval=0.001, max_batch=args.batch,
+                trace=bool(trace_dir),
             )
             pipe.start()
 
@@ -170,7 +200,7 @@ async def _run_wire(backend: str, args) -> dict:
 
             async def client(cid: int):
                 rng = np.random.default_rng(cid)
-                for _ in range(args.ops):
+                for op_i in range(args.ops):
                     key = b"ycsb%06d" % int(rng.zipf(1.2) % args.records)
                     kr = (key, key + b"\x00")
                     if rng.random() < 0.5:  # RMW with bounded retries
@@ -182,18 +212,37 @@ async def _run_wire(backend: str, args) -> dict:
                             rv = await pipe.get_read_version()
                             cur = await pipe.read(key, rv)
                             n = int.from_bytes(cur or b"\0" * 8, "little")
-                            try:
-                                await pipe.commit(
-                                    CommitTransaction(
-                                        read_conflict_ranges=[kr],
-                                        write_conflict_ranges=[kr],
-                                        read_snapshot=rv,
-                                        mutations=[Mutation(
-                                            0, key,
-                                            (n + 1).to_bytes(8, "little"),
-                                        )],
-                                    )
+                            txn = CommitTransaction(
+                                read_conflict_ranges=[kr],
+                                write_conflict_ranges=[kr],
+                                read_snapshot=rv,
+                                mutations=[Mutation(
+                                    0, key,
+                                    (n + 1).to_bytes(8, "little"),
+                                )],
+                            )
+                            if trace_dir:
+                                from foundationdb_tpu.utils import (
+                                    commit_debug as _cdbg,
                                 )
+                                from foundationdb_tpu.utils import (
+                                    trace as _tr,
+                                )
+
+                                txn.debug_id = (
+                                    f"wire-{cid}-{op_i}-{_attempt}"
+                                )
+                                _tr.g_trace_batch.add_event(
+                                    "CommitDebug", txn.debug_id,
+                                    _cdbg.COMMIT_BEFORE,
+                                )
+                            try:
+                                await pipe.commit(txn)
+                                if trace_dir:
+                                    _tr.g_trace_batch.add_event(
+                                        "CommitDebug", txn.debug_id,
+                                        _cdbg.COMMIT_AFTER,
+                                    )
                                 if len(lat) < 100_000:
                                     lat.append(time.perf_counter() - t0)
                                 stats["committed"] += 1
@@ -229,6 +278,36 @@ async def _run_wire(backend: str, args) -> dict:
             for p in procs:
                 p.stop()
             os.environ.pop("RESOLVER_KERNEL", None)
+    if trace_dir:
+        # merge this process's trace with the resolver process's and
+        # reconstruct: committed wire transactions must chain across the
+        # process boundary (same trace ids on both sides of the UDS)
+        from foundationdb_tpu.utils import commit_debug as cd
+
+        sink.flush()
+        # rolled generations first (TraceLog rotates path -> path.1 at
+        # max_events): a big run's older half lives in the .1 files
+        files = [
+            p
+            for base in (
+                os.path.join(trace_dir, f"proxy-{backend}.jsonl"),
+                os.path.join(trace_dir, f"resolver-{backend}.jsonl"),
+            )
+            for p in (base + ".1", base)
+            if os.path.exists(p)
+        ]
+        idx = cd.TraceIndex(cd.load_jsonl(files))
+        tls = idx.timelines()
+        cross = [
+            tl for tl in tls
+            if cd.RESOLVER_BEFORE in tl.locations()
+        ]
+        print(
+            f"[trace] {len(tls)} committed timeline(s), "
+            f"{len(cross)} crossed the process boundary "
+            f"(resolver events from the child process); "
+            f"files: {files}", flush=True,
+        )
     # same successful-ops definition as cluster mode (cross-mode
     # comparable); "conflicted" counts retried attempts
     ops = stats["committed"] + stats["reads"]
@@ -265,6 +344,11 @@ def main():
     ap.add_argument("--spec5", action="store_true",
                     help="BASELINE.md:36 config-5 preset: wire mode, 256K "
                          "in-flight, both backends")
+    ap.add_argument("--trace-dir", default=None,
+                    help="wire mode: write per-process TraceLog JSONL "
+                         "files here, thread span contexts + debug ids "
+                         "across the UDS, and reconstruct cross-process "
+                         "timelines after the run (commit_debug)")
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
     if args.legacy:

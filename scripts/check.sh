@@ -35,6 +35,13 @@ echo "== seed x 3 schedule perturbations, api workload + auditor on)    =="
 # --perturb runs the unperturbed base seed first, so one lane covers both
 JAX_PLATFORMS=cpu python scripts/soak.py --smoke --perturb 3
 
+echo "== commit_debug smoke (one traced seed: the reconstructor must   =="
+echo "== yield >=1 complete commit timeline, zero chain violations)    =="
+t0=$(date +%s.%N)
+JAX_PLATFORMS=cpu python scripts/commit_debug.py --smoke
+t1=$(date +%s.%N)
+awk -v a="$t0" -v b="$t1" 'BEGIN {printf "commit_debug smoke wall time: %.1fs\n", b - a}'
+
 echo "== pytest (fast lane: -m 'not slow') =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider "$@"

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Reconstruct per-transaction commit timelines from a TraceLog JSONL file.
+
+The contrib/commit_debug.py role for this framework: ingest the
+trace_batch micro-events ("CommitProxy.commitBatch.Before",
+"Resolver.resolveBatch.AfterQueueSizeCheck", ...), the CommitAttachID
+attach records and the CommitDebugVersion version-join records, and
+print one timeline per committed transaction plus an aggregated stage
+waterfall (GRV / batching / get-version / resolution / logging / reply).
+The chain-integrity checks (the soak span-chain gate) run over the same
+input and report violations.
+
+Usage:
+  python scripts/commit_debug.py trace.jsonl [trace.jsonl.1 ...]
+  python scripts/commit_debug.py --smoke     # run one traced seed, check
+  python scripts/commit_debug.py trace.jsonl --timelines 5 --check
+
+With multiple files (a rolled trace, or one file per role process from a
+wire-mode run) pass them oldest-first; records are merged before
+reconstruction, which is how a `bench_pipeline.py --mode wire --trace-dir`
+run's per-process traces become one cross-process timeline.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_smoke() -> int:
+    """The check.sh lane: one short traced seed must yield >=1 complete
+    commit timeline and ZERO chain-integrity violations (~seconds)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from foundationdb_tpu.testing.soak import run_seed
+    from foundationdb_tpu.utils import commit_debug as cd
+    from foundationdb_tpu.utils import trace as _tr
+
+    captured = {}
+    orig = _tr.install
+
+    def spy(log, batch):
+        captured.setdefault("log", log)
+        return orig(log, batch)
+
+    _tr.install = spy
+    try:
+        # smoke spec: the shortest checked-in seed shape; run_seed's own
+        # span-chain gate already fails on violations — the reconstructor
+        # below re-checks from the RAW events like the offline CLI would
+        sig = run_seed(1, spec="smoke", trace=True)
+    finally:
+        _tr.install = orig
+    events = captured["log"].events
+    index = cd.TraceIndex(events)
+    timelines = index.timelines()
+    violations = cd.check_chains(index)
+    complete = [
+        tl for tl in timelines
+        if {"grv", "resolution", "logging", "total"}
+        <= set(tl.stage_durations())
+    ]
+    print(
+        f"commit_debug smoke: {len(events)} events, "
+        f"{len(timelines)} committed timeline(s), "
+        f"{len(complete)} with a full stage waterfall, "
+        f"{len(violations)} violation(s); trace digest {sig[-2][:12]}"
+    )
+    if not timelines or violations:
+        print("SMOKE FAILED")
+        for v in violations[:10]:
+            print(f"  {v}")
+        return 1
+    wf = cd.waterfall(timelines)
+    for stage in ("grv", "batching", "get_version", "resolution",
+                  "logging", "reply", "total"):
+        if stage in wf:
+            s = wf[stage]
+            print(
+                f"  {stage:12s} n={s['count']:4d} mean={s['mean']*1e3:8.3f}ms"
+                f" p50={s['p50']*1e3:8.3f}ms max={s['max']*1e3:8.3f}ms"
+            )
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("files", nargs="*", help="TraceLog JSONL file(s)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run one traced smoke seed and self-check")
+    ap.add_argument("--timelines", type=int, default=3,
+                    help="print the N slowest timelines (0 = none)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit nonzero on chain-integrity violations")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the waterfall as one JSON object")
+    args = ap.parse_args()
+
+    if args.smoke:
+        return run_smoke()
+    if not args.files:
+        ap.error("pass TraceLog JSONL file(s) or --smoke")
+
+    from foundationdb_tpu.utils import commit_debug as cd
+
+    records = cd.load_jsonl(args.files)
+    index = cd.TraceIndex(records)
+    timelines = index.timelines()
+    violations = cd.check_chains(index)
+    wf = cd.waterfall(timelines)
+
+    if args.json:
+        print(json.dumps({
+            "events": len(records),
+            "committed_timelines": len(timelines),
+            "violations": violations,
+            "waterfall": wf,
+        }))
+    else:
+        print(
+            f"{len(records)} events -> {len(timelines)} committed "
+            f"transaction timeline(s), {len(violations)} violation(s)"
+        )
+        if wf:
+            print("stage waterfall (seconds):")
+            for stage, s in sorted(wf.items()):
+                print(
+                    f"  {stage:12s} n={s['count']:5d} "
+                    f"mean={s['mean']*1e3:9.3f}ms p50={s['p50']*1e3:9.3f}ms "
+                    f"max={s['max']*1e3:9.3f}ms"
+                )
+        if args.timelines:
+            slowest = sorted(
+                timelines,
+                key=lambda tl: tl.stage_durations().get("total", 0.0),
+                reverse=True,
+            )[: args.timelines]
+            for tl in slowest:
+                print()
+                print(cd.render_timeline(tl))
+        for v in violations[:20]:
+            print(f"VIOLATION: {v}")
+    return 1 if (args.check and violations) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,7 +31,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ["JAX_PLATFORMS"] = "cpu"  # force off any device tunnel (sim is CPU-only)
 
 
-def _perturbed_rerun(seed, spec, pid, spec_label):
+def _perturbed_rerun(seed, spec, pid, spec_label, trace=False):
     """One perturbed re-run with the (seed, perturb) pair named in any
     failure — run_seed's own asserts only know the seed, and a report
     that can't be reproduced is no report (both sweep and smoke lanes
@@ -39,7 +39,7 @@ def _perturbed_rerun(seed, spec, pid, spec_label):
     from foundationdb_tpu.testing import soak
 
     try:
-        return soak.run_seed(seed, spec=spec, perturb=pid)
+        return soak.run_seed(seed, spec=spec, perturb=pid, trace=trace)
     except Exception as e:
         raise AssertionError(
             f"seed {seed} perturb {pid} (spec {spec_label}): {e}"
@@ -47,13 +47,15 @@ def _perturbed_rerun(seed, spec, pid, spec_label):
 
 
 def _one(args):
-    seed, spec_name, check_determinism, perturb = args
+    seed, spec_name, check_determinism, perturb, trace = args
     from foundationdb_tpu.testing import soak
 
     t0 = time.perf_counter()
-    sig, hits = soak.run_seed(seed, spec=spec_name, collect_probes=True)
+    sig, hits = soak.run_seed(
+        seed, spec=spec_name, collect_probes=True, trace=trace
+    )
     if check_determinism:
-        sig2 = soak.run_seed(seed, spec=spec_name)
+        sig2 = soak.run_seed(seed, spec=spec_name, trace=trace)
         if sig != sig2:
             raise AssertionError(
                 f"seed {seed} (spec {spec_name}): NONDETERMINISTIC\n"
@@ -69,9 +71,11 @@ def _one(args):
     # seeds every (seed, perturb) pair runs twice and must match —
     # the unseed-determinism contract extended to perturbed schedules.
     for pid in range(1, perturb + 1):
-        psig = _perturbed_rerun(seed, spec_name, pid, spec_name)
+        psig = _perturbed_rerun(seed, spec_name, pid, spec_name, trace=trace)
         if check_determinism:
-            psig2 = soak.run_seed(seed, spec=spec_name, perturb=pid)
+            psig2 = soak.run_seed(
+                seed, spec=spec_name, perturb=pid, trace=trace
+            )
             if psig != psig2:
                 raise AssertionError(
                     f"seed {seed} perturb {pid} (spec {spec_name}): "
@@ -81,7 +85,7 @@ def _one(args):
 
 
 def sweep(spec_name: str, seeds: list, jobs: int, probe_gate: bool,
-          perturb: int = 0) -> int:
+          perturb: int = 0, trace: bool = False) -> int:
     """Run one spec's seed sweep; returns the number of failures."""
     from foundationdb_tpu.testing.spec import load_spec
     from foundationdb_tpu.utils import probes as _probes
@@ -89,7 +93,7 @@ def sweep(spec_name: str, seeds: list, jobs: int, probe_gate: bool,
     spec = load_spec(spec_name)
     det_every = spec.policy["determinism_every"]
     work = [
-        (s, spec_name, i % det_every == 0, perturb)
+        (s, spec_name, i % det_every == 0, perturb, trace)
         for i, s in enumerate(seeds)
     ]
     t0 = time.perf_counter()
@@ -196,6 +200,13 @@ def main():
              "must still pass and each (seed, perturbation) must be "
              "exactly reproducible",
     )
+    ap.add_argument(
+        "--trace", action="store_true",
+        help="run every seed with commit-path telemetry on: the "
+             "span-chain gate arms (a committed txn missing a pipeline "
+             "stage fails the seed) and the trace digest joins the "
+             "determinism signature (bit-identical per seed/perturb)",
+    )
     args = ap.parse_args()
 
     from foundationdb_tpu.utils import probes as _probes
@@ -226,11 +237,12 @@ def main():
             )
             t0 = time.perf_counter()
             try:
-                sig = soak.run_seed(args.start, spec=spec)
+                sig = soak.run_seed(args.start, spec=spec, trace=args.trace)
                 # the perturbation smoke lane: K reorderings of the
                 # same smoke seed must all pass every gate
                 for pid in range(1, args.perturb + 1):
-                    _perturbed_rerun(args.start, spec, pid, name)
+                    _perturbed_rerun(args.start, spec, pid, name,
+                                     trace=args.trace)
                 print(
                     f"spec {name:16s} seed {args.start} ok in "
                     f"{time.perf_counter() - t0:4.1f}s  "
@@ -247,7 +259,8 @@ def main():
         return
 
     seeds = list(range(args.start, args.start + args.seeds))
-    if sweep(args.spec, seeds, args.jobs, args.probe_gate, args.perturb):
+    if sweep(args.spec, seeds, args.jobs, args.probe_gate, args.perturb,
+             trace=args.trace):
         sys.exit(1)
 
 

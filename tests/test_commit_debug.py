@@ -1,0 +1,278 @@
+"""commit_debug reconstruction + the span-chain soak gate.
+
+Unit layer: synthetic TraceLog records through utils/commit_debug —
+timelines, waterfall, every chain-integrity check in BOTH directions
+(clean input passes; each corruption class is caught). Integration
+layer: run_seed(trace=True) — a real soak seed must reconstruct a
+complete GRV -> commit -> resolve -> tlog -> storage timeline for every
+committed transaction, bit-reproducibly, and the gate's divergence
+self-test (_corrupt_trace) must fail the seed.
+"""
+
+import pytest
+
+from foundationdb_tpu.utils import commit_debug as cd
+
+# -- synthetic-chain helpers ------------------------------------------------
+
+
+def micro(loc, ident, t, name="CommitDebug"):
+    return {"Type": name, "ID": ident, "Location": loc, "Time": t}
+
+
+def full_chain(txn="t1", batch="b1", version=100, messages=2):
+    """One committed transaction's complete event set."""
+    return [
+        micro(cd.GRV_BEFORE, txn, 0.00, "TransactionDebug"),
+        micro(cd.GRV_REPLY, txn, 0.008, "TransactionDebug"),
+        micro(cd.GRV_AFTER, txn, 0.01, "TransactionDebug"),
+        micro(cd.COMMIT_BEFORE, txn, 0.02),
+        micro(f"attach:{batch}", txn, 0.03, "CommitAttachID"),
+        micro(cd.BATCH_BEFORE, batch, 0.03),
+        micro(cd.BATCH_GETTING_VERSION, batch, 0.031),
+        micro(cd.BATCH_GOT_VERSION, batch, 0.032),
+        micro(cd.RESOLVER_BEFORE, batch, 0.033),
+        micro(cd.RESOLVER_AFTER_QUEUE, batch, 0.0335),
+        micro(cd.RESOLVER_AFTER_ORDERER, batch, 0.034),
+        micro(cd.RESOLVER_AFTER, batch, 0.035),
+        micro(cd.BATCH_AFTER_RESOLUTION, batch, 0.036),
+        {"Type": "CommitDebugVersion", "ID": batch, "Version": version,
+         "Messages": messages, "Time": 0.036},
+        micro(cd.TLOG_BEFORE_WAIT, batch, 0.0365),
+        micro(cd.TLOG_AFTER_COMMIT, batch, 0.037),
+        micro(cd.BATCH_AFTER_LOG_PUSH, batch, 0.038),
+        micro(cd.STORAGE_APPLIED, cd.version_id(version), 0.04),
+        micro(cd.COMMIT_AFTER, txn, 0.05),
+    ]
+
+
+def violations_of(records):
+    return cd.check_chains(cd.TraceIndex(records))
+
+
+# -- reconstruction ---------------------------------------------------------
+
+
+def test_full_chain_reconstructs_clean():
+    idx = cd.TraceIndex(full_chain())
+    assert idx.committed_ids() == ["t1"]
+    (tl,) = idx.timelines()
+    assert tl.batch_id == "b1" and tl.version == 100
+    # every stage present, time-ascending
+    times = [t for t, _loc in tl.events]
+    assert times == sorted(times)
+    stages = tl.stage_durations()
+    assert set(stages) >= {
+        "grv", "batching", "get_version", "resolution", "logging",
+        "reply", "total",
+    }
+    assert stages["total"] == pytest.approx(0.03)
+    assert stages["grv"] == pytest.approx(0.01)
+    assert violations_of(full_chain()) == []
+
+
+def test_two_txns_share_a_batch():
+    recs = full_chain("t1", "b1") + [
+        micro(cd.COMMIT_BEFORE, "t2", 0.021),
+        micro("attach:b1", "t2", 0.03, "CommitAttachID"),
+        micro(cd.COMMIT_AFTER, "t2", 0.051),
+    ]
+    idx = cd.TraceIndex(recs)
+    assert idx.committed_ids() == ["t1", "t2"]
+    assert violations_of(recs) == []
+    wf = cd.waterfall(idx.timelines())
+    assert wf["total"]["count"] == 2
+
+
+def test_waterfall_and_render():
+    idx = cd.TraceIndex(full_chain())
+    wf = cd.waterfall(idx.timelines())
+    assert wf["resolution"]["count"] == 1
+    assert wf["logging"]["mean"] > 0
+    out = cd.render_timeline(idx.timelines()[0])
+    assert "t1" in out and cd.RESOLVER_BEFORE in out
+
+
+def test_uncommitted_txn_not_gated():
+    """No COMMIT_AFTER -> not a committed chain, nothing required."""
+    recs = [
+        micro(cd.COMMIT_BEFORE, "t9", 0.0),
+        micro("attach:b9", "t9", 0.001, "CommitAttachID"),
+    ]
+    idx = cd.TraceIndex(recs)
+    assert idx.committed_ids() == []
+    assert violations_of(recs) == []
+
+
+# -- each corruption class is caught ---------------------------------------
+
+
+@pytest.mark.parametrize("drop", [
+    cd.BATCH_BEFORE,
+    cd.BATCH_GOT_VERSION,
+    cd.BATCH_AFTER_RESOLUTION,
+    cd.BATCH_AFTER_LOG_PUSH,
+    cd.RESOLVER_BEFORE,
+    cd.RESOLVER_AFTER,
+    cd.TLOG_AFTER_COMMIT,
+])
+def test_missing_pipeline_stage_is_a_violation(drop):
+    recs = [r for r in full_chain() if r.get("Location") != drop]
+    vs = violations_of(recs)
+    assert vs and "missing pipeline stage" in vs[0]
+    assert drop in vs[0]
+
+
+def test_missing_storage_apply_is_a_violation_iff_messages():
+    no_storage = [
+        r for r in full_chain()
+        if r.get("Location") != cd.STORAGE_APPLIED
+    ]
+    vs = violations_of(no_storage)
+    assert vs and "storage message tag" in vs[0]
+    # a batch with ZERO storage messages (conflict-range-only commits)
+    # legitimately has no storage apply
+    empty = [
+        r for r in full_chain(messages=0)
+        if r.get("Location") != cd.STORAGE_APPLIED
+    ]
+    assert violations_of(empty) == []
+
+
+def test_orphan_commit_and_half_grv_are_violations():
+    # committed but never attached to any batch
+    recs = [
+        micro(cd.COMMIT_BEFORE, "tx", 0.0),
+        micro(cd.COMMIT_AFTER, "tx", 0.01),
+    ]
+    vs = violations_of(recs)
+    assert vs and "never attached" in vs[0]
+    # GRV issued but never answered
+    recs2 = full_chain()
+    recs2 = [r for r in recs2 if r.get("Location") != cd.GRV_AFTER]
+    assert any("GRV issued" in v for v in violations_of(recs2))
+    # missing CommitDebugVersion join record
+    recs3 = [
+        r for r in full_chain() if r["Type"] != "CommitDebugVersion"
+    ]
+    assert any("CommitDebugVersion" in v for v in violations_of(recs3))
+
+
+def test_span_checks_orphan_and_time_inversion():
+    spans = [
+        {"location": "a.commitBatch", "span_id": 1, "parent_id": 0,
+         "begin": 0.0, "end": 1.0},
+        {"location": "r.resolveBatch", "span_id": 2, "parent_id": 1,
+         "begin": 0.1, "end": 0.9},
+    ]
+    assert cd.check_spans(spans) == []
+    orphan = spans + [
+        {"location": "x", "span_id": 3, "parent_id": 99,
+         "begin": 0.0, "end": 0.1},
+    ]
+    assert any("orphan parent 99" in v for v in cd.check_spans(orphan))
+    inverted = spans + [
+        {"location": "y", "span_id": 4, "parent_id": 0,
+         "begin": 0.5, "end": 0.2},
+    ]
+    assert any("before begin" in v for v in cd.check_spans(inverted))
+    # the TraceLog "Span" sink shape (CamelCase keys) parses identically
+    camel = [
+        {"Location": "a.commitBatch", "SpanID": 1, "ParentID": 0,
+         "Begin": 0.0, "End": 1.0},
+    ]
+    assert cd.check_spans(camel) == []
+
+
+def test_gate_probe_fires_on_violation():
+    from foundationdb_tpu.utils import probes
+
+    before = probes.snapshot().get("trace.span_chain_gate_tripped", 0)
+    violations_of(full_chain())  # clean: no hit
+    assert probes.snapshot().get(
+        "trace.span_chain_gate_tripped", 0) == before
+    violations_of([
+        micro(cd.COMMIT_BEFORE, "tx", 0.0),
+        micro(cd.COMMIT_AFTER, "tx", 0.01),
+    ])
+    assert probes.snapshot()["trace.span_chain_gate_tripped"] == before + 1
+
+
+def test_load_jsonl_roundtrip(tmp_path):
+    import json
+
+    p = tmp_path / "t.jsonl"
+    p.write_text(
+        "\n".join(json.dumps(r) for r in full_chain()) + "\n"
+    )
+    assert violations_of(cd.load_jsonl([str(p)])) == []
+
+
+# -- wire codec: the per-txn telemetry fields travel ------------------------
+
+
+def test_commit_transaction_codec_carries_debug_id_and_span():
+    from foundationdb_tpu.models.types import CommitTransaction
+    from foundationdb_tpu.wire import codec
+
+    t = CommitTransaction(
+        read_conflict_ranges=[(b"a", b"b")],
+        debug_id="origin-1-7",
+        span=(123456, 789),
+    )
+    got = codec.decode(codec.encode(t))
+    assert got.debug_id == "origin-1-7"
+    assert got.span == (123456, 789)
+    bare = codec.decode(codec.encode(CommitTransaction()))
+    assert bare.debug_id is None and bare.span is None
+
+
+# -- the traced soak seed (integration) -------------------------------------
+
+
+def test_traced_seed_reconstructs_every_commit():
+    """The acceptance shape: a traced soak seed yields a complete
+    pipeline timeline for every committed transaction, and the trace
+    digest is bit-identical across a re-run."""
+    from foundationdb_tpu.testing.soak import run_seed
+    from foundationdb_tpu.utils import trace as _tr
+
+    captured = {}
+    orig = _tr.install
+
+    def spy(log, batch):
+        captured.setdefault("log", log)
+        return orig(log, batch)
+
+    _tr.install = spy
+    try:
+        sig = run_seed(1, spec="smoke", trace=True)
+    finally:
+        _tr.install = orig
+    digest, n_chains = sig[-2], sig[-1]
+    assert n_chains >= 1
+    idx = cd.TraceIndex(captured["log"].events)
+    assert cd.check_chains(idx) == []
+    # every committed txn's timeline covers resolve AND logging
+    for tl in idx.timelines():
+        assert cd.RESOLVER_BEFORE in tl.locations()
+        assert cd.BATCH_AFTER_LOG_PUSH in tl.locations()
+    # bit-reproducible: same seed, same digest
+    sig2 = run_seed(1, spec="smoke", trace=True)
+    assert sig2[-2] == digest
+
+
+def test_corrupt_trace_fails_the_seed():
+    from foundationdb_tpu.testing.soak import run_seed
+
+    with pytest.raises(AssertionError, match="span-chain violation"):
+        run_seed(1, spec="smoke", trace=True, _corrupt_trace=True)
+
+
+def test_untraced_seed_signature_shape_unchanged():
+    """trace=False keeps the 8-tuple signature (no digest appended):
+    existing determinism tooling reads fixed positions."""
+    from foundationdb_tpu.testing.soak import run_seed
+
+    sig = run_seed(1, spec="smoke")
+    assert len(sig) == 8

@@ -355,6 +355,127 @@ def test_manifest_drift_flagged(tmp_path):
     assert got == ["probe.manifest-drift"]
 
 
+# -- trace-event accounting family (tree checks) ----------------------------
+
+
+def _trace_rules(ctxs, tmp_path, register=True):
+    """Run the trace ledger check; with register=True the fixture's own
+    events are pre-registered so only NON-drift findings surface."""
+    from foundationdb_tpu.analysis.manifest import save_trace_manifest
+    from foundationdb_tpu.analysis.rules_trace import (
+        check_trace_ledger,
+        tree_trace_manifest,
+    )
+
+    man = tmp_path / "tm.json"
+    if register:
+        save_trace_manifest(tree_trace_manifest(ctxs), path=man)
+    return [
+        f.rule for f in check_trace_ledger(ctxs, manifest_path=man)
+    ]
+
+
+def test_trace_lowercase_event_flagged(tmp_path):
+    ctxs = ctxs_from(
+        "from foundationdb_tpu.utils.trace import TraceEvent\n"
+        "def f():\n    TraceEvent('badName').log()\n"
+    )
+    assert "trace.lowercase-event" in _trace_rules(ctxs, tmp_path)
+    ok = ctxs_from(
+        "from foundationdb_tpu.utils.trace import TraceEvent\n"
+        "def f():\n    TraceEvent('GoodName').log()\n"
+    )
+    assert _trace_rules(ok, tmp_path) == []
+
+
+def test_trace_dynamic_event_flagged(tmp_path):
+    ctxs = ctxs_from(
+        "from foundationdb_tpu.utils.trace import TraceEvent\n"
+        "def f(name):\n    TraceEvent(name).log()\n"
+    )
+    assert "trace.dynamic-name" in _trace_rules(ctxs, tmp_path)
+
+
+def test_trace_detail_case_flagged(tmp_path):
+    ctxs = ctxs_from(
+        "from foundationdb_tpu.utils.trace import TraceEvent\n"
+        "def f():\n    TraceEvent('Ev').detail('bad_key', 1).log()\n"
+    )
+    assert "trace.detail-case" in _trace_rules(ctxs, tmp_path)
+    ok = ctxs_from(
+        "from foundationdb_tpu.utils.trace import TraceEvent\n"
+        "def f():\n    TraceEvent('Ev').detail('GoodKey', 1).log()\n"
+    )
+    assert _trace_rules(ok, tmp_path) == []
+
+
+def test_trace_detail_case_is_anchored_to_trace_events(tmp_path):
+    """Only .detail() on a TraceEvent chain is the trace schema's
+    business: an unrelated object's .detail() API must not gate-fail,
+    while name-bound and with-bound TraceEvents are still covered."""
+    unrelated = ctxs_from(
+        "def f(err):\n    err.detail('shard_id', 1)\n"
+    )
+    assert _trace_rules(unrelated, tmp_path) == []
+    bound = ctxs_from(
+        "from foundationdb_tpu.utils.trace import TraceEvent\n"
+        "def f():\n"
+        "    ev = TraceEvent('Ev')\n"
+        "    ev.detail('bad_key', 1)\n"
+        "    ev.log()\n"
+    )
+    assert "trace.detail-case" in _trace_rules(bound, tmp_path)
+    with_bound = ctxs_from(
+        "from foundationdb_tpu.utils.trace import TraceEvent\n"
+        "def f():\n"
+        "    with TraceEvent('Ev') as e:\n"
+        "        e.detail('bad_key', 1)\n"
+    )
+    assert "trace.detail-case" in _trace_rules(with_bound, tmp_path)
+
+
+def test_trace_batch_names_accounted(tmp_path):
+    """g_trace_batch.add_event/add_attach NAME args join the event
+    schema (they render as TraceLog Types) — casing enforced, manifest
+    tracked."""
+    from foundationdb_tpu.analysis.rules_trace import tree_trace_manifest
+
+    ctxs = ctxs_from(
+        "from foundationdb_tpu.utils import trace\n"
+        "def f(d):\n"
+        "    trace.g_trace_batch.add_event('commitDebug', d, 'X.Before')\n"
+    )
+    assert "trace.lowercase-event" in _trace_rules(ctxs, tmp_path)
+    ok = ctxs_from(
+        "from foundationdb_tpu.utils import trace\n"
+        "def f(d):\n"
+        "    trace.g_trace_batch.add_event('CommitDebug', d, 'X.Before')\n"
+        "    trace.g_trace_batch.add_attach('CommitAttachID', d, 'b1')\n"
+    )
+    assert _trace_rules(ok, tmp_path) == []
+    assert set(tree_trace_manifest(ok)) == {"CommitDebug", "CommitAttachID"}
+
+
+def test_trace_manifest_drift_flagged(tmp_path):
+    ctxs = ctxs_from(
+        "from foundationdb_tpu.utils.trace import TraceEvent\n"
+        "def f():\n    TraceEvent('NewEvent').log()\n"
+    )
+    got = _trace_rules(ctxs, tmp_path, register=False)
+    assert got == ["trace.manifest-drift"]
+
+
+def test_live_tree_trace_manifest_is_current():
+    from foundationdb_tpu.analysis.manifest import load_trace_manifest
+    from foundationdb_tpu.analysis.rules_trace import tree_trace_manifest
+
+    result = run_analysis(root=REPO)
+    assert tree_trace_manifest(result.contexts) == load_trace_manifest(), (
+        "trace_manifest.json is stale: run `python -m "
+        "foundationdb_tpu.analysis --write-trace-manifest`"
+    )
+
+
 # -- the live tree: the actual gate ----------------------------------------
 
 

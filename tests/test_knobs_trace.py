@@ -86,21 +86,30 @@ def test_resolver_emits_trace_batch(request):
     from foundationdb_tpu.runtime.flow import Scheduler
     from foundationdb_tpu.utils import trace
 
-    trace.g_trace_batch.dump()
+    # the global batch sink ships disabled; runs that trace install or
+    # enable it explicitly (testing/soak.py run_seed(trace=True))
     sched = Scheduler(sim=True)
-    res = Resolver(sched, TEST_CONFIG)
-    t = sched.spawn(
-        res.resolve(
-            ResolveTransactionBatchRequest(
-                prev_version=-1, version=0, last_received_version=-1,
-                transactions=[], debug_id="dbg1",
+    prev = trace.install(
+        trace.TraceLog(clock=sched.now),
+        trace.TraceBatch(clock=sched.now, enabled=True),
+    )
+    try:
+        res = Resolver(sched, TEST_CONFIG)
+        t = sched.spawn(
+            res.resolve(
+                ResolveTransactionBatchRequest(
+                    prev_version=-1, version=0, last_received_version=-1,
+                    transactions=[], debug_id="dbg1",
+                )
             )
         )
-    )
-    sched.run_until(t.done)
-    locs = [e[3] for e in trace.g_trace_batch.dump() if e[2] == "dbg1"]
+        sched.run_until(t.done)
+        locs = [e[3] for e in trace.g_trace_batch.dump() if e[2] == "dbg1"]
+    finally:
+        trace.install(*prev)
     assert locs == [
         "Resolver.resolveBatch.Before",
+        "Resolver.resolveBatch.AfterQueueSizeCheck",
         "Resolver.resolveBatch.AfterOrderer",
         "Resolver.resolveBatch.After",
     ]

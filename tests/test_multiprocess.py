@@ -194,3 +194,89 @@ def test_multi_resolver_min_combine(tmp_path):
     finally:
         for p in procs:
             p.stop()
+
+
+def test_span_context_propagates_across_process_boundary(tmp_path):
+    """ISSUE 5 wire acceptance: a traced commit batch's span context
+    rides the UDS resolve request into the resolver OS PROCESS, whose
+    child span (same trace id, parent edge) and Resolver.resolveBatch.*
+    micro-events land in its --trace-file — commit_debug merges both
+    processes' files into one cross-process timeline."""
+    import json
+    import time as _time
+
+    from foundationdb_tpu.utils import commit_debug as cd
+    from foundationdb_tpu.utils import spans as _spans
+    from foundationdb_tpu.utils import trace as _tr
+
+    res_trace = str(tmp_path / "resolver.jsonl")
+    procs = [
+        mp.spawn_role("resolver", str(tmp_path), trace_file=res_trace),
+        mp.spawn_role("tlog", str(tmp_path)),
+        mp.spawn_role("storage", str(tmp_path)),
+    ]
+    proxy_trace = str(tmp_path / "proxy.jsonl")
+    sink = _tr.TraceLog(
+        min_severity=_tr.SEV_DEBUG, clock=_time.time, path=proxy_trace
+    )
+    prev_sinks = _tr.install(
+        sink, _tr.TraceBatch(clock=_time.time, logger=sink, enabled=True)
+    )
+    prev_exp = _spans.set_exporter(_spans.SpanExporter(trace_log=sink))
+    try:
+        async def scenario():
+            resolver = await mp.connect(procs[0].address)
+            tlog = await mp.connect(procs[1].address)
+            storage = await mp.connect(procs[2].address)
+            pipe = mp.ProxyPipeline(
+                [resolver], tlog, storage, trace=True
+            )
+            pipe.start()
+            txn = CommitTransaction(
+                write_conflict_ranges=[(b"w", b"w\x00")],
+                mutations=[Mutation(0, b"w", b"1")],
+                debug_id="xproc-1",
+            )
+            _tr.g_trace_batch.add_event(
+                "CommitDebug", "xproc-1", cd.COMMIT_BEFORE
+            )
+            v = await pipe.commit(txn)
+            _tr.g_trace_batch.add_event(
+                "CommitDebug", "xproc-1", cd.COMMIT_AFTER
+            )
+            assert v > 0
+            await pipe.stop()
+            for c in (resolver, tlog, storage):
+                await c.close()
+
+        run(scenario())
+    finally:
+        _tr.install(*prev_sinks)
+        _spans.set_exporter(prev_exp)
+        for p in procs:
+            p.stop()
+
+    proxy_recs = cd.load_jsonl([proxy_trace])
+    res_recs = cd.load_jsonl([res_trace])
+    # the child process exported a resolveBatch span chained to a trace
+    # id minted in THIS process
+    proxy_tids = {
+        r["TraceID"] for r in proxy_recs if r["Type"] == "Span"
+    }
+    child_spans = [
+        r for r in res_recs
+        if r["Type"] == "Span"
+        and r["Location"] == "Resolver.resolveBatch"
+    ]
+    assert child_spans
+    assert any(
+        s["TraceID"] in proxy_tids and s["ParentID"] for s in child_spans
+    )
+    # and the merged files reconstruct one cross-process timeline
+    idx = cd.TraceIndex(proxy_recs + res_recs)
+    (tl,) = idx.timelines()
+    assert tl.debug_id == "xproc-1"
+    locs = tl.locations()
+    assert cd.RESOLVER_BEFORE in locs and cd.RESOLVER_AFTER in locs
+    assert cd.TLOG_AFTER_COMMIT in locs and cd.STORAGE_APPLIED in locs
+    assert json.dumps(tl.stage_durations())  # waterfall JSON-able

@@ -497,6 +497,29 @@ def test_every_declared_probe_fires():
     sched8.run_until(sched8.spawn(_blocker(), name="probe-blocker").done)
     assert sched8.slow_tasks
 
+    # -- telemetry probes (ISSUE 5) ---------------------------------------
+    # latency band overflow: a sample past every threshold hits the inf
+    # bucket; counter flush: one trace_counters call; span-chain gate:
+    # the checker over a deliberately broken chain must trip
+    from foundationdb_tpu.utils import commit_debug as cdbg
+    from foundationdb_tpu.utils.metrics import (
+        CounterCollection,
+        LatencyBands,
+    )
+    from foundationdb_tpu.utils.trace import TraceLog, trace_counters
+
+    LatencyBands("probe", bands=(0.001,)).add(9.0)
+    trace_counters(
+        TraceLog(), "ProbeMetrics", "r0", CounterCollection("m", ["a"])
+    )
+    broken = cdbg.check_chains(cdbg.TraceIndex([
+        {"Type": "CommitDebug", "ID": "tp", "Time": 0.0,
+         "Location": cdbg.COMMIT_BEFORE},
+        {"Type": "CommitDebug", "ID": "tp", "Time": 0.1,
+         "Location": cdbg.COMMIT_AFTER},
+    ]))
+    assert broken  # committed txn never attached to a batch
+
     assert probes.missed() == [], (
         f"declared CODE_PROBEs never fired: {probes.missed()}\n"
         f"fired: { {k: v for k, v in probes.snapshot().items() if v} }"
