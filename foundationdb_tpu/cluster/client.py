@@ -102,6 +102,10 @@ class Transaction:
         #: against the Ratekeeper's per-tag quota (tag throttling)
         self.tag = tag
         self._read_version: Optional[int] = None
+        # in-flight GRV request (prefetch_read_version): issued without
+        # awaiting so read-set building overlaps the GRV batch roundtrip
+        self._grv_promise = None
+        self._grv_span = None
         self.writes = WriteMap()
         self.mutations: list = []
         self.read_conflicts: list[tuple[bytes, bytes]] = []
@@ -122,30 +126,55 @@ class Transaction:
 
     # -- reads ------------------------------------------------------------
 
+    def prefetch_read_version(self) -> None:
+        """Issue the GRV request NOW without awaiting it — the client-
+        side GRV/read-set overlap (the reference NativeAPI's eager
+        readVersionFuture): the request joins the GRV proxy's current
+        batch while the caller keeps building its read set / RYW
+        overlay, and the first read awaits the in-flight reply instead
+        of paying the whole GRV roundtrip serially. Idempotent; a
+        no-op once a read version is pinned."""
+        if self._read_version is not None or self._grv_promise is not None:
+            return
+        gspan = None
+        if self.debug_id is not None:
+            # span-threaded GRV: the span opens at ISSUE time so the
+            # waterfall shows the overlapped window, and finishes when
+            # the reply is consumed (get_read_version)
+            from foundationdb_tpu.utils.spans import Span
+
+            gspan = Span(
+                "NativeAPI.getConsistentReadVersion",
+                clock=self.db.sched.now,
+            )
+            _trace.g_trace_batch.add_event(
+                "TransactionDebug", self.debug_id, _cd.GRV_BEFORE
+            )
+        p = self.db.grv_proxy.get_read_version(self.tag)
+        if self.debug_id is not None:
+            p.debug_id = self.debug_id  # rides to the batcher
+            p.span_ctx = gspan.context
+        self._grv_promise = p
+        self._grv_span = gspan
+
     async def get_read_version(self) -> int:
         if self._read_version is None:
-            if self.debug_id is None:
-                self._read_version = await self.db.grv_proxy \
-                    .get_read_version(self.tag).future
-            else:
-                # span-threaded GRV: the request carries this span's
-                # context and the GRV proxy's batch span chains to it
-                from foundationdb_tpu.utils.spans import Span
-
-                with Span(
-                    "NativeAPI.getConsistentReadVersion",
-                    clock=self.db.sched.now,
-                ) as gspan:
-                    _trace.g_trace_batch.add_event(
-                        "TransactionDebug", self.debug_id, _cd.GRV_BEFORE
-                    )
-                    p = self.db.grv_proxy.get_read_version(self.tag)
-                    p.debug_id = self.debug_id  # rides to the batcher
-                    p.span_ctx = gspan.context
-                    self._read_version = await p.future
+            self.prefetch_read_version()
+            # ownership transfer, not a snapshot: the in-flight promise
+            # and its span are POPPED before the await precisely so no
+            # concurrent consumer can double-await them; the fields are
+            # deliberately not re-read after the wait.
+            p, self._grv_promise = self._grv_promise, None
+            gspan, self._grv_span = self._grv_span, None  # flowcheck: ignore[flow.stale-read-across-wait]
+            try:
+                self._read_version = await p.future
+                if self.debug_id is not None:
                     _trace.g_trace_batch.add_event(
                         "TransactionDebug", self.debug_id, _cd.GRV_AFTER
                     )
+            finally:
+                if gspan is not None:
+                    gspan.finish()
         return self._read_version
 
     async def get(self, key: bytes, *, snapshot: bool = False) -> Optional[bytes]:
@@ -387,6 +416,60 @@ class Transaction:
         # (the overload-retry loop is exactly what tag throttling exists
         # to contain)
         self.__init__(self.db, tag=self.tag)
+
+
+class CommitPipeline:
+    """Client-side commit pipelining: keep up to `depth` commits from
+    ONE client in flight at once (the reference NativeAPI pattern of
+    not awaiting each commit before starting the next — commit latency
+    is hidden behind the proxy's batch pipeline instead of serializing
+    the client). submit() returns the commit's future immediately and
+    only blocks when the window is full; drain() awaits the stragglers.
+
+    Ordering: the proxy pipeline assigns versions in batch order, so
+    two pipelined commits may land in the same or successive batches —
+    the client must not assume commit N completes before it submits
+    commit N+1 (that's the point). Conflict-dependent work (RMW) still
+    needs the await before the dependent read.
+    """
+
+    def __init__(self, db: "Database", depth: int = 4):
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self.db = db
+        self.depth = depth
+        self._inflight: list = []
+
+    async def submit(self, txn: Transaction):
+        """Start txn.commit() without awaiting it; returns a future
+        (await it for the version / NotCommitted). Blocks only while
+        `depth` commits are already outstanding (windowed
+        backpressure, oldest-first)."""
+        while len(self._inflight) >= self.depth:
+            head = self._inflight.pop(0)
+            try:
+                await head
+            except Exception:  # flowcheck: ignore[actor.swallow]
+                # not swallowed: the future stays readable and the
+                # submitter's handle (the SAME future) carries the error
+                pass
+        task = self.db.sched.spawn(
+            txn.commit(), name=f"commit-pipeline-{id(txn) & 0xFFFF}"
+        )
+        self._inflight.append(task.done)
+        return task.done
+
+    async def drain(self) -> None:
+        """Await every outstanding commit (errors surface on the
+        futures submit() returned, never here)."""
+        inflight, self._inflight = self._inflight, []
+        for fut in inflight:
+            try:
+                await fut
+            except Exception:  # flowcheck: ignore[actor.swallow]
+                # errors surface on the handles submit() returned (the
+                # same multi-awaitable futures) — drain only completes
+                pass
 
 
 def _dedup(ranges):
@@ -726,6 +809,11 @@ class Database:
 
     def create_transaction(self, tag: str = None) -> Transaction:
         return Transaction(self, tag=tag)
+
+    def commit_pipeline(self, depth: int = 4) -> CommitPipeline:
+        """Client-side commit pipelining (see CommitPipeline): up to
+        `depth` commits from this client in flight concurrently."""
+        return CommitPipeline(self, depth=depth)
 
     def special_key(self, key: bytes):
         """The \\xff\\xff special key space (SpecialKeySpace.actor.cpp):

@@ -173,6 +173,35 @@ class CommitProxy:
         self.key_servers = key_servers
         self.batch_interval = batch_interval
         self.max_batch_txns = max_batch_txns
+        # Adaptive batching (the reference's dynamic commitBatcher):
+        # ctor args seed the controller — batch_interval is the initial
+        # accumulation window, max_batch_txns the initial count target —
+        # and the knob bounds cap every excursion. See cluster/batching.
+        from foundationdb_tpu.cluster.batching import AdaptiveBatchSizer
+        from foundationdb_tpu.utils.knobs import SERVER_KNOBS as _K
+
+        # max_interval is capped at the ctor interval: adaptivity only
+        # SHRINKS the window under load and relaxes back to the
+        # configured cadence — idle behavior is byte-identical to a
+        # fixed-interval proxy (existing sims keep their schedules).
+        self.batch_sizer = AdaptiveBatchSizer(
+            interval=batch_interval,
+            min_interval=min(
+                batch_interval, _K.COMMIT_TRANSACTION_BATCH_INTERVAL_MIN
+            ),
+            max_interval=min(
+                batch_interval,
+                _K.COMMIT_TRANSACTION_BATCH_INTERVAL_MAX,
+            ),
+            target_count=max_batch_txns,
+            max_count=max(
+                max_batch_txns, _K.COMMIT_TRANSACTION_BATCH_COUNT_MAX
+            ),
+            max_bytes=_K.COMMIT_TRANSACTION_BATCH_BYTES_MAX,
+            latency_budget=_K.COMMIT_BATCH_STAGE_LATENCY_BUDGET,
+            alpha=_K.COMMIT_TRANSACTION_BATCH_INTERVAL_SMOOTHER_ALPHA,
+            latency_fraction=_K.COMMIT_TRANSACTION_BATCH_INTERVAL_LATENCY_FRACTION,
+        )
         self.on_state_mutation = on_state_mutation
         # read-only view of the materialized txn-state store: the
         # dbLocked check consults it so EVERY client handle is covered
@@ -272,6 +301,7 @@ class CommitProxy:
     # -- phase 0: batching (commitBatcher :361) ----------------------------
 
     async def _batcher(self) -> None:
+        from foundationdb_tpu.cluster.batching import commit_txn_bytes
         from foundationdb_tpu.runtime.flow import any_of
 
         while True:
@@ -288,6 +318,7 @@ class CommitProxy:
             # waiter: send() delivers values INTO waiter futures, so a
             # stop() between delivery and resumption would orphan an
             # untracked one (stop recovers self._pending_next).
+            sizer = self.batch_sizer
             ok, first = self.requests.stream.try_next()
             if not ok:
                 if self._pending_next is None:
@@ -295,7 +326,7 @@ class CommitProxy:
                 idx, val = await any_of(
                     [
                         self._pending_next,
-                        self.sched.delay(10 * self.batch_interval),
+                        self.sched.delay(10 * sizer.interval),
                     ]
                 )
                 if idx == 1:
@@ -306,27 +337,50 @@ class CommitProxy:
             # self._collecting is visible to stop(): requests gathered but
             # not yet dispatched must not die silently with the batcher.
             batch = self._collecting = [first]
-            deadline = self.sched.now() + self.batch_interval
+            # adaptive targets, snapshotted at batch open (the controller
+            # moves between batches, never mid-accumulation)
+            count_target = min(sizer.target_count, self.max_batch_txns)
+            bytes_target = sizer.target_bytes
+            batch_bytes = commit_txn_bytes(first.transaction)
+            deadline = self.sched.now() + sizer.interval
 
             def drain():
-                while len(batch) < self.max_batch_txns:
+                nonlocal batch_bytes
+                while (
+                    len(batch) < count_target
+                    and batch_bytes < bytes_target
+                ):
                     ok, req = self.requests.stream.try_next()
                     if not ok:
                         return
                     batch.append(req)
+                    batch_bytes += commit_txn_bytes(req.transaction)
+
+            def full() -> bool:
+                return (
+                    len(batch) >= count_target
+                    or batch_bytes >= bytes_target
+                )
 
             drain()
             # allow a short accumulation window
-            while len(batch) < self.max_batch_txns and self.sched.now() < deadline:
-                await self.sched.delay(self.batch_interval / 4)
+            while not full() and self.sched.now() < deadline:
+                await self.sched.delay(sizer.interval / 4)
                 drain()
             self._collecting = []
-            self._spawn_batch(batch)
+            # dispatch-side feedback: a full batch means traffic outran
+            # the window (shrink it); an underfull interval-expiry batch
+            # relaxes it back toward the MAX knob
+            if full():
+                sizer.batch_full()
+            else:
+                sizer.batch_underfull(len(batch))
+            self._spawn_batch(batch, was_full=full())
 
-    def _spawn_batch(self, batch: list) -> None:
+    def _spawn_batch(self, batch: list, was_full: bool = False) -> None:
         self._batch_num += 1
         task = self.sched.spawn(
-            self._commit_batch(batch, self._batch_num),
+            self._commit_batch(batch, self._batch_num, was_full),
             name=f"{self.proxy_id}-batch{self._batch_num}",
         )
         self._inflight[task] = None
@@ -336,9 +390,12 @@ class CommitProxy:
 
     # -- phases 1-5 (commitBatch :2516) ------------------------------------
 
-    async def _commit_batch(self, batch: list[CommitRequest], batch_num: int) -> None:
+    async def _commit_batch(
+        self, batch: list[CommitRequest], batch_num: int,
+        was_full: bool = False,
+    ) -> None:
         try:
-            await self._commit_batch_impl(batch, batch_num)
+            await self._commit_batch_impl(batch, batch_num, was_full)
         except BaseException as e:
             # An internal failure must not strand the clients (their reply
             # futures) nor leave the error invisible. The version chain may
@@ -351,7 +408,8 @@ class CommitProxy:
             raise
 
     async def _commit_batch_impl(
-        self, batch: list[CommitRequest], batch_num: int
+        self, batch: list[CommitRequest], batch_num: int,
+        was_full: bool = False,
     ) -> None:
         self.counters.add("commitBatchIn")
         # span per commit batch (the reference's commitBatch span,
@@ -388,12 +446,16 @@ class CommitProxy:
                 "CommitDebug", dbg, _cd.BATCH_BEFORE
             )
         try:
-            await self._commit_batch_spanned(batch, batch_num, batch_span, dbg)
+            await self._commit_batch_spanned(
+                batch, batch_num, batch_span, dbg, was_full
+            )
         finally:
             # failure paths (dead resolver, recovery kill) still export
             batch_span.finish()
 
-    async def _commit_batch_spanned(self, batch, batch_num, batch_span, dbg):
+    async def _commit_batch_spanned(
+        self, batch, batch_num, batch_span, dbg, was_full=False
+    ):
         # databaseLocked (NativeAPI's commit check against \xff/dbLocked,
         # here proxy-side via the materialized txn-state store so no
         # client handle can bypass it): non-lock-aware txns fail fast.
@@ -455,12 +517,14 @@ class CommitProxy:
             rq.span = batch_span.context.as_tuple()
             rq.debug_id = dbg
         self.latest_batch_resolving.set(batch_num)
+        _t_resolve = self.sched.now()
         replies = await all_of(
             [
                 self.sched.spawn(res.resolve(req)).done
                 for res, req in zip(self.resolvers, reqs)
             ]
         )
+        _resolve_s = self.sched.now() - _t_resolve
         self.last_received_version = version
         if dbg is not None:
             _trace.g_trace_batch.add_event(
@@ -544,6 +608,7 @@ class CommitProxy:
                 "Messages",
                 sum(1 for tag in messages if tag != LOG_STREAM_TAG),
             ).log()
+        _t_log = self.sched.now()
         await self.tlog.commit(
             TLogCommitRequest(
                 prev_version=prev_version, version=version, messages=messages,
@@ -552,6 +617,13 @@ class CommitProxy:
             )
         )
         self.latest_batch_logging.set(batch_num)
+        if batch:
+            # completion-side feedback: count/bytes targets follow the
+            # measured resolve+log stage seconds (empty idle batches
+            # carry no sizing evidence and are excluded)
+            self.batch_sizer.observe_stage_latency(
+                _resolve_s + (self.sched.now() - _t_log), full=was_full
+            )
         if dbg is not None:
             _trace.g_trace_batch.add_event(
                 "CommitDebug", dbg, _cd.BATCH_AFTER_LOG_PUSH

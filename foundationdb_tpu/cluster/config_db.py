@@ -212,8 +212,11 @@ async def restore_broadcast(db) -> dict:
     return await read_overrides(db)
 
 
-async def read_overrides(db) -> dict[str, object]:
-    txn = db.create_transaction()
+async def read_overrides(db, txn=None) -> dict[str, object]:
+    # pass `txn` to read at ITS read version (LocalConfiguration.refresh
+    # reads overrides + generation in one transaction)
+    if txn is None:
+        txn = db.create_transaction()
     items = await txn.get_range(CONF_PREFIX, CONF_PREFIX + b"\xff")
     import ast
 
@@ -241,22 +244,41 @@ class LocalConfiguration:
             self._task.cancel()
 
     async def refresh(self) -> None:
-        overrides = await read_overrides(self.db)
+        # overrides and generation read in ONE transaction (one read
+        # version): self.generation is exactly the generation of the
+        # override set just applied, so the watch loop's gen compare
+        # can detect any commit this refresh missed
+        txn = self.db.create_transaction()
+        overrides = await read_overrides(self.db, txn=txn)
+        raw = await txn.get(CONF_GENERATION, snapshot=True)
         self.knobs.reset()
         for name, value in overrides.items():
             try:
                 self.knobs.set(name, value)
             except KeyError:
                 pass  # unknown knob: ignored, as the reference does
-        txn = self.db.create_transaction()
-        raw = await txn.get(CONF_GENERATION, snapshot=True)
         self.generation = int.from_bytes(raw or b"\0" * 8, "little")
 
     async def _watch(self) -> None:
         try:
             await self.refresh()
             while True:
+                # read-compare-then-watch, all at ONE read version: a
+                # generation bump BETWEEN the last refresh's read
+                # version and this transaction's is caught by the
+                # compare (refresh again, no watch armed); a bump AFTER
+                # this read version fires the watch, whose expected
+                # value was read at the same version. The old
+                # arm-without-comparing loop silently lost any commit
+                # landing in the refresh->watch window until the NEXT
+                # bump — exposed by PR-6's adaptive batching shifting
+                # GRV/commit timing in the sims.
                 txn = self.db.create_transaction()
+                raw = await txn.get(CONF_GENERATION, snapshot=True)
+                gen = int.from_bytes(raw or b"\0" * 8, "little")
+                if gen != self.generation:
+                    await self.refresh()
+                    continue
                 fut = await txn.watch(CONF_GENERATION)
                 await fut
                 await self.refresh()

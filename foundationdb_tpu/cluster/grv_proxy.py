@@ -47,6 +47,28 @@ class GrvProxy:
         self.sequencer = sequencer
         self.ratekeeper = ratekeeper
         self.batch_interval = batch_interval
+        # Adaptive GRV batching (GrvProxyServer's START_TRANSACTION_
+        # BATCH_* discipline): the accumulation interval shrinks while
+        # requests keep arriving faster than batches go out and relaxes
+        # when the queue drains underfull — same controller as the
+        # commit proxy (cluster/batching.py), knob-bounded.
+        from foundationdb_tpu.cluster.batching import AdaptiveBatchSizer
+        from foundationdb_tpu.utils.knobs import SERVER_KNOBS as _K
+
+        # max_interval capped at the ctor interval: the controller only
+        # shrinks the window under load; idle cadence is unchanged
+        self.batch_sizer = AdaptiveBatchSizer(
+            interval=batch_interval,
+            min_interval=min(
+                batch_interval, _K.START_TRANSACTION_BATCH_INTERVAL_MIN
+            ),
+            max_interval=min(
+                batch_interval, _K.START_TRANSACTION_BATCH_INTERVAL_MAX
+            ),
+            target_count=_K.START_TRANSACTION_BATCH_COUNT_MAX,
+            max_count=_K.START_TRANSACTION_BATCH_COUNT_MAX,
+            alpha=_K.START_TRANSACTION_BATCH_INTERVAL_SMOOTHER_ALPHA,
+        )
         self.requests = PromiseStream()
         self.counters = CounterCollection(
             "GrvProxyMetrics", ["txnRequestIn", "txnRequestOut", "grvBatches"]
@@ -134,7 +156,7 @@ class GrvProxy:
                 p = await self._armed
                 self._pending.append(p)
                 self._armed = None
-            await self.sched.delay(self.batch_interval)
+            await self.sched.delay(self.batch_sizer.interval)
             while True:
                 ok, p = self.requests.stream.try_next()
                 if not ok:
@@ -225,3 +247,10 @@ class GrvProxy:
                         "TransactionDebug", p.debug_id, _cd.GRV_REPLY
                     )
                 p.send(version)
+            # interval feedback: requests still waiting after a dispatch
+            # mean the window is too long (shrink toward the MIN knob);
+            # a drained queue relaxes it back to the configured cadence
+            if self._pending or self.requests.stream._queue:
+                self.batch_sizer.batch_full()
+            else:
+                self.batch_sizer.batch_underfull(len(batch))

@@ -217,6 +217,64 @@ StorageSnapshotReq = _message(
 StorageSnapshotReply = _message(
     0x0225, "StorageSnapshotReply", [("version", "i64"), ("kvs", "kvlist")]
 )
+
+
+def _w_byteslist(out, bs):
+    codec.w_u32(out, len(bs))
+    for b in bs:
+        codec.w_bytes(out, b)
+
+
+def _r_byteslist(buf, off):
+    n, off = codec.r_u32(buf, off)
+    bs = []
+    for _ in range(n):
+        b, off = codec.r_bytes(buf, off)
+        bs.append(b)
+    return bs, off
+
+
+def _w_optbyteslist(out, vs):
+    codec.w_u32(out, len(vs))
+    for v in vs:
+        _w_optbytes(out, v)
+
+
+def _r_optbyteslist(buf, off):
+    n, off = codec.r_u32(buf, off)
+    vs = []
+    for _ in range(n):
+        v, off = _r_optbytes(buf, off)
+        vs.append(v)
+    return vs, off
+
+
+_WRITERS["byteslist"] = _w_byteslist
+_READERS["byteslist"] = _r_byteslist
+_WRITERS["optbyteslist"] = _w_optbyteslist
+_READERS["optbyteslist"] = _r_optbyteslist
+
+# Batched storage reads: every read the proxy process coalesces in one
+# event-loop turn rides ONE wire roundtrip (keys[i] is served at
+# versions[i] — exact MVCC semantics per key; the server waits once for
+# max(versions)). The single-get RPC path stays for point reads.
+StorageGetBatch = _message(
+    0x0226, "StorageGetBatch",
+    [("versions", "i64list"), ("keys", "byteslist")],
+)
+StorageGetBatchReply = _message(
+    0x0227, "StorageGetBatchReply", [("values", "optbyteslist")]
+)
+# Batched version-ordered applies: the pipeline's applier drains its
+# queue in one RPC (one WAL group fsync when persistent), keeping the
+# storage version close behind the committed version so versioned
+# reads don't stall on a one-RPC-per-version apply chain.
+StorageApplyBatch = _message(
+    0x0228, "StorageApplyBatch",
+    [("versions", "i64list"), ("groups", "mutgroups")],
+)
+TOKEN_STORAGE_GET_BATCH = 0x0305
+TOKEN_STORAGE_APPLY_BATCH = 0x0306
 RoleVersionReq = _message(0x0230, "RoleVersionReq", [("pad", "u8")])
 RoleVersionReply = _message(0x0231, "RoleVersionReply", [("version", "i64")])
 
@@ -264,8 +322,47 @@ class ResolverRole:
                 window_versions=window,
             ) if not cfg_env else eval(cfg_env)  # noqa: S307 (operator-supplied)
             self._cs = make_conflict_set(kcfg, backend)
+            self._warm_compile(kcfg, backend)
         else:
             raise ValueError(f"unknown resolver backend {backend!r}")
+
+    def _warm_compile(self, kcfg, backend: str) -> None:
+        """Compile the resolver kernels at ROLE STARTUP, not on the
+        first commit batch: a cold jit compile (seconds) landing inside
+        the first resolve request was the wire-mode tpu-force p50
+        pathology (PIPELINE_r06: 18.9s) — the stall hid in commit
+        latency where no ledger attributed it. A throwaway conflict set
+        with the same config drives every padded-shape kernel through
+        the shared module-level jit cache (shapes are G-independent, so
+        one dummy resolve covers all batch sizes), and the measured
+        seconds land in KernelStageMetrics.compile where cluster_status
+        and commit_debug can see them."""
+        import time as _time
+
+        from foundationdb_tpu.models.conflict_set import make_conflict_set
+
+        t0 = _time.perf_counter()
+        scratch = make_conflict_set(kcfg, backend)
+        scratch.resolve(
+            [
+                CommitTransaction(
+                    read_conflict_ranges=[(b"\x00warm", b"\x00warm\x00")],
+                    write_conflict_ranges=[(b"\x00warm", b"\x00warm\x00")],
+                    read_snapshot=0,
+                )
+            ],
+            1,
+        )
+        dt = _time.perf_counter() - t0
+        metrics = getattr(self._cs, "metrics", None)
+        if metrics is not None:
+            metrics.compile.sample(dt)
+            metrics.counters.add("warmCompiles")
+        from foundationdb_tpu.utils.trace import SEV_INFO, TraceEvent
+
+        TraceEvent("ResolverWarmCompile", severity=SEV_INFO).detail(
+            "Backend", backend
+        ).detail("Seconds", round(dt, 3)).log()
 
     def _cond_lazy(self) -> asyncio.Condition:
         if self._cond is None:
@@ -554,7 +651,7 @@ class StorageRole:
         return os.path.join(self._data_dir, "storage.ckpt")
 
     def _serialize_checkpoint(self) -> bytes:
-        out: list = []
+        out = codec.WriteBuffer()
         codec.w_i64(out, self.version)
         kvs = []
         for k, hist in self.history.items():
@@ -565,7 +662,7 @@ class StorageRole:
             if value is not None:
                 kvs.append((k, value))
         _w_kvlist(out, kvs)
-        return b"".join(out)
+        return out.getvalue()
 
     def _write_checkpoint_blob(self, blob: bytes) -> None:
         # values inside the blob are already sealed (seal-once at apply)
@@ -742,6 +839,30 @@ class StorageRole:
                 await self._log_durably([req])
         return await self._apply_logged(req)
 
+    async def apply_batch(self, req: "StorageApplyBatch") -> StorageApplyReply:
+        """Version-ordered group apply (the pipeline applier's drain):
+        one sealing pass, ONE write-ahead group fsync (when persistent)
+        and one ordered in-memory apply sweep for the whole chunk —
+        the storage-side twin of the tlog's group commit."""
+        reqs = [
+            StorageApply(version=v, mutations=m)
+            for v, m in zip(req.versions, req.groups)
+            if v > self.version
+        ]
+        if reqs and self._enc is not None:
+            loop = asyncio.get_event_loop()
+            reqs = await loop.run_in_executor(
+                None, lambda rs: [self._seal_values(r) for r in rs], reqs
+            )
+        if reqs and self._dq is not None:
+            await self._log_durably(reqs)
+        rep = None
+        for r in reqs:
+            rep = await self._apply_logged(r)
+        return rep if rep is not None else StorageApplyReply(
+            durable_version=self.version
+        )
+
     async def _log_durably(self, reqs: list) -> None:
         """Run the write-ahead fsync in the executor under a per-store
         lock: log records must hit the disk in version order (replay
@@ -850,6 +971,54 @@ class StorageRole:
             )
         return StorageGetReply(value=value)
 
+    def _get_at(self, key: bytes, version: int):
+        """Newest value <= version from the in-memory history (still
+        sealed when encryption is on)."""
+        value = None
+        for v, val in self.history.get(key, []):
+            if v <= version:
+                value = val
+            else:
+                break
+        return value
+
+    async def get_batch(self, req: "StorageGetBatch") -> "StorageGetBatchReply":
+        """Coalesced reads: ONE version wait (max of the batch), then
+        every key served at ITS OWN requested version — exact MVCC
+        semantics, one wire roundtrip for a whole event-loop turn's
+        worth of proxy-process reads."""
+        vmax = max(req.versions) if req.versions else 0
+        cond = self._cond_lazy()
+        async with cond:
+            await cond.wait_for(lambda: self.version >= vmax)
+        if self._lsm is not None:
+            # preads + decrypt off the loop, one executor hop per batch
+            def read_open_all():
+                out = []
+                for k, rv in zip(req.keys, req.versions):
+                    v = self._lsm.get(k, rv)
+                    if v is not None and self._enc is not None:
+                        v = self._enc.open(v)
+                    out.append(v)
+                return out
+
+            values = await asyncio.get_event_loop().run_in_executor(
+                None, read_open_all
+            )
+            return StorageGetBatchReply(values=values)
+        values = [
+            self._get_at(k, rv) for k, rv in zip(req.keys, req.versions)
+        ]
+        if self._enc is not None:
+            values = await asyncio.get_event_loop().run_in_executor(
+                None,
+                lambda vs: [
+                    self._enc.open(v) if v is not None else None for v in vs
+                ],
+                values,
+            )
+        return StorageGetBatchReply(values=values)
+
     async def snapshot(self, req: StorageSnapshotReq) -> StorageSnapshotReply:
         cond = self._cond_lazy()
         async with cond:
@@ -954,7 +1123,9 @@ async def _serve_role(
         if tlog_address:
             await role.catch_up_from_tlog(tlog_address)
         server.register(TOKEN_STORAGE_APPLY, role.apply)
+        server.register(TOKEN_STORAGE_APPLY_BATCH, role.apply_batch)
         server.register(TOKEN_STORAGE_GET, role.get)
+        server.register(TOKEN_STORAGE_GET_BATCH, role.get_batch)
         server.register(TOKEN_STORAGE_SNAPSHOT, role.snapshot)
         server.register(TOKEN_STORAGE_VERSION, role.get_version)
     else:
@@ -1053,15 +1224,71 @@ class NotCommittedError(Exception):
     pass
 
 
+class AsyncNotified:
+    """Monotone value with when_at_least — the runtime/flow `Notified`
+    (NotifiedVersion) for asyncio: the wire pipeline's batch-ordering
+    chains wait on it exactly like the simulated proxy's
+    latest_batch_resolving / latest_batch_logging chains."""
+
+    def __init__(self, value: int = 0):
+        self._value = value
+        self._waiters: list[tuple[int, asyncio.Future]] = []
+
+    def get(self) -> int:
+        return self._value
+
+    def set(self, value: int) -> None:
+        if value < self._value:
+            raise ValueError(
+                f"Notified must not decrease: {value} < {self._value}"
+            )
+        self._value = value
+        still = []
+        for threshold, fut in self._waiters:
+            if fut.done():
+                continue
+            if threshold <= value:
+                fut.set_result(value)
+            else:
+                still.append((threshold, fut))
+        self._waiters = still
+
+    async def when_at_least(self, threshold: int) -> int:
+        if self._value >= threshold:
+            return self._value
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.append((threshold, fut))
+        return await fut
+
+
+class PipelineFailedError(Exception):
+    """A predecessor batch died mid-chain; this proxy generation is
+    broken (the in-process CommitProxy's `failed` discipline)."""
+
+
+# A/B toggle for the resolve-hop payload (measurement): 1 = conflict
+# metadata only (default), 0 = full transactions incl. mutations.
+_RESOLVE_STRIP = os.environ.get("RESOLVE_STRIP", "1") != "0"
+
+
 class ProxyPipeline:
     """Sequencer + commit proxy over wire-connected roles.
 
     The 5-phase commitBatch pipeline
     (fdbserver/CommitProxyServer.actor.cpp:2516-2555) against remote
-    resolver/tlog/storage processes: version allocation (master getVersion
-    semantics, monotonic + prevVersion chain), resolution RPC, verdict
-    min-combine, tlog push, storage apply, client replies. GRV serves the
-    last tlog-durable version (commit-before-GRV visibility).
+    resolver/tlog/storage processes, STAGE-OVERLAPPED: successive batches
+    run concurrently through resolve -> tlog-push -> reply, ordered only
+    at the Notified-chain handoffs — batch N+1's resolution is on the
+    wire while batch N is logging (the resolver serializes versions by
+    the prev_version chain server-side), its tlog push waits only for
+    batch N's push, and client replies fire as soon as the batch's own
+    push is durable. Storage applies ride a third ordered chain BEHIND
+    the replies (reads wait for the storage version they need, so
+    lagging applies cost read latency, never correctness) — the
+    reference's storage lag. Batching is adaptive (cluster/batching.py):
+    the accumulation interval shrinks while batches fill early and the
+    count/bytes targets follow measured resolve+log seconds. GRV serves
+    the last tlog-durable version (commit-before-GRV visibility).
     """
 
     def __init__(
@@ -1075,13 +1302,39 @@ class ProxyPipeline:
         max_batch: int = 512,
         start_version: int = 0,
         trace: bool = False,
+        pipeline_depth: int = None,
     ):
+        from foundationdb_tpu.cluster.batching import AdaptiveBatchSizer
+        from foundationdb_tpu.utils.knobs import SERVER_KNOBS as _K
+
         self.resolvers = resolvers
         self.tlog = tlog
         self.storage = storage
         self.version_step = version_step
         self.batch_interval = batch_interval
         self.max_batch = max_batch
+        self.batch_sizer = AdaptiveBatchSizer(
+            interval=batch_interval,
+            min_interval=min(
+                batch_interval, _K.COMMIT_TRANSACTION_BATCH_INTERVAL_MIN
+            ),
+            # unlike the in-process proxy (whose window only shrinks, to
+            # keep existing sim schedules), the wire pipeline's window
+            # may GROW to the MAX knob: under a slow resolver (kernel
+            # dispatch cost) the latency-fraction rule earns bigger
+            # batches that amortize the per-dispatch cost
+            max_interval=max(
+                batch_interval, _K.COMMIT_TRANSACTION_BATCH_INTERVAL_MAX
+            ),
+            target_count=max_batch,
+            max_count=max(
+                max_batch, _K.COMMIT_TRANSACTION_BATCH_COUNT_MAX
+            ),
+            max_bytes=_K.COMMIT_TRANSACTION_BATCH_BYTES_MAX,
+            latency_budget=_K.COMMIT_BATCH_STAGE_LATENCY_BUDGET,
+            alpha=_K.COMMIT_TRANSACTION_BATCH_INTERVAL_SMOOTHER_ALPHA,
+            latency_fraction=_K.COMMIT_TRANSACTION_BATCH_INTERVAL_LATENCY_FRACTION,
+        )
         #: commit-path tracing: batches carry span contexts + debug ids
         #: over the wire to the resolver processes, and this process
         #: emits the CommitProxy.* micro-events (enable the global
@@ -1094,12 +1347,41 @@ class ProxyPipeline:
         self.committed_version = start_version
         self.prev_version = -1 if start_version == 0 else start_version
         self._last_allocated = start_version
+        # the resolve/push version chain: batch N+1's prev_version is
+        # batch N's version, assigned synchronously at spawn
+        self._chain_prev = self.prev_version
         self._queue: list[tuple[CommitTransaction, asyncio.Future]] = []
         self._batcher_task: asyncio.Task | None = None
-        self._commit_lock = asyncio.Lock()
+        # batch-ordering chain (batch numbers, 1-based)
+        self._latest_batch_logging = AsyncNotified(0)
+        self._inflight: set[asyncio.Task] = set()
+        self._depth = asyncio.Semaphore(
+            pipeline_depth
+            if pipeline_depth is not None
+            else _K.MAX_PIPELINED_COMMIT_BATCHES
+        )
+        self.failed: Optional[BaseException] = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        # ordered apply queue: (version, mutations) appended in commit
+        # order at reply time, drained by ONE applier task in batched
+        # StorageApplyBatch RPCs — replies never wait on storage, and
+        # the storage version trails the committed version by at most
+        # one drain roundtrip (the reference's bounded storage lag)
+        self._apply_queue: list[tuple[int, list]] = []
+        self._apply_event: asyncio.Event | None = None
+        self._applier_task: asyncio.Task | None = None
+        self.applied_version = start_version
+        self._last_enqueued_apply = start_version
+        # read coalescer: every read issued in the same event-loop turn
+        # rides one StorageGetBatch RPC (per-key versions, exact MVCC)
+        self._read_pending: list = []
+        self._read_flush_scheduled = False
 
     def start(self) -> None:
+        self._loop = asyncio.get_event_loop()
+        self._apply_event = asyncio.Event()
         self._batcher_task = asyncio.ensure_future(self._batcher())
+        self._applier_task = asyncio.ensure_future(self._applier())
 
     async def stop(self) -> None:
         if self._batcher_task:
@@ -1109,50 +1391,204 @@ class ProxyPipeline:
             except asyncio.CancelledError:
                 pass
             self._batcher_task = None
+        # drain in-flight batches: their replies must not die with the
+        # pipeline (and tests must not leak pending tasks)
+        if self._inflight:
+            await asyncio.gather(
+                *list(self._inflight), return_exceptions=True
+            )
+        # flush the apply queue so storage converges to committed state
+        # before the roles go down (consistency checks snapshot here);
+        # applied_version advances only after the batch RPC is acked, so
+        # this cannot cancel a drain mid-roundtrip
+        if self._applier_task:
+            while (
+                self.applied_version < self._last_enqueued_apply
+                and self.failed is None
+                and not self._applier_task.done()
+            ):
+                self._apply_event.set()
+                await asyncio.sleep(0.001)
+            self._applier_task.cancel()
+            try:
+                await self._applier_task
+            except asyncio.CancelledError:
+                pass
+            self._applier_task = None
 
     async def get_read_version(self) -> int:
         return self.committed_version
 
     async def commit(self, txn: CommitTransaction) -> int:
         """Returns the commit version or raises NotCommittedError."""
-        fut = asyncio.get_event_loop().create_future()
+        loop = self._loop or asyncio.get_event_loop()
+        fut = loop.create_future()
+        if self.failed is not None:
+            fut.set_exception(
+                transport.RemoteError(
+                    f"commit pipeline failed: {self.failed!r}"
+                )
+            )
+            return await fut
         self._queue.append((txn, fut))
         return await fut
 
     async def read(self, key: bytes, version: int) -> Optional[bytes]:
-        reply = await self.storage.call(
-            TOKEN_STORAGE_GET, StorageGet(key=key, version=version)
-        )
-        return reply.value
+        """Versioned point read, coalesced: reads enqueued in the same
+        event-loop turn go out as ONE StorageGetBatch roundtrip (each
+        key still served at its own version server-side)."""
+        loop = self._loop or asyncio.get_event_loop()
+        fut = loop.create_future()
+        self._read_pending.append((key, version, fut))
+        if not self._read_flush_scheduled:
+            self._read_flush_scheduled = True
+            loop.call_soon(self._flush_reads)
+        return await fut
+
+    def _flush_reads(self) -> None:
+        self._read_flush_scheduled = False
+        pending, self._read_pending = self._read_pending, []
+        if pending:
+            t = asyncio.ensure_future(self._read_batch(pending))
+            self._inflight.add(t)
+            t.add_done_callback(self._inflight.discard)
+
+    async def _read_batch(self, pending) -> None:
+        try:
+            rep = await self.storage.call(
+                TOKEN_STORAGE_GET_BATCH,
+                StorageGetBatch(
+                    versions=[v for _k, v, _f in pending],
+                    keys=[k for k, _v, _f in pending],
+                ),
+            )
+            for (_k, _v, fut), val in zip(pending, rep.values):
+                if not fut.done():
+                    fut.set_result(val)
+        except Exception as e:
+            for _k, _v, fut in pending:
+                if not fut.done():
+                    fut.set_exception(
+                        transport.RemoteError(f"read batch: {e!r}")
+                    )
+
+    async def _applier(self) -> None:
+        """Single ordered drain of the apply queue: many versions per
+        StorageApplyBatch RPC. Append order IS commit order (appends
+        happen synchronously after each batch's logging-chain set)."""
+        while True:
+            await self._apply_event.wait()
+            self._apply_event.clear()
+            while self._apply_queue:
+                q, self._apply_queue = self._apply_queue, []
+                try:
+                    await self.storage.call(
+                        TOKEN_STORAGE_APPLY_BATCH,
+                        StorageApplyBatch(
+                            versions=[v for v, _m in q],
+                            groups=[m for _v, m in q],
+                        ),
+                    )
+                except Exception as e:
+                    if self.failed is None:
+                        self.failed = e
+                    return
+                self.applied_version = q[-1][0]
+                if self.trace:
+                    from foundationdb_tpu.utils import commit_debug as _cdbg
+                    from foundationdb_tpu.utils import trace as _tr
+
+                    for v, m in q:
+                        if m:
+                            _tr.g_trace_batch.add_event(
+                                "CommitDebug", _cdbg.version_id(v),
+                                _cdbg.STORAGE_APPLIED,
+                            )
 
     async def _batcher(self) -> None:
+        from foundationdb_tpu.cluster.batching import commit_txn_bytes
+
         while True:
-            await asyncio.sleep(self.batch_interval)
+            await asyncio.sleep(self.batch_sizer.interval)
             if not self._queue:
                 continue
-            batch, self._queue = (
-                self._queue[: self.max_batch],
-                self._queue[self.max_batch :],
+            sizer = self.batch_sizer
+            count_target = min(sizer.target_count, self.max_batch)
+            take, nbytes = 0, 0
+            for txn, _f in self._queue:
+                if take >= count_target or nbytes >= sizer.target_bytes:
+                    break
+                take += 1
+                nbytes += commit_txn_bytes(txn)
+            batch, self._queue = self._queue[:take], self._queue[take:]
+            was_full = bool(self._queue) or take >= count_target
+            if was_full:
+                sizer.batch_full()
+            else:
+                sizer.batch_underfull(take)
+            # bounded pipeline depth: acquire BEFORE allocating the
+            # version so a stalled chain backpressures the batcher
+            # instead of growing an unbounded in-flight set
+            await self._depth.acquire()
+            self._batch_seq += 1
+            num = self._batch_seq
+            # phase 1, synchronous at spawn: version allocation
+            # (monotonic across failed attempts — a dead batch consumed
+            # its version; the reference master never re-hands one) and
+            # the prev_version chain hand-off, in batch order.
+            version = (
+                max(self.committed_version, self._last_allocated)
+                + self.version_step
             )
-            try:
-                await self._commit_batch(batch)
-            except Exception as e:
-                for _txn, fut in batch:
-                    if not fut.done():
-                        fut.set_exception(
-                            transport.RemoteError(f"commit pipeline: {e!r}")
-                        )
+            self._last_allocated = version
+            prev_version, self._chain_prev = self._chain_prev, version
+            t = asyncio.ensure_future(
+                self._commit_batch(batch, num, prev_version, version,
+                                   was_full)
+            )
+            self._inflight.add(t)
 
-    async def _commit_batch(self, batch) -> None:
+            def _done(_f, t=t):
+                self._inflight.discard(t)
+                self._depth.release()
+
+            t.add_done_callback(_done)
+
+    async def _commit_batch(
+        self, batch, num, prev_version, version, was_full
+    ) -> None:
+        try:
+            await self._commit_batch_traced(
+                batch, num, prev_version, version, was_full
+            )
+        except Exception as e:
+            # A hole in the version chain breaks this proxy generation:
+            # fail the batch's clients, mark the pipeline failed, and
+            # advance the ordering chains so successors fail fast
+            # instead of wedging on when_at_least forever.
+            if self.failed is None:
+                self.failed = e
+            for _txn, fut in batch:
+                if not fut.done():
+                    fut.set_exception(
+                        transport.RemoteError(f"commit pipeline: {e!r}")
+                    )
+            if num > self._latest_batch_logging.get():
+                self._latest_batch_logging.set(num)
+
+    async def _commit_batch_traced(
+        self, batch, num, prev_version, version, was_full
+    ) -> None:
         if not self.trace:
-            await self._commit_batch_inner(batch, None, None)
+            await self._commit_batch_impl(
+                batch, num, prev_version, version, was_full, None, None
+            )
             return
         from foundationdb_tpu.utils import commit_debug as _cdbg
         from foundationdb_tpu.utils import trace as _tr
         from foundationdb_tpu.utils.spans import Span
 
-        self._batch_seq += 1
-        dbg = f"pipe-b{self._batch_seq}"
+        dbg = f"pipe-b{num}"
         for t, _f in batch:
             if t.debug_id is not None:
                 _tr.g_trace_batch.add_attach(
@@ -1161,94 +1597,118 @@ class ProxyPipeline:
         _tr.g_trace_batch.add_event("CommitDebug", dbg, _cdbg.BATCH_BEFORE)
         with Span("ProxyPipeline.commitBatch") as span:
             span.attribute("Txns", len(batch))
-            await self._commit_batch_inner(batch, dbg, span)
+            await self._commit_batch_impl(
+                batch, num, prev_version, version, was_full, dbg, span
+            )
 
-    async def _commit_batch_inner(self, batch, dbg, span) -> None:
+    async def _commit_batch_impl(
+        self, batch, num, prev_version, version, was_full, dbg, span
+    ) -> None:
+        if self.failed is not None:
+            raise PipelineFailedError(repr(self.failed))
+        loop = asyncio.get_event_loop()
         txns = [t for t, _f in batch]
         if dbg is not None:
             from foundationdb_tpu.utils import commit_debug as _cdbg
             from foundationdb_tpu.utils import trace as _tr
-        async with self._commit_lock:
-            # phase 1: version allocation (sequencer). Monotonic across
-            # FAILED attempts too: a batch that died after resolution
-            # consumed its version (the resolver advanced past it and
-            # recorded its reply); reusing it would replay the dead
-            # batch's verdicts onto different transactions. The reference
-            # master never re-hands a version either — recovery skips
-            # them (masterserver.actor.cpp getVersion monotonicity).
-            version = (
-                max(self.committed_version, self._last_allocated)
-                + self.version_step
+
+            _tr.g_trace_batch.add_event(
+                "CommitDebug", dbg, _cdbg.BATCH_GOT_VERSION
             )
-            self._last_allocated = version
-            # phase 2: resolution (all resolvers see the full batch; each
-            # owns a key partition in multi-resolver configs — here every
-            # resolver sees everything and verdicts min-combine,
-            # CommitProxyServer.actor.cpp:1551-1567)
-            if dbg is not None:
-                _tr.g_trace_batch.add_event(
-                    "CommitDebug", dbg, _cdbg.BATCH_GOT_VERSION
-                )
-            req = ResolveTransactionBatchRequest(
-                prev_version=self.prev_version,
+        # phase 2: resolution — fired IMMEDIATELY (no wait on batch N:
+        # the resolver's own prev_version chain serializes versions
+        # server-side, Resolver.actor.cpp:269-290), so batch N+1's
+        # resolve overlaps batch N's logging. All resolvers see the full
+        # batch; verdicts min-combine (CommitProxyServer:1551-1567).
+        # The resolve hop carries CONFLICT METADATA only — ranges, read
+        # snapshot, per-txn debug id — never the data mutations, which
+        # stay proxy-side for the tlog push (the resolver's verdict
+        # doesn't read them): mutation bytes off the wire roughly
+        # halves resolve encode+decode for write-heavy batches.
+        req = ResolveTransactionBatchRequest(
+            prev_version=prev_version,
+            version=version,
+            last_received_version=prev_version,
+            transactions=(
+                [
+                    CommitTransaction(
+                        read_conflict_ranges=t.read_conflict_ranges,
+                        write_conflict_ranges=t.write_conflict_ranges,
+                        read_snapshot=t.read_snapshot,
+                        report_conflicting_keys=t.report_conflicting_keys,
+                        debug_id=t.debug_id,
+                    )
+                    for t in txns
+                ]
+                if _RESOLVE_STRIP
+                else txns
+            ),
+            debug_id=dbg,
+            span=span.context.as_tuple() if span is not None else None,
+        )
+        t_resolve = loop.time()
+        replies = await asyncio.gather(
+            *(r.call(TOKEN_RESOLVE, req) for r in self.resolvers)
+        )
+        resolve_s = loop.time() - t_resolve
+        if dbg is not None:
+            _tr.g_trace_batch.add_event(
+                "CommitDebug", dbg, _cdbg.BATCH_AFTER_RESOLUTION
+            )
+        verdicts = [
+            min(int(rep.committed[i]) for rep in replies)
+            for i in range(len(txns))
+        ]
+        # phase 3: collect committed mutations
+        mutations = []
+        for t, v in zip(txns, verdicts):
+            if v == TransactionResult.COMMITTED:
+                mutations.extend(t.mutations)
+        # phase 4: log — ordered at the logging chain hand-off only
+        if dbg is not None:
+            _tr.TraceEvent(
+                "CommitDebugVersion", severity=_tr.SEV_DEBUG
+            ).detail("ID", dbg).detail("Version", version).detail(
+                "Messages", 1 if mutations else 0
+            ).log()
+        await self._latest_batch_logging.when_at_least(num - 1)
+        if self.failed is not None:
+            raise PipelineFailedError(repr(self.failed))
+        t_log = loop.time()
+        await self.tlog.call(
+            TOKEN_TLOG_PUSH,
+            TLogPush(
                 version=version,
-                last_received_version=self.prev_version,
-                transactions=txns,
-                debug_id=dbg,
-                span=span.context.as_tuple() if span is not None else None,
+                prev_version=prev_version,
+                mutations=mutations,
+            ),
+        )
+        log_s = loop.time() - t_log
+        if dbg is not None:
+            _tr.g_trace_batch.add_event(
+                "CommitDebug", dbg, _cdbg.TLOG_AFTER_COMMIT
             )
-            replies = await asyncio.gather(
-                *(r.call(TOKEN_RESOLVE, req) for r in self.resolvers)
+            _tr.g_trace_batch.add_event(
+                "CommitDebug", dbg, _cdbg.BATCH_AFTER_LOG_PUSH
             )
-            if dbg is not None:
-                _tr.g_trace_batch.add_event(
-                    "CommitDebug", dbg, _cdbg.BATCH_AFTER_RESOLUTION
-                )
-            verdicts = [
-                min(int(rep.committed[i]) for rep in replies)
-                for i in range(len(txns))
-            ]
-            # phase 3: collect committed mutations
-            mutations = []
-            for t, v in zip(txns, verdicts):
-                if v == TransactionResult.COMMITTED:
-                    mutations.extend(t.mutations)
-            # phase 4: log
-            if dbg is not None:
-                _tr.TraceEvent(
-                    "CommitDebugVersion", severity=_tr.SEV_DEBUG
-                ).detail("ID", dbg).detail("Version", version).detail(
-                    "Messages", 1 if mutations else 0
-                ).log()
-            await self.tlog.call(
-                TOKEN_TLOG_PUSH,
-                TLogPush(
-                    version=version,
-                    prev_version=self.prev_version,
-                    mutations=mutations,
-                ),
-            )
-            if dbg is not None:
-                _tr.g_trace_batch.add_event(
-                    "CommitDebug", dbg, _cdbg.TLOG_AFTER_COMMIT
-                )
-                _tr.g_trace_batch.add_event(
-                    "CommitDebug", dbg, _cdbg.BATCH_AFTER_LOG_PUSH
-                )
-            # phase 4b: apply to storage (the storage pull loop collapsed
-            # into a push for this pipeline; versioned reads still hold)
-            await self.storage.call(
-                TOKEN_STORAGE_APPLY,
-                StorageApply(version=version, mutations=mutations),
-            )
-            if dbg is not None and mutations:
-                _tr.g_trace_batch.add_event(
-                    "CommitDebug", _cdbg.version_id(version),
-                    _cdbg.STORAGE_APPLIED,
-                )
-            self.prev_version = version
-            self.committed_version = version
-        # phase 5: replies
+        self.prev_version = version
+        self.committed_version = version
+        # guarded like the error path: a FAILED successor batch advances
+        # the chain past us (fail-fast for its own successors), and an
+        # unguarded set(num) here would raise Notified-must-not-decrease
+        # AFTER our push is durable — turning a committed batch into a
+        # client error and skipping its storage apply while
+        # committed_version already advanced (reads at our GRV would
+        # wedge server-side until the RPC timeout)
+        if num > self._latest_batch_logging.get():
+            self._latest_batch_logging.set(num)
+        self.batch_sizer.observe_stage_latency(
+            resolve_s + log_s, full=was_full
+        )
+        # phase 5: replies fire as soon as OUR push is durable — no
+        # wait for storage. The chain hand-off above makes replies
+        # version-ordered: batch N's reply loop runs synchronously
+        # after set(num=N) and before N+1 can resume from its wait.
         for (txn, fut), v in zip(batch, verdicts):
             if fut.done():
                 continue
@@ -1256,6 +1716,15 @@ class ProxyPipeline:
                 fut.set_result(version)
             else:
                 fut.set_exception(NotCommittedError(TransactionResult(v).name))
+        # phase 6: storage apply rides the applier's ordered queue
+        # BEHIND the replies (the storage pull loop collapsed into a
+        # batched ordered push; versioned reads wait server-side for the
+        # version they need, so a lagging apply costs read latency,
+        # never correctness). Appended with no await since the logging
+        # set above — queue order IS commit order.
+        self._apply_queue.append((version, mutations))
+        self._last_enqueued_apply = version
+        self._apply_event.set()
 
 
 def _tls_from_env():
@@ -1278,6 +1747,11 @@ def _tls_from_env():
 
 async def connect(address, **kw) -> transport.RpcConnection:
     conn = transport.RpcConnection(address, tls=_tls_from_env())
+    # generous default retry budget: a tpu-force resolver role warm-
+    # compiles its kernels BEFORE binding the socket (so the compile
+    # stall can never hide inside the first commit batch), which can
+    # take tens of seconds on a cold jit cache
+    kw.setdefault("retries", 1200)
     await conn.connect(**kw)
     return conn
 

@@ -74,8 +74,13 @@ class KernelStageMetrics:
                 "exactFallbacks",
                 "rebases",
                 "overflowRaised",
+                "warmCompiles",
             ],
         )
+        # warm-compile / first-dispatch seconds (ResolverRole startup
+        # prewarm records here so a compile stall is attributed to
+        # startup, never hidden inside the first batch's commit latency)
+        self.compile = LatencySample("compileSeconds")
         self.pack = LatencySample("packSeconds")
         self.transfer = LatencySample("transferSeconds")
         self.kernel = LatencySample("kernelSeconds")
@@ -87,8 +92,8 @@ class KernelStageMetrics:
 
     def as_dict(self) -> dict:
         out: dict = dict(self.counters.as_dict())
-        for s in (self.pack, self.transfer, self.kernel, self.fence,
-                  self.delta_occupancy, self.main_occupancy):
+        for s in (self.compile, self.pack, self.transfer, self.kernel,
+                  self.fence, self.delta_occupancy, self.main_occupancy):
             out[s.name] = s.as_dict()
         return out
 

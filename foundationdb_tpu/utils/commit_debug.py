@@ -251,7 +251,7 @@ def check_spans(spans: list[dict]) -> list[str]:
 
 def waterfall(timelines: list[Timeline]) -> dict[str, dict[str, float]]:
     """Aggregate stage durations across timelines: stage ->
-    {count, mean, p50, max} (seconds)."""
+    {count, mean, p50, p90, p99, max} (seconds)."""
     stages: dict[str, list[float]] = {}
     for tl in timelines:
         for name, dt in tl.stage_durations().items():
@@ -263,9 +263,35 @@ def waterfall(timelines: list[Timeline]) -> dict[str, dict[str, float]]:
             "count": len(xs),
             "mean": sum(xs) / len(xs),
             "p50": xs[len(xs) // 2],
+            "p90": xs[min(len(xs) - 1, int(len(xs) * 0.90))],
+            "p99": xs[min(len(xs) - 1, int(len(xs) * 0.99))],
             "max": xs[-1],
         }
     return out
+
+
+def text_histogram(xs: list[float], width: int = 40) -> list[str]:
+    """Power-of-two latency histogram (the reference trace event
+    histogram shape): one line per occupied bucket, `#` bar scaled to
+    the modal bucket. Input seconds; buckets labeled in ms."""
+    if not xs:
+        return []
+    import math
+
+    buckets: dict[int, int] = {}
+    for x in xs:
+        ms = x * 1e3
+        b = -60 if ms <= 0 else math.floor(math.log2(ms))
+        buckets[b] = buckets.get(b, 0) + 1
+    peak = max(buckets.values())
+    lines = []
+    for b in sorted(buckets):
+        lo = 0.0 if b == -60 else 2.0 ** b
+        hi = 2.0 ** (b + 1)
+        n = buckets[b]
+        bar = "#" * max(1, round(n / peak * width))
+        lines.append(f"[{lo:10.3f}, {hi:10.3f}) ms  {n:6d}  {bar}")
+    return lines
 
 
 def render_timeline(tl: Timeline) -> str:

@@ -125,9 +125,45 @@ def make_server_knobs() -> Knobs:
     )
     k.define("RESOLVER_STATE_MEMORY_LIMIT", 1_000_000)
     k.define(
-        "COMMIT_TRANSACTION_BATCH_INTERVAL_MIN", 0.005,
+        "COMMIT_TRANSACTION_BATCH_INTERVAL_MIN", 0.001,
         randomize=lambda r: float(r.choice([0.001, 0.005, 0.01])),
     )
+    # Adaptive commit batching (the reference's dynamic commitBatcher,
+    # fdbserver/CommitProxyServer.actor.cpp:361 + ServerKnobs
+    # COMMIT_TRANSACTION_BATCH_*): the interval SHRINKS when batches
+    # fill early (load) and relaxes when dispatches go out underfull;
+    # batch count/bytes targets follow the measured resolve+log stage
+    # latency. All movement is bounded by these knobs.
+    k.define(
+        "COMMIT_TRANSACTION_BATCH_INTERVAL_MAX", 0.020,
+        randomize=lambda r: float(r.choice([0.010, 0.020, 0.050])),
+    )
+    k.define("COMMIT_TRANSACTION_BATCH_INTERVAL_SMOOTHER_ALPHA", 0.1)
+    # the interval tracks this fraction of the smoothed resolve+log
+    # stage latency (the reference's BATCH_INTERVAL_LATENCY_FRACTION):
+    # slow stages earn longer windows (bigger batches amortize a fixed
+    # per-dispatch cost), fast pipelines shrink back toward MIN
+    k.define("COMMIT_TRANSACTION_BATCH_INTERVAL_LATENCY_FRACTION", 0.1)
+    k.define("COMMIT_TRANSACTION_BATCH_COUNT_MAX", 32768)
+    k.define("COMMIT_TRANSACTION_BATCH_BYTES_MAX", 8 << 20)
+    # per-batch resolve+log stage-latency budget the count/bytes targets
+    # steer toward (seconds): latency above budget shrinks the targets,
+    # latency under half budget with full batches grows them
+    k.define("COMMIT_BATCH_STAGE_LATENCY_BUDGET", 0.100)
+    # GRV batching follows the same controller (GrvProxyServer's
+    # START_TRANSACTION_BATCH_* discipline)
+    k.define("START_TRANSACTION_BATCH_INTERVAL_MIN", 0.0005)
+    k.define(
+        "START_TRANSACTION_BATCH_INTERVAL_MAX", 0.010,
+        randomize=lambda r: float(r.choice([0.005, 0.010, 0.020])),
+    )
+    k.define("START_TRANSACTION_BATCH_INTERVAL_SMOOTHER_ALPHA", 0.1)
+    k.define("START_TRANSACTION_BATCH_COUNT_MAX", 65536)
+    # Commit-pipeline depth: how many commit batches may be in flight
+    # concurrently through resolve -> tlog-push -> reply, ordered only
+    # at the Notified-chain handoffs (the reference bounds pipelining
+    # the same way via the resolution/logging version chains).
+    k.define("MAX_PIPELINED_COMMIT_BATCHES", 16)
     k.define("RESOLVER_BACKEND", "tpu")  # the resolver_backend knob
     # Below this batch capacity the TPU path cannot win: per-dispatch
     # overhead dominates and the CPU resolves a small batch in well

@@ -18,6 +18,7 @@ shape of the reference's MutationRef.
 from __future__ import annotations
 
 import struct
+import threading
 from typing import Any, Callable
 
 from foundationdb_tpu.models.types import (
@@ -36,8 +37,9 @@ class CodecError(ValueError):
 
 
 # ---------------------------------------------------------------------------
-# Primitive writers/readers. A Writer is a list[bytes] accumulator (joined
-# once at the end); a Reader is (memoryview, offset) threaded explicitly.
+# Primitive writers/readers. A Writer is a WriteBuffer — a reusable,
+# growable bytearray written with pack_into (no per-field bytes objects,
+# no join); a Reader is (memoryview, offset) threaded explicitly.
 
 _U8 = struct.Struct("<B")
 _U16 = struct.Struct("<H")
@@ -46,37 +48,124 @@ _I64 = struct.Struct("<q")
 _U64 = struct.Struct("<Q")
 
 
-def w_u8(out: list, v: int) -> None:
-    out.append(_U8.pack(v))
+class WriteBuffer:
+    """Reusable encode buffer: preallocated bytearray, explicit length.
+
+    The zero-copy wire discipline (the reference's PacketWriter over
+    arena-backed PacketBuffers, fdbrpc/FlowTransport): every encoder
+    packs directly into this buffer; the transport frames in place
+    (`reserve` + `patch_u32`) and hands the kernel ONE memoryview —
+    nothing per-message is allocated on the steady-state path. `reset()`
+    rewinds for the next message; capacity is retained across reuse.
+    """
+
+    __slots__ = ("buf", "length")
+
+    def __init__(self, capacity: int = 1 << 16):
+        self.buf = bytearray(capacity)
+        self.length = 0
+
+    def reset(self) -> None:
+        self.length = 0
+
+    def __len__(self) -> int:
+        return self.length
+
+    def _grow(self, need: int) -> None:
+        cap = len(self.buf)
+        want = self.length + need
+        if want > cap:
+            self.buf.extend(b"\x00" * max(cap, want - cap))
+
+    def reserve(self, n: int) -> int:
+        """Reserve n bytes (e.g. a frame header patched after the
+        payload); returns their offset."""
+        self._grow(n)
+        off = self.length
+        self.length += n
+        return off
+
+    def put_u8(self, v: int) -> None:
+        self._grow(1)
+        self.buf[self.length] = v & 0xFF
+        self.length += 1
+
+    def put_u16(self, v: int) -> None:
+        self._grow(2)
+        _U16.pack_into(self.buf, self.length, v)
+        self.length += 2
+
+    def put_u32(self, v: int) -> None:
+        self._grow(4)
+        _U32.pack_into(self.buf, self.length, v)
+        self.length += 4
+
+    def put_i64(self, v: int) -> None:
+        self._grow(8)
+        _I64.pack_into(self.buf, self.length, v)
+        self.length += 8
+
+    def put_u64(self, v: int) -> None:
+        self._grow(8)
+        _U64.pack_into(self.buf, self.length, v)
+        self.length += 8
+
+    def put_bytes(self, b) -> None:
+        n = len(b)
+        self._grow(4 + n)
+        _U32.pack_into(self.buf, self.length, n)
+        self.buf[self.length + 4 : self.length + 4 + n] = b
+        self.length += 4 + n
+
+    def put_raw(self, b) -> None:
+        n = len(b)
+        self._grow(n)
+        self.buf[self.length : self.length + n] = b
+        self.length += n
+
+    def patch_u32(self, off: int, v: int) -> None:
+        _U32.pack_into(self.buf, off, v)
+
+    def view(self) -> memoryview:
+        """The encoded bytes, zero-copy. Valid until the next write or
+        reset; asyncio transports copy what they cannot send at once,
+        so handing this straight to writer.write() is safe."""
+        return memoryview(self.buf)[: self.length]
+
+    def getvalue(self) -> bytes:
+        return bytes(self.buf[: self.length])
 
 
-def w_u16(out: list, v: int) -> None:
-    out.append(_U16.pack(v))
+def w_u8(out: WriteBuffer, v: int) -> None:
+    out.put_u8(v)
 
 
-def w_u32(out: list, v: int) -> None:
-    out.append(_U32.pack(v))
+def w_u16(out: WriteBuffer, v: int) -> None:
+    out.put_u16(v)
 
 
-def w_i64(out: list, v: int) -> None:
-    out.append(_I64.pack(v))
+def w_u32(out: WriteBuffer, v: int) -> None:
+    out.put_u32(v)
 
 
-def w_u64(out: list, v: int) -> None:
-    out.append(_U64.pack(v))
+def w_i64(out: WriteBuffer, v: int) -> None:
+    out.put_i64(v)
 
 
-def w_bytes(out: list, b: bytes) -> None:
-    out.append(_U32.pack(len(b)))
-    out.append(b)
+def w_u64(out: WriteBuffer, v: int) -> None:
+    out.put_u64(v)
 
 
-def w_str(out: list, s: str | None) -> None:
-    w_bytes(out, b"" if s is None else s.encode("utf-8"))
+def w_bytes(out: WriteBuffer, b: bytes) -> None:
+    out.put_bytes(b)
 
 
-def w_bool(out: list, v: bool) -> None:
-    out.append(_U8.pack(1 if v else 0))
+def w_str(out: WriteBuffer, s: str | None) -> None:
+    out.put_bytes(b"" if s is None else s.encode("utf-8"))
+
+
+def w_bool(out: WriteBuffer, v: bool) -> None:
+    out.put_u8(1 if v else 0)
 
 
 def r_u8(buf: memoryview, off: int) -> tuple[int, int]:
@@ -140,7 +229,7 @@ class Mutation:
         return f"Mutation({self.op}, {self.param1!r}, {self.param2!r})"
 
 
-def w_mutation(out: list, m: Any) -> None:
+def w_mutation(out: WriteBuffer, m: Any) -> None:
     if isinstance(m, tuple):
         op, p1, p2 = m
     else:
@@ -161,7 +250,7 @@ def r_mutation(buf: memoryview, off: int) -> tuple[Mutation, int]:
 # Wire types.
 
 
-def w_commit_transaction(out: list, t: CommitTransaction) -> None:
+def w_commit_transaction(out: WriteBuffer, t: CommitTransaction) -> None:
     w_u32(out, len(t.read_conflict_ranges))
     for b, e in t.read_conflict_ranges:
         w_bytes(out, b)
@@ -221,7 +310,7 @@ def r_commit_transaction(buf: memoryview, off: int) -> tuple[CommitTransaction, 
     )
 
 
-def w_resolve_request(out: list, r: ResolveTransactionBatchRequest) -> None:
+def w_resolve_request(out: WriteBuffer, r: ResolveTransactionBatchRequest) -> None:
     w_i64(out, r.prev_version)
     w_i64(out, r.version)
     w_i64(out, r.last_received_version)
@@ -274,7 +363,7 @@ def r_resolve_request(
     )
 
 
-def w_resolve_reply(out: list, r: ResolveTransactionBatchReply) -> None:
+def w_resolve_reply(out: WriteBuffer, r: ResolveTransactionBatchReply) -> None:
     w_u32(out, len(r.committed))
     for v in r.committed:
         w_u8(out, int(v))
@@ -375,19 +464,42 @@ register(
 register(0x0103, ResolveTransactionBatchReply, w_resolve_reply, r_resolve_reply)
 
 
-def encode(msg: Any) -> bytes:
-    """Serialize a registered message to bytes: u16 type id + payload."""
+def encode_into(out: WriteBuffer, msg: Any) -> None:
+    """Serialize a registered message into `out` (u16 type id + payload)
+    without allocating — the transport frames around it in place."""
     tid = _TYPE_IDS.get(type(msg))
     if tid is None:
         raise CodecError(f"unregistered wire type {type(msg).__name__}")
-    out: list = [_U16.pack(tid)]
+    out.put_u16(tid)
     _REGISTRY[tid][0](out, msg)
-    return b"".join(out)
+
+
+# Reusable per-thread encode buffer for the bytes-returning entry point
+# (role WALs, tests): one buffer per thread because storage seals/logs
+# encode from executor threads concurrently with the event loop.
+_TLS = threading.local()
+
+
+def _tls_buffer() -> WriteBuffer:
+    buf = getattr(_TLS, "buf", None)
+    if buf is None:
+        buf = _TLS.buf = WriteBuffer()
+    buf.reset()
+    return buf
+
+
+def encode(msg: Any) -> bytes:
+    """Serialize a registered message to bytes: u16 type id + payload."""
+    buf = _tls_buffer()
+    encode_into(buf, msg)
+    return buf.getvalue()
 
 
 def decode(data: bytes | memoryview) -> Any:
-    """Inverse of encode. Raises CodecError on unknown type / truncation."""
-    buf = memoryview(data)
+    """Inverse of encode. Accepts a memoryview (transports pass their
+    frame payload slices without copying). Raises CodecError on unknown
+    type / truncation / trailing bytes."""
+    buf = data if isinstance(data, memoryview) else memoryview(data)
     if len(buf) < 2:
         raise CodecError("short message")
     tid = _U16.unpack_from(buf, 0)[0]

@@ -34,6 +34,7 @@ from __future__ import annotations
 import asyncio
 import ssl as _ssl
 import struct
+import sys
 import zlib
 from typing import Any, Callable
 
@@ -86,7 +87,10 @@ async def _handshake(reader, writer, protocol_version: int = None) -> None:
         )
 
 
-async def _read_frame(reader) -> bytes:
+async def _read_frame(reader) -> memoryview:
+    """One frame's body as a memoryview: the payload slice the caller
+    hands to codec.decode never copies (readexactly's bytes object is
+    the only per-frame allocation on the receive path)."""
     hdr = await reader.readexactly(_HDR.size)
     length, crc = _HDR.unpack(hdr)
     if length > MAX_FRAME:
@@ -94,12 +98,45 @@ async def _read_frame(reader) -> bytes:
     body = await reader.readexactly(length)
     if zlib.crc32(body) & 0xFFFFFFFF != crc:
         raise ChecksumError("frame checksum mismatch")
-    return body
+    return memoryview(body)
 
 
-def _write_frame(writer, body: bytes) -> None:
-    writer.write(_HDR.pack(len(body), zlib.crc32(body) & 0xFFFFFFFF))
-    writer.write(body)
+class _FrameBuffer:
+    """Per-connection reusable frame encoder: header + preamble + codec
+    payload packed into ONE WriteBuffer, written with ONE writer.write.
+
+    Frame build and write are synchronous (no await between them), so
+    concurrent requests on a shared connection can share the buffer: by
+    the time control yields, a plain-socket transport has either sent
+    the view or copied the remainder into its own buffer. TLS transports
+    retain references in the SSL write backlog, so `zero_copy=False`
+    hands them an immutable bytes copy instead. On Python >= 3.12 the
+    selector transport buffers the caller's memoryview WITHOUT copying
+    under backpressure (gh-91166), so view reuse is disabled there too —
+    the next frame would corrupt the queued one.
+    """
+
+    __slots__ = ("buf", "zero_copy")
+
+    _VIEW_REUSE_SAFE = sys.version_info < (3, 12)
+
+    def __init__(self, zero_copy: bool):
+        self.buf = codec.WriteBuffer()
+        self.zero_copy = zero_copy and self._VIEW_REUSE_SAFE
+
+    def send(self, writer, preamble: bytes, msg=None, raw: bytes = None):
+        buf = self.buf
+        buf.reset()
+        hdr = buf.reserve(_HDR.size)
+        buf.put_raw(preamble)
+        if msg is not None:
+            codec.encode_into(buf, msg)
+        if raw is not None:
+            buf.put_raw(raw)
+        body = buf.view()[_HDR.size:]
+        buf.patch_u32(hdr, len(body))
+        buf.patch_u32(hdr + 4, zlib.crc32(body) & 0xFFFFFFFF)
+        writer.write(buf.view() if self.zero_copy else buf.getvalue())
 
 
 Address = "str | tuple[str, int]"  # UDS path or (host, port)
@@ -160,15 +197,16 @@ class RpcServer:
                 sslobj = writer.get_extra_info("ssl_object")
                 self.tls.verify_peer(sslobj)
             await _handshake(reader, writer, self.protocol_version)
+            fb = _FrameBuffer(zero_copy=self.tls is None)
             pending: set[asyncio.Task] = set()
             while True:
                 body = await _read_frame(reader)
                 kind, reqid, token = _REQ.unpack_from(body, 0)
                 if kind != KIND_REQUEST:
                     raise TransportError(f"unexpected frame kind {kind}")
-                payload = body[_REQ.size :]
+                payload = body[_REQ.size :]  # memoryview slice, no copy
                 t = asyncio.ensure_future(
-                    self._dispatch(writer, reqid, token, payload)
+                    self._dispatch(writer, reqid, token, payload, fb)
                 )
                 pending.add(t)
                 t.add_done_callback(pending.discard)
@@ -185,17 +223,24 @@ class RpcServer:
             self._conns.discard(writer)
             writer.close()
 
-    async def _dispatch(self, writer, reqid: int, token: int, payload: bytes):
+    async def _dispatch(
+        self, writer, reqid: int, token: int, payload, fb: _FrameBuffer
+    ):
         try:
-            handler = self._handlers.get(token)
-            if handler is None:
-                raise UnknownEndpointError(f"no endpoint {token:#x}")
-            reply = await handler(codec.decode(payload))
-            body = _REP.pack(KIND_REPLY, reqid) + codec.encode(reply)
-        except Exception as e:  # travels back as an error frame
-            body = _REP.pack(KIND_ERROR, reqid) + repr(e).encode("utf-8")
-        try:
-            _write_frame(writer, body)
+            try:
+                handler = self._handlers.get(token)
+                if handler is None:
+                    raise UnknownEndpointError(f"no endpoint {token:#x}")
+                reply = await handler(codec.decode(payload))
+                # build+write share the connection's frame buffer: no
+                # await between fb.send entry and writer.write (see
+                # _FrameBuffer)
+                fb.send(writer, _REP.pack(KIND_REPLY, reqid), msg=reply)
+            except Exception as e:  # travels back as an error frame
+                fb.send(
+                    writer, _REP.pack(KIND_ERROR, reqid),
+                    raw=repr(e).encode("utf-8"),
+                )
             await writer.drain()
         except ConnectionError:
             pass
@@ -213,6 +258,8 @@ class RpcConnection:
         self._next_id = 1
         self._waiters: dict[int, asyncio.Future] = {}
         self._reader_task: asyncio.Task | None = None
+        self._fb = _FrameBuffer(zero_copy=tls is None)
+        self._loop: asyncio.AbstractEventLoop | None = None
 
     async def connect(self, *, retries: int = 50, delay: float = 0.1) -> None:
         last = None
@@ -290,11 +337,13 @@ class RpcConnection:
                 fut = self._waiters.pop(reqid, None)
                 if fut is None or fut.done():
                     continue
-                payload = body[_REP.size :]
+                payload = body[_REP.size :]  # memoryview slice, no copy
                 if kind == KIND_REPLY:
                     fut.set_result(codec.decode(payload))
                 elif kind == KIND_ERROR:
-                    fut.set_exception(RemoteError(payload.decode("utf-8")))
+                    fut.set_exception(
+                        RemoteError(bytes(payload).decode("utf-8"))
+                    )
                 else:
                     fut.set_exception(TransportError(f"bad frame kind {kind}"))
         except (asyncio.IncompleteReadError, ConnectionError, ChecksumError) as e:
@@ -308,13 +357,37 @@ class RpcConnection:
     async def call(self, token: int, msg: Any, *, timeout: float = 30.0) -> Any:
         reqid = self._next_id
         self._next_id += 1
-        fut = asyncio.get_event_loop().create_future()
+        loop = self._loop
+        if loop is None:
+            loop = self._loop = asyncio.get_running_loop()
+        fut = loop.create_future()
         self._waiters[reqid] = fut
-        body = _REQ.pack(KIND_REQUEST, reqid, token) + codec.encode(msg)
+        # timeout via call_later, NOT asyncio.wait_for: wait_for wraps
+        # every call in an extra Task (expensive at wire rates on
+        # 3.10); a timer handle is one heap entry, cancelled on the
+        # overwhelmingly common fast path
+        handle = (
+            loop.call_later(timeout, self._expire_call, reqid)
+            if timeout is not None
+            else None
+        )
         try:
-            _write_frame(self._writer, body)
+            # request framed in the connection's reusable buffer; one
+            # writer.write, no intermediate bytes (see _FrameBuffer)
+            self._fb.send(
+                self._writer, _REQ.pack(KIND_REQUEST, reqid, token), msg=msg
+            )
             await self._writer.drain()
-            return await asyncio.wait_for(fut, timeout)
+            return await fut
         finally:
+            if handle is not None:
+                handle.cancel()
             # a timed-out / failed call must not leak its waiter entry
             self._waiters.pop(reqid, None)
+
+    def _expire_call(self, reqid: int) -> None:
+        fut = self._waiters.pop(reqid, None)
+        if fut is not None and not fut.done():
+            fut.set_exception(
+                asyncio.TimeoutError(f"rpc {reqid} timed out")
+            )

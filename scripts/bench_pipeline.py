@@ -308,6 +308,8 @@ async def _run_wire(backend: str, args) -> dict:
             f"(resolver events from the child process); "
             f"files: {files}", flush=True,
         )
+        stats["traced_timelines"] = len(tls)
+        stats["traced_cross_process"] = len(cross)
     # same successful-ops definition as cluster mode (cross-mode
     # comparable); "conflicted" counts retried attempts
     ops = stats["committed"] + stats["reads"]
@@ -349,12 +351,26 @@ def main():
                          "files here, thread span contexts + debug ids "
                          "across the UDS, and reconstruct cross-process "
                          "timelines after the run (commit_debug)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI lane: tiny in-flight traced wire run (native "
+                         "backend); exits nonzero unless consistency is "
+                         "\"ok\" AND >=1 complete cross-process "
+                         "commit_debug timeline reconstructed")
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
     if args.legacy:
         args.clients = args.legacy[0]
         if len(args.legacy) > 1:
             args.ops = args.legacy[1]
+    if args.smoke:
+        args.mode = "wire"
+        args.clients = 32
+        args.ops = 2
+        args.backends = args.backends or "native"
+        if not args.trace_dir:
+            import tempfile as _tf
+
+            args.trace_dir = _tf.mkdtemp(prefix="bench_pipe_smoke_")
     if args.spec5:
         args.mode = "wire"
         args.clients = 256 * 1024
@@ -392,7 +408,19 @@ def main():
     if args.json_out:
         with open(args.json_out, "a") as f:
             f.write(json.dumps(row) + "\n")
+    if args.smoke:
+        bad = [
+            b for b, r in results.items()
+            if r.get("consistency") != "ok"
+            or r.get("traced_timelines", 0) < 1
+            or r.get("traced_cross_process", 0) < 1
+        ]
+        if bad:
+            print(f"bench_pipeline smoke FAILED for {bad}")
+            return 1
+        print("bench_pipeline smoke ok")
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -42,6 +42,13 @@ JAX_PLATFORMS=cpu python scripts/commit_debug.py --smoke
 t1=$(date +%s.%N)
 awk -v a="$t0" -v b="$t1" 'BEGIN {printf "commit_debug smoke wall time: %.1fs\n", b - a}'
 
+echo "== bench_pipeline smoke (tiny traced wire run over real role    =="
+echo "== processes: consistency ok + >=1 cross-process timeline)       =="
+t0=$(date +%s.%N)
+JAX_PLATFORMS=cpu python scripts/bench_pipeline.py --smoke
+t1=$(date +%s.%N)
+awk -v a="$t0" -v b="$t1" 'BEGIN {printf "bench_pipeline smoke wall time: %.1fs\n", b - a}'
+
 echo "== pytest (fast lane: -m 'not slow') =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider "$@"

@@ -95,6 +95,11 @@ def main() -> int:
                     help="exit nonzero on chain-integrity violations")
     ap.add_argument("--json", action="store_true",
                     help="emit the waterfall as one JSON object")
+    ap.add_argument("--aggregate", action="store_true",
+                    help="aggregate report: per-stage p50/p90/p99 table "
+                         "across ALL reconstructed txns + a power-of-two "
+                         "latency histogram per stage (one-command "
+                         "before/after comparisons)")
     args = ap.parse_args()
 
     if args.smoke:
@@ -117,6 +122,35 @@ def main() -> int:
             "violations": violations,
             "waterfall": wf,
         }))
+    elif args.aggregate:
+        print(
+            f"{len(records)} events -> {len(timelines)} committed "
+            f"transaction timeline(s), {len(violations)} violation(s)"
+        )
+        order = ["grv", "batching", "get_version", "resolution",
+                 "logging", "reply", "total"]
+        stages = [s for s in order if s in wf] + sorted(
+            set(wf) - set(order)
+        )
+        print(f"  {'stage':12s} {'n':>6s} {'mean':>10s} {'p50':>10s} "
+              f"{'p90':>10s} {'p99':>10s} {'max':>10s}   (ms)")
+        for stage in stages:
+            s = wf[stage]
+            print(
+                f"  {stage:12s} {s['count']:6d} {s['mean']*1e3:10.3f} "
+                f"{s['p50']*1e3:10.3f} {s['p90']*1e3:10.3f} "
+                f"{s['p99']*1e3:10.3f} {s['max']*1e3:10.3f}"
+            )
+        per_stage: dict[str, list[float]] = {}
+        for tl in timelines:
+            for name, dt in tl.stage_durations().items():
+                per_stage.setdefault(name, []).append(dt)
+        for stage in stages:
+            print(f"\n  {stage} latency histogram:")
+            for line in cd.text_histogram(per_stage[stage]):
+                print(f"    {line}")
+        for v in violations[:20]:
+            print(f"VIOLATION: {v}")
     else:
         print(
             f"{len(records)} events -> {len(timelines)} committed "
@@ -145,4 +179,7 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `| head` closed the pipe: not an error
+        os._exit(0)

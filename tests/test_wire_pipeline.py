@@ -1,0 +1,485 @@
+"""Zero-copy wire codec properties + stage-overlapped commit pipeline.
+
+The PR-6 perf seams: (1) the reusable WriteBuffer encode path and
+memoryview decode path must roundtrip arbitrary registered messages —
+including buffer reuse, growth from tiny capacities and frames arriving
+in dribbled partial reads; (2) the wire ProxyPipeline must OVERLAP
+batch N+1's resolution with batch N's tlog push (ordering enforced
+only at the Notified-chain handoffs) while client replies stay
+version-ordered; (3) the read coalescer and the batched applier must
+preserve exact MVCC semantics.
+"""
+
+import asyncio
+import random
+import struct
+
+import pytest
+
+from foundationdb_tpu.cluster import multiprocess as mp
+from foundationdb_tpu.models.types import (
+    CommitTransaction,
+    ResolveTransactionBatchReply,
+    ResolveTransactionBatchRequest,
+    TransactionResult,
+)
+from foundationdb_tpu.wire import codec, transport
+from foundationdb_tpu.wire.codec import Mutation
+
+# ---------------------------------------------------------------------------
+# Codec property tests (seeded random — no external property library).
+
+
+def _rand_bytes(rng, lo=0, hi=64):
+    return bytes(rng.getrandbits(8) for _ in range(rng.randint(lo, hi)))
+
+
+def _rand_txn(rng):
+    def ranges():
+        out = []
+        for _ in range(rng.randint(0, 5)):
+            b = _rand_bytes(rng, 1, 24)
+            out.append((b, b + b"\x00" + _rand_bytes(rng, 0, 4)))
+        return out
+
+    return CommitTransaction(
+        read_conflict_ranges=ranges(),
+        write_conflict_ranges=ranges(),
+        read_snapshot=rng.randint(0, 2**50),
+        report_conflicting_keys=bool(rng.getrandbits(1)),
+        mutations=[
+            Mutation(rng.randint(0, 1), _rand_bytes(rng, 1, 32),
+                     _rand_bytes(rng, 0, 128))
+            for _ in range(rng.randint(0, 6))
+        ],
+    )
+
+
+def _rand_messages(seed, n=60):
+    rng = random.Random(seed)
+    msgs = []
+    for _ in range(n):
+        pick = rng.randint(0, 5)
+        if pick == 0:
+            msgs.append(_rand_txn(rng))
+        elif pick == 1:
+            msgs.append(ResolveTransactionBatchRequest(
+                prev_version=rng.randint(-1, 100),
+                version=rng.randint(100, 2**40),
+                last_received_version=rng.randint(-1, 100),
+                transactions=[_rand_txn(rng) for _ in range(rng.randint(0, 4))],
+            ))
+        elif pick == 2:
+            msgs.append(ResolveTransactionBatchReply(
+                committed=[rng.randint(0, 2) for _ in range(rng.randint(0, 8))]
+            ))
+        elif pick == 3:
+            msgs.append(mp.StorageGetBatch(
+                versions=[rng.randint(0, 2**40)
+                          for _ in range(rng.randint(0, 10))],
+                keys=[_rand_bytes(rng, 1, 40)
+                      for _ in range(rng.randint(0, 10))],
+            ))
+        elif pick == 4:
+            msgs.append(mp.StorageGetBatchReply(values=[
+                None if rng.getrandbits(1) else _rand_bytes(rng, 0, 64)
+                for _ in range(rng.randint(0, 10))
+            ]))
+        else:
+            n_v = rng.randint(0, 5)
+            msgs.append(mp.StorageApplyBatch(
+                versions=[rng.randint(0, 2**40) for _ in range(n_v)],
+                groups=[
+                    [Mutation(0, _rand_bytes(rng, 1, 16),
+                              _rand_bytes(rng, 0, 32))
+                     for _ in range(rng.randint(0, 3))]
+                    for _ in range(n_v)
+                ],
+            ))
+    return msgs
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_codec_random_roundtrip_property(seed):
+    for msg in _rand_messages(seed):
+        got = codec.decode(codec.encode(msg))
+        assert got == msg, (msg, got)
+
+
+@pytest.mark.parametrize("seed", [7, 8])
+def test_codec_reused_buffer_matches_fresh_encode(seed):
+    """One WriteBuffer reused across every message (the steady-state
+    transport discipline) must produce bytes identical to a fresh
+    per-message encode, and earlier getvalue() results must survive
+    later reuse (they are copies, not views)."""
+    buf = codec.WriteBuffer(capacity=16)  # forces growth paths
+    snapshots = []
+    msgs = _rand_messages(seed, n=40)
+    for msg in msgs:
+        buf.reset()
+        codec.encode_into(buf, msg)
+        snapshots.append(buf.getvalue())
+    for msg, snap in zip(msgs, snapshots):
+        assert snap == codec.encode(msg)
+        assert codec.decode(snap) == msg
+
+
+def test_codec_decode_from_offset_memoryview():
+    """decode must accept a payload that sits at a nonzero offset of a
+    larger buffer (the transport's frame slices) without copying."""
+    msg = _rand_txn(random.Random(42))
+    payload = codec.encode(msg)
+    framed = b"\xaa" * 7 + payload + b"\xbb" * 3
+    view = memoryview(framed)[7 : 7 + len(payload)]
+    assert codec.decode(view) == msg
+
+
+def test_write_buffer_reserve_patch():
+    buf = codec.WriteBuffer(capacity=8)
+    off = buf.reserve(8)
+    buf.put_bytes(b"hello world, this grows past capacity")
+    buf.patch_u32(off, 0xDEADBEEF)
+    buf.patch_u32(off + 4, len(buf) - 8)
+    raw = buf.getvalue()
+    a, b = struct.unpack_from("<II", raw, 0)
+    assert a == 0xDEADBEEF and b == len(raw) - 8
+
+
+def _drain_writer():
+    class W:
+        def __init__(self):
+            self.chunks = []
+
+        def write(self, b):
+            # a transport consumes the view synchronously; copy like a
+            # real socket would before the buffer is reused
+            self.chunks.append(bytes(b))
+
+    return W()
+
+
+@pytest.mark.parametrize("chunk_size", [1, 3, 7, 1024])
+def test_frame_roundtrip_partial_reads(chunk_size):
+    """A _FrameBuffer-framed message fed to the reader in dribbled
+    chunks (rolled/partial reads) must reassemble and decode exactly;
+    a corrupted byte must fail the CRC check."""
+
+    async def go():
+        fb = transport._FrameBuffer(zero_copy=True)
+        w = _drain_writer()
+        msg = _rand_txn(random.Random(chunk_size))
+        preamble = transport._REQ.pack(transport.KIND_REQUEST, 77, 0x0101)
+        fb.send(w, preamble, msg=msg)
+        wire_bytes = b"".join(w.chunks)
+
+        reader = asyncio.StreamReader()
+        for i in range(0, len(wire_bytes), chunk_size):
+            reader.feed_data(wire_bytes[i : i + chunk_size])
+        body = await transport._read_frame(reader)
+        kind, reqid, token = transport._REQ.unpack_from(body, 0)
+        assert (kind, reqid, token) == (transport.KIND_REQUEST, 77, 0x0101)
+        assert codec.decode(body[transport._REQ.size :]) == msg
+
+        # flip one payload byte -> checksum failure
+        corrupted = bytearray(wire_bytes)
+        corrupted[-1] ^= 0xFF
+        reader2 = asyncio.StreamReader()
+        reader2.feed_data(bytes(corrupted))
+        with pytest.raises(transport.ChecksumError):
+            await transport._read_frame(reader2)
+
+    asyncio.run(go())
+
+
+def test_frame_buffer_reuse_across_messages():
+    """Consecutive sends through one _FrameBuffer (the per-connection
+    steady state) must each produce an independently valid frame."""
+
+    async def go():
+        fb = transport._FrameBuffer(zero_copy=True)
+        w = _drain_writer()
+        msgs = _rand_messages(11, n=10)
+        frames = []
+        for i, m in enumerate(msgs):
+            before = len(w.chunks)
+            fb.send(w, transport._REQ.pack(transport.KIND_REQUEST, i, 1),
+                    msg=m)
+            frames.append(b"".join(w.chunks[before:]))
+        for i, (m, f) in enumerate(zip(msgs, frames)):
+            reader = asyncio.StreamReader()
+            reader.feed_data(f)
+            body = await transport._read_frame(reader)
+            kind, reqid, _token = transport._REQ.unpack_from(body, 0)
+            assert reqid == i
+            assert codec.decode(body[transport._REQ.size :]) == m
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# Stage-overlapped pipeline: stub roles with controllable latencies.
+
+
+class _StubConn:
+    """Duck-typed RpcConnection: in-process handlers + event journal."""
+
+    def __init__(self, journal, latencies=None):
+        self.journal = journal
+        self.latencies = latencies or {}
+
+    async def call(self, token, msg, **_kw):
+        raise NotImplementedError
+
+
+class _StubResolver(_StubConn):
+    def __init__(self, journal, latency=0.0):
+        super().__init__(journal)
+        self.latency = latency
+        self.version = -1
+
+    async def call(self, token, req, **_kw):
+        assert token == mp.TOKEN_RESOLVE
+        self.journal.append(("resolve_start", req.version))
+        if self.latency:
+            await asyncio.sleep(self.latency)
+        # version-chain contract (Resolver.actor.cpp): requests arrive
+        # with prev_version == our current version when pipelined
+        # in-order from one proxy
+        assert req.prev_version >= self.version or self.version == -1
+        self.version = req.version
+        self.journal.append(("resolve_end", req.version))
+        return ResolveTransactionBatchReply(
+            committed=[int(TransactionResult.COMMITTED)]
+            * len(req.transactions)
+        )
+
+
+class _StubTLog(_StubConn):
+    def __init__(self, journal, latency=0.0):
+        super().__init__(journal)
+        self.latency = latency
+        self.version = -1
+
+    async def call(self, token, req, **_kw):
+        assert token == mp.TOKEN_TLOG_PUSH
+        self.journal.append(("push_start", req.version))
+        if self.latency:
+            await asyncio.sleep(self.latency)
+        assert req.version > self.version
+        self.version = req.version
+        self.journal.append(("push_end", req.version))
+        return mp.TLogPushReply(durable_version=self.version)
+
+
+class _StubStorage(_StubConn):
+    def __init__(self, journal):
+        super().__init__(journal)
+        self.version = 0
+        self.data = {}
+
+    async def call(self, token, req, **_kw):
+        if token == mp.TOKEN_STORAGE_APPLY_BATCH:
+            self.journal.append(("apply_batch", tuple(req.versions)))
+            assert list(req.versions) == sorted(req.versions)
+            for v, muts in zip(req.versions, req.groups):
+                assert v > self.version
+                for m in muts:
+                    self.data.setdefault(m.param1, []).append((v, m.param2))
+                self.version = v
+            return mp.StorageApplyReply(durable_version=self.version)
+        if token == mp.TOKEN_STORAGE_GET_BATCH:
+            self.journal.append(("get_batch", tuple(req.keys)))
+            vals = []
+            for k, rv in zip(req.keys, req.versions):
+                assert self.version >= rv, "read served before apply"
+                val = None
+                for v, x in self.data.get(k, []):
+                    if v <= rv:
+                        val = x
+                vals.append(val)
+            return mp.StorageGetBatchReply(values=vals)
+        raise AssertionError(f"unexpected token {token:#x}")
+
+
+def _txn(key: bytes, value: bytes, rv: int = 0) -> CommitTransaction:
+    kr = (key, key + b"\x00")
+    return CommitTransaction(
+        read_conflict_ranges=[kr], write_conflict_ranges=[kr],
+        read_snapshot=rv, mutations=[Mutation(0, key, value)],
+    )
+
+
+def test_batch_overlap_resolve_vs_log_push_and_ordered_replies():
+    """THE pipelining pin: with a slow tlog, batch N+1's resolve must
+    START (and finish) while batch N's push is still in flight —
+    overlap enforced only at the Notified-chain handoff — and the
+    client replies must still complete in version order."""
+
+    async def go():
+        journal = []
+        resolver = _StubResolver(journal, latency=0.0)
+        tlog = _StubTLog(journal, latency=0.05)
+        storage = _StubStorage(journal)
+        pipe = mp.ProxyPipeline(
+            [resolver], tlog, storage,
+            batch_interval=0.005, max_batch=4,
+        )
+        pipe.start()
+        reply_order = []
+
+        async def commit(key, tag):
+            v = await pipe.commit(_txn(key, b"v-" + tag))
+            reply_order.append((tag, v))
+            return v
+
+        # wave 1 -> batch 1; wave 2 lands while batch 1's push sleeps
+        t1 = asyncio.ensure_future(commit(b"k1", b"a"))
+        await asyncio.sleep(0.02)  # batch 1 dispatched, push in flight
+        t2 = asyncio.ensure_future(commit(b"k2", b"b"))
+        v1, v2 = await t1, await t2
+        await pipe.stop()
+
+        assert v2 > v1
+        # journal proves the overlap: batch 2's resolve_end lands
+        # between batch 1's push_start and push_end
+        def idx(ev):
+            return journal.index(ev)
+
+        assert idx(("resolve_end", v2)) < idx(("push_end", v1)), journal
+        assert idx(("push_start", v1)) < idx(("resolve_start", v2)), journal
+        # pushes themselves stay strictly ordered by the chain
+        assert idx(("push_end", v1)) < idx(("push_start", v2)), journal
+        # replies completed in version order
+        assert reply_order == [(b"a", v1), (b"b", v2)]
+        # applies arrived version-ordered and batched
+        applied = [v for ev, vs in journal if ev == "apply_batch"
+                   for v in vs]
+        assert applied == sorted(applied) and set(applied) == {v1, v2}
+
+    asyncio.run(go())
+
+
+def test_read_coalescer_single_rpc_exact_versions():
+    """Reads issued in the same event-loop turn ride ONE StorageGetBatch
+    and each key is served at ITS version (not the batch max)."""
+
+    async def go():
+        journal = []
+        resolver = _StubResolver(journal)
+        tlog = _StubTLog(journal)
+        storage = _StubStorage(journal)
+        pipe = mp.ProxyPipeline(
+            [resolver], tlog, storage, batch_interval=0.002, max_batch=64,
+        )
+        pipe.start()
+        v1 = await pipe.commit(_txn(b"k", b"old"))
+        # ensure the apply drained so v1 is readable
+        while storage.version < v1:
+            await asyncio.sleep(0.002)
+        v2 = await pipe.commit(_txn(b"k", b"new"))
+        while storage.version < v2:
+            await asyncio.sleep(0.002)
+
+        journal.clear()
+        r_old, r_new = await asyncio.gather(
+            pipe.read(b"k", v1), pipe.read(b"k", v2)
+        )
+        await pipe.stop()
+        assert r_old == b"old" and r_new == b"new"
+        gets = [ev for ev in journal if ev[0] == "get_batch"]
+        assert len(gets) == 1 and len(gets[0][1]) == 2, journal
+
+    asyncio.run(go())
+
+
+def test_successor_failure_does_not_fail_inflight_predecessor():
+    """A FAILED batch N advances the logging chain past a still-pushing
+    batch N-1 (fail-fast for N's successors). N-1's durable commit must
+    survive that leapfrog: its clients get their version, its storage
+    apply is enqueued — never a Notified-must-not-decrease error
+    converting a committed batch into a client failure."""
+
+    class _SecondBatchDiesResolver(_StubResolver):
+        def __init__(self, journal):
+            super().__init__(journal)
+            self.calls = 0
+
+        async def call(self, token, req, **_kw):
+            self.calls += 1
+            if self.calls >= 2:
+                raise transport.RemoteError("resolver died")
+            return await super().call(token, req, **_kw)
+
+    class _GatedTLog(_StubTLog):
+        """Push completes only when the test releases it — pins the
+        interleaving deterministically (no real-time races)."""
+
+        def __init__(self, journal, release):
+            super().__init__(journal)
+            self.release = release
+
+        async def call(self, token, req, **_kw):
+            assert token == mp.TOKEN_TLOG_PUSH
+            self.journal.append(("push_start", req.version))
+            await self.release.wait()
+            assert req.version > self.version
+            self.version = req.version
+            self.journal.append(("push_end", req.version))
+            return mp.TLogPushReply(durable_version=self.version)
+
+    async def go():
+        journal = []
+        release = asyncio.Event()
+        resolver = _SecondBatchDiesResolver(journal)
+        tlog = _GatedTLog(journal, release)
+        storage = _StubStorage(journal)
+        pipe = mp.ProxyPipeline(
+            [resolver], tlog, storage, batch_interval=0.005, max_batch=4,
+        )
+        pipe.start()
+        t1 = asyncio.ensure_future(pipe.commit(_txn(b"k1", b"v1")))
+        while not any(ev[0] == "push_start" for ev in journal):
+            await asyncio.sleep(0.001)  # batch 1's push now in flight
+        t2 = asyncio.ensure_future(pipe.commit(_txn(b"k2", b"v2")))
+        # batch 2's resolve dies while batch 1's push is HELD: its
+        # error path advances the logging chain past batch 1
+        with pytest.raises(transport.RemoteError):
+            await t2
+        assert pipe.failed is not None
+        release.set()  # batch 1's push becomes durable AFTER the leapfrog
+        v1 = await t1  # batch 1 committed despite the leapfrog
+        # batch 1's apply was enqueued and drains to storage
+        for _ in range(200):
+            if storage.version >= v1:
+                break
+            await asyncio.sleep(0.005)
+        assert storage.version >= v1, "committed batch's apply dropped"
+        await pipe.stop()
+
+    asyncio.run(go())
+
+
+def test_pipeline_failure_fails_fast_not_wedged():
+    """A mid-chain resolver death must fail that batch's clients AND
+    every later commit immediately (failed-generation discipline), not
+    wedge successors on when_at_least forever."""
+
+    class _DyingResolver(_StubResolver):
+        async def call(self, token, req, **_kw):
+            raise transport.RemoteError("resolver died")
+
+    async def go():
+        journal = []
+        pipe = mp.ProxyPipeline(
+            [_DyingResolver(journal)], _StubTLog(journal),
+            _StubStorage(journal), batch_interval=0.002, max_batch=4,
+        )
+        pipe.start()
+        with pytest.raises(transport.RemoteError):
+            await pipe.commit(_txn(b"k", b"v"))
+        assert pipe.failed is not None
+        with pytest.raises(transport.RemoteError):
+            await asyncio.wait_for(pipe.commit(_txn(b"k", b"v2")), 1.0)
+        await pipe.stop()
+
+    asyncio.run(go())
