@@ -284,6 +284,25 @@ class CommitProxy:
             if not req.reply.is_set:
                 req.reply.send_error(CommitUnknownResult())
 
+    # -- saturation sensors ------------------------------------------------
+
+    def saturation(self) -> dict:
+        """The commit proxy's qos sensor block: in-flight batch depth
+        (the pipelined-batch overlap the Notified chains order), queued
+        and mid-accumulation requests, and the AdaptiveBatchSizer's live
+        interval/count/bytes targets — the control surface the future
+        Ratekeeper reads before deciding a txn/s budget."""
+        return {
+            "inflight_batches": len(self._inflight),
+            "queued_requests": (
+                len(self.requests.stream._queue) + len(self._collecting)
+            ),
+            "batches_started": self._batch_num,
+            "batches_logged": self.latest_batch_logging.get(),
+            "batch_sizer": self.batch_sizer.as_dict(),
+            "failed": self.failed is not None,
+        }
+
     # -- client entry -----------------------------------------------------
 
     def commit(self, txn: CommitTransaction) -> Promise:

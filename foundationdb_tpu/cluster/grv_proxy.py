@@ -113,6 +113,22 @@ class GrvProxy:
             if not p.is_set:
                 p.send_error(GrvProxyFailedError())
 
+    def saturation(self) -> dict:
+        """The GRV proxy's qos sensor block: read-version queue depth
+        (requests admitted but not yet answered — the front-door queue
+        the Ratekeeper budget throttles), the live batch-sizer targets,
+        and the tags currently metered by a throttle bucket."""
+        return {
+            "queued_requests": (
+                len(self._pending) + len(self.requests.stream._queue)
+            ),
+            "batch_sizer": self.batch_sizer.as_dict(),
+            "throttled_tags": sorted(
+                t for t, tok in self._tag_tokens.items()
+                if tok != float("inf")
+            ),
+        }
+
     def get_read_version(self, tag: str = None) -> Promise:
         """tag: optional transaction tag; tagged requests are metered
         against the Ratekeeper's per-tag quota (GlobalTagThrottler's

@@ -183,6 +183,20 @@ class LogSystem:
         for t in self._live_satellites():
             t.pop(tag, up_to_version, consumer)
 
+    def tag_backlog_bytes(self, tag: int, consumer: str = "storage") -> int:
+        """Worst retained bytes for one consumer's tag across live
+        replicas (the per-storage write-queue sensor: replicas hold the
+        same stream, so the slowest-trimmed one is the honest depth).
+        Dead replicas don't report — a frozen log isn't a queue."""
+        return max(
+            (
+                t.tag_backlog_bytes(tag, consumer)
+                for t, alive in zip(self.tlogs, self.live)
+                if alive
+            ),
+            default=0,
+        )
+
     def has_log_consumers(self) -> bool:
         return any(t.has_log_consumers() for t in self._live_logs())
 

@@ -278,9 +278,19 @@ TOKEN_STORAGE_APPLY_BATCH = 0x0306
 RoleVersionReq = _message(0x0230, "RoleVersionReq", [("pad", "u8")])
 RoleVersionReply = _message(0x0231, "RoleVersionReply", [("version", "i64")])
 
+# Saturation telemetry (fdbtop / wire_cluster_status): every spawned
+# role answers StatusRequest with its status block — role kind, version,
+# and the `qos` sensor dict — as a JSON document. The status schema IS
+# a JSON document end to end (the reference's status JSON,
+# fdbclient/Schemas.cpp); a field-by-field wire layout here would only
+# re-derive JSON at the reader and ossify the sensor set.
+StatusRequest = _message(0x0240, "StatusRequest", [("pad", "u8")])
+StatusReply = _message(0x0241, "StatusReply", [("payload", "str")])
+
 TOKEN_TLOG_VERSION = 0x0203
 TOKEN_STORAGE_VERSION = 0x0304
 TOKEN_RESOLVER_VERSION = 0x0102
+TOKEN_STATUS = 0x0501
 
 
 # ---------------------------------------------------------------------------
@@ -304,6 +314,27 @@ class ResolverRole:
         self._cond: asyncio.Condition | None = None
         self._replies: dict[int, ResolveTransactionBatchReply] = {}
         self._backend = backend
+        # -- saturation sensors: the reference resolver's exact four
+        # distributions (Resolver.actor.cpp resolverLatencyDist /
+        # queueWaitLatencyDist / computeTimeDist / queueDepthDist) on
+        # the WALL clock — this is a real OS process, there is no
+        # virtual clock to be deterministic against
+        from foundationdb_tpu.utils.metrics import LatencySample
+
+        from foundationdb_tpu.utils.metrics import TimerSmoother
+
+        self._waiting = 0  # requests parked on the version chain
+        self.queue_depth = LatencySample("queueDepth")
+        self.queue_wait_latency = LatencySample("queueWaitLatency")
+        self.compute_time = LatencySample("computeTime")
+        self.resolver_latency = LatencySample("resolverLatency")
+        # busy-fraction smoother (the Ratekeeper's resolver-occupancy
+        # input): compute seconds accumulate as a rate — a resolver
+        # spending ~every wall second inside _resolve_now reads ~1.0.
+        # This is the signal that catches few-huge-batch saturation,
+        # where queue DEPTH stays deceptively small because the
+        # blocking compute keeps arrivals out of the parked count.
+        self.occupancy = TimerSmoother(2.0)
         if backend == "native":
             from foundationdb_tpu.native import NativeSkipListConflictSet
 
@@ -399,11 +430,20 @@ class ResolverRole:
                 span.finish()
 
     async def _resolve_ordered(self, req: ResolveTransactionBatchRequest):
+        import time as _time
+
+        t_arrive = _time.perf_counter()
         cond = self._cond_lazy()
         async with cond:
-            await cond.wait_for(
-                lambda: self.version >= req.prev_version
-            )
+            self._waiting += 1
+            self.queue_depth.sample(self._waiting)
+            try:
+                await cond.wait_for(
+                    lambda: self.version >= req.prev_version
+                )
+            finally:
+                self._waiting -= 1
+            self.queue_wait_latency.sample(_time.perf_counter() - t_arrive)
             if req.version <= self.version:
                 # duplicate (proxy retry): replay the recorded reply
                 reply = self._replies.get(req.version)
@@ -412,7 +452,12 @@ class ResolverRole:
                         f"version {req.version} already resolved and expired"
                     )
                 return reply
+            t_compute = _time.perf_counter()
             reply = self._resolve_now(req)
+            dt_compute = _time.perf_counter() - t_compute
+            self.compute_time.sample(dt_compute)
+            self.occupancy.add_delta(dt_compute)
+            self.resolver_latency.sample(_time.perf_counter() - t_arrive)
             self._replies[req.version] = reply
             # retain a bounded replay window
             floor = req.version - self.window
@@ -438,6 +483,28 @@ class ResolverRole:
             state_mutations=[],
             debug_id=req.debug_id,
         )
+
+    def status(self) -> dict:
+        """StatusRequest payload: role kind, version, and the qos
+        sensor block (the four reference distributions + kernel
+        occupancy on jitted backends)."""
+        qos = {
+            "queue_depth": self._waiting,
+            "occupancy": self.occupancy.smooth_rate(),
+            "queue_depth_dist": self.queue_depth.as_dict(),
+            "queue_wait_dist": self.queue_wait_latency.as_dict(),
+            "compute_time_dist": self.compute_time.as_dict(),
+            "resolver_latency_dist": self.resolver_latency.as_dict(),
+        }
+        metrics = getattr(self._cs, "metrics", None)
+        if metrics is not None:
+            qos["kernel"] = metrics.qos()
+        return {
+            "role": "resolver",
+            "version": self.version,
+            "backend": self._backend,
+            "qos": qos,
+        }
 
 
 def _looks_sealed(blob: bytes) -> bool:
@@ -490,6 +557,14 @@ class TLogRole:
         self.entries: list[tuple[int, list]] = []  # (version, mutations)
         self.version = -1
         self._dq = None
+        # -- saturation sensors (the Ratekeeper's TLogQueueInfo inputs):
+        # retained queue bytes through a wall-clock smoother — this is
+        # a real OS process, the reference's Smoother(timer()) shape
+        from foundationdb_tpu.utils.metrics import TimerSmoother
+
+        self._queue_bytes = 0
+        self.smoothed_queue_bytes = TimerSmoother(1.0)
+        self.smoothed_input_bytes = TimerSmoother(1.0)
         # the tlog persists the SAME mutation bytes storage seals — an
         # unencrypted tlog disk would hollow out the at-rest guarantee
         # (code review r5); whole records are sealed here (no ordering
@@ -514,6 +589,11 @@ class TLogRole:
                 rec = codec.decode(blob)
                 self.entries.append((rec.version, list(rec.mutations)))
                 self.version = max(self.version, rec.version)
+            self._queue_bytes = sum(
+                8 + len(m.param1) + len(m.param2)
+                for _v, ms in self.entries for m in ms
+            )
+            self.smoothed_queue_bytes.set_total(self._queue_bytes)
 
     async def push(self, req: TLogPush) -> TLogPushReply:
         if req.version <= self.version:
@@ -536,7 +616,35 @@ class TLogRole:
                 raise transport.RemoteError("tlog disk commit failed")
         self.entries.append((req.version, list(req.mutations)))
         self.version = req.version
+        nb = sum(
+            8 + len(m.param1) + len(m.param2) for m in req.mutations
+        )
+        self._queue_bytes += nb
+        self.smoothed_input_bytes.add_delta(nb)
+        self.smoothed_queue_bytes.set_total(self._queue_bytes)
         return TLogPushReply(durable_version=self.version)
+
+    def status(self) -> dict:
+        """StatusRequest payload: retained queue depth/bytes (smoothed
+        + instantaneous) and the durable version — the wire analog of
+        the sim tlog's `saturation()` block."""
+        return {
+            "role": "log",
+            "version": self.version,
+            "qos": {
+                "queue_mutations": sum(
+                    len(ms) for _v, ms in self.entries
+                ),
+                "queue_bytes": self._queue_bytes,
+                "smoothed_queue_bytes": (
+                    self.smoothed_queue_bytes.smooth_total()
+                ),
+                "input_bytes_per_s": (
+                    self.smoothed_input_bytes.smooth_rate()
+                ),
+                "entries": len(self.entries),
+            },
+        }
 
     async def peek(self, req: TLogPeek) -> TLogPeekReply:
         i = self._first_after(req.after_version)
@@ -627,6 +735,18 @@ class StorageRole:
         self.engine = engine
         self._lsm = None
         self.window = window
+        # -- saturation sensors: smoothed apply bandwidth + batch-size
+        # distribution (the version LAG vs the committed head is joined
+        # at assembly time — status.py assemble_status — because only
+        # the parent pipeline knows the head, Status.actor.cpp's shape)
+        from foundationdb_tpu.utils.metrics import (
+            LatencySample,
+            TimerSmoother,
+        )
+
+        self.smoothed_input_bytes = TimerSmoother(1.0)
+        self.apply_batch_size = LatencySample("applyBatchMutations")
+        self._applies = 0
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
             _check_encryption_marker(data_dir, encryption)
@@ -759,6 +879,11 @@ class StorageRole:
             self._seq_by_version = kept
 
     def _apply_mutations(self, version: int, mutations) -> None:
+        self._applies += 1
+        self.apply_batch_size.sample(len(mutations))
+        self.smoothed_input_bytes.add_delta(sum(
+            8 + len(m.param1) + len(m.param2) for m in mutations
+        ))
         if self._lsm is not None:
             # values arrive pre-sealed (seal-once in apply/catch-up);
             # keys stay plaintext for run ordering (crypto/at_rest.py)
@@ -932,6 +1057,25 @@ class StorageRole:
 
     async def get_version(self, req: RoleVersionReq) -> RoleVersionReply:
         return RoleVersionReply(version=self.version)
+
+    def status(self) -> dict:
+        """StatusRequest payload: apply bandwidth, batch-size
+        distribution, and the store size — the wire analog of the sim
+        storage's `saturation()` block (version lag vs the committed
+        head is joined at assembly time)."""
+        return {
+            "role": "storage",
+            "version": self.version,
+            "engine": self.engine,
+            "qos": {
+                "applies": self._applies,
+                "apply_batch_mutations": self.apply_batch_size.as_dict(),
+                "input_bytes_per_s": (
+                    self.smoothed_input_bytes.smooth_rate()
+                ),
+                "keys": len(self.history),
+            },
+        }
 
     async def get(self, req: StorageGet) -> StorageGetReply:
         cond = self._cond_lazy()
@@ -1130,6 +1274,15 @@ async def _serve_role(
         server.register(TOKEN_STORAGE_VERSION, role.get_version)
     else:
         raise ValueError(f"unknown role {role_name!r}")
+
+    # saturation telemetry: EVERY spawned role answers StatusRequest
+    # with its status block (fdbtop / wire_cluster_status poll this)
+    import json as _json
+
+    async def status(_req: StatusRequest) -> StatusReply:
+        return StatusReply(payload=_json.dumps(role.status()))
+
+    server.register(TOKEN_STATUS, status)
     await server.start()
     # run until killed
     await asyncio.Event().wait()
@@ -1376,6 +1529,14 @@ class ProxyPipeline:
         # rides one StorageGetBatch RPC (per-key versions, exact MVCC)
         self._read_pending: list = []
         self._read_flush_scheduled = False
+        # -- saturation sensors (the parent process plays BOTH proxies
+        # in wire mode: commit batching here, GRV at get_read_version)
+        from foundationdb_tpu.utils.metrics import TimerSmoother
+
+        self._batches_inflight = 0
+        self.smoothed_queue_depth = TimerSmoother(1.0)
+        self.smoothed_grv_rate = TimerSmoother(1.0)
+        self.grvs_served = 0
 
     def start(self) -> None:
         self._loop = asyncio.get_event_loop()
@@ -1417,7 +1578,49 @@ class ProxyPipeline:
             self._applier_task = None
 
     async def get_read_version(self) -> int:
+        self.grvs_served += 1
+        self.smoothed_grv_rate.add_delta(1.0)
         return self.committed_version
+
+    # -- saturation sensors ------------------------------------------------
+
+    def saturation(self) -> dict:
+        """The wire commit proxy's qos block: in-flight batch depth
+        (the stage-overlap window), queued requests (smoothed +
+        instantaneous), the apply backlog behind the replies, and the
+        AdaptiveBatchSizer's live interval/count/bytes targets."""
+        return {
+            "inflight_batches": self._batches_inflight,
+            "queued_requests": len(self._queue),
+            "smoothed_queued_requests": (
+                self.smoothed_queue_depth.smooth_total()
+            ),
+            "batches_started": self._batch_seq,
+            "batches_logged": self._latest_batch_logging.get(),
+            "apply_backlog_versions": max(
+                0, self._last_enqueued_apply - self.applied_version
+            ),
+            "apply_queue_batches": len(self._apply_queue),
+            "read_backlog_keys": len(self._read_pending),
+            "batch_sizer": self.batch_sizer.as_dict(),
+            "failed": self.failed is not None,
+        }
+
+    def grv_saturation(self) -> dict:
+        """The wire GRV front door's qos block (this process serves
+        read versions directly off the committed head)."""
+        return {
+            # GRVs answer synchronously off committed_version — the
+            # wire front door cannot queue, and a nonzero count here
+            # would send performance_limited_by chasing a bottleneck
+            # that cannot exist (the read-coalescer backlog is the
+            # proxy block's read_backlog_keys)
+            "queued_requests": 0,
+            "grvs_served": self.grvs_served,
+            "grv_per_s": self.smoothed_grv_rate.smooth_rate(),
+            "committed_version": self.committed_version,
+            "applied_version": self.applied_version,
+        }
 
     async def commit(self, txn: CommitTransaction) -> int:
         """Returns the commit version or raises NotCommittedError."""
@@ -1547,9 +1750,12 @@ class ProxyPipeline:
                                    was_full)
             )
             self._inflight.add(t)
+            self._batches_inflight += 1
+            self.smoothed_queue_depth.set_total(len(self._queue))
 
             def _done(_f, t=t):
                 self._inflight.discard(t)
+                self._batches_inflight -= 1
                 self._depth.release()
 
             t.add_done_callback(_done)
@@ -1754,6 +1960,75 @@ async def connect(address, **kw) -> transport.RpcConnection:
     kw.setdefault("retries", 1200)
     await conn.connect(**kw)
     return conn
+
+
+# ---------------------------------------------------------------------------
+# Wire-mode status aggregation (the fdbtop substrate).
+
+
+def _pipeline_status_blocks(pipeline: "ProxyPipeline") -> dict[str, dict]:
+    """The parent process's own process blocks: it plays both proxies
+    in wire mode (commit batching + the GRV front door)."""
+    return {
+        "proxy0": {
+            "role": "commit_proxy",
+            "committed_version": pipeline.committed_version,
+            "qos": pipeline.saturation(),
+        },
+        "grv_proxy0": {
+            "role": "grv_proxy",
+            "qos": pipeline.grv_saturation(),
+        },
+    }
+
+
+async def wire_cluster_status(
+    roles: dict[str, transport.RpcConnection],
+    pipeline: "ProxyPipeline" = None,
+    *,
+    lag_target: float = 2_000_000.0,
+) -> dict:
+    """Reference-shaped status JSON for a wire-mode cluster: one
+    StatusRequest RPC per role process, plus the parent pipeline's own
+    proxy blocks, assembled through the SAME qos math as the sim
+    `cluster_status()` (cluster/status.py assemble_status)."""
+    import json as _json
+
+    from foundationdb_tpu.cluster.status import assemble_status
+
+    procs: dict[str, dict] = {}
+    for name, conn in roles.items():
+        reply = await conn.call(TOKEN_STATUS, StatusRequest(pad=0))
+        procs[name] = _json.loads(reply.payload)
+    if pipeline is not None:
+        procs.update(_pipeline_status_blocks(pipeline))
+    return assemble_status(procs, lag_target=lag_target)
+
+
+def serve_status(
+    socket_dir: str, pipeline: "ProxyPipeline"
+) -> transport.RpcServer:
+    """Parent-side status endpoint: an RpcServer on proxy0.sock in the
+    role socket dir, answering StatusRequest with the pipeline's OWN
+    proxy blocks — so an external fdbtop polling the socket dir sees
+    the commit/GRV proxy sensors next to the role processes' (the
+    parent is just another process with a status socket). Caller must
+    `await server.start()` and close it at teardown."""
+    import json as _json
+
+    address = os.path.join(socket_dir, "proxy0.sock")
+    server = transport.RpcServer(address, tls=_tls_from_env())
+
+    async def status(_req: StatusRequest) -> StatusReply:
+        blocks = _pipeline_status_blocks(pipeline)
+        payload = blocks["proxy0"]
+        # the GRV block rides along; fdbtop splits it out into its own
+        # process row (one socket, both proxy roles)
+        payload["grv_proxy"] = blocks["grv_proxy0"]
+        return StatusReply(payload=_json.dumps(payload))
+
+    server.register(TOKEN_STATUS, status)
+    return server
 
 
 def main() -> None:

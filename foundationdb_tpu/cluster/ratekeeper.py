@@ -88,6 +88,26 @@ class Ratekeeper:
         """GetRateInfoRequest: the current per-second txn budget."""
         return self.tps_budget
 
+    def status(self) -> dict:
+        """The Ratekeeper's slice of the status `qos` section (the
+        reference surfaces transactions_per_second_limit and the
+        throttled-tag set the same way, Status.actor.cpp): the live
+        budget, its bounds, the control inputs, and both quota tiers —
+        so the admission-control loop is observable from day one."""
+        lag = self.worst_lag()
+        return {
+            "transactions_per_second_limit": self.tps_budget,
+            "max_tps": self.max_tps,
+            "min_tps": self.min_tps,
+            "worst_storage_lag_versions": lag,
+            "lag_target_versions": self.lag_target,
+            "lag_limit_versions": self.lag_limit,
+            "throttled_intervals": self.counters.get("throttled"),
+            "control_loops": self.counters.get("loops"),
+            "tag_quotas": dict(self.tag_quotas),
+            "auto_tag_quotas": dict(self.auto_tag_quotas),
+        }
+
     def set_tag_quota(self, tag: str, tps: float) -> None:
         """Management surface: cap a transaction tag's start rate."""
         self.tag_quotas[tag] = tps

@@ -116,6 +116,39 @@ class StorageServer:
         self.read_latency_bands = LatencyBands(
             "ReadLatencyMetrics", READ_LATENCY_BANDS
         )
+        # -- saturation sensors (StorageQueueInfo: the Ratekeeper's
+        # per-storage inputs — smoothed input bytes, version lag,
+        # fetchKeys backlog) — virtual-clock smoothers, deterministic
+        # per seed
+        from foundationdb_tpu.utils.metrics import Smoother
+
+        self.smoothed_input_bytes = Smoother(1.0, clock=sched.now)
+        #: mutations applied by the last pull batch (the apply-queue
+        #: depth proxy: a lagging replica catches up in huge batches)
+        self.last_batch_mutations = 0
+
+    def saturation(self) -> dict:
+        """The storage server's qos sensor block: how far the apply
+        cursor trails the log (apply-queue depth in versions), the
+        fetchKeys backlog, and the smoothed write bandwidth. The
+        cluster-level version lag (vs the sequencer head) is derived at
+        status-assembly time — this process doesn't know the head."""
+        return {
+            "apply_lag_versions": max(
+                0, self.tlog.version.get() - self.version.get()
+            ),
+            "write_queue_bytes": self.tlog.tag_backlog_bytes(
+                self.tag, self.consumer
+            ),
+            "apply_batch_mutations": self.last_batch_mutations,
+            "input_bytes_per_s": self.smoothed_input_bytes.smooth_rate(),
+            "fetch_backlog_ranges": len(self._fetching),
+            "fetch_backlog_mutations": sum(
+                len(buf) for buf in self._fetching.values()
+            ),
+            "keys": self._live_count,
+            "mvcc_window_versions": self.window_versions,
+        }
 
     def start(self) -> None:
         self.stopped = False
@@ -145,10 +178,18 @@ class StorageServer:
                 entries, log_version = await self.tlog.peek(
                     self.tag, self.version.get()
                 )
+                self.last_batch_mutations = sum(
+                    len(msgs) for _v, msgs in entries
+                )
                 for v, msgs in entries:
                     assert v > self.version.get()
                     for m in msgs:
                         self._ingest(v, m)
+                        try:
+                            nb = 8 + len(m[1]) + len(m[2])
+                        except Exception:
+                            nb = 32
+                        self.smoothed_input_bytes.add_delta(nb)
                     self.version.set(v)
                     if _trace.g_trace_batch.enabled:
                         # version-keyed (storage sits below the debug-id

@@ -30,6 +30,15 @@ declare("tlog.diskqueue_recovery", "simdisk.torn_tail",
 Tag = int  # storage tag (the reference's Tag{locality, id})
 
 
+def _mut_bytes(m) -> int:
+    """Cheap per-mutation byte estimate (the queue-bytes sensor's unit;
+    MutationRef::expectedSize analog — never exact serialization)."""
+    try:
+        return 8 + len(m[1]) + len(m[2])
+    except Exception:
+        return 32
+
+
 @dataclasses.dataclass
 class TLogCommitRequest:
     prev_version: int
@@ -98,6 +107,63 @@ class TLog:
         # lagging consumer therefore bounds tlog MEMORY, not disk.
         self._spilled: dict[Tag, list[tuple[int, int]]] = {}
         self._mem_mutations = 0
+        # -- saturation sensors (the Ratekeeper's TLogQueueInfo inputs:
+        # Ratekeeper.actor.cpp tracks each log's queue bytes through a
+        # Smoother before computing the txn/s budget) -----------------
+        # retained mutation BYTES, maintained incrementally alongside
+        # _mem_mutations (same update sites)
+        self._mem_bytes = 0
+        from foundationdb_tpu.utils.metrics import Smoother
+
+        #: smoothed retained-queue bytes on the VIRTUAL clock (sim
+        #: determinism: identical per seed, safe next to trace digests)
+        self.smoothed_queue_bytes = Smoother(1.0, clock=sched.now)
+        #: smoothed input bytes/s (the reference's smoothInputBytes)
+        self.smoothed_input_bytes = Smoother(1.0, clock=sched.now)
+
+    def saturation(self) -> dict:
+        """The tlog's qos sensor block (status JSON `processes.*.qos`):
+        retained queue depth/bytes (smoothed + instantaneous) and the
+        durability lag — how far the slowest storage pop cursor trails
+        this log's version."""
+        storage_marks = [
+            self._popped["storage"].get(tag, 0)
+            for tag in set(self._messages) | set(self._spilled)
+            if tag != LOG_STREAM_TAG
+        ]
+        v = self.version.get()
+        return {
+            "queue_mutations": self._mem_mutations,
+            "queue_bytes": self._mem_bytes,
+            "smoothed_queue_bytes": self.smoothed_queue_bytes.smooth_total(),
+            "input_bytes_per_s": self.smoothed_input_bytes.smooth_rate(),
+            "spilled_versions": sum(
+                len(e) for e in self._spilled.values()
+            ),
+            "durability_lag_versions": (
+                v - min(storage_marks) if storage_marks else 0
+            ),
+        }
+
+    def tag_backlog_bytes(self, tag: Tag, consumer: str = "storage") -> int:
+        """Bytes this log still retains for one consumer's tag — the
+        per-storage write-queue depth (the reference's storage queue =
+        bytesInput - bytesDurable, measured here at the log because the
+        sim storage applies synchronously once it pulls). Spilled
+        versions count at the estimate used when they were spilled."""
+        mark = self._popped.get(consumer, {}).get(tag, 0)
+        n = sum(
+            _mut_bytes(m)
+            for v, msgs in self._messages.get(tag, [])
+            if v > mark
+            for m in msgs
+        )
+        # spilled entries carry no byte estimate; charge a flat floor
+        # per spilled VERSION entry so the backlog never reads as zero
+        n += 32 * sum(
+            1 for v, _seq in self._spilled.get(tag, []) if v > mark
+        )
+        return n
 
     def lock(self, epoch: int, recovery_version: int = None) -> None:
         """Recovery locks the log to a new generation: pushes from older
@@ -139,6 +205,10 @@ class TLog:
         for tag, msgs in req.messages.items():
             self._messages.setdefault(tag, []).append((req.version, msgs))
             self._mem_mutations += len(msgs)
+            nb = sum(_mut_bytes(m) for m in msgs)
+            self._mem_bytes += nb
+            self.smoothed_input_bytes.add_delta(nb)
+        self.smoothed_queue_bytes.set_total(self._mem_bytes)
         self.version.set(req.version)
         if req.debug_id is not None:
             _trace.g_trace_batch.add_event(
@@ -187,9 +257,11 @@ class TLog:
                         (ev, seq_of[ev])
                     )
                     self._mem_mutations -= len(msgs)
+                    self._mem_bytes -= sum(_mut_bytes(m) for m in msgs)
                 else:
                     kept.append((ev, msgs))
             self._messages[tag] = kept
+        self.smoothed_queue_bytes.set_total(self._mem_bytes)
 
     def _entries_for(self, tag: Tag, after_version: int):
         """Merged (version, msgs) view of a tag: spilled versions read
@@ -313,6 +385,7 @@ class TLog:
         self._messages = {}
         self._spilled = {}
         self._mem_mutations = 0
+        self._mem_bytes = 0
         self._seq_of_version = []
         last_version = 0
         for seq, blob in self.dq.recovered:
@@ -322,8 +395,10 @@ class TLog:
             for tag, msgs in messages.items():
                 self._messages.setdefault(tag, []).append((v, msgs))
                 self._mem_mutations += len(msgs)
+                self._mem_bytes += sum(_mut_bytes(m) for m in msgs)
             self._seq_of_version.append((v, seq))
             last_version = v
+        self.smoothed_queue_bytes.set_total(self._mem_bytes)
         self._maybe_spill()  # a big recovered tail re-spills immediately
         if last_version > self.version.get():
             self.version.set(last_version)
@@ -345,6 +420,7 @@ class TLog:
             for v, msgs in peer._entries_for(tag, my_v):
                 self._messages.setdefault(tag, []).append((v, msgs))
                 self._mem_mutations += len(msgs)
+                self._mem_bytes += sum(_mut_bytes(m) for m in msgs)
                 copied.setdefault(v, {})[tag] = msgs
         for tag in self._messages:
             self._messages[tag].sort(key=lambda e: e[0])
@@ -361,6 +437,7 @@ class TLog:
         self._popped = {
             n: dict(m) for n, m in peer._popped.items()
         }
+        self.smoothed_queue_bytes.set_total(self._mem_bytes)
         self._maybe_spill()  # the copied tail respects the memory budget
 
     def _trim(self, tag: Tag) -> None:
@@ -379,8 +456,14 @@ class TLog:
                 self._mem_mutations -= sum(
                     len(m) for _v, m in self._messages.get(tag, [])
                 )
+                self._mem_bytes -= sum(
+                    _mut_bytes(m)
+                    for _v, ms in self._messages.get(tag, [])
+                    for m in ms
+                )
                 self._messages[tag] = []
                 self._spilled.pop(tag, None)
+                self.smoothed_queue_bytes.set_total(self._mem_bytes)
                 return
             floor = min(m.get(tag, 0) for m in extras)
         else:
@@ -396,6 +479,10 @@ class TLog:
             (v, m) for v, m in self._messages.get(tag, []) if v <= floor
         ]
         self._mem_mutations -= sum(len(m) for _v, m in dropped)
+        self._mem_bytes -= sum(
+            _mut_bytes(m) for _v, ms in dropped for m in ms
+        )
+        self.smoothed_queue_bytes.set_total(self._mem_bytes)
         self._messages[tag] = [
             (v, m) for v, m in self._messages.get(tag, []) if v > floor
         ]

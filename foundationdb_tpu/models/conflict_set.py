@@ -97,6 +97,33 @@ class KernelStageMetrics:
             out[s.name] = s.as_dict()
         return out
 
+    def qos(self) -> dict:
+        """The compressed occupancy view the saturation layer reads
+        (status `qos` / fdbtop): per-batch kernel seconds (the fixed
+        per-dispatch cost the tpu-force p99 backup rides on), the share
+        of resolve wall time inside the device stages, and tier fill —
+        one small dict, not the full stage-sample dump (as_dict)."""
+        batches = self.counters.get("resolveBatches")
+        stage_total = (
+            self.pack.total + self.transfer.total + self.kernel.total
+            + self.fence.total
+        )
+        return {
+            "batches": batches,
+            "kernel_seconds_per_batch": (
+                stage_total / batches if batches else 0.0
+            ),
+            "kernel_p99_seconds": self.kernel.quantile(0.99),
+            "compile_seconds": self.compile.total,
+            "delta_occupancy": self.delta_occupancy.max or 0.0,
+            "main_occupancy": self.main_occupancy.max or 0.0,
+            "compactions": self.counters.get("compactions"),
+            "fallbacks": (
+                self.counters.get("latchTrips")
+                + self.counters.get("exactFallbacks")
+            ),
+        }
+
 
 class HistoryOverflowError(RuntimeError):
     """Compacted history exceeded `history_capacity`.

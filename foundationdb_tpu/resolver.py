@@ -220,6 +220,13 @@ class Resolver:
         self.queue_wait_latency = LatencySample("queueWaitLatency")
         self.compute_time = LatencySample("computeTime")
         self.queue_depth = LatencySample("queueDepth")
+        # busy-fraction smoother (the Ratekeeper's resolver-occupancy
+        # input): compute seconds as a decayed rate on the VIRTUAL
+        # clock — deterministic per seed, ~0 in sim unless a scenario
+        # models compute delay, ~1.0 on a saturated wire resolver
+        from foundationdb_tpu.utils.metrics import Smoother
+
+        self.occupancy = Smoother(2.0, clock=sched.now)
         # iops sample feeding the ResolutionBalancer (Resolver.actor.cpp:
         # 337-344). Bounded: the reference samples with decay; an
         # unbounded dict leaks on long multi-resolver soaks (VERDICT r1
@@ -511,7 +518,9 @@ class Resolver:
             self._state_changed.trigger()
             if any_popped or breached:
                 self.check_needed_version.trigger()
-            self.compute_time.sample(self.sched.now() - begin_compute)
+            dt_compute = self.sched.now() - begin_compute
+            self.compute_time.sample(dt_compute)
+            self.occupancy.add_delta(dt_compute)
         else:
             # duplicate resolve batch request (:513)
             code_probe(
@@ -529,6 +538,34 @@ class Resolver:
         code_probe(out is None, "resolver.unknown_duplicate_never")
         span.attribute("txns", len(req.transactions))
         return out  # None == the reference's Never()
+
+    # -- saturation sensors (the Ratekeeper's resolver occupancy input) ----
+
+    def saturation(self) -> dict:
+        """The resolver's qos sensor block: the reference's exact four
+        distributions (resolverLatencyDist / queueWaitLatencyDist /
+        computeTimeDist / queueDepthDist, Resolver.actor.cpp:156-213)
+        plus state-memory pressure and — on kernel backends — the TPU
+        occupancy summary from KernelStageMetrics. All virtual-clock
+        samples: deterministic per seed, safe next to trace digests."""
+        out = {
+            "queue_depth": self.version.num_waiting(),
+            "occupancy": self.occupancy.smooth_rate(),
+            "queue_depth_dist": self.queue_depth.as_dict(),
+            "queue_wait_dist": self.queue_wait_latency.as_dict(),
+            "compute_time_dist": self.compute_time.as_dict(),
+            "resolver_latency_dist": self.resolver_latency.as_dict(),
+            "state_bytes": self.total_state_bytes,
+            "state_memory_limit": self.state_memory_limit,
+            "state_pressure": (
+                self.total_state_bytes / self.state_memory_limit
+                if self.state_memory_limit else 0.0
+            ),
+        }
+        metrics = getattr(self.conflict_set, "metrics", None)
+        if metrics is not None:
+            out["kernel"] = metrics.qos()
+        return out
 
     # -- balancer endpoints (ResolverInterface metrics/split) -------------
 

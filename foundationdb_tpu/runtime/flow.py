@@ -564,6 +564,11 @@ class Task:
                 auditor.end_step()
             sched = self._sched
             elapsed = _time.perf_counter() - t0  # flowcheck: ignore[determinism]
+            # run-loop utilization accounting (Net2's networkMetrics
+            # priority-busy counters): every step's wall time lands in
+            # the busy total — one add on a float already in hand
+            sched._busy_wall += elapsed
+            sched._steps += 1
             # fast path: two clock reads + one compare per step; the
             # full per-actor profile is opt-in (Scheduler(profile=True))
             if sched._profile or elapsed > sched.SLOW_TASK_THRESHOLD:
@@ -681,6 +686,36 @@ class Scheduler:
         #: step counts/totals for fast actors are intentionally absent
         self.actor_profile: dict[str, list] = {}
         self.slow_tasks: list[tuple[str, float]] = []
+        # run-loop utilization (the Net2 networkMetrics busy fraction):
+        # WALL seconds spent inside actor steps vs wall seconds since
+        # construction. Wall-clock on purpose — it measures how busy
+        # this OS process's loop is, which virtual time cannot; status
+        # readers surface it, traced simulation output never does (the
+        # trace-digest determinism contract).
+        self._busy_wall = 0.0
+        self._steps = 0
+        self._slow_task_total = 0
+        self._wall_anchor = _time.perf_counter()  # flowcheck: ignore[determinism]
+
+    def run_loop_stats(self) -> dict:
+        """Saturation view of the run loop: busy fraction, step count,
+        slow-task ledger summary. The "~40% idle parent loop" class of
+        diagnosis (PIPELINE_r07) reads directly off `utilization`
+        instead of being reconstructed from traces after the fact."""
+        wall = _time.perf_counter() - self._wall_anchor  # flowcheck: ignore[determinism]
+        slow_by_actor: dict[str, int] = {}
+        for name, _s in self.slow_tasks:
+            slow_by_actor[name] = slow_by_actor.get(name, 0) + 1
+        return {
+            "utilization": (self._busy_wall / wall) if wall > 0 else 0.0,
+            "busy_seconds": self._busy_wall,
+            "wall_seconds": wall,
+            "steps": self._steps,
+            "slow_tasks": self._slow_task_total,
+            "slow_tasks_by_actor": dict(
+                sorted(slow_by_actor.items(), key=lambda kv: -kv[1])[:10]
+            ),
+        }
 
     def _note_step(self, name: str, elapsed: float) -> None:
         st = self.actor_profile.get(name)
@@ -691,6 +726,7 @@ class Scheduler:
         if elapsed > st[2]:
             st[2] = elapsed
         if elapsed > self.SLOW_TASK_THRESHOLD:
+            self._slow_task_total += 1
             if len(self.slow_tasks) >= 256:  # bounded, like trace rolls
                 del self.slow_tasks[:128]
             code_probe(True, "runtime.slow_task")

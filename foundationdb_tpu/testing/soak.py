@@ -117,7 +117,8 @@ def plan_for_seed(seed: int, spec=None) -> SeedPlan:
 def run_seed(seed: int, spec=None, collect_probes: bool = False,
              _inject_fault=None, _corrupt_api: bool = False,
              perturb: int = 0, _inject_race: bool = False,
-             trace: bool = False, _corrupt_trace: bool = False):
+             trace: bool = False, _corrupt_trace: bool = False,
+             status_probe: bool = False):
     """Run one ensemble seed under a named spec; returns the
     deterministic signature (and, with collect_probes, the CODE_PROBE
     hit snapshot for ensemble coverage accounting — the Joshua side of
@@ -160,6 +161,14 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
     (seed, perturb). `_corrupt_trace` is the gate's divergence
     self-test: it deletes one pipeline stage's events before the check,
     which must then fail the seed.
+
+    `status_probe=True` arms the saturation-sensor determinism guard:
+    a background actor samples the full `cluster_status()` document
+    (every role's saturation() sensors, smoother decay, qos assembly)
+    on a virtual-clock cadence during the run. Combined with
+    `trace=True`, the digest check proves reading the sensors leaves
+    traced output bit-identical per (seed, perturb) — the new gauges
+    stay OUT of the trace-digest contract.
     """
     from foundationdb_tpu.cluster.commit_proxy import (
         CommitUnknownResult,
@@ -731,6 +740,31 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
             # must catch whatever this actor lets escape
             sched.spawn(  # flowcheck: ignore[actor.fire-and-forget]
                 _inject_fault(sched, cluster, db), name="injected-fault"
+            )
+        if status_probe:
+            # saturation-sensor determinism guard: SAMPLE the full
+            # status document (every saturation() sensor, the smoothers'
+            # _update() decay, the qos assembly) on a cadence DURING the
+            # run — the trace-digest check below then proves that
+            # reading the sensors leaves traced output bit-identical
+            # per (seed, perturb). JSON-serialization is part of the
+            # contract (status consumers are JSON readers).
+            import json as _status_json
+
+            from foundationdb_tpu.cluster.status import cluster_status
+
+            async def status_sampler():
+                # bounded: covers the bulk of a seed's virtual runtime
+                # and terminates so all_of(tasks) can complete
+                for _ in range(40):
+                    doc = cluster_status(cluster)
+                    _status_json.dumps(doc)
+                    qos = doc["cluster"]["qos"]
+                    assert "performance_limited_by" in qos
+                    await sched.delay(0.05)
+
+            tasks.append(
+                sched.spawn(status_sampler(), name="status-probe").done
             )
         if plan.laggard_txn:
             tasks.append(sched.spawn(laggard(), name="soak-laggard").done)

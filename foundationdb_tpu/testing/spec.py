@@ -23,6 +23,14 @@ A spec declares:
 * `[probes].expected` — CODE_PROBE names this spec exists to reach;
   validated against analysis/probe_manifest.json and reported by
   scripts/soak.py's coverage accounting.
+* `[probes.budgets]` — OPTIONAL per-probe expected occurrence rates
+  (probe name -> expected hits per seed, e.g. 0.02 for a probe that
+  fires ~2 times per 100 seeds). The `--probe-gate` only FAILS on a
+  missed expected probe once the sweep is big enough that the budget
+  predicts >= PROBE_GATE_MIN_EXPECTED occurrences — so a short smoke
+  sweep can't false-fail on a statistically rare probe, while a full
+  sweep still gates it. A probe without a budget gates at any sweep
+  size (the pre-budget behavior).
 
 Derivation is order-pinned: `plan_for_seed` draws one value per field
 in a single canonical order, so two specs that differ only in numbers
@@ -82,6 +90,13 @@ TOPOLOGY_FIELDS = (
 
 VALID_BACKENDS = ("cpu", "tpu", "tpu-force")
 
+#: a budgeted expected probe only gates a sweep once the budget predicts
+#: at least this many occurrences across the swept seeds (below that, a
+#: miss is statistically unremarkable — e.g. a 0.02/seed probe over the
+#: 1-seed smoke lane predicts 0.02 hits, and failing on its absence
+#: would be pure noise)
+PROBE_GATE_MIN_EXPECTED = 3.0
+
 
 class SpecError(ValueError):
     """A spec file is malformed: missing/unknown fields, bad types, or
@@ -104,6 +119,21 @@ class SoakSpec:
     # workload field -> probability, plus api_actors / api_rounds ints
     workloads: dict
     expected_probes: tuple = ()
+    # probe name -> expected occurrences per seed (see PROBE_GATE_MIN_
+    # EXPECTED); () == no budgets, every expected probe gates any sweep
+    probe_budgets: tuple = ()
+
+    def gated_probes(self, n_seeds: int) -> set:
+        """The expected probes the `--probe-gate` may FAIL on for a
+        sweep of n_seeds: unbudgeted probes always gate; a budgeted
+        probe gates only once n_seeds * budget >= the minimum expected
+        occurrence count."""
+        budgets = dict(self.probe_budgets)
+        return {
+            p for p in self.expected_probes
+            if p not in budgets
+            or n_seeds * budgets[p] >= PROBE_GATE_MIN_EXPECTED
+        }
 
     # -- schema -----------------------------------------------------------
 
@@ -201,6 +231,17 @@ class SoakSpec:
             raise SpecError(
                 f"spec {self.name!r}: probes.expected must be strings"
             )
+        for p, rate in self.probe_budgets:
+            if p not in self.expected_probes:
+                raise SpecError(
+                    f"spec {self.name!r}: probes.budgets names {p!r} "
+                    f"which is not in probes.expected"
+                )
+            if not isinstance(rate, (int, float)) or not 0.0 < rate <= 1.0:
+                raise SpecError(
+                    f"spec {self.name!r}: probes.budgets.{p} must be an "
+                    f"expected per-seed rate in (0, 1], got {rate!r}"
+                )
         return self
 
     # -- (de)serialization ------------------------------------------------
@@ -216,7 +257,13 @@ class SoakSpec:
             },
             "faults": dict(sorted(self.faults.items())),
             "workloads": dict(sorted(self.workloads.items())),
-            "probes": {"expected": sorted(self.expected_probes)},
+            "probes": {
+                "expected": sorted(self.expected_probes),
+                **(
+                    {"budgets": dict(sorted(self.probe_budgets))}
+                    if self.probe_budgets else {}
+                ),
+            },
         }
 
     @classmethod
@@ -234,6 +281,11 @@ class SoakSpec:
                 workloads=dict(d["workloads"]),
                 expected_probes=tuple(
                     sorted(d.get("probes", {}).get("expected", ()))
+                ),
+                probe_budgets=tuple(
+                    sorted(
+                        d.get("probes", {}).get("budgets", {}).items()
+                    )
                 ),
             )
         except (KeyError, TypeError, AttributeError) as e:

@@ -12,7 +12,8 @@ reporting without the reference's mergeability machinery.
 from __future__ import annotations
 
 import math
-from typing import Optional
+import time as _time
+from typing import Callable, Optional
 
 from foundationdb_tpu.utils.probes import code_probe, declare
 
@@ -52,6 +53,158 @@ class CounterCollection:
 
     def as_dict(self) -> dict[str, int]:
         return {k: c.value for k, c in self._counters.items()}
+
+
+class Smoother:
+    """Exponential time-decay smoother (fdbrpc/Stats.h:77-113 Smoother).
+
+    Tracks a TOTAL whose smoothed estimate decays toward the true total
+    with e-folding time `folding_time`: after one folding time, ~63% of
+    a step change is reflected; `smooth_rate()` is the decayed estimate
+    of d(total)/dt — the reference's Ratekeeper feeds storage/TLog queue
+    byte totals through exactly this filter before computing a rate
+    limit, so transient spikes don't whipsaw admission.
+
+    The clock is injected: simulation roles pass the scheduler's VIRTUAL
+    clock so smoothed values are deterministic per seed (and safe next
+    to the trace-digest determinism contract); wire roles pass a wall
+    clock (see TimerSmoother). Updates at a non-advancing clock are
+    absorbed exactly (the decay factor is 1 at dt=0).
+    """
+
+    __slots__ = ("folding_time", "clock", "time", "total", "estimate")
+
+    def __init__(self, folding_time: float,
+                 clock: Optional[Callable[[], float]] = None):
+        if folding_time <= 0:
+            raise ValueError(f"folding_time must be > 0, got {folding_time}")
+        self.folding_time = folding_time
+        self.clock = clock or (lambda: 0.0)
+        self.reset(0.0)
+
+    def reset(self, value: float) -> None:
+        self.time = self.clock()
+        self.total = value
+        self.estimate = value
+
+    def _update(self) -> None:
+        t = self.clock()
+        elapsed = t - self.time
+        if elapsed > 0:
+            self.time = t
+            self.estimate += (self.total - self.estimate) * (
+                1.0 - math.exp(-elapsed / self.folding_time)
+            )
+
+    def set_total(self, total: float) -> None:
+        self.add_delta(total - self.total)
+
+    def add_delta(self, delta: float) -> None:
+        self._update()
+        self.total += delta
+
+    def smooth_total(self) -> float:
+        self._update()
+        return self.estimate
+
+    def smooth_rate(self) -> float:
+        """Decayed d(total)/dt — the signal the reference's queue-bytes
+        and version-rate smoothers expose to Ratekeeper."""
+        self._update()
+        return (self.total - self.estimate) / self.folding_time
+
+
+class TimerSmoother(Smoother):
+    """Smoother on the wall clock (the reference's TimerSmoother uses
+    timer() where Smoother uses now()): for wire-mode role processes,
+    where there is no virtual clock. Never use inside a simulation —
+    wall-clock-derived values must stay out of traced output (the
+    trace-digest determinism contract)."""
+
+    def __init__(self, folding_time: float):
+        super().__init__(folding_time, clock=_time.monotonic)
+
+
+class Gauge:
+    """A named current-value sensor: set() directly, or bind a supplier
+    callable so readers always see the live value (the status JSON's
+    pull model — the reference's StorageQueueInfo fields are exactly
+    this shape, sampled at status time)."""
+
+    __slots__ = ("name", "_value", "_supplier")
+
+    def __init__(self, name: str, supplier: Optional[Callable[[], float]] = None):
+        self.name = name
+        self._value = 0.0
+        self._supplier = supplier
+
+    def set(self, value: float) -> None:
+        self._value = value
+
+    def get(self) -> float:
+        if self._supplier is not None:
+            return self._supplier()
+        return self._value
+
+
+class MetricHistory:
+    """Bounded ring buffer of (time, value) samples: sparkline-grade
+    time series for fdbtop's per-role history columns. Fixed capacity,
+    O(1) append, oldest-first iteration; memory is bounded however long
+    the process lives (the TraceLog rolling discipline for gauges)."""
+
+    __slots__ = ("capacity", "_buf", "_next", "_full")
+
+    def __init__(self, capacity: int = 60):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be > 0, got {capacity}")
+        self.capacity = capacity
+        self._buf: list = [None] * capacity
+        self._next = 0
+        self._full = False
+
+    def append(self, t: float, value: float) -> None:
+        self._buf[self._next] = (t, value)
+        self._next = (self._next + 1) % self.capacity
+        if self._next == 0:
+            self._full = True
+
+    def __len__(self) -> int:
+        return self.capacity if self._full else self._next
+
+    def samples(self) -> list[tuple[float, float]]:
+        """Oldest-first (time, value) pairs."""
+        if not self._full:
+            return [s for s in self._buf[: self._next]]
+        return [
+            s for s in self._buf[self._next:] + self._buf[: self._next]
+        ]
+
+    def values(self) -> list[float]:
+        return [v for _t, v in self.samples()]
+
+    def last(self) -> Optional[float]:
+        n = len(self)
+        if n == 0:
+            return None
+        return self._buf[(self._next - 1) % self.capacity][1]
+
+
+def sparkline(values: list[float], width: int = 24) -> str:
+    """Render a value series as a unicode sparkline (fdbtop's history
+    column). Scales to the series' own min/max; empty series -> ''."""
+    if not values:
+        return ""
+    ticks = "▁▂▃▄▅▆▇█"
+    vals = values[-width:]
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return ticks[0] * len(vals)
+    return "".join(
+        ticks[min(len(ticks) - 1, int((v - lo) / span * len(ticks)))]
+        for v in vals
+    )
 
 
 class LatencySample:

@@ -171,7 +171,17 @@ async def _run_wire(backend: str, args) -> dict:
             f"window_versions={kcfg.window_versions}, "
             f"delta_capacity={kcfg.delta_capacity})"
         )
-    with tempfile.TemporaryDirectory() as sock_dir:
+    import contextlib
+
+    # --socket-dir pins the role sockets to a caller-owned dir so an
+    # EXTERNAL fdbtop can poll StatusRequest on them mid-run (the
+    # check.sh fdbtop lane); default stays a self-cleaning tempdir
+    sock_ctx = (
+        contextlib.nullcontext(args.socket_dir)
+        if getattr(args, "socket_dir", None)
+        else tempfile.TemporaryDirectory()
+    )
+    with sock_ctx as sock_dir:
         def role_trace(name):
             if not trace_dir:
                 return None
@@ -193,6 +203,12 @@ async def _run_wire(backend: str, args) -> dict:
                 trace=bool(trace_dir),
             )
             pipe.start()
+            status_server = None
+            if getattr(args, "serve_status", False):
+                # the parent's own proxy/GRV qos blocks on proxy0.sock,
+                # next to the role sockets — fdbtop sees every role
+                status_server = mp.serve_status(sock_dir, pipe)
+                await status_server.start()
 
             stats = {"committed": 0, "conflicted": 0, "reads": 0}
             committed_by_key: dict[bytes, int] = {}
@@ -271,7 +287,16 @@ async def _run_wire(backend: str, args) -> dict:
                 assert got.get(key, 0) == cnt, (
                     f"{key}: storage={got.get(key, 0)} committed={cnt}"
                 )
+            hold = float(getattr(args, "hold", 0) or 0)
+            if hold:
+                # keep the cluster (and status sockets) alive so an
+                # external fdbtop can poll a LIVE wire cluster
+                print(f"[hold] cluster live for {hold:.0f}s "
+                      f"(sockets in {sock_dir})", flush=True)
+                await asyncio.sleep(hold)
             await pipe.stop()
+            if status_server is not None:
+                await status_server.close()
             for c in (resolver, tlog, storage):
                 await c.close()
         finally:
@@ -357,6 +382,15 @@ def main():
                          "\"ok\" AND >=1 complete cross-process "
                          "commit_debug timeline reconstructed")
     ap.add_argument("--json-out", default=None)
+    ap.add_argument("--socket-dir", default=None,
+                    help="wire mode: pin role sockets to this dir so an "
+                         "external fdbtop can poll them mid-run")
+    ap.add_argument("--serve-status", action="store_true",
+                    help="wire mode: serve the parent's commit/GRV proxy "
+                         "qos blocks on proxy0.sock (StatusRequest RPC)")
+    ap.add_argument("--hold", type=float, default=0.0,
+                    help="wire mode: keep the cluster alive N seconds "
+                         "after the workload (fdbtop polling window)")
     args = ap.parse_args()
     if args.legacy:
         args.clients = args.legacy[0]

@@ -1,0 +1,468 @@
+#!/usr/bin/env python
+"""fdbtop: live terminal monitor for a cluster's saturation telemetry.
+
+The `fdbcli status` / `top` hybrid this framework's qos section makes
+possible: one screen with a row per role process — queue depth/bytes,
+version lag, batch-sizer targets, kernel occupancy — plus a sparkline
+history per row, refreshed live. Works against BOTH deployment shapes:
+
+  wire mode (real OS role processes over UDS):
+      python scripts/fdbtop.py --socket-dir /path/to/socks --watch
+      python scripts/fdbtop.py --socket-dir ... --once --json   # CI
+      python scripts/fdbtop.py --conf cluster.conf --once --json
+
+    Every role process answers the StatusRequest RPC (cluster/
+    multiprocess.py TOKEN_STATUS) with its qos block; the parent
+    pipeline (bench_pipeline --serve-status) serves its commit/GRV
+    proxy blocks on proxy0.sock in the same dir. fdbtop assembles the
+    blocks through cluster/status.py assemble_status — the SAME qos
+    math as the in-sim `cluster_status()`, one schema for both shapes.
+
+  sim mode (in-process deterministic cluster + demo workload):
+      python scripts/fdbtop.py --sim --watch
+      python scripts/fdbtop.py --sim --once --json
+
+  CI smoke (scripts/check.sh lane):
+      python scripts/fdbtop.py --smoke
+
+    Spins the bench_pipeline wire smoke with a status socket, polls
+    `--once --json` style until every role reports a qos entry, exits
+    nonzero on any missing sensor.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from foundationdb_tpu.utils.metrics import MetricHistory, sparkline  # noqa: E402
+
+#: per-role headline gauge (the sparkline column): path into the qos
+#: block, rendered per poll into a bounded MetricHistory ring
+HEADLINE = {
+    "log": ("smoothed_queue_bytes", "queue B"),
+    "storage": ("version_lag_versions", "lag v"),
+    "resolver": ("queue_depth", "queue"),
+    "commit_proxy": ("queued_requests", "queued"),
+    "grv_proxy": ("queued_requests", "queued"),
+    "master": ("version", "version"),
+}
+
+#: sensors every role's qos block must carry (the --smoke/--require
+#: gate; schema-pinned in tests/test_fdbtop.py)
+REQUIRED_SENSORS = {
+    "log": ("queue_bytes", "smoothed_queue_bytes", "input_bytes_per_s"),
+    "storage": ("version_lag_versions", "input_bytes_per_s"),
+    "resolver": ("queue_depth", "queue_wait_dist", "compute_time_dist",
+                 "occupancy"),
+    "commit_proxy": ("queued_requests", "inflight_batches", "batch_sizer"),
+    "grv_proxy": ("queued_requests",),
+}
+
+
+# ---------------------------------------------------------------------------
+# Wire-mode polling.
+
+
+async def _poll_wire(socket_dir: str, conns: dict, *, retries: int = 40):
+    """One status poll over every .sock in the dir; connections are
+    cached across polls (watch mode). Returns the assembled document."""
+    from foundationdb_tpu.cluster import multiprocess as mp
+    from foundationdb_tpu.cluster.status import assemble_status
+
+    procs: dict[str, dict] = {}
+    for path in sorted(glob.glob(os.path.join(socket_dir, "*.sock"))):
+        name = os.path.basename(path)[: -len(".sock")]
+        conn = conns.get(name)
+        if conn is None:
+            try:
+                conn = await mp.connect(path, retries=retries)
+            except (OSError, ConnectionError):
+                continue  # half-started cluster: render what answers
+            conns[name] = conn
+        try:
+            reply = await conn.call(
+                mp.TOKEN_STATUS, mp.StatusRequest(pad=0), timeout=5.0
+            )
+        except Exception:
+            conns.pop(name, None)
+            try:
+                await conn.close()
+            except Exception:
+                pass
+            continue
+        block = json.loads(reply.payload)
+        # the parent pipeline's socket carries BOTH proxy roles; split
+        # the embedded GRV block into its own process row
+        grv = block.pop("grv_proxy", None)
+        procs[name] = block
+        if grv is not None:
+            procs[f"grv_{name}"] = grv
+    return assemble_status(procs)
+
+
+async def _close_conns(conns: dict) -> None:
+    for conn in conns.values():
+        try:
+            await conn.close()
+        except Exception:
+            pass
+    conns.clear()
+
+
+def _conf_socket_dirs(conf_path: str) -> list[str]:
+    """Socket dirs named by a foundationdb.conf-style role file
+    (cluster/monitor.py parse_conf) — `fdbtop --conf` monitors a
+    Monitor-managed cluster without knowing where its sockets live."""
+    from foundationdb_tpu.cluster.monitor import parse_conf
+
+    return sorted({s.socket_dir for s in parse_conf(conf_path).values()})
+
+
+# ---------------------------------------------------------------------------
+# Sim mode: an in-process cluster + demo workload on the virtual clock.
+
+
+class _SimWorld:
+    """A small simulated cluster whose virtual time advances between
+    polls — the `--sim` backend (same render path as wire mode)."""
+
+    def __init__(self, seed: int = 0):
+        import numpy as np
+
+        from foundationdb_tpu.cluster.database import (
+            ClusterConfig,
+            open_cluster,
+        )
+
+        self.rng = np.random.default_rng(seed)
+        self.sched, self.cluster, self.db = open_cluster(
+            ClusterConfig(
+                n_commit_proxies=2, n_resolvers=2, n_storage=2, n_tlogs=2
+            )
+        )
+        self._stop = False
+        for w in range(4):
+            self.sched.spawn(self._workload(w))
+
+    async def _workload(self, wid: int) -> None:
+        i = 0
+        while not self._stop:
+            txn = self.db.create_transaction()
+            key = b"fdbtop-%d-%06d" % (wid, int(self.rng.integers(4096)))
+            txn.set(key, b"x" * int(self.rng.integers(16, 512)))
+            try:
+                await txn.commit()
+            except Exception:
+                pass  # conflicts are workload, not monitor, business
+            i += 1
+            await self.sched.delay(0.002 * (wid + 1))
+
+    def poll(self) -> dict:
+        from foundationdb_tpu.cluster.status import cluster_status
+
+        self.sched.run_for(0.25)  # advance virtual time between frames
+        return cluster_status(self.cluster)
+
+    def stop(self) -> None:
+        self._stop = True
+        self.cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# Rendering.
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        if v and (abs(v) >= 1e5 or abs(v) < 1e-2):
+            return f"{v:9.2e}"
+        return f"{v:9.2f}"
+    return f"{v!s:>9}"
+
+
+def _row_metrics(role: str, block: dict) -> list[tuple[str, object]]:
+    """The per-role detail columns after the headline gauge."""
+    q = block.get("qos", {})
+    if role == "log":
+        return [
+            ("mutations", q.get("queue_mutations", 0)),
+            ("in B/s", q.get("input_bytes_per_s", 0.0)),
+            ("dur.lag", q.get("durability_lag_versions", 0)),
+        ]
+    if role == "storage":
+        return [
+            ("in B/s", q.get("input_bytes_per_s", 0.0)),
+            ("fetch", q.get("fetch_backlog_ranges", 0)),
+            ("keys", q.get("keys", block.get("keys", 0))),
+        ]
+    if role == "resolver":
+        k = q.get("kernel") or {}
+        return [
+            ("occ", q.get("occupancy", 0.0)),
+            ("qwait p99", q.get("queue_wait_dist", {}).get("p99", 0.0)),
+            ("compute p99", q.get("compute_time_dist", {}).get("p99", 0.0)),
+            ("kern s/b", k.get("kernel_seconds_per_batch", 0.0)),
+        ]
+    if role == "commit_proxy":
+        bs = q.get("batch_sizer", {})
+        return [
+            ("inflight", q.get("inflight_batches", 0)),
+            ("interval", bs.get("interval", 0.0)),
+            ("count", bs.get("target_count", 0)),
+        ]
+    if role == "grv_proxy":
+        bs = q.get("batch_sizer", {})
+        return [
+            ("grv/s", q.get("grv_per_s", 0.0)),
+            ("throttled", len(q.get("throttled_tags", []))),
+            ("interval", bs.get("interval", 0.0)),
+        ]
+    return [("version", block.get("version", 0))]
+
+
+def render(status: dict, histories: dict[str, MetricHistory],
+           t: float) -> str:
+    cl = status.get("cluster", {})
+    qos = cl.get("qos", {})
+    limited = qos.get("performance_limited_by", {})
+    lines = []
+    tps = qos.get("transactions_per_second_limit")
+    lines.append(
+        "fdbtop — limited by: "
+        f"{limited.get('name', '?')}"
+        + (f" ({limited.get('reason_server_id')})"
+           if limited.get("reason_server_id") else "")
+        + f"  pressure={limited.get('pressure', 0.0):.2f}"
+        + (f"  tps_limit={tps:g}" if tps is not None else "")
+    )
+    run_loop = cl.get("run_loop")
+    if run_loop:
+        lines.append(
+            f"run loop: {run_loop['utilization'] * 100:5.1f}% busy, "
+            f"{run_loop['steps']} steps, "
+            f"{run_loop['slow_tasks']} slow tasks"
+        )
+    lines.append(
+        f"{'process':<14} {'role':<13} {'gauge':<8} {'value':>9}  "
+        f"{'history':<24} detail"
+    )
+    for name in sorted(cl.get("processes", {})):
+        block = cl["processes"][name]
+        role = block.get("role", "?")
+        path, label = HEADLINE.get(role, ("version", "version"))
+        val = block.get("qos", {}).get(path, block.get(path, 0)) or 0
+        hist = histories.setdefault(name, MetricHistory(120))
+        hist.append(t, float(val))
+        detail = "  ".join(
+            f"{k}={_fmt(v).strip()}" for k, v in _row_metrics(role, block)
+        )
+        lines.append(
+            f"{name:<14} {role:<13} {label:<8} {_fmt(val)}  "
+            f"{sparkline(hist.values()):<24} {detail}"
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Modes.
+
+
+def check_status(status: dict, require: list[str]) -> list[str]:
+    """The smoke gate: every required role present, every process's qos
+    non-empty, every role-required sensor key populated. Returns the
+    list of problems (empty == healthy)."""
+    problems = []
+    procs = status.get("cluster", {}).get("processes", {})
+    roles_seen = {b.get("role") for b in procs.values()}
+    for role in require:
+        if role not in roles_seen:
+            problems.append(f"no process with role {role!r}")
+    for name, block in sorted(procs.items()):
+        qos = block.get("qos")
+        if not qos:
+            problems.append(f"{name}: empty qos block")
+            continue
+        for key in REQUIRED_SENSORS.get(block.get("role", ""), ()):
+            if key not in qos:
+                problems.append(f"{name}: qos missing sensor {key!r}")
+    if "performance_limited_by" not in status.get("cluster", {}).get(
+        "qos", {}
+    ):
+        problems.append("cluster.qos missing performance_limited_by")
+    return problems
+
+
+async def _wire_main(args) -> int:
+    histories: dict[str, MetricHistory] = {}
+    dirs = (
+        _conf_socket_dirs(args.conf) if args.conf else [args.socket_dir]
+    )
+    # one connection cache PER socket dir: sockets are keyed by
+    # basename, and two dirs may each hold e.g. storage0.sock — a
+    # shared cache would silently poll only the first
+    conns_by_dir: dict = {d: {} for d in dirs}
+    try:
+        while True:
+            procs_all: dict = {}
+            status = None
+            for i, d in enumerate(dirs):
+                status = await _poll_wire(d, conns_by_dir[d])
+                for name, block in status["cluster"]["processes"].items():
+                    # same basename in a later dir: suffix, don't drop
+                    key = name if name not in procs_all else f"{name}@{i}"
+                    procs_all[key] = block
+            if len(dirs) > 1:
+                from foundationdb_tpu.cluster.status import assemble_status
+
+                status = assemble_status(procs_all)
+            if args.json:
+                print(json.dumps(status, sort_keys=True))
+            else:
+                if args.watch:
+                    print("\x1b[2J\x1b[H", end="")
+                print(render(status, histories, time.monotonic()))
+            if args.require:
+                problems = check_status(status, args.require.split(","))
+                if problems:
+                    for p in problems:
+                        print(f"fdbtop: MISSING SENSOR: {p}",
+                              file=sys.stderr)
+                    return 1
+            if not args.watch:
+                return 0
+            await asyncio.sleep(args.interval)
+    finally:
+        for dir_conns in conns_by_dir.values():
+            await _close_conns(dir_conns)
+
+
+def _sim_main(args) -> int:
+    world = _SimWorld(seed=args.seed)
+    histories: dict[str, MetricHistory] = {}
+    try:
+        while True:
+            status = world.poll()
+            if args.json:
+                print(json.dumps(status, sort_keys=True))
+            else:
+                if args.watch:
+                    print("\x1b[2J\x1b[H", end="")
+                print(render(status, histories, world.sched.now()))
+            if args.require:
+                problems = check_status(status, args.require.split(","))
+                if problems:
+                    for p in problems:
+                        print(f"fdbtop: MISSING SENSOR: {p}",
+                              file=sys.stderr)
+                    return 1
+            if not args.watch:
+                return 0
+            time.sleep(args.interval)
+    finally:
+        world.stop()
+
+
+def _smoke_main(args) -> int:
+    """The check.sh lane: spin the bench_pipeline wire smoke with a
+    status socket, poll until every role answers with a qos block,
+    gate on the required sensor set."""
+    import tempfile
+
+    sock_dir = tempfile.mkdtemp(prefix="fdbtop_smoke_")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bench = subprocess.Popen(
+        [
+            sys.executable,
+            os.path.join(repo, "scripts", "bench_pipeline.py"),
+            "--smoke", "--socket-dir", sock_dir, "--serve-status",
+            "--hold", "20",
+        ],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    require = ["log", "storage", "resolver", "commit_proxy", "grv_proxy"]
+    try:
+        deadline = time.monotonic() + 120
+        last_problems = ["no status yet"]
+        while time.monotonic() < deadline:
+            if bench.poll() is not None and bench.returncode != 0:
+                print("fdbtop --smoke: bench_pipeline FAILED",
+                      file=sys.stderr)
+                return 1
+            conns: dict = {}
+
+            async def one_poll():
+                try:
+                    return await _poll_wire(sock_dir, conns, retries=2)
+                finally:
+                    await _close_conns(conns)
+
+            status = asyncio.run(one_poll())
+            last_problems = check_status(status, require)
+            if not last_problems:
+                print(json.dumps(status, sort_keys=True))
+                print(
+                    "fdbtop smoke ok: "
+                    f"{len(status['cluster']['processes'])} processes, "
+                    "all qos sensors present"
+                )
+                return 0
+            time.sleep(0.5)
+        for p in last_problems:
+            print(f"fdbtop --smoke: MISSING SENSOR: {p}", file=sys.stderr)
+        return 1
+    finally:
+        if bench.poll() is None:
+            bench.terminate()
+            try:
+                bench.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                bench.kill()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group()
+    src.add_argument("--socket-dir",
+                     help="wire mode: dir of role UDS sockets")
+    src.add_argument("--conf",
+                     help="wire mode: monitor conf naming the roles")
+    src.add_argument("--sim", action="store_true",
+                     help="in-process sim cluster + demo workload")
+    src.add_argument("--smoke", action="store_true",
+                     help="CI: bench_pipeline wire smoke + sensor gate")
+    ap.add_argument("--watch", action="store_true",
+                    help="refresh live until interrupted")
+    ap.add_argument("--once", action="store_true",
+                    help="one poll then exit (default)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw status JSON instead of the table")
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--require", default="",
+        help="comma-separated role kinds that must report qos "
+             "(exit nonzero on any missing sensor)",
+    )
+    args = ap.parse_args()
+    if args.smoke:
+        return _smoke_main(args)
+    if args.sim:
+        return _sim_main(args)
+    if not args.socket_dir and not args.conf:
+        ap.error("one of --socket-dir / --conf / --sim / --smoke required")
+    return asyncio.run(_wire_main(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

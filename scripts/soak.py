@@ -31,7 +31,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ["JAX_PLATFORMS"] = "cpu"  # force off any device tunnel (sim is CPU-only)
 
 
-def _perturbed_rerun(seed, spec, pid, spec_label, trace=False):
+def _perturbed_rerun(seed, spec, pid, spec_label, trace=False,
+                     status_probe=False):
     """One perturbed re-run with the (seed, perturb) pair named in any
     failure — run_seed's own asserts only know the seed, and a report
     that can't be reproduced is no report (both sweep and smoke lanes
@@ -39,7 +40,8 @@ def _perturbed_rerun(seed, spec, pid, spec_label, trace=False):
     from foundationdb_tpu.testing import soak
 
     try:
-        return soak.run_seed(seed, spec=spec, perturb=pid, trace=trace)
+        return soak.run_seed(seed, spec=spec, perturb=pid, trace=trace,
+                             status_probe=status_probe)
     except Exception as e:
         raise AssertionError(
             f"seed {seed} perturb {pid} (spec {spec_label}): {e}"
@@ -47,15 +49,17 @@ def _perturbed_rerun(seed, spec, pid, spec_label, trace=False):
 
 
 def _one(args):
-    seed, spec_name, check_determinism, perturb, trace = args
+    seed, spec_name, check_determinism, perturb, trace, status_probe = args
     from foundationdb_tpu.testing import soak
 
     t0 = time.perf_counter()
     sig, hits = soak.run_seed(
-        seed, spec=spec_name, collect_probes=True, trace=trace
+        seed, spec=spec_name, collect_probes=True, trace=trace,
+        status_probe=status_probe,
     )
     if check_determinism:
-        sig2 = soak.run_seed(seed, spec=spec_name, trace=trace)
+        sig2 = soak.run_seed(seed, spec=spec_name, trace=trace,
+                             status_probe=status_probe)
         if sig != sig2:
             raise AssertionError(
                 f"seed {seed} (spec {spec_name}): NONDETERMINISTIC\n"
@@ -71,10 +75,12 @@ def _one(args):
     # seeds every (seed, perturb) pair runs twice and must match —
     # the unseed-determinism contract extended to perturbed schedules.
     for pid in range(1, perturb + 1):
-        psig = _perturbed_rerun(seed, spec_name, pid, spec_name, trace=trace)
+        psig = _perturbed_rerun(seed, spec_name, pid, spec_name,
+                                trace=trace, status_probe=status_probe)
         if check_determinism:
             psig2 = soak.run_seed(
-                seed, spec=spec_name, perturb=pid, trace=trace
+                seed, spec=spec_name, perturb=pid, trace=trace,
+                status_probe=status_probe,
             )
             if psig != psig2:
                 raise AssertionError(
@@ -85,7 +91,8 @@ def _one(args):
 
 
 def sweep(spec_name: str, seeds: list, jobs: int, probe_gate: bool,
-          perturb: int = 0, trace: bool = False) -> int:
+          perturb: int = 0, trace: bool = False,
+          status_probe: bool = False) -> int:
     """Run one spec's seed sweep; returns the number of failures."""
     from foundationdb_tpu.testing.spec import load_spec
     from foundationdb_tpu.utils import probes as _probes
@@ -93,7 +100,7 @@ def sweep(spec_name: str, seeds: list, jobs: int, probe_gate: bool,
     spec = load_spec(spec_name)
     det_every = spec.policy["determinism_every"]
     work = [
-        (s, spec_name, i % det_every == 0, perturb, trace)
+        (s, spec_name, i % det_every == 0, perturb, trace, status_probe)
         for i, s in enumerate(seeds)
     ]
     t0 = time.perf_counter()
@@ -164,8 +171,20 @@ def sweep(spec_name: str, seeds: list, jobs: int, probe_gate: bool,
             f"[{spec_name}] spec-EXPECTED probes never hit: "
             f"{expected_missed}"
         )
-        if probe_gate:
-            failures.append(("probe-gate", repr(expected_missed)))
+        # occurrence budgets: a rare probe (e.g. api_unknown_resolved,
+        # ~2/100 seeds) only gates once this sweep is big enough that
+        # its budget predicts >= PROBE_GATE_MIN_EXPECTED hits — short
+        # smoke sweeps report the miss but can't false-fail on it
+        gated = spec.gated_probes(len(seeds))
+        under_budget = sorted(set(expected_missed) - gated)
+        gated_missed = sorted(set(expected_missed) & gated)
+        if under_budget:
+            print(
+                f"[{spec_name}] missed-but-under-budget at "
+                f"{len(seeds)} seed(s) (not gated): {under_budget}"
+            )
+        if probe_gate and gated_missed:
+            failures.append(("probe-gate", repr(gated_missed)))
     if failures:
         print(f"[{spec_name}] FAILURES:")
         for s, e in failures:
@@ -199,6 +218,13 @@ def main():
              "tie-breaking among equally-runnable actors; every gate "
              "must still pass and each (seed, perturbation) must be "
              "exactly reproducible",
+    )
+    ap.add_argument(
+        "--status-probe", action="store_true",
+        help="arm the saturation-sensor determinism guard: a background "
+             "actor samples the full cluster_status() document during "
+             "every seed (with --trace, the digest check then proves "
+             "reading the sensors leaves traces bit-identical)",
     )
     ap.add_argument(
         "--trace", action="store_true",
@@ -237,12 +263,14 @@ def main():
             )
             t0 = time.perf_counter()
             try:
-                sig = soak.run_seed(args.start, spec=spec, trace=args.trace)
+                sig = soak.run_seed(args.start, spec=spec, trace=args.trace,
+                                    status_probe=args.status_probe)
                 # the perturbation smoke lane: K reorderings of the
                 # same smoke seed must all pass every gate
                 for pid in range(1, args.perturb + 1):
                     _perturbed_rerun(args.start, spec, pid, name,
-                                     trace=args.trace)
+                                     trace=args.trace,
+                                     status_probe=args.status_probe)
                 print(
                     f"spec {name:16s} seed {args.start} ok in "
                     f"{time.perf_counter() - t0:4.1f}s  "
@@ -260,7 +288,7 @@ def main():
 
     seeds = list(range(args.start, args.start + args.seeds))
     if sweep(args.spec, seeds, args.jobs, args.probe_gate, args.perturb,
-             trace=args.trace):
+             trace=args.trace, status_probe=args.status_probe):
         sys.exit(1)
 
 

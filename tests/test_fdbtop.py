@@ -1,0 +1,411 @@
+"""Saturation telemetry: status qos schema pin, StatusRequest wire
+codec, the shared assemble_status math, and fdbtop's polling/gating
+paths against both deployment shapes (in-sim cluster and real OS role
+processes over UDS)."""
+
+import asyncio
+import json
+import os
+import sys
+
+import pytest
+
+from foundationdb_tpu.cluster import multiprocess as mp
+from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+from foundationdb_tpu.cluster.status import (
+    assemble_status,
+    cluster_status,
+    performance_limited_by,
+    qos_section,
+)
+from foundationdb_tpu.models.types import CommitTransaction
+from foundationdb_tpu.wire import codec
+from foundationdb_tpu.wire.codec import Mutation
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "scripts"),
+)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# Schema pin: the qos keys every status consumer (fdbtop, the future
+# Ratekeeper control loop) may rely on, for every role.
+
+ROLE_QOS_KEYS = {
+    "log": {"queue_mutations", "queue_bytes", "smoothed_queue_bytes",
+            "input_bytes_per_s", "durability_lag_versions"},
+    "storage": {"apply_lag_versions", "input_bytes_per_s",
+                "fetch_backlog_ranges", "version_lag_versions",
+                "mvcc_window_versions"},
+    "resolver": {"queue_depth", "queue_depth_dist", "queue_wait_dist",
+                 "compute_time_dist", "resolver_latency_dist",
+                 "state_pressure", "occupancy"},
+    "commit_proxy": {"inflight_batches", "queued_requests",
+                     "batches_started", "batch_sizer"},
+    "grv_proxy": {"queued_requests", "batch_sizer", "throttled_tags"},
+}
+
+CLUSTER_QOS_KEYS = {
+    "worst_queue_bytes_log_server", "worst_smoothed_queue_bytes_log_server",
+    "worst_durability_lag_log_server", "worst_version_lag_storage_server",
+    "worst_queue_depth_resolver", "worst_occupancy_resolver",
+    "worst_queued_requests_commit_proxy",
+    "worst_queued_requests_grv_proxy", "limiting_process",
+    "performance_limited_by",
+    # the Ratekeeper integration (satellite: observable from day one)
+    "transactions_per_second_limit", "max_tps", "min_tps",
+    "worst_storage_lag_versions", "lag_target_versions",
+    "lag_limit_versions", "tag_quotas", "auto_tag_quotas",
+}
+
+
+@pytest.fixture(scope="module")
+def sim_status():
+    sched, cluster, db = open_cluster(
+        ClusterConfig(n_commit_proxies=2, n_resolvers=2, n_storage=2,
+                      n_tlogs=2)
+    )
+
+    async def body():
+        for i in range(25):
+            txn = db.create_transaction()
+            txn.set(b"sat%03d" % i, b"v" * 64)
+            await txn.commit()
+
+    sched.run_until(sched.spawn(body()).done)
+    status = cluster_status(cluster)
+    cluster.stop()
+    return status
+
+
+def test_sim_status_qos_schema_pin(sim_status):
+    """Every role instance carries its qos block with the pinned sensor
+    keys; the cluster qos section carries worst-* + ratekeeper keys."""
+    cl = sim_status["cluster"]
+    assert CLUSTER_QOS_KEYS <= set(cl["qos"])
+    json.dumps(sim_status)  # the whole document stays JSON-able
+    seen_roles = set()
+    for name, block in cl["processes"].items():
+        role = block["role"]
+        if role in ROLE_QOS_KEYS:
+            seen_roles.add(role)
+            assert ROLE_QOS_KEYS[role] <= set(block["qos"]), (
+                f"{name}: qos missing "
+                f"{ROLE_QOS_KEYS[role] - set(block['qos'])}"
+            )
+    assert seen_roles == set(ROLE_QOS_KEYS)
+    # run-loop utilization rides along (wall-clock, status-only)
+    rl = cl["run_loop"]
+    assert {"utilization", "busy_seconds", "steps",
+            "slow_tasks", "slow_tasks_by_actor"} <= set(rl)
+    assert 0.0 <= rl["utilization"] <= 1.0
+
+
+def test_performance_limited_by_scoring():
+    # healthy default below the 0.5 floor
+    out = performance_limited_by([("tlog0", "log_server_write_queue", 0.2)])
+    assert out["name"] == "workload" and out["reason_server_id"] == ""
+    # the worst candidate past the floor names the process + reason
+    out = performance_limited_by([
+        ("tlog0", "log_server_write_queue", 0.7),
+        ("storage1", "storage_server_durability_lag", 1.9),
+        ("resolver0", "resolver_queue", 0.6),
+    ])
+    assert out["name"] == "storage_server_durability_lag"
+    assert out["reason_server_id"] == "storage1"
+    assert out["pressure"] == pytest.approx(1.9)
+
+
+def test_qos_section_attribution_shifts_with_pressure():
+    """The limiting-process attribution follows the saturated sensor —
+    the acceptance shape (a saturation run shifts the attribution)."""
+    from foundationdb_tpu.cluster.status import TLOG_QUEUE_BYTES_TARGET
+
+    idle = qos_section(
+        {"tlog0": {"queue_bytes": 0, "smoothed_queue_bytes": 0.0}},
+        {"storage0": {"version_lag_versions": 0}},
+        {"resolver0": {"queue_depth": 0}}, {}, {},
+        lag_target=2e6,
+    )
+    assert idle["performance_limited_by"]["name"] == "workload"
+    # saturate the tlog queue: attribution moves to the log server
+    hot = qos_section(
+        {"tlog0": {"queue_bytes": 2 * TLOG_QUEUE_BYTES_TARGET,
+                   "smoothed_queue_bytes": 2.0 * TLOG_QUEUE_BYTES_TARGET}},
+        {"storage0": {"version_lag_versions": 0}},
+        {"resolver0": {"queue_depth": 0}}, {}, {},
+        lag_target=2e6,
+    )
+    assert hot["performance_limited_by"]["name"] == "log_server_write_queue"
+    assert hot["limiting_process"] == "tlog0"
+    # now the resolver chain backs up PAST the tlog's pressure
+    hot2 = qos_section(
+        {"tlog0": {"smoothed_queue_bytes": 0.6 * TLOG_QUEUE_BYTES_TARGET}},
+        {}, {"resolver0": {"queue_depth": 16}}, {}, {},
+        lag_target=2e6,
+    )
+    assert hot2["performance_limited_by"]["name"] == "resolver_queue"
+    assert hot2["limiting_process"] == "resolver0"
+    # a compute-bound resolver: queue stays shallow (few, huge batches)
+    # but its busy fraction pins — occupancy names it, not the queue
+    hot3 = qos_section(
+        {"tlog0": {"smoothed_queue_bytes": 0.6 * TLOG_QUEUE_BYTES_TARGET}},
+        {}, {"resolver0": {"queue_depth": 1, "occupancy": 0.97}}, {}, {},
+        lag_target=2e6,
+    )
+    assert hot3["performance_limited_by"]["name"] == "resolver_busy"
+    assert hot3["limiting_process"] == "resolver0"
+    assert hot3["worst_occupancy_resolver"] == pytest.approx(0.97)
+
+
+def test_assemble_status_version_lag_join_and_degradation():
+    procs = {
+        "proxy0": {"role": "commit_proxy", "committed_version": 9000,
+                   "qos": {"queued_requests": 1}},
+        "storage0": {"role": "storage", "version": 2000, "qos": {}},
+        "tlog0": {"role": "log", "version": 9000, "qos": {}},
+        "mystery0": {"role": "wigglytuff", "qos": {}},  # unknown: ignored
+        "bare0": {},  # no role, no qos: degrades, never crashes
+    }
+    doc = assemble_status(procs, lag_target=1000.0)
+    q = doc["cluster"]["qos"]
+    # the storage block was joined against the head (max committed/log)
+    assert (doc["cluster"]["processes"]["storage0"]["qos"]
+            ["version_lag_versions"] == 7000)
+    assert q["worst_version_lag_storage_server"] == 7000
+    # 7000/1000 lag pressure dominates -> storage names the limit
+    assert q["performance_limited_by"]["name"] == (
+        "storage_server_durability_lag"
+    )
+    assert q["limiting_process"] == "storage0"
+
+
+def test_status_request_wire_codec_roundtrip():
+    """StatusRequest/StatusReply survive encode->decode, including a
+    nested JSON payload with non-ASCII and numeric edge values."""
+    req = mp.StatusRequest(pad=0)
+    blob = codec.encode(req)
+    back = codec.decode(blob)
+    assert isinstance(back, mp.StatusRequest) and back.pad == 0
+    payload = json.dumps({
+        "role": "log", "version": 2**53,
+        "qos": {"smoothed_queue_bytes": 1234.5678,
+                "names": ["ünïcode", "δ"], "flag": True, "none": None},
+    })
+    rep = mp.StatusReply(payload=payload)
+    back = codec.decode(codec.encode(rep))
+    assert isinstance(back, mp.StatusReply)
+    assert json.loads(back.payload) == json.loads(payload)
+
+
+def test_fdbtop_check_status_gate_both_directions():
+    import fdbtop
+
+    good = {
+        "cluster": {
+            "qos": {"performance_limited_by": {"name": "workload"}},
+            "processes": {
+                "tlog0": {"role": "log", "qos": {
+                    "queue_bytes": 0, "smoothed_queue_bytes": 0.0,
+                    "input_bytes_per_s": 0.0}},
+                "storage0": {"role": "storage", "qos": {
+                    "version_lag_versions": 0, "input_bytes_per_s": 0.0}},
+                "resolver0": {"role": "resolver", "qos": {
+                    "queue_depth": 0, "queue_wait_dist": {},
+                    "compute_time_dist": {}, "occupancy": 0.0}},
+                "proxy0": {"role": "commit_proxy", "qos": {
+                    "queued_requests": 0, "inflight_batches": 0,
+                    "batch_sizer": {}}},
+                "grv_proxy0": {"role": "grv_proxy",
+                               "qos": {"queued_requests": 0}},
+            },
+        }
+    }
+    require = ["log", "storage", "resolver", "commit_proxy", "grv_proxy"]
+    assert fdbtop.check_status(good, require) == []
+    # a missing role fails
+    partial = json.loads(json.dumps(good))
+    del partial["cluster"]["processes"]["resolver0"]
+    assert any("resolver" in p for p in
+               fdbtop.check_status(partial, require))
+    # an empty qos block fails
+    empty = json.loads(json.dumps(good))
+    empty["cluster"]["processes"]["tlog0"]["qos"] = {}
+    assert any("tlog0" in p for p in fdbtop.check_status(empty, require))
+    # a missing sensor key fails
+    missing = json.loads(json.dumps(good))
+    del missing["cluster"]["processes"]["proxy0"]["qos"]["batch_sizer"]
+    assert any("batch_sizer" in p for p in
+               fdbtop.check_status(missing, require))
+    # a missing performance_limited_by fails
+    nolim = json.loads(json.dumps(good))
+    nolim["cluster"]["qos"] = {}
+    assert any("performance_limited_by" in p for p in
+               fdbtop.check_status(nolim, require))
+
+
+def test_fdbtop_render_sim_status(sim_status):
+    """The table renderer digests a full sim status document: one row
+    per process, sparkline history column, limiting header."""
+    import fdbtop
+
+    histories = {}
+    out1 = fdbtop.render(sim_status, histories, 0.0)
+    out2 = fdbtop.render(sim_status, histories, 1.0)
+    for name in sim_status["cluster"]["processes"]:
+        assert name in out1
+    assert "limited by" in out1
+    assert "run loop" in out1
+    # histories accumulate across frames
+    assert all(len(h) == 2 for h in histories.values())
+    assert "▁" in out2
+
+
+# ---------------------------------------------------------------------------
+# Wire mode: StatusRequest against real OS role processes, the parent's
+# status socket, wire_cluster_status aggregation, and fdbtop's poll.
+
+
+def test_wire_status_and_fdbtop_poll(tmp_path):
+    """fdbtop --once --json shape against a live multiprocess cluster:
+    every role (including both parent-side proxies) reports a qos
+    entry, and the assembled document passes the smoke sensor gate."""
+    import fdbtop
+
+    procs = [
+        mp.spawn_role("resolver", str(tmp_path)),
+        mp.spawn_role("tlog", str(tmp_path)),
+        mp.spawn_role("storage", str(tmp_path)),
+    ]
+
+    async def scenario():
+        resolver = await mp.connect(procs[0].address)
+        tlog = await mp.connect(procs[1].address)
+        storage = await mp.connect(procs[2].address)
+        pipe = mp.ProxyPipeline([resolver], tlog, storage,
+                                batch_interval=0.001)
+        pipe.start()
+        server = mp.serve_status(str(tmp_path), pipe)
+        await server.start()
+        for i in range(20):
+            k = b"w%02d" % i
+            rv = await pipe.get_read_version()
+            await pipe.commit(CommitTransaction(
+                read_conflict_ranges=[(k, k + b"\x00")],
+                write_conflict_ranges=[(k, k + b"\x00")],
+                read_snapshot=rv,
+                mutations=[Mutation(0, k, b"v" * 32)],
+            ))
+        # 1) direct RPC: every role process answers StatusRequest
+        for conn, want_role in ((resolver, "resolver"), (tlog, "log"),
+                                (storage, "storage")):
+            rep = await conn.call(mp.TOKEN_STATUS, mp.StatusRequest(pad=0))
+            block = json.loads(rep.payload)
+            assert block["role"] == want_role and block["qos"]
+        # 2) parent-side aggregation
+        doc = await mp.wire_cluster_status(
+            {"resolver0": resolver, "tlog0": tlog, "storage0": storage},
+            pipe,
+        )
+        roles = {b["role"] for b in doc["cluster"]["processes"].values()}
+        assert roles == {"resolver", "log", "storage",
+                         "commit_proxy", "grv_proxy"}
+        assert "performance_limited_by" in doc["cluster"]["qos"]
+        # the tlog accumulated real queue bytes from the workload
+        tq = doc["cluster"]["processes"]["tlog0"]["qos"]
+        assert tq["queue_bytes"] > 0
+        # 3) fdbtop's own polling path over the socket dir (the
+        #    --once --json engine), proxy0.sock GRV split included
+        conns = {}
+        try:
+            top = await fdbtop._poll_wire(str(tmp_path), conns)
+        finally:
+            await fdbtop._close_conns(conns)
+        assert fdbtop.check_status(
+            top, ["log", "storage", "resolver", "commit_proxy",
+                  "grv_proxy"]
+        ) == []
+        json.dumps(top)
+        await pipe.stop()
+        await server.close()
+        for c in (resolver, tlog, storage):
+            await c.close()
+
+    try:
+        run(scenario())
+    finally:
+        for p in procs:
+            p.stop()
+
+
+def test_saturated_resolver_shifts_wire_attribution(tmp_path):
+    """Acceptance shape in miniature: park the resolver chain (a gap in
+    prev_version never filled) so commit batches queue on resolution —
+    the wire qos attribution must move off 'workload' onto the
+    resolver."""
+    procs = [mp.spawn_role("resolver", str(tmp_path))]
+
+    async def scenario():
+        from foundationdb_tpu.models.types import (
+            ResolveTransactionBatchRequest,
+        )
+
+        resolver = await mp.connect(procs[0].address)
+        # hole at prev_version=500: these requests park on the chain
+        waiters = [
+            asyncio.ensure_future(resolver.call(
+                mp.TOKEN_RESOLVE,
+                ResolveTransactionBatchRequest(
+                    transactions=[], version=1000 + i,
+                    prev_version=500 + i, last_received_version=0,
+                ),
+            ))
+            for i in range(12)
+        ]
+        await asyncio.sleep(0.3)  # let them arrive and park
+        rep = await resolver.call(mp.TOKEN_STATUS, mp.StatusRequest(pad=0))
+        block = json.loads(rep.payload)
+        assert block["qos"]["queue_depth"] >= 12
+        doc = assemble_status({"resolver0": block})
+        lim = doc["cluster"]["qos"]["performance_limited_by"]
+        assert lim["name"] == "resolver_queue"
+        assert lim["reason_server_id"] == "resolver0"
+        for w in waiters:
+            w.cancel()
+        await asyncio.gather(*waiters, return_exceptions=True)
+        await resolver.close()
+
+    try:
+        run(scenario())
+    finally:
+        for p in procs:
+            p.stop()
+
+
+def test_fdbtop_sim_once_json_smoke():
+    """`fdbtop --sim --once --json --require ...` end to end in a
+    subprocess: exit 0 and a parseable status document on stdout."""
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [_sys.executable, os.path.join(repo, "scripts", "fdbtop.py"),
+         "--sim", "--once", "--json",
+         "--require", "log,storage,resolver,commit_proxy,grv_proxy"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    assert "performance_limited_by" in doc["cluster"]["qos"]

@@ -253,3 +253,138 @@ def test_counter_flush_probe_fires():
     before = probes.snapshot().get("metrics.counters_flushed", 0)
     trace_counters(log, "MetricsEvent", "r0", c)
     assert probes.snapshot()["metrics.counters_flushed"] == before + 1
+
+
+# ---------------------------------------------------------------------------
+# Saturation-telemetry primitives (PR 7): Smoother / TimerSmoother /
+# Gauge / MetricHistory / sparkline.
+
+
+def test_smoother_step_converges_to_closed_form():
+    """Exponential decay vs the closed form: after a step from 0 to T,
+    estimate(t) = T * (1 - exp(-t/tau)) for any sampling cadence."""
+    from foundationdb_tpu.utils.metrics import Smoother
+
+    clock = [0.0]
+    for tau in (0.5, 1.0, 3.0):
+        sm = Smoother(tau, clock=lambda: clock[0])
+        clock[0] = 0.0
+        sm.reset(0.0)
+        sm.set_total(100.0)  # the step
+        for t in (0.1, 0.25, tau, 2 * tau, 5 * tau):
+            clock[0] = t
+            want = 100.0 * (1.0 - math.exp(-t / tau))
+            assert sm.smooth_total() == pytest.approx(want, rel=1e-9)
+        # one folding time reflects ~63.2% of the step
+        clock[0] = tau
+        sm2 = Smoother(tau, clock=lambda: clock[0])
+    # converged: far past tau the estimate is the total
+    clock[0] = 50.0
+    assert sm.smooth_total() == pytest.approx(100.0, rel=1e-6)
+
+
+def test_smoother_closed_form_is_sampling_cadence_invariant():
+    """Reading the estimate through many small steps must equal one
+    big step (the exponential's semigroup property) — the property
+    that makes status polling frequency irrelevant to the value."""
+    from foundationdb_tpu.utils.metrics import Smoother
+
+    clock = [0.0]
+    a = Smoother(1.0, clock=lambda: clock[0])
+    b = Smoother(1.0, clock=lambda: clock[0])
+    a.set_total(42.0)
+    b.set_total(42.0)
+    # a: polled at every 0.01; b: read once at t=2
+    for i in range(1, 201):
+        clock[0] = i * 0.01
+        a.smooth_total()
+    assert a.smooth_total() == pytest.approx(b.smooth_total(), rel=1e-9)
+
+
+def test_smoother_ramp_rate_tracks_input_rate():
+    """A constant-rate ramp: smooth_rate converges to the true rate
+    (the Ratekeeper's queue-bytes-per-second signal)."""
+    from foundationdb_tpu.utils.metrics import Smoother
+
+    clock = [0.0]
+    sm = Smoother(1.0, clock=lambda: clock[0])
+    for i in range(1, 501):
+        clock[0] = i * 0.01
+        sm.add_delta(5.0)  # 500/s
+    assert sm.smooth_rate() == pytest.approx(500.0, rel=0.02)
+    # rate decays back toward zero once input stops (exp(-10) of the
+    # gap remains: ~0.023 of the 500/s peak)
+    clock[0] += 10.0
+    assert sm.smooth_rate() < 0.1
+
+
+def test_smoother_non_advancing_clock_and_validation():
+    from foundationdb_tpu.utils.metrics import Smoother
+
+    sm = Smoother(1.0)  # default clock never advances
+    sm.add_delta(10.0)
+    sm.add_delta(5.0)
+    assert sm.total == 15.0
+    assert sm.smooth_total() == 0.0  # no time passed: no decay applied
+    with pytest.raises(ValueError):
+        Smoother(0.0)
+    with pytest.raises(ValueError):
+        Smoother(-1.0)
+
+
+def test_timer_smoother_uses_wall_clock():
+    import time as _time
+
+    from foundationdb_tpu.utils.metrics import TimerSmoother
+
+    sm = TimerSmoother(0.05)
+    sm.set_total(10.0)
+    _time.sleep(0.2)  # 4 folding times: ~98% reflected
+    assert sm.smooth_total() > 9.0
+
+
+def test_gauge_set_and_supplier():
+    from foundationdb_tpu.utils.metrics import Gauge
+
+    g = Gauge("depth")
+    assert g.get() == 0.0
+    g.set(7.0)
+    assert g.get() == 7.0
+    live = [1]
+    g2 = Gauge("live", supplier=lambda: live[0] * 2.0)
+    assert g2.get() == 2.0
+    live[0] = 5
+    assert g2.get() == 10.0
+
+
+def test_metric_history_ring_wraparound():
+    from foundationdb_tpu.utils.metrics import MetricHistory
+
+    h = MetricHistory(4)
+    assert len(h) == 0 and h.last() is None and h.samples() == []
+    for i in range(3):
+        h.append(float(i), float(i * 10))
+    assert len(h) == 3
+    assert h.values() == [0.0, 10.0, 20.0]
+    assert h.last() == 20.0
+    # wrap: capacity stays 4, oldest-first order preserved
+    for i in range(3, 11):
+        h.append(float(i), float(i * 10))
+    assert len(h) == 4
+    assert h.values() == [70.0, 80.0, 90.0, 100.0]
+    assert h.samples()[0] == (7.0, 70.0)
+    assert h.last() == 100.0
+    with pytest.raises(ValueError):
+        MetricHistory(0)
+
+
+def test_sparkline_shape():
+    from foundationdb_tpu.utils.metrics import sparkline
+
+    assert sparkline([]) == ""
+    assert sparkline([5.0, 5.0, 5.0]) == "▁▁▁"
+    s = sparkline([0, 1, 2, 3, 4, 5, 6, 7])
+    assert len(s) == 8
+    assert s[0] == "▁" and s[-1] == "█"
+    # width bound: only the trailing `width` samples render
+    assert len(sparkline(list(range(100)), width=24)) == 24

@@ -168,3 +168,47 @@ def test_api_correctness_spec_smoke_tpu_seed():
     )
     sig = soak.run_seed(seed, spec=spec)
     assert sig[1] > 0 and sig[7] is not None
+
+
+def test_probe_budgets_schema_and_gating():
+    """[probes.budgets]: per-spec expected-probe occurrence rates — a
+    budgeted rare probe only gates sweeps big enough that the budget
+    predicts PROBE_GATE_MIN_EXPECTED occurrences; unbudgeted probes
+    gate any sweep (the pre-budget behavior)."""
+    from foundationdb_tpu.testing.spec import PROBE_GATE_MIN_EXPECTED
+
+    spec = load_spec("api_correctness")
+    budgets = dict(spec.probe_budgets)
+    # the motivating probe carries its measured ~2/100-seed rate
+    assert budgets.get("workload.api_unknown_resolved") == pytest.approx(
+        0.02
+    )
+    rare = "workload.api_unknown_resolved"
+    threshold = PROBE_GATE_MIN_EXPECTED / budgets[rare]
+    assert rare not in spec.gated_probes(1)          # smoke sweep: safe
+    assert rare not in spec.gated_probes(int(threshold) - 1)
+    assert rare in spec.gated_probes(int(threshold))  # full sweep: gates
+    # every unbudgeted expected probe gates even a 1-seed sweep
+    unbudgeted = set(spec.expected_probes) - set(budgets)
+    assert unbudgeted <= spec.gated_probes(1)
+    # roundtrip carries budgets
+    assert SoakSpec.from_dict(spec.to_dict()).probe_budgets == (
+        spec.probe_budgets
+    )
+
+
+def test_probe_budgets_are_validated():
+    spec = load_spec("api_correctness")
+    with pytest.raises(SpecError):
+        d = spec.to_dict()
+        # a budget for a probe the spec doesn't expect is a typo
+        d["probes"]["budgets"] = {"workload.no_such_probe": 0.02}
+        SoakSpec.from_dict(d)
+    with pytest.raises(SpecError):
+        d = spec.to_dict()
+        d["probes"]["budgets"] = {"workload.api_unknown_resolved": 0.0}
+        SoakSpec.from_dict(d)
+    with pytest.raises(SpecError):
+        d = spec.to_dict()
+        d["probes"]["budgets"] = {"workload.api_unknown_resolved": 2.0}
+        SoakSpec.from_dict(d)
