@@ -26,7 +26,10 @@ from foundationdb_tpu.cluster.commit_proxy import (
     NotCommitted,
     TransactionTooOldError,
 )
-from foundationdb_tpu.cluster.grv_proxy import GrvProxyFailedError
+from foundationdb_tpu.cluster.grv_proxy import (
+    GrvProxyFailedError,
+    GrvThrottledError,
+)
 from foundationdb_tpu.models.types import CommitTransaction
 from foundationdb_tpu.utils import commit_debug as _cd
 from foundationdb_tpu.utils import trace as _trace
@@ -881,13 +884,18 @@ class Database:
                         mark = await probe.get(
                             b"\xff/idmp/" + idemp_id, snapshot=True
                         )
-                    except (TransactionTooOldError, GrvProxyFailedError):
+                    except (TransactionTooOldError, GrvProxyFailedError,
+                            GrvThrottledError):
                         mark = None
                     if mark is not None:
                         return result  # the first attempt committed
                 await self.sched.delay(backoff)
                 backoff = min(backoff * 2, 0.1)
-            except (NotCommitted, TransactionTooOldError, GrvProxyFailedError):
+            except (NotCommitted, TransactionTooOldError,
+                    GrvProxyFailedError, GrvThrottledError):
+                # grv_throttled: the front door shed this request under
+                # overload — the exponential backoff below IS the
+                # client side of the admission-control contract
                 await self.sched.delay(backoff)
                 backoff = min(backoff * 2, 0.1)
         raise RuntimeError("transaction retry limit reached")

@@ -207,9 +207,19 @@ class Cluster:
         self.balancer = ResolutionBalancer(
             sched, self.resolvers, self.key_resolvers, self.commit_proxies
         )
+        # The multi-input admission controller: every saturation sensor
+        # the PR-7 telemetry substrate exposes feeds the control law —
+        # tlog queue bytes, storage version lag, resolver occupancy +
+        # queue depth, proxy queue depth, and the GRV proxies' observed
+        # admission rate. Proxy/GRV lists are SUPPLIERS because recovery
+        # rebuilds the proxy generation (build_proxies reassigns).
         self.ratekeeper = Ratekeeper(
             sched, self.sequencer, self.storage_servers,
             liveness=self.storage_live,
+            tlog_system=self.tlog,
+            resolvers=self.resolvers,
+            proxies=lambda: self.commit_proxies,
+            grv_proxies=lambda: [self.grv_proxy],
         )
         self.grv_proxy = GrvProxy(sched, self.sequencer, ratekeeper=self.ratekeeper)
         # What clients actually talk to (network-wrapped under simulation).

@@ -26,12 +26,21 @@ from foundationdb_tpu.utils.metrics import (
 )
 from foundationdb_tpu.utils.probes import declare
 
-declare("ratekeeper.tag_throttled")
+declare("ratekeeper.tag_throttled", "grv.throttled")
 
 
 class GrvProxyFailedError(Exception):
     """Retryable: this GRV proxy generation died (recovery replaced it);
     the client's retry loop re-resolves the current generation."""
+
+
+class GrvThrottledError(Exception):
+    """Retryable: the GRV queue is over its bound under admission
+    control — the front door SHEDS the request instead of queueing it
+    unboundedly (the reference's GRV proxy drops requests past
+    START_TRANSACTION_MAX_QUEUE_SIZE the same way). Clients back off
+    and retry; offered load past capacity degrades into delayed admits
+    plus retryable sheds, never into an unbounded promise queue."""
 
 
 class GrvProxy:
@@ -42,11 +51,28 @@ class GrvProxy:
         *,
         ratekeeper=None,
         batch_interval: float = 0.001,
+        max_queue: int = None,
     ):
         self.sched = sched
         self.sequencer = sequencer
         self.ratekeeper = ratekeeper
         self.batch_interval = batch_interval
+        from foundationdb_tpu.utils.knobs import SERVER_KNOBS as _SK
+
+        #: bounded GRV queue: requests past this depth are SHED with the
+        #: retryable GrvThrottledError instead of queued (overload must
+        #: degrade gracefully, not accumulate an unbounded promise list)
+        self.max_queue = (
+            max_queue if max_queue is not None
+            else _SK.GRV_PROXY_MAX_QUEUE
+        )
+        # fail-safe state: when the Ratekeeper's budget goes STALE (the
+        # loop died or stopped updating), the effective budget decays
+        # toward the Ratekeeper's conservative floor instead of
+        # freezing at the last (possibly full-speed) value
+        self._failsafe_budget: float | None = None
+        self._effective_tps: float = float("inf")
+        self._budget_stale = False
         # Adaptive GRV batching (GrvProxyServer's START_TRANSACTION_
         # BATCH_* discipline): the accumulation interval shrinks while
         # requests keep arriving faster than batches go out and relaxes
@@ -71,7 +97,8 @@ class GrvProxy:
         )
         self.requests = PromiseStream()
         self.counters = CounterCollection(
-            "GrvProxyMetrics", ["txnRequestIn", "txnRequestOut", "grvBatches"]
+            "GrvProxyMetrics",
+            ["txnRequestIn", "txnRequestOut", "grvBatches", "grvShed"],
         )
         # GRV latency distribution + reference-style latency bands
         # (GrvProxyServer.actor.cpp grvLatencyBands), in virtual time
@@ -118,10 +145,17 @@ class GrvProxy:
         (requests admitted but not yet answered — the front-door queue
         the Ratekeeper budget throttles), the live batch-sizer targets,
         and the tags currently metered by a throttle bucket."""
+        tps = self._effective_tps
         return {
             "queued_requests": (
                 len(self._pending) + len(self.requests.stream._queue)
             ),
+            "max_queue": self.max_queue,
+            "transactions_per_second_limit": (
+                tps if tps != float("inf") else None
+            ),
+            "budget_stale": self._budget_stale,
+            "sheds": self.counters.get("grvShed"),
             "batch_sizer": self.batch_sizer.as_dict(),
             "throttled_tags": sorted(
                 t for t, tok in self._tag_tokens.items()
@@ -147,6 +181,22 @@ class GrvProxy:
             # queued into the dead stream would strand its client
             # forever — fail fast with the retryable error instead.
             p.send_error(GrvProxyFailedError())
+            return p
+        if (
+            self.max_queue is not None
+            and len(self._pending) + len(self.requests.stream._queue)
+            >= self.max_queue
+        ):
+            # bounded front-door queue: shed with the retryable
+            # throttle error — delayed-or-shed at GRV is the ONLY
+            # admission-control enforcement point (decision parity:
+            # an admitted transaction resolves identically to the
+            # unthrottled path)
+            from foundationdb_tpu.utils.probes import code_probe
+
+            self.counters.add("grvShed")
+            code_probe(True, "grv.throttled")
+            p.send_error(GrvThrottledError())
             return p
         self.requests.send(p)
         return p
@@ -180,15 +230,57 @@ class GrvProxy:
                 self._pending.append(p)
 
             now = self.sched.now()
-            if self.ratekeeper is not None:
-                tps = self.ratekeeper.get_rate_info()
-                tokens = min(
-                    tokens + tps * (now - last), max(tps * 0.1, 1.0)
-                )
-            else:
-                tokens = float(len(self._pending))
             dt = now - last
             last = now
+            if self.ratekeeper is not None:
+                tps = self.ratekeeper.get_rate_info()
+                # fail-safe: a dead/flapping Ratekeeper (control loop
+                # not updating) must not be trusted at full speed — the
+                # effective budget decays toward the conservative
+                # failsafe floor until fresh budgets flow again
+                age_fn = getattr(self.ratekeeper, "budget_age", None)
+                stale_after = 4.0 * getattr(
+                    self.ratekeeper, "interval", 0.25
+                )
+                stale = (
+                    age_fn is not None and age_fn(now) > stale_after
+                )
+                if stale:
+                    import math as _math
+
+                    from foundationdb_tpu.cluster.ratekeeper import (
+                        FAILSAFE_TAU,
+                    )
+                    from foundationdb_tpu.utils.probes import code_probe
+
+                    floor = getattr(
+                        self.ratekeeper, "failsafe_tps", 10.0
+                    )
+                    tau = getattr(
+                        self.ratekeeper, "failsafe_tau", FAILSAFE_TAU
+                    )
+                    if self._failsafe_budget is None:
+                        self._failsafe_budget = max(tps, floor)
+                        code_probe(True, "ratekeeper.failsafe")
+                    self._failsafe_budget = max(
+                        floor,
+                        self._failsafe_budget
+                        * _math.exp(-max(dt, 0.0) / tau),
+                    )
+                    tps = min(tps, self._failsafe_budget)
+                else:
+                    self._failsafe_budget = None
+                self._budget_stale = stale
+                self._effective_tps = tps
+                # token bucket with a burst cap: at most ~100ms of
+                # budget (never less than one token) accumulates idle
+                tokens = min(
+                    tokens + tps * dt, max(tps * 0.1, 1.0)
+                )
+            else:
+                self._budget_stale = False
+                self._effective_tps = float("inf")
+                tokens = float(len(self._pending))
             n = min(len(self._pending), int(tokens))
             if n == 0:
                 continue

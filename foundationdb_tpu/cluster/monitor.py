@@ -36,7 +36,7 @@ from foundationdb_tpu.cluster.multiprocess import spawn_role
 @dataclasses.dataclass
 class RoleSpec:
     name: str
-    kind: str                      # resolver | tlog | storage
+    kind: str                      # resolver | tlog | storage | ratekeeper
     socket_dir: str
     index: int = 0
     backend: str = "native"
@@ -44,6 +44,9 @@ class RoleSpec:
     tlog_address: Optional[str] = None
     storage_engine: str = "memory"
     encrypt: bool = False
+    #: ratekeeper: comma list of peer role sockets whose StatusRequest
+    #: sensors feed the admission law
+    peers: Optional[str] = None
 
     @property
     def address(self) -> str:
@@ -72,6 +75,7 @@ def parse_conf(path: str) -> dict[str, RoleSpec]:
             tlog_address=sec.get("tlog_address", None),
             storage_engine=sec.get("storage_engine", "memory"),
             encrypt=sec.getboolean("encrypt", False),
+            peers=sec.get("peers", None),
         )
         if spec.address in addresses:
             raise ValueError(
@@ -133,6 +137,7 @@ class Monitor:
             # without this, a supervised restart of an encrypted store
             # would crash-loop on the ENCRYPTION_MODE marker
             encrypt=spec.encrypt,
+            peers=spec.peers.split(",") if spec.peers else None,
         )
         self.children[spec.name] = _Child(
             spec=spec, proc=proc, started_at=time.monotonic(),

@@ -32,6 +32,7 @@ import subprocess
 import sys
 from typing import Any, Optional
 
+from foundationdb_tpu.cluster.grv_proxy import GrvThrottledError  # noqa: F401
 from foundationdb_tpu.models.types import (
     CommitTransaction,
     ResolveTransactionBatchReply,
@@ -287,10 +288,20 @@ RoleVersionReply = _message(0x0231, "RoleVersionReply", [("version", "i64")])
 StatusRequest = _message(0x0240, "StatusRequest", [("pad", "u8")])
 StatusReply = _message(0x0241, "StatusReply", [("payload", "str")])
 
+# Admission control over the wire (Ratekeeper.actor.cpp:475
+# GetRateInfoRequest): the front door (ProxyPipeline's GRV path)
+# periodically fetches the transactions-per-second budget from the
+# ratekeeper role process. JSON payload for the same reason as
+# StatusReply: the budget document (budget + binding limiter +
+# fail-safe state) is a status-schema slice, not a hot-path message.
+GetRateInfoRequest = _message(0x0242, "GetRateInfoRequest", [("pad", "u8")])
+GetRateInfoReply = _message(0x0243, "GetRateInfoReply", [("payload", "str")])
+
 TOKEN_TLOG_VERSION = 0x0203
 TOKEN_STORAGE_VERSION = 0x0304
 TOKEN_RESOLVER_VERSION = 0x0102
 TOKEN_STATUS = 0x0501
+TOKEN_GET_RATE_INFO = 0x0502
 
 
 # ---------------------------------------------------------------------------
@@ -1201,6 +1212,125 @@ class StorageRole:
         return StorageSnapshotReply(version=self.version, kvs=kvs)
 
 
+class RatekeeperRole:
+    """Wire-mode Ratekeeper: `fdbserver/Ratekeeper.actor.cpp` as an OS
+    process. Polls every peer role's StatusRequest for its saturation
+    sensors (the same qos blocks fdbtop renders), drives the SAME
+    `AdmissionController` law the sim Ratekeeper runs, and serves the
+    live budget over GetRateInfo. Robustness contract: a peer that
+    stops answering simply contributes no sensors this interval; when
+    NO peer answers, the law's fail-safe decay engages (budget decays
+    toward the conservative floor) — and a consumer that cannot reach
+    THIS process applies its own decay (ProxyPipeline._rate_fetcher),
+    so a dead ratekeeper never freezes the cluster at full speed."""
+
+    def __init__(self, peers: list[str], *, interval: float = 0.25):
+        import time as _time
+
+        from foundationdb_tpu.cluster.ratekeeper import AdmissionController
+
+        self.peers = [p for p in peers if p]
+        self.interval = interval
+        self.law = AdmissionController(clock=_time.monotonic)
+        self._conns: dict[str, transport.RpcConnection] = {}
+        self._task: asyncio.Task | None = None
+        self.polls = 0
+        self.poll_failures = 0
+        #: last cycle's observed GRV admission rate (the law's
+        #: actualTps input) — surfaced in status so the wire feedback
+        #: path is testable end to end
+        self.observed_grv_per_s = 0.0
+
+    async def start(self) -> None:
+        self._task = asyncio.ensure_future(self._poll_loop())
+
+    async def _poll_one(self, path: str) -> dict:
+        import json as _json
+
+        conn = self._conns.get(path)
+        if conn is None:
+            conn = transport.RpcConnection(path, tls=_tls_from_env())
+            await conn.connect(retries=1)
+            self._conns[path] = conn
+        reply = await conn.call(
+            TOKEN_STATUS, StatusRequest(pad=0), timeout=2.0
+        )
+        return _json.loads(reply.payload)
+
+    async def _poll_loop(self) -> None:
+        from foundationdb_tpu.cluster.status import _QOS_SLOT
+
+        while True:
+            slots: dict = {
+                "tlogs": {}, "storages": {}, "resolvers": {},
+                "proxies": {},
+            }
+            answered = 0
+            current_tps = 0.0
+            # polls are independent I/O and go out CONCURRENTLY: one
+            # hung peer (2s call timeout) bounds the cycle at the
+            # slowest single peer, not the sum — a serial loop would
+            # stretch the control cadence ~Nx while the served budget
+            # sat frozen at its last (possibly full-speed) value
+            results = await asyncio.gather(
+                *(self._poll_one(p) for p in self.peers),
+                return_exceptions=True,
+            )
+            for path, block in zip(self.peers, results):
+                if isinstance(block, BaseException):
+                    self.poll_failures += 1
+                    conn = self._conns.pop(path, None)
+                    if conn is not None:
+                        try:
+                            await conn.close()
+                        except Exception:
+                            pass
+                    continue
+                name = os.path.basename(path)
+                if name.endswith(".sock"):
+                    name = name[: -len(".sock")]
+                answered += 1
+                slot = _QOS_SLOT.get(block.get("role", ""))
+                if slot in slots:
+                    slots[slot][name] = block.get("qos", {})
+                # the parent pipeline's status socket embeds its GRV
+                # block (a process block: role + qos): its served-GRV
+                # rate is the law's actualTps
+                grv = block.get("grv_proxy")
+                if grv:
+                    current_tps = max(
+                        current_tps,
+                        float(grv.get("qos", {}).get("grv_per_s", 0.0)),
+                    )
+            self.polls += 1
+            self.observed_grv_per_s = current_tps
+            if answered == 0:
+                # total sensor dropout: fail safe, never full speed
+                self.law.decay()
+            else:
+                self.law.update(slots, current_tps=current_tps)
+            await asyncio.sleep(self.interval)
+
+    async def get_rate_info(
+        self, _req: GetRateInfoRequest
+    ) -> GetRateInfoReply:
+        import json as _json
+
+        return GetRateInfoReply(payload=_json.dumps(self.law.rate_info()))
+
+    def status(self) -> dict:
+        return {
+            "role": "ratekeeper",
+            "qos": {
+                **self.law.rate_info(),
+                "peer_polls": self.polls,
+                "peer_poll_failures": self.poll_failures,
+                "peers": len(self.peers),
+                "observed_grv_per_s": self.observed_grv_per_s,
+            },
+        }
+
+
 async def _serve_role(
     role_name: str,
     address,
@@ -1210,6 +1340,7 @@ async def _serve_role(
     storage_engine: str = "memory",
     encrypt: bool = False,
     trace_file: str | None = None,
+    peers: list[str] | None = None,
 ) -> None:
     if trace_file:
         # per-process trace sink (the reference's one-trace-file-per-
@@ -1272,6 +1403,10 @@ async def _serve_role(
         server.register(TOKEN_STORAGE_GET_BATCH, role.get_batch)
         server.register(TOKEN_STORAGE_SNAPSHOT, role.snapshot)
         server.register(TOKEN_STORAGE_VERSION, role.get_version)
+    elif role_name == "ratekeeper":
+        role = RatekeeperRole(peers or [])
+        server.register(TOKEN_GET_RATE_INFO, role.get_rate_info)
+        await role.start()
     else:
         raise ValueError(f"unknown role {role_name!r}")
 
@@ -1318,6 +1453,7 @@ def spawn_role(
     storage_engine: str = "memory",
     encrypt: bool = False,
     trace_file: str | None = None,
+    peers: list[str] | None = None,
 ) -> RoleProcess:
     """Start one role as a child OS process serving a UDS in socket_dir.
 
@@ -1351,6 +1487,10 @@ def spawn_role(
         cmd += ["--data-dir", data_dir]
     if trace_file:
         cmd += ["--trace-file", trace_file]
+    if peers:
+        # ratekeeper: the role sockets whose StatusRequest sensors feed
+        # the admission law
+        cmd += ["--peers", ",".join(peers)]
     if tlog_address:
         cmd += ["--tlog-address", tlog_address]
     if storage_engine != "memory":
@@ -1456,6 +1596,9 @@ class ProxyPipeline:
         start_version: int = 0,
         trace: bool = False,
         pipeline_depth: int = None,
+        ratekeeper: transport.RpcConnection = None,
+        rate_fetch_interval: float = 0.25,
+        max_grv_queue: int = None,
     ):
         from foundationdb_tpu.cluster.batching import AdaptiveBatchSizer
         from foundationdb_tpu.utils.knobs import SERVER_KNOBS as _K
@@ -1463,6 +1606,30 @@ class ProxyPipeline:
         self.resolvers = resolvers
         self.tlog = tlog
         self.storage = storage
+        # -- admission control (the wire GRV front door): the budget is
+        # fetched from the ratekeeper role over GetRateInfo and enforced
+        # as an arrival-spacing token bucket with a burst cap; requests
+        # whose backlog would exceed the bounded queue are SHED with the
+        # retryable grv_throttled error (same contract as the sim
+        # GrvProxy). No ratekeeper connection == unthrottled.
+        self._rk_conn = ratekeeper
+        self._rate_interval = rate_fetch_interval
+        self.max_grv_queue = (
+            max_grv_queue if max_grv_queue is not None
+            else _K.GRV_PROXY_MAX_QUEUE
+        )
+        from foundationdb_tpu.cluster.ratekeeper import FAILSAFE_TAU
+
+        self._rate_limit = float("inf")
+        self._rate_floor = 1e4
+        self._rate_tau = FAILSAFE_TAU
+        self._rate_info: dict = {}
+        self._rate_stale = False
+        self._rate_failures = 0
+        self._rate_task: asyncio.Task | None = None
+        self._grv_next_slot = 0.0
+        self.grv_sheds = 0
+        self.grv_throttle_waits = 0
         self.version_step = version_step
         self.batch_interval = batch_interval
         self.max_batch = max_batch
@@ -1543,8 +1710,17 @@ class ProxyPipeline:
         self._apply_event = asyncio.Event()
         self._batcher_task = asyncio.ensure_future(self._batcher())
         self._applier_task = asyncio.ensure_future(self._applier())
+        if self._rk_conn is not None:
+            self._rate_task = asyncio.ensure_future(self._rate_fetcher())
 
     async def stop(self) -> None:
+        if self._rate_task:
+            self._rate_task.cancel()
+            try:
+                await self._rate_task
+            except asyncio.CancelledError:
+                pass
+            self._rate_task = None
         if self._batcher_task:
             self._batcher_task.cancel()
             try:
@@ -1577,7 +1753,101 @@ class ProxyPipeline:
                 pass
             self._applier_task = None
 
+    async def _rate_fetcher(self) -> None:
+        """Budget-fetch loop (GetRateInfoRequest cadence). A ratekeeper
+        that stops answering FAILS SAFE: after two consecutive misses
+        the effective budget decays exponentially toward the
+        conservative floor — a dead ratekeeper must clamp the front
+        door, never freeze it at full speed."""
+        import json as _json
+        import math as _math
+        import time as _time
+
+        last = _time.monotonic()
+        while True:
+            now = _time.monotonic()
+            dt = max(0.0, now - last)
+            last = now
+            try:
+                rep = await self._rk_conn.call(
+                    TOKEN_GET_RATE_INFO, GetRateInfoRequest(pad=0),
+                    timeout=2.0,
+                )
+                info = _json.loads(rep.payload)
+                self._rate_limit = float(
+                    info["transactions_per_second_limit"]
+                )
+                self._rate_floor = float(
+                    info.get("failsafe_tps", self._rate_floor)
+                )
+                self._rate_tau = float(
+                    info.get("failsafe_tau", self._rate_tau)
+                )
+                self._rate_info = info
+                self._rate_failures = 0
+                self._rate_stale = False
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self._rate_failures += 1
+                if self._rate_failures >= 2:
+                    self._rate_stale = True
+                    if self._rate_limit == float("inf"):
+                        self._rate_limit = self._rate_floor
+                    else:
+                        self._rate_limit = max(
+                            self._rate_floor,
+                            self._rate_limit
+                            * _math.exp(-dt / self._rate_tau),
+                        )
+            await asyncio.sleep(self._rate_interval)
+
+    def _grv_backlog(self) -> int:
+        """Requests currently parked in the admission throttle (the
+        token schedule's lead over now, in request slots) — the wire
+        GRV front door's queue-depth sensor."""
+        import time as _time
+
+        rate = self._rate_limit
+        if self._rk_conn is None or rate == float("inf"):
+            return 0
+        return max(
+            0, int((self._grv_next_slot - _time.monotonic()) * rate)
+        )
+
+    async def _grv_admit(self) -> None:
+        """Arrival-spacing token bucket: each admit takes the next
+        1/rate-spaced slot; the slot may lag `now` by up to the burst
+        allowance (0.1s of budget), and a backlog past the bounded
+        queue sheds with the retryable grv_throttled error."""
+        import time as _time
+
+        from foundationdb_tpu.cluster.grv_proxy import GrvThrottledError
+
+        rate = self._rate_limit
+        if rate == float("inf"):
+            return
+        rate = max(rate, 1e-3)
+        now = _time.monotonic()
+        burst = max(1.0, rate * 0.1)
+        slot = max(self._grv_next_slot, now - burst / rate) + 1.0 / rate
+        backlog = slot - now
+        if backlog * rate > self.max_grv_queue:
+            # the slot is NOT consumed: a shed request must not push
+            # the schedule further out for the next arrival
+            self.grv_sheds += 1
+            raise GrvThrottledError()
+        self._grv_next_slot = slot
+        if backlog > 0:
+            self.grv_throttle_waits += 1
+            await asyncio.sleep(backlog)
+
     async def get_read_version(self) -> int:
+        if self._rk_conn is not None:
+            # admission control gates HERE and only here: an admitted
+            # transaction's resolve/commit path is byte-identical to
+            # the unthrottled one (decision parity)
+            await self._grv_admit()
         self.grvs_served += 1
         self.smoothed_grv_rate.add_delta(1.0)
         return self.committed_version
@@ -1610,16 +1880,27 @@ class ProxyPipeline:
         """The wire GRV front door's qos block (this process serves
         read versions directly off the committed head)."""
         return {
-            # GRVs answer synchronously off committed_version — the
-            # wire front door cannot queue, and a nonzero count here
-            # would send performance_limited_by chasing a bottleneck
-            # that cannot exist (the read-coalescer backlog is the
-            # proxy block's read_backlog_keys)
-            "queued_requests": 0,
+            # the admission throttle's backlog: callers parked inside
+            # _grv_admit waiting for their token slot. Without a
+            # ratekeeper the front door answers synchronously (the
+            # read-coalescer backlog is the proxy block's
+            # read_backlog_keys) — then this is genuinely 0.
+            "queued_requests": self._grv_backlog(),
             "grvs_served": self.grvs_served,
             "grv_per_s": self.smoothed_grv_rate.smooth_rate(),
             "committed_version": self.committed_version,
             "applied_version": self.applied_version,
+            # admission-control surface (None == unthrottled: no
+            # ratekeeper connection configured)
+            "transactions_per_second_limit": (
+                self._rate_limit
+                if self._rate_limit != float("inf") else None
+            ),
+            "budget_limited_by": self._rate_info.get("budget_limited_by"),
+            "budget_stale": self._rate_stale,
+            "sheds": self.grv_sheds,
+            "throttle_waits": self.grv_throttle_waits,
+            "max_queue": self.max_grv_queue,
         }
 
     async def commit(self, txn: CommitTransaction) -> int:
@@ -2042,6 +2323,9 @@ def main() -> None:
                     choices=("memory", "lsm"))
     ap.add_argument("--encrypt", action="store_true")
     ap.add_argument("--trace-file", default=None)
+    ap.add_argument("--peers", default=None,
+                    help="ratekeeper: comma list of peer role sockets "
+                         "to poll StatusRequest sensors from")
     args = ap.parse_args()
     asyncio.run(
         _serve_role(
@@ -2053,6 +2337,7 @@ def main() -> None:
             storage_engine=args.storage_engine,
             encrypt=args.encrypt,
             trace_file=args.trace_file,
+            peers=args.peers.split(",") if args.peers else None,
         )
     )
 

@@ -63,6 +63,12 @@ QOS_REASONS = {
     "grv_proxy_queue": (
         "Read-version requests are queueing at the GRV proxy."
     ),
+    # the Ratekeeper's fail-safe direction (one vocabulary: the budget's
+    # binding limiter and performance_limited_by share these ids)
+    "ratekeeper_failsafe": (
+        "The Ratekeeper's sensor feed is stale or no storage replica is "
+        "live; admission is clamped toward the fail-safe floor."
+    ),
 }
 
 
@@ -219,6 +225,11 @@ def assemble_status(
         if slot is not None:
             # the live dict, so the join below lands in the document
             slots[slot][name] = block.setdefault("qos", {})
+        elif block.get("role") == "ratekeeper" and ratekeeper is None:
+            # a wire RatekeeperRole's status block IS the qos
+            # ratekeeper payload (budget, binding limiter, fail-safe
+            # state) — merge it like the sim path merges rk.status()
+            ratekeeper = block.get("qos", {})
     # version-lag join: a storage process doesn't know the committed
     # head — derive it from the proxy/log blocks (the reference's
     # Status.actor.cpp joins the same way) and fill

@@ -227,6 +227,15 @@ class Resolver:
         from foundationdb_tpu.utils.metrics import Smoother
 
         self.occupancy = Smoother(2.0, clock=sched.now)
+        #: virtual per-transaction resolution cost (seconds of VIRTUAL
+        #: clock awaited per transaction before the conflict check).
+        #: 0.0 in ordinary sims (resolution is instantaneous in virtual
+        #: time, so a sim cluster has no finite capacity to saturate);
+        #: saturation/overload scenarios set it so offered load past
+        #: 1/cost txn/s genuinely backs up — the occupancy Smoother
+        #: then reads a true busy fraction, which is the Ratekeeper's
+        #: resolver_busy input.
+        self.sim_compute_cost_per_txn = 0.0
         # iops sample feeding the ResolutionBalancer (Resolver.actor.cpp:
         # 337-344). Bounded: the reference samples with decay; an
         # unbounded dict leaks on long multi-resolver soaks (VERDICT r1
@@ -342,6 +351,26 @@ class Resolver:
             trace.g_trace_batch.add_event(
                 "CommitDebug", req.debug_id, _cd.RESOLVER_AFTER_ORDERER
             )
+
+        if (
+            self.sim_compute_cost_per_txn
+            and req.transactions
+            # a redelivered duplicate (version already advanced past
+            # this batch's prev) takes the cached-reply path below and
+            # must not re-pay the service delay or re-count busy time
+            and self.version.get() == req.prev_version
+        ):
+            # virtual service time (saturation scenarios): awaited
+            # BEFORE the version check below so the duplicate-batch
+            # dispatch decision still happens after the last await —
+            # the compute phase proper must stay await-free. Successor
+            # batches stay blocked on the version chain throughout, so
+            # service is serialized and capacity is 1/cost txn/s.
+            cost = self.sim_compute_cost_per_txn * len(req.transactions)
+            await self.sched.delay(cost)
+            # the modeled compute seconds feed the busy-fraction
+            # smoother exactly like measured compute in dt_compute
+            self.occupancy.add_delta(cost)
 
         if self.version.get() == req.prev_version:
             # ---- compute phase (no awaits until version.set) -----------

@@ -214,7 +214,10 @@ class ApiWorkload:
         from foundationdb_tpu.cluster.failure_monitor import (
             ProcessFailedError,
         )
-        from foundationdb_tpu.cluster.grv_proxy import GrvProxyFailedError
+        from foundationdb_tpu.cluster.grv_proxy import (
+            GrvProxyFailedError,
+            GrvThrottledError,
+        )
 
         self.sched = sched
         self.db = db
@@ -233,8 +236,8 @@ class ApiWorkload:
         self._conflict = NotCommitted
         self._too_old = TransactionTooOldError
         self._retryable = (
-            GrvProxyFailedError, ProcessFailedError, TransactionTooOldError,
-            NotCommitted, CommitUnknownResult,
+            GrvProxyFailedError, GrvThrottledError, ProcessFailedError,
+            TransactionTooOldError, NotCommitted, CommitUnknownResult,
         )
 
     # -- generation -------------------------------------------------------

@@ -75,6 +75,18 @@ class SeedPlan:
     #                            ConfigNode quorum under a coordinator
     #                            minority kill; the broadcast copy is
     #                            wiped and restored from the quorum
+    # round-8 (admission control) fault classes
+    ratekeeper_restart: bool   # kill + restart the Ratekeeper mid-run:
+    #                            the GRV front door's stale-budget
+    #                            fail-safe decays toward the floor,
+    #                            then the budget recovers after restart
+    sensor_dropout: bool       # the control loop's sensor feed goes
+    #                            stale: the law itself decays fail-safe
+    #                            instead of freezing at full speed
+    overload_burst: bool       # open-loop burst past a finite resolver
+    #                            capacity: throttle + bounded-queue
+    #                            shedding engage and RECOVER while the
+    #                            other fault classes interleave
     sideband: bool             # Sideband.actor.cpp analog: a commit's
     #                            version handed to a checker must make
     #                            the write visible at exactly that
@@ -177,7 +189,10 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
     )
     from foundationdb_tpu.cluster.consistency import check_cluster
     from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
-    from foundationdb_tpu.cluster.grv_proxy import GrvProxyFailedError
+    from foundationdb_tpu.cluster.grv_proxy import (
+        GrvProxyFailedError,
+        GrvThrottledError,
+    )
     from foundationdb_tpu.runtime.flow import all_of
     from foundationdb_tpu.utils.knobs import SERVER_KNOBS
 
@@ -188,6 +203,9 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
         TransactionTooOldError,
         CommitUnknownResult,
         GrvProxyFailedError,
+        # overload shedding at the GRV front door: delayed-or-shed is
+        # the admission-control contract; clients back off and retry
+        GrvThrottledError,
         # every replica of a team can be transiently dead under composed
         # faults (silent kill + reboot): the read retry budget exhausts
         # and surfaces the process failure — a real client backs off and
@@ -681,6 +699,66 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
                 p.failed = RuntimeError("soak kill")
                 p.stop()
 
+        async def admission_chaos():
+            """r8 overload-survival scenarios (runs CONCURRENTLY with
+            chaos/coordination_chaos/workload, so throttle windows
+            interleave with kills and recoveries): the admission loop
+            must survive its own death. Ratekeeper kill/restart — the
+            GRV front door's stale-budget detector decays the
+            effective budget toward the fail-safe floor, then recovers
+            after restart; sensor dropout — the law itself fails safe
+            on a stale feed; overload burst — open-loop load past a
+            finite (virtually-modeled) resolver capacity must engage
+            the throttle and the bounded-queue shed, then drain."""
+            rk = cluster.ratekeeper
+            grv = cluster.grv_proxy
+            if plan.sensor_dropout:
+                await sched.delay(0.1)
+                rk.sensor_dropout = True
+                await sched.delay(0.8)
+                rk.sensor_dropout = False
+            if plan.ratekeeper_restart:
+                await sched.delay(0.1)
+                rk.stop()
+                # past the GRV proxy's staleness threshold (4x the
+                # control interval), so the fail-safe decay engages
+                # before the restart brings fresh budgets back
+                await sched.delay(6.0 * rk.interval)
+                rk.start()
+            if plan.overload_burst:
+                old_q = grv.max_queue
+                old_interval = rk.interval
+                old_cost = [
+                    r.sim_compute_cost_per_txn for r in cluster.resolvers
+                ]
+                grv.max_queue = 12
+                rk.interval = 0.05
+                for r in cluster.resolvers:
+                    r.sim_compute_cost_per_txn = 0.004
+
+                async def burst_txn(i):
+                    txn = db.create_transaction()
+                    txn.set(b"ob/%02d" % (i % 16), b"b%d" % i)
+                    try:
+                        await txn.commit()
+                    except retryable:
+                        await sched.delay(0.01)
+
+                burst = []
+                for i in range(150):
+                    # ~500 offered txn/s against ~250/s of capacity:
+                    # the GRV queue must fill, shed, and drain
+                    burst.append(
+                        sched.spawn(burst_txn(i), name=f"burst{i}").done
+                    )
+                    await sched.delay(0.002)
+                await all_of(burst)
+                await sched.delay(0.5)  # drain + budget recovery
+                grv.max_queue = old_q
+                rk.interval = old_interval
+                for r, c in zip(cluster.resolvers, old_cost):
+                    r.sim_compute_cost_per_txn = c
+
         api = None
         if plan.api:
             from foundationdb_tpu.testing.api_workload import ApiWorkload
@@ -713,7 +791,8 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
         w = sched.spawn(workload(), name="soak-load")
         c = sched.spawn(chaos(), name="soak-chaos")
         cc = sched.spawn(coordination_chaos(), name="soak-coord-chaos")
-        tasks = [w.done, c.done, cc.done]
+        ac = sched.spawn(admission_chaos(), name="soak-admission-chaos")
+        tasks = [w.done, c.done, cc.done, ac.done]
         if api is not None:
             tasks.extend(
                 sched.spawn(coro, name=f"soak-api-{i}").done

@@ -67,6 +67,11 @@ FAULT_FIELDS = (
     "silent_kill",
     "tlog_spill",
     "knob_quorum",
+    # r8 admission-control fault classes (append-only: new draws land
+    # after the existing fault draws)
+    "ratekeeper_restart",
+    "sensor_dropout",
+    "overload_burst",
 )
 
 #: canonical auxiliary-workload draw order

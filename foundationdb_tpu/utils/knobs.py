@@ -159,6 +159,14 @@ def make_server_knobs() -> Knobs:
     )
     k.define("START_TRANSACTION_BATCH_INTERVAL_SMOOTHER_ALPHA", 0.1)
     k.define("START_TRANSACTION_BATCH_COUNT_MAX", 65536)
+    # Bounded GRV front-door queue (the reference's START_TRANSACTION_
+    # MAX_QUEUE_SIZE): read-version requests past this depth are SHED
+    # with the retryable grv_throttled error instead of queueing
+    # unboundedly — overload degrades into delayed admits + client
+    # backoff, never into an ever-growing promise list. NOT randomized:
+    # ordinary ensemble seeds must not shed by surprise; overload
+    # scenarios tighten it explicitly.
+    k.define("GRV_PROXY_MAX_QUEUE", 8192)
     # Commit-pipeline depth: how many commit batches may be in flight
     # concurrently through resolve -> tlog-push -> reply, ordered only
     # at the Notified-chain handoffs (the reference bounds pipelining

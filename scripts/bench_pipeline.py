@@ -193,14 +193,28 @@ async def _run_wire(backend: str, args) -> dict:
             mp.spawn_role("tlog", sock_dir),
             mp.spawn_role("storage", sock_dir),
         ]
+        if getattr(args, "ratekeeper", False):
+            # the admission-control role: polls every role's
+            # StatusRequest sensors (plus the parent's proxy0.sock when
+            # --serve-status is on) and serves the budget over
+            # GetRateInfo — the pipeline's GRV front door enforces it
+            procs.append(mp.spawn_role(
+                "ratekeeper", sock_dir,
+                peers=[p.address for p in procs]
+                + [os.path.join(sock_dir, "proxy0.sock")],
+            ))
         try:
             resolver = await mp.connect(procs[0].address)
             tlog = await mp.connect(procs[1].address)
             storage = await mp.connect(procs[2].address)
+            rk_conn = None
+            if getattr(args, "ratekeeper", False):
+                rk_conn = await mp.connect(procs[3].address)
             pipe = mp.ProxyPipeline(
                 [resolver], tlog, storage,
                 batch_interval=0.001, max_batch=args.batch,
                 trace=bool(trace_dir),
+                ratekeeper=rk_conn,
             )
             pipe.start()
             status_server = None
@@ -210,9 +224,23 @@ async def _run_wire(backend: str, args) -> dict:
                 status_server = mp.serve_status(sock_dir, pipe)
                 await status_server.start()
 
-            stats = {"committed": 0, "conflicted": 0, "reads": 0}
+            stats = {"committed": 0, "conflicted": 0, "reads": 0,
+                     "grv_throttled": 0}
             committed_by_key: dict[bytes, int] = {}
             lat: list[float] = []
+
+            async def grv():
+                # client-side backoff on grv_throttled: the front door
+                # sheds past its queue bound under admission control;
+                # the retry-with-backoff IS the client contract
+                backoff = 0.001
+                while True:
+                    try:
+                        return await pipe.get_read_version()
+                    except mp.GrvThrottledError:
+                        stats["grv_throttled"] += 1
+                        await asyncio.sleep(backoff)
+                        backoff = min(backoff * 2, 0.1)
 
             async def client(cid: int):
                 rng = np.random.default_rng(cid)
@@ -225,7 +253,7 @@ async def _run_wire(backend: str, args) -> dict:
                         # conflicted attempt's GRV+read+commit round
                         t0 = time.perf_counter()
                         for _attempt in range(8):
-                            rv = await pipe.get_read_version()
+                            rv = await grv()
                             cur = await pipe.read(key, rv)
                             n = int.from_bytes(cur or b"\0" * 8, "little")
                             txn = CommitTransaction(
@@ -269,7 +297,7 @@ async def _run_wire(backend: str, args) -> dict:
                             except mp.NotCommittedError:
                                 stats["conflicted"] += 1
                     else:
-                        rv = await pipe.get_read_version()
+                        rv = await grv()
                         await pipe.read(key, rv)
                         stats["reads"] += 1
 
@@ -278,7 +306,7 @@ async def _run_wire(backend: str, args) -> dict:
             wall = time.perf_counter() - t0
 
             # exact-count consistency check across the process boundary
-            rv = await pipe.get_read_version()
+            rv = await grv()
             snap = await storage.call(
                 mp.TOKEN_STORAGE_SNAPSHOT, mp.StorageSnapshotReq(version=rv)
             )
@@ -388,6 +416,11 @@ def main():
     ap.add_argument("--serve-status", action="store_true",
                     help="wire mode: serve the parent's commit/GRV proxy "
                          "qos blocks on proxy0.sock (StatusRequest RPC)")
+    ap.add_argument("--ratekeeper", action="store_true",
+                    help="wire mode: spawn the ratekeeper role (polls "
+                         "every role's StatusRequest sensors, serves the "
+                         "budget over GetRateInfo) and enforce it at the "
+                         "pipeline's GRV front door")
     ap.add_argument("--hold", type=float, default=0.0,
                     help="wire mode: keep the cluster alive N seconds "
                          "after the workload (fdbtop polling window)")
@@ -396,6 +429,12 @@ def main():
         args.clients = args.legacy[0]
         if len(args.legacy) > 1:
             args.ops = args.legacy[1]
+    if args.ratekeeper:
+        # the ratekeeper's actualTps feedback comes from the parent's
+        # status socket (the embedded GRV block): without it the law
+        # scales every engaged limit from min_tps and a throttle would
+        # clamp to the floor instead of tracking the admission rate
+        args.serve_status = True
     if args.smoke:
         args.mode = "wire"
         args.clients = 32

@@ -49,6 +49,13 @@ JAX_PLATFORMS=cpu python scripts/bench_pipeline.py --smoke
 t1=$(date +%s.%N)
 awk -v a="$t0" -v b="$t1" 'BEGIN {printf "bench_pipeline smoke wall time: %.1fs\n", b - a}'
 
+echo "== saturation smoke (short overload ramp via the saturation spec: =="
+echo "== admission ON must hold the p99/goodput SLO, OFF must violate)  =="
+t0=$(date +%s.%N)
+JAX_PLATFORMS=cpu python scripts/saturation.py --smoke
+t1=$(date +%s.%N)
+awk -v a="$t0" -v b="$t1" 'BEGIN {printf "saturation smoke wall time: %.1fs\n", b - a}'
+
 echo "== fdbtop smoke (bench_pipeline wire cluster held live, fdbtop  =="
 echo "== polls StatusRequest: every role must report its qos sensors)  =="
 t0=$(date +%s.%N)

@@ -56,6 +56,9 @@ HEADLINE = {
     "commit_proxy": ("queued_requests", "queued"),
     "grv_proxy": ("queued_requests", "queued"),
     "master": ("version", "version"),
+    # the admission budget as a live sparkline: watching the limit dip
+    # and recover IS watching the control loop work
+    "ratekeeper": ("transactions_per_second_limit", "tps lim"),
 }
 
 #: sensors every role's qos block must carry (the --smoke/--require
@@ -66,7 +69,9 @@ REQUIRED_SENSORS = {
     "resolver": ("queue_depth", "queue_wait_dist", "compute_time_dist",
                  "occupancy"),
     "commit_proxy": ("queued_requests", "inflight_batches", "batch_sizer"),
-    "grv_proxy": ("queued_requests",),
+    "grv_proxy": ("queued_requests", "sheds", "budget_stale"),
+    "ratekeeper": ("transactions_per_second_limit", "budget_limited_by",
+                   "budget_stale"),
 }
 
 
@@ -225,8 +230,16 @@ def _row_metrics(role: str, block: dict) -> list[tuple[str, object]]:
         bs = q.get("batch_sizer", {})
         return [
             ("grv/s", q.get("grv_per_s", 0.0)),
+            ("sheds", q.get("sheds", 0)),
             ("throttled", len(q.get("throttled_tags", []))),
             ("interval", bs.get("interval", 0.0)),
+        ]
+    if role == "ratekeeper":
+        limited = q.get("budget_limited_by") or {}
+        return [
+            ("by", limited.get("name", "?")),
+            ("stale", int(bool(q.get("budget_stale")))),
+            ("polls", q.get("peer_polls", q.get("control_loops", 0))),
         ]
     return [("version", block.get("version", 0))]
 
@@ -238,6 +251,12 @@ def render(status: dict, histories: dict[str, MetricHistory],
     limited = qos.get("performance_limited_by", {})
     lines = []
     tps = qos.get("transactions_per_second_limit")
+    budget_by = qos.get("budget_limited_by") or {}
+    sheds = sum(
+        b.get("qos", {}).get("sheds", 0) or 0
+        for b in cl.get("processes", {}).values()
+        if b.get("role") == "grv_proxy"
+    )
     lines.append(
         "fdbtop — limited by: "
         f"{limited.get('name', '?')}"
@@ -245,6 +264,9 @@ def render(status: dict, histories: dict[str, MetricHistory],
            if limited.get("reason_server_id") else "")
         + f"  pressure={limited.get('pressure', 0.0):.2f}"
         + (f"  tps_limit={tps:g}" if tps is not None else "")
+        + (f"  budget by {budget_by['name']}" if budget_by else "")
+        + ("  [BUDGET STALE]" if qos.get("budget_stale") else "")
+        + (f"  sheds={sheds}" if sheds else "")
     )
     run_loop = cl.get("run_loop")
     if run_loop:
@@ -386,11 +408,12 @@ def _smoke_main(args) -> int:
             sys.executable,
             os.path.join(repo, "scripts", "bench_pipeline.py"),
             "--smoke", "--socket-dir", sock_dir, "--serve-status",
-            "--hold", "20",
+            "--ratekeeper", "--hold", "20",
         ],
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
-    require = ["log", "storage", "resolver", "commit_proxy", "grv_proxy"]
+    require = ["log", "storage", "resolver", "commit_proxy", "grv_proxy",
+               "ratekeeper"]
     try:
         deadline = time.monotonic() + 120
         last_problems = ["no status yet"]

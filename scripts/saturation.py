@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Saturation ramp driver: the overload-survival SLO gate as a CLI.
+
+    python scripts/saturation.py --smoke          # check.sh lane
+    python scripts/saturation.py --full           # the graded ramp
+    python scripts/saturation.py --full --json-out SATURATION_r08.json
+
+Runs testing/saturation.run_saturation (the `[saturation]` table of
+testing/specs/saturation.toml) in BOTH directions:
+
+* admission ON  — the gate MUST pass: offered load ramped past the
+  modeled capacity keeps commit p99 inside the band and goodput >=
+  min_goodput_frac of peak (graceful degradation).
+* admission OFF — the SAME ramp with the ratekeeper disconnected MUST
+  violate the gate (the collapse the control loop exists to prevent);
+  an OFF run that passes means the ramp isn't actually saturating and
+  the gate is vacuous.
+
+Exit status is nonzero if either direction lands wrong — a machine-
+checked SLO, not a bench note.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="quick ramp (spec quick_ramp), both directions")
+    ap.add_argument("--full", action="store_true",
+                    help="full ramp (spec ramp), both directions")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--spec", default="saturation")
+    ap.add_argument("--json-out", default=None,
+                    help="append both reports as JSON lines")
+    args = ap.parse_args()
+    quick = not args.full
+
+    from foundationdb_tpu.testing.saturation import run_saturation
+
+    rc = 0
+    reports = []
+    for admission in (True, False):
+        rep = run_saturation(
+            admission=admission, seed=args.seed, quick=quick,
+            spec_name=args.spec,
+        )
+        reports.append(rep)
+        label = "ON " if admission else "OFF"
+        print(f"== admission {label}: capacity {rep['capacity_tps']} tps, "
+              f"ramp x{rep['ramp']} @ {rep['step_seconds']}s ==")
+        for s in rep["steps"]:
+            print(
+                f"  {s['multiplier']:>4}x  offered {s['offered']:>6} "
+                f"admitted {s['admitted']:>6} committed {s['committed']:>6} "
+                f"shed {s['shed']:>6} too_old {s['too_old']:>5}  "
+                f"goodput {s['goodput_tps']:>7} tps  "
+                f"p50 {s['commit_p50_s'] * 1e3:7.1f}ms  "
+                f"p99 {s['commit_p99_s'] * 1e3:7.1f}ms"
+            )
+        slo = rep["slo"]
+        print(f"  peak goodput {rep['peak_goodput_tps']} tps; "
+              f"SLO {'PASSED' if slo['passed'] else 'VIOLATED'}"
+              + (f": {slo['violations']}" if slo["violations"] else ""))
+        if admission and not slo["passed"]:
+            print("saturation: admission-ON ramp VIOLATED the SLO gate",
+                  file=sys.stderr)
+            rc = 1
+        if not admission and slo["passed"]:
+            print("saturation: admission-OFF ramp PASSED the gate — the "
+                  "ramp is not saturating; the SLO is vacuous",
+                  file=sys.stderr)
+            rc = 1
+    if args.json_out:
+        with open(args.json_out, "a") as f:
+            for rep in reports:
+                f.write(json.dumps(rep) + "\n")
+    print("saturation gate ok" if rc == 0 else "saturation gate FAILED")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,7 +52,8 @@ ROLE_QOS_KEYS = {
                  "state_pressure", "occupancy"},
     "commit_proxy": {"inflight_batches", "queued_requests",
                      "batches_started", "batch_sizer"},
-    "grv_proxy": {"queued_requests", "batch_sizer", "throttled_tags"},
+    "grv_proxy": {"queued_requests", "batch_sizer", "throttled_tags",
+                  "sheds", "budget_stale", "max_queue"},
 }
 
 CLUSTER_QOS_KEYS = {
@@ -62,10 +63,13 @@ CLUSTER_QOS_KEYS = {
     "worst_queued_requests_commit_proxy",
     "worst_queued_requests_grv_proxy", "limiting_process",
     "performance_limited_by",
-    # the Ratekeeper integration (satellite: observable from day one)
+    # the Ratekeeper integration (r8: the live budget, its binding
+    # limiter — one vocabulary with performance_limited_by — and the
+    # fail-safe state)
     "transactions_per_second_limit", "max_tps", "min_tps",
     "worst_storage_lag_versions", "lag_target_versions",
     "lag_limit_versions", "tag_quotas", "auto_tag_quotas",
+    "budget_limited_by", "budget_stale", "failsafe_tps",
 }
 
 
@@ -227,11 +231,17 @@ def test_fdbtop_check_status_gate_both_directions():
                     "queued_requests": 0, "inflight_batches": 0,
                     "batch_sizer": {}}},
                 "grv_proxy0": {"role": "grv_proxy",
-                               "qos": {"queued_requests": 0}},
+                               "qos": {"queued_requests": 0, "sheds": 0,
+                                       "budget_stale": False}},
+                "ratekeeper0": {"role": "ratekeeper", "qos": {
+                    "transactions_per_second_limit": 1e7,
+                    "budget_limited_by": {"name": "workload"},
+                    "budget_stale": False}},
             },
         }
     }
-    require = ["log", "storage", "resolver", "commit_proxy", "grv_proxy"]
+    require = ["log", "storage", "resolver", "commit_proxy", "grv_proxy",
+               "ratekeeper"]
     assert fdbtop.check_status(good, require) == []
     # a missing role fails
     partial = json.loads(json.dumps(good))

@@ -28,8 +28,11 @@ def drive(sched, coro):
 def test_every_declared_probe_fires():
     from foundationdb_tpu.testing.soak import run_seed
 
-    # -- ensemble seeds: recovery, state txns, conservative writes ------
-    for seed in (3, 5):
+    # -- ensemble seeds: recovery, state txns, conservative writes;
+    # seed 29 draws atomic_ops + overload_burst under the r8 draw order
+    # (the admission burst sheds at the bounded GRV queue and throttles
+    # the budget) ------
+    for seed in (3, 5, 29):
         run_seed(seed)
 
     # -- resolver rare paths --------------------------------------------

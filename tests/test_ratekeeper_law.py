@@ -1,0 +1,500 @@
+"""r8 admission-control law unit suite (the ISSUE-8 satellite): the
+multi-input AdmissionController's contract — monotonicity, hysteresis,
+anti-windup, fail-safe decay — plus the two r8 bugfix regressions
+(all-dead liveness must clamp, auto tag quotas must not undercut a
+management quota) and the decision-parity pin (throttling delays or
+sheds at GRV only)."""
+
+from __future__ import annotations
+
+import pytest
+
+from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+from foundationdb_tpu.cluster.ratekeeper import (
+    AdmissionController,
+    Ratekeeper,
+)
+from foundationdb_tpu.cluster.status import QOS_REASONS
+from foundationdb_tpu.runtime.flow import Scheduler
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float = 0.25) -> None:
+        self.t += dt
+
+
+def make_law(**kw) -> tuple[AdmissionController, Clock]:
+    clock = Clock()
+    kw.setdefault("max_tps", 10_000.0)
+    kw.setdefault("min_tps", 10.0)
+    return AdmissionController(clock=clock, **kw), clock
+
+
+def storage_slots(lag: float) -> dict:
+    return {"storages": {"storage0": {"version_lag_versions": lag}}}
+
+
+def resolver_slots(occ: float, queue: int = 0) -> dict:
+    return {"resolvers": {"resolver0": {"occupancy": occ,
+                                        "queue_depth": queue}}}
+
+
+# ---------------------------------------------------------------------------
+# Monotonicity: more lag / queue / occupancy never yields a BIGGER
+# budget than less, stepping from identical state.
+
+
+@pytest.mark.parametrize("slots_of, lo, hi", [
+    (storage_slots, 1_000_000.0, 4_000_000.0),
+    (lambda v: resolver_slots(v), 0.5, 1.8),
+    (lambda v: resolver_slots(0.0, int(v)), 4, 40),
+    (lambda v: {"tlogs": {"tlog0": {"smoothed_queue_bytes": v}}},
+     16 << 20, 256 << 20),
+    (lambda v: {"proxies": {"proxy0": {"queued_requests": v}}},
+     1000, 20000),
+])
+def test_monotone_in_every_sensor(slots_of, lo, hi):
+    budgets = []
+    for v in (lo, hi):
+        law, clock = make_law()
+        clock.tick()
+        budgets.append(
+            law.update(slots_of(v), current_tps=500.0)
+        )
+    assert budgets[1] <= budgets[0], (
+        f"worse sensor reading produced a BIGGER budget: {budgets}"
+    )
+
+
+def test_hard_lag_limit_clamps_to_min():
+    law, clock = make_law()
+    clock.tick()
+    law.update(storage_slots(5_000_000.0), current_tps=5000.0)
+    assert law.tps_budget == law.min_tps
+    assert law.limited_by["name"] == "storage_server_durability_lag"
+    assert law.limited_by["reason_server_id"] == "storage0"
+
+
+def test_binding_limiter_names_share_status_vocabulary():
+    """Every reason id the law can emit is a QOS_REASONS key — one
+    vocabulary with status performance_limited_by."""
+    cases = [
+        (storage_slots(5_000_000.0), "storage_server_durability_lag"),
+        (resolver_slots(1.5), "resolver_busy"),
+        (resolver_slots(0.0, 40), "resolver_queue"),
+        ({"tlogs": {"tlog0": {"smoothed_queue_bytes": 512 << 20}}},
+         "log_server_write_queue"),
+        ({"proxies": {"proxy0": {"queued_requests": 50_000}}},
+         "commit_proxy_queue"),
+    ]
+    for slots, want in cases:
+        law, clock = make_law()
+        clock.tick()
+        law.update(slots, current_tps=500.0)
+        assert law.limited_by["name"] == want, (slots, law.limited_by)
+        assert law.limited_by["name"] in QOS_REASONS
+    law, clock = make_law()
+    clock.tick()
+    law.update({"storages": {}}, current_tps=500.0)
+    assert law.limited_by["name"] == "workload"
+
+
+# ---------------------------------------------------------------------------
+# Hysteresis: a noisy sensor oscillating across the target boundary
+# must not flap the budget between full speed and clamp.
+
+
+def test_hysteresis_no_flap_across_target_boundary():
+    law, clock = make_law()
+    target = law.resolver_busy_target
+    # engage hard once
+    clock.tick()
+    law.update(resolver_slots(1.5 * target), current_tps=500.0)
+    engaged_budget = law.tps_budget
+    assert engaged_budget < law.max_tps
+    # noise oscillates +-5% around the target: above release_frac, so
+    # the limiter stays ENGAGED — the budget may drift but must never
+    # snap back to full speed, and step-to-step movement stays bounded
+    prev = law.tps_budget
+    for i in range(40):
+        noisy = target * (1.05 if i % 2 == 0 else 0.95)
+        clock.tick()
+        law.update(resolver_slots(noisy), current_tps=500.0)
+        assert law.tps_budget < law.max_tps, (
+            f"budget flapped to full speed at step {i}"
+        )
+        assert law.tps_budget <= prev * law.growth_factor + law.min_tps
+        prev = law.tps_budget
+    # the sensor drops BELOW the release fraction: now it may release
+    # and recover to full speed
+    for _ in range(40):
+        clock.tick()
+        law.update(
+            resolver_slots(0.5 * law.release_frac * target),
+            current_tps=500.0,
+        )
+    assert law.tps_budget == law.max_tps
+
+
+def test_hysteresis_is_per_process_not_per_reason():
+    """A healthy peer must not release the engagement an overloaded
+    process of the SAME role holds inside the hysteresis band."""
+    law, clock = make_law()
+    target = law.resolver_busy_target
+
+    def two(hot_occ):
+        return {"resolvers": {
+            "resolver0": {"occupancy": hot_occ, "queue_depth": 0},
+            # iterates AFTER resolver0: far below the release fraction
+            "resolver1": {"occupancy": 0.01, "queue_depth": 0},
+        }}
+
+    clock.tick()
+    law.update(two(1.4 * target), current_tps=500.0)
+    assert law.tps_budget < law.max_tps
+    # resolver0 drops into the band (above release, below target): the
+    # idle resolver1 must not have released resolver0's engagement
+    for _ in range(10):
+        clock.tick()
+        law.update(two(0.95 * target), current_tps=500.0)
+        assert law.tps_budget < law.max_tps, (
+            "idle peer released the hot process's engaged limiter"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Anti-windup: after load drops, the budget recovers to max within a
+# bounded number of intervals — through intermediate values, never in
+# one leap.
+
+
+def test_anti_windup_bounded_recovery():
+    law, clock = make_law()
+    clock.tick()
+    law.update(storage_slots(5_000_000.0), current_tps=5000.0)
+    assert law.tps_budget == law.min_tps
+    seen = [law.tps_budget]
+    for _ in range(25):
+        clock.tick()
+        law.update(storage_slots(0.0), current_tps=100.0)
+        # bounded growth per interval (no single leap to max)
+        assert law.tps_budget <= (
+            seen[-1] * law.growth_factor + law.min_tps
+        )
+        seen.append(law.tps_budget)
+        if law.tps_budget == law.max_tps:
+            break
+    assert law.tps_budget == law.max_tps, (
+        f"budget failed to recover within 25 intervals: {seen}"
+    )
+    assert len(seen) > 3  # through intermediate values
+
+
+# ---------------------------------------------------------------------------
+# Fail-safe: a stale sensor feed decays toward the conservative floor.
+
+
+def test_failsafe_decay_on_stale_sensor_feed():
+    law, clock = make_law()
+    assert law.tps_budget == law.max_tps
+    budgets = []
+    for _ in range(20):
+        clock.tick(0.5)
+        budgets.append(law.update(None, current_tps=0.0))
+    # monotone decay toward the floor — never frozen at full speed
+    assert budgets[0] < law.max_tps
+    assert all(b2 <= b1 for b1, b2 in zip(budgets, budgets[1:]))
+    assert budgets[-1] == pytest.approx(law.failsafe_tps)
+    assert law.failsafe_tps >= law.min_tps
+    assert law.stale
+    assert law.limited_by["name"] == "ratekeeper_failsafe"
+    # fresh sensors: recovery resumes
+    for _ in range(30):
+        clock.tick()
+        law.update({"storages": {}}, current_tps=100.0)
+    assert law.tps_budget == law.max_tps and not law.stale
+
+
+def test_failsafe_decay_never_raises_a_low_budget():
+    law, clock = make_law()
+    clock.tick()
+    law.update(storage_slots(5_000_000.0), current_tps=100.0)
+    assert law.tps_budget == law.min_tps
+    clock.tick(5.0)
+    law.update(None, current_tps=0.0)
+    # the floor is a DECAY TARGET for a high budget, never a boost for
+    # an already-clamped one
+    assert law.tps_budget == law.min_tps
+
+
+# ---------------------------------------------------------------------------
+# r8 bugfix regression: all storage replicas dead must clamp, not
+# admit at max (worst_lag over an empty live set reads 0.0).
+
+
+def test_all_dead_liveness_clamps_to_min_tps():
+    sched = Scheduler(sim=True)
+
+    class SeqStub:
+        class _N:
+            def __init__(self):
+                self.v = 10_000_000
+
+            def get(self):
+                return self.v
+
+        def __init__(self):
+            self.live_committed = self._N()
+
+    class SSStub:
+        def __init__(self):
+            self.version = SeqStub._N()
+            self.version.v = 0  # hugely lagged — but dead
+
+    liveness = [False, False]
+    rk = Ratekeeper(
+        sched, SeqStub(), [SSStub(), SSStub()],
+        interval=0.05, liveness=liveness,
+    )
+    rk.start()
+    sched.run_for(0.5)
+    # the old law: dead replicas excluded -> worst_lag 0.0 -> max_tps.
+    # An all-dead cluster must fail SAFE instead.
+    assert rk.worst_lag() == 0.0  # the trap input
+    assert rk.get_rate_info() == rk.min_tps
+    assert rk.law.limited_by["name"] == "ratekeeper_failsafe"
+    # one replica reports back alive: the budget recovers
+    liveness[0] = True
+    rk.storage_servers[0].version.v = 10_000_000
+    sched.run_for(2.0)
+    assert rk.get_rate_info() == rk.max_tps
+    rk.stop()
+
+
+# ---------------------------------------------------------------------------
+# r8 bugfix regression: the auto tag tier must never undercut an
+# explicit management quota, and the lift path fires its probe.
+
+
+def test_auto_tag_quota_never_undercuts_management_quota():
+    sched = Scheduler(sim=True)
+
+    class SeqStub:
+        class _N:
+            def __init__(self, v):
+                self.v = v
+
+            def get(self):
+                return self.v
+
+        def __init__(self):
+            self.live_committed = self._N(5000)
+
+    class SSStub:
+        def __init__(self):
+            self.version = SeqStub._N(0)
+
+    rk = Ratekeeper(sched, SeqStub(), [SSStub()], interval=0.05,
+                    lag_target=1000, lag_limit=10_000)
+    rk.set_tag_quota("batch", 50.0)
+    rk.start()
+
+    async def drive():
+        # heavy stress, "batch" dominating, across MANY intervals: the
+        # old ratchet walked the auto quota monotonically down to
+        # min_tag_tps (1.0), starving a tag the operator explicitly
+        # granted 50 tps
+        for _ in range(12):
+            for _ in range(200):
+                rk.note_tag_admission("batch")
+            await sched.delay(0.05)
+        return True
+
+    t = sched.spawn(drive())
+    sched.run_until(t.done)
+    assert t.done.get()
+    auto = rk.auto_tag_quotas.get("batch", float("inf"))
+    assert auto >= 50.0, (
+        f"auto quota {auto} undercut the explicit set_tag_quota(50)"
+    )
+    assert rk.get_tag_quota("batch") == 50.0
+    rk.stop()
+
+
+def test_auto_tag_quota_lift_fires_probe():
+    from foundationdb_tpu.utils import probes
+
+    sched = Scheduler(sim=True)
+
+    class SeqStub:
+        class _N:
+            def __init__(self, v):
+                self.v = v
+
+            def get(self):
+                return self.v
+
+        def __init__(self):
+            self.live_committed = self._N(5000)
+
+    class SSStub:
+        def __init__(self):
+            self.version = SeqStub._N(0)
+
+    seq, ss = SeqStub(), SSStub()
+    rk = Ratekeeper(sched, seq, [ss], interval=0.05,
+                    lag_target=1000, lag_limit=10_000)
+    rk.start()
+    before = probes.snapshot().get("ratekeeper.auto_tag_lifted", 0)
+
+    async def drive():
+        for _ in range(6):
+            for _ in range(100):
+                rk.note_tag_admission("hot")
+            await sched.delay(0.05)
+        assert rk.get_tag_quota("hot") < float("inf")
+        ss.version.v = 5000  # stress clears
+        for _ in range(30):
+            await sched.delay(0.05)
+            if rk.get_tag_quota("hot") == float("inf"):
+                return True
+        return False
+
+    t = sched.spawn(drive())
+    sched.run_until(t.done)
+    assert t.done.get(), "auto quota never lifted after recovery"
+    assert probes.snapshot().get("ratekeeper.auto_tag_lifted", 0) > before
+    rk.stop()
+
+
+# ---------------------------------------------------------------------------
+# Consumer-side fail-safe + decision parity + shed retryability
+# against a real sim cluster.
+
+
+def test_dead_ratekeeper_decays_grv_budget_to_failsafe_floor():
+    sched, cluster, db = open_cluster(ClusterConfig(n_storage=1))
+    rk = cluster.ratekeeper
+    grv = cluster.grv_proxy
+    rk.stop()  # the Ratekeeper dies; its last budget was max_tps
+
+    async def probe_grvs():
+        # ~6s of virtual time: past the 1s staleness threshold plus the
+        # ~3.5s the exponential decay (tau 0.5) needs to cross from
+        # max_tps down to the failsafe floor
+        for _ in range(60):
+            txn = db.create_transaction()
+            await txn.get_read_version()
+            await sched.delay(0.1)
+
+    t = sched.spawn(probe_grvs())
+    sched.run_until(t.done)
+    # well past the staleness threshold: the front door no longer
+    # trusts the dead ratekeeper's full-speed budget
+    assert grv._budget_stale
+    assert grv._effective_tps <= rk.failsafe_tps
+    assert grv._effective_tps >= rk.min_tps
+    # restart: fresh budgets flow and the decay disengages
+    rk.start()
+    t2 = sched.spawn(probe_grvs())
+    sched.run_until(t2.done)
+    assert not grv._budget_stale
+    cluster.stop()
+
+
+def test_grv_shed_is_retryable_and_bounded():
+    """The bounded queue sheds with the retryable error; db.run backs
+    off and completes; shed requests never strand a promise."""
+    from foundationdb_tpu.cluster.grv_proxy import GrvThrottledError
+
+    sched, cluster, db = open_cluster(ClusterConfig(n_storage=1))
+    grv = cluster.grv_proxy
+    grv.max_queue = 4
+    cluster.ratekeeper.tps_budget = 20.0
+    cluster.ratekeeper.stop()  # budget frozen low; stale decay keeps it low
+
+    sheds = [0]
+
+    async def flood():
+        # 40 bare GRVs against a 4-deep queue at ~20tps: most shed
+        outcomes = []
+        for _ in range(40):
+            p = db.grv_proxy.get_read_version()
+            outcomes.append(p)
+        ok = err = 0
+        for p in outcomes:
+            try:
+                await p.future
+                ok += 1
+            except GrvThrottledError:
+                err += 1
+        sheds[0] = err
+        return ok
+
+    t = sched.spawn(flood())
+    sched.run_until(t.done)
+    assert sheds[0] > 0, "bounded queue never shed"
+    assert t.done.get() >= 1
+    assert grv.counters.get("grvShed") == sheds[0]
+
+    # and the client retry loop absorbs sheds transparently
+    async def via_run():
+        async def body(txn):
+            txn.set(b"shed-ok", b"1")
+        await db.run(body, max_retries=200)
+        t2 = db.create_transaction()
+        return await t2.get(b"shed-ok")
+
+    t3 = sched.spawn(via_run())
+    sched.run_until(t3.done)
+    assert t3.done.get() == b"1"
+    cluster.stop()
+
+
+def test_decision_parity_throttle_gates_grv_only():
+    """A transaction HOLDING a read version commits identically however
+    hard the budget is clamped: admission control delays or sheds at
+    GRV only — no resolver/commit-path coupling exists to change a
+    committed/aborted decision for admitted transactions."""
+    sched, cluster, db = open_cluster(ClusterConfig(n_storage=1))
+
+    async def body():
+        # pin read versions BEFORE the clamp
+        t_ok = db.create_transaction()
+        t_conflict = db.create_transaction()
+        await t_ok.get_read_version()
+        await t_conflict.get_read_version()
+        # writer that will conflict with t_conflict's read
+        w = db.create_transaction()
+        w.set(b"parity", b"w")
+        await w.commit()
+        # clamp the budget to the floor and starve new admissions
+        cluster.ratekeeper.stop()
+        cluster.ratekeeper.tps_budget = cluster.ratekeeper.min_tps
+        # admitted transactions still resolve EXACTLY as unthrottled:
+        t_ok.set(b"parity-ok", b"1")
+        v = await t_ok.commit()
+        assert v > 0
+        _ = await t_conflict.get(b"parity")  # conflicting read below w
+        t_conflict.set(b"parity2", b"x")
+        from foundationdb_tpu.cluster.commit_proxy import NotCommitted
+
+        try:
+            await t_conflict.commit()
+            raise AssertionError(
+                "stale read must conflict exactly as unthrottled"
+            )
+        except NotCommitted:
+            pass
+        return True
+
+    t = sched.spawn(body())
+    sched.run_until(t.done)
+    assert t.done.get()
+    cluster.stop()

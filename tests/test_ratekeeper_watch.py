@@ -28,20 +28,30 @@ def test_ratekeeper_control_law():
     sched.run_for(0.5)
     assert rk.get_rate_info() == 1000.0
 
-    # storage falls far behind the committed head -> throttled to min
+    # storage falls past the hard lag limit -> clamped to min
     seq.report_live_committed_version(10_000_000)
     sched.run_for(0.5)
     assert rk.get_rate_info() == rk.min_tps
+    assert rk.counters.get("throttled") > 0
+    assert rk.law.limited_by["name"] == "storage_server_durability_lag"
 
-    # mid-lag -> proportional budget
+    # mid-lag (over target, under the hard limit) with no admitted
+    # traffic: the multiplicative law holds the clamp — recovery only
+    # begins once the limiter RELEASES (hysteresis, not a memoryless
+    # interpolation that would flap with the sensor)
     ss.version.set(10_000_000 - 3_000_000)
     sched.run_for(0.5)
-    assert rk.min_tps < rk.get_rate_info() < 1000.0
+    assert rk.get_rate_info() < 1000.0
 
-    # catch up -> full speed again
+    # catch up -> the budget recovers through INTERMEDIATE values
+    # (bounded growth per interval, anti-windup), then reaches max
     ss.version.set(10_000_000)
-    sched.run_for(0.5)
+    sched.run_for(0.15)  # one loop: partial recovery only
+    mid = rk.get_rate_info()
+    assert rk.min_tps < mid < 1000.0
+    sched.run_for(1.5)
     assert rk.get_rate_info() == 1000.0
+    assert rk.law.limited_by["name"] == "workload"
     rk.stop()
 
 
