@@ -30,8 +30,14 @@ BENCH_CPU_BATCHES (default 4), BENCH_MODE (uniform | zipf | range —
 BASELINE.json configs 1-3), BENCH_KERNEL (tiered | classic),
 BENCH_FUSE (group size; tiered compiles ONCE for any value),
 BENCH_DELTA_CAP, BENCH_COMPACT_INTERVAL, BENCH_REPS.
+
+Flags: --profile-dir DIR captures a jax.profiler device/compile trace
+of the PRIMARY measurement phase (TensorBoard/XProf xplanes);
+--perf-ledger PATH / --no-perf control the perf-ledger row every run
+appends to perf/history.jsonl (foundationdb_tpu/utils/perf.py).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -45,6 +51,16 @@ def log(*a):
 
 
 def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--profile-dir", default=os.environ.get(
+        "BENCH_PROFILE_DIR") or None,
+        help="capture a jax.profiler trace of the primary phase here")
+    ap.add_argument("--perf-ledger", default=None,
+                    help="append the run's perf record to this JSONL "
+                         "(default: perf/history.jsonl)")
+    ap.add_argument("--no-perf", action="store_true",
+                    help="skip the perf-ledger append")
+    args = ap.parse_args()
     n_txns = int(os.environ.get("BENCH_TXNS", 65536))
     # 32-batch default (r5): the stream is long enough that per-fence
     # startup noise amortizes — measured 3.41x (32) vs 3.19x (16) on
@@ -85,10 +101,14 @@ def main():
 
     import jax
 
-    from foundationdb_tpu.utils import compile_cache
+    from foundationdb_tpu.utils import compile_cache, perf
 
     cache_dir = compile_cache.enable()
     log(f"compilation cache: {cache_dir}")
+    # the FULL device fingerprint (r10 satellite): `backend` alone made
+    # CPU-host and v5e ledger rows indistinguishable to a comparator
+    fingerprint = perf.device_fingerprint()
+    log(f"fingerprint: {fingerprint}")
 
     from foundationdb_tpu.config import KernelConfig
     from foundationdb_tpu.models.conflict_set import TpuConflictSet
@@ -276,6 +296,13 @@ def main():
         warm.prewarm_exact(dg)
     jax.block_until_ready(warm.state)
 
+    # HLO cost-model extraction (ISSUE 10): FLOPs / bytes accessed of
+    # the compiled group program, per run — hardware sessions compare
+    # achieved rate against this roofline. Warm signature => persistent
+    # compile-cache hit, so this costs deserialization, not a compile.
+    hlo_cost = warm.kernel_cost_analysis(dev_groups[0])
+    log(f"kernel HLO cost model: {hlo_cost or 'unavailable'}")
+
     # G-independence probe (opt-in: BENCH_COMPILE_PROBE=1): compile the
     # SAME kernel at extra group sizes and log the wall time per G. The
     # tiered kernel's scan body is G-independent, so the curve is ~flat
@@ -387,18 +414,24 @@ def main():
     # stream rate should approach the device-resident rate.
     latchy = config.fixpoint_latch or config.dedup_reads
     incl_samples = []
-    for _rep in range(reps):
-        cs_s = TpuConflictSet(config)
-        t0 = time.perf_counter()
-        outs_s = cs_s.resolve_stream_pipelined(batches, chunk=fuse)
-        np.asarray(outs_s[-1].verdict)  # honest fence
-        total = time.perf_counter() - t0
-        if latchy and any(
-            bool(np.asarray(o.unconverged).any()) for o in outs_s
-        ):
-            log("phase 3b: latch tripped; skipping incl-transfer sample")
-            continue
-        incl_samples.append(n_txns * n_batches / total)
+    # --profile-dir: the PRIMARY phase runs under a jax.profiler trace
+    # (device/compile timelines per dispatch — the per-device timing
+    # attribution the multi-chip shard work will need)
+    with perf.profile_trace(args.profile_dir):
+        for _rep in range(reps):
+            cs_s = TpuConflictSet(config)
+            t0 = time.perf_counter()
+            outs_s = cs_s.resolve_stream_pipelined(batches, chunk=fuse)
+            np.asarray(outs_s[-1].verdict)  # honest fence
+            total = time.perf_counter() - t0
+            if latchy and any(
+                bool(np.asarray(o.unconverged).any()) for o in outs_s
+            ):
+                log("phase 3b: latch tripped; skipping incl-transfer sample")
+                continue
+            incl_samples.append(n_txns * n_batches / total)
+    if args.profile_dir:
+        log(f"jax.profiler trace captured in {args.profile_dir}")
     incl_rate = med(incl_samples) if incl_samples else 0.0
     log(f"PRIMARY incl-transfer pipelined (pack->copy->compute overlap): "
         f"{incl_rate:,.0f} txn/s ({len(incl_samples)} reps, "
@@ -517,51 +550,61 @@ def main():
             log(f"small-batch n={n_small}: {small[str(n_small)]}")
 
     suffix = "" if mode == "uniform" else f"_{mode}"
-    print(
-        json.dumps(
-            {
-                "metric": f"resolver_txns_per_sec_{n_txns // 1024}k_batch{suffix}",
-                # PRIMARY (r6, VERDICT r5 task 2): the transfer-inclusive
-                # pipelined rate — pack + host->device copy + kernel,
-                # overlapped. The r3-r5 primary (device-resident) ships
-                # as device_resident_txn_s; "staging": "pipelined" marks
-                # the methodology switch (BASELINE.md note).
-                "value": round(incl_rate, 1),
-                "unit": "txn/s",
-                "vs_baseline": round(incl_rate / cpu_rate, 3),
-                "baseline": cpu_name,
-                "baseline_txns_per_sec": round(cpu_rate, 1),
-                "reps": reps,
-                "baseline_spread": [
-                    round(min(cpu_samples[cpu_name]), 1),
-                    round(max(cpu_samples[cpu_name]), 1),
-                ],
-                "device_resident_txn_s": round(dev_rate, 1),
-                "device_resident_vs_baseline": round(dev_rate / cpu_rate, 3),
-                "device_spread": [
-                    round(min(dev_samples), 1),
-                    round(max(dev_samples), 1),
-                ],
-                "incl_spread": [
-                    round(min(incl_samples), 1),
-                    round(max(incl_samples), 1),
-                ] if incl_samples else [],
-                "staging": "pipelined",
-                "backend": jax.default_backend(),
-                "kernel": kernel,
-                "delta_capacity": config.delta_capacity,
-                "dedup_reads": config.dedup_reads,
-                "compact_interval": config.compact_interval,
-                "fused_dispatch": fuse,
-                "batches": n_batches,
-                "p50_ms": round(p50 * 1e3, 1),
-                "p99_ms": round(p99 * 1e3, 1),
-                "p50_incl_transfer_ms": round(p50_h * 1e3, 1),
-                "ablation": ledger,
-                **({"small_batch": small} if small else {}),
-            }
-        )
-    )
+    cc_stats = compile_cache.stats()
+    row = {
+        "metric": f"resolver_txns_per_sec_{n_txns // 1024}k_batch{suffix}",
+        # PRIMARY (r6, VERDICT r5 task 2): the transfer-inclusive
+        # pipelined rate — pack + host->device copy + kernel,
+        # overlapped. The r3-r5 primary (device-resident) ships
+        # as device_resident_txn_s; "staging": "pipelined" marks
+        # the methodology switch (BASELINE.md note).
+        "value": round(incl_rate, 1),
+        "unit": "txn/s",
+        "vs_baseline": round(incl_rate / cpu_rate, 3),
+        "baseline": cpu_name,
+        "baseline_txns_per_sec": round(cpu_rate, 1),
+        "reps": reps,
+        "baseline_spread": [
+            round(min(cpu_samples[cpu_name]), 1),
+            round(max(cpu_samples[cpu_name]), 1),
+        ],
+        "device_resident_txn_s": round(dev_rate, 1),
+        "device_resident_vs_baseline": round(dev_rate / cpu_rate, 3),
+        "device_spread": [
+            round(min(dev_samples), 1),
+            round(max(dev_samples), 1),
+        ],
+        "incl_spread": [
+            round(min(incl_samples), 1),
+            round(max(incl_samples), 1),
+        ] if incl_samples else [],
+        "staging": "pipelined",
+        "backend": jax.default_backend(),
+        # full device fingerprint (kind/count/jaxlib): without
+        # it CPU-host and v5e rows are indistinguishable to the
+        # perfcheck comparator
+        "device": fingerprint,
+        "compile_cache": cc_stats,
+        "hlo_cost": hlo_cost,
+        "kernel": kernel,
+        "delta_capacity": config.delta_capacity,
+        "dedup_reads": config.dedup_reads,
+        "compact_interval": config.compact_interval,
+        "fused_dispatch": fuse,
+        "batches": n_batches,
+        "p50_ms": round(p50 * 1e3, 1),
+        "p99_ms": round(p99 * 1e3, 1),
+        "p50_incl_transfer_ms": round(p50_h * 1e3, 1),
+        "ablation": ledger,
+        **({"small_batch": small} if small else {}),
+    }
+    print(json.dumps(row))
+    # the canonical perf-ledger row (utils/perf.py): the printed JSON
+    # stays the human/driver view; the ledger is what perfcheck gates
+    if not args.no_perf:
+        rec = perf.bench_row_to_record(row, fingerprint=fingerprint)
+        path = perf.append(rec, path=args.perf_ledger)
+        log(f"perf ledger row appended to {path}")
 
 
 if __name__ == "__main__":

@@ -347,9 +347,17 @@ class ResolverRole:
         # blocking compute keeps arrivals out of the parked count.
         self.occupancy = TimerSmoother(2.0)
         if backend == "native":
+            from foundationdb_tpu.models.conflict_set import (
+                KernelStageMetrics,
+            )
             from foundationdb_tpu.native import NativeSkipListConflictSet
 
             self._cs = NativeSkipListConflictSet(window=window)
+            # the native skip list has no stage split, but the kernel
+            # panel must still render (fdbtop pins it): compute seconds
+            # land in the "kernel" stage and the compile-cache counters
+            # are process-global anyway
+            self._kernel_metrics = KernelStageMetrics()
         elif backend in ("cpu", "tpu", "tpu-force"):
             from foundationdb_tpu.config import KernelConfig
             from foundationdb_tpu.models.conflict_set import make_conflict_set
@@ -363,7 +371,14 @@ class ResolverRole:
                 history_capacity=1 << 16,
                 window_versions=window,
             ) if not cfg_env else eval(cfg_env)  # noqa: S307 (operator-supplied)
+            from foundationdb_tpu.models.conflict_set import (
+                KernelStageMetrics,
+            )
+
             self._cs = make_conflict_set(kcfg, backend)
+            self._kernel_metrics = (
+                getattr(self._cs, "metrics", None) or KernelStageMetrics()
+            )
             self._warm_compile(kcfg, backend)
         else:
             raise ValueError(f"unknown resolver backend {backend!r}")
@@ -400,6 +415,13 @@ class ResolverRole:
         if metrics is not None:
             metrics.compile.sample(dt)
             metrics.counters.add("warmCompiles")
+        # per-signature compile seconds in the process-global compile
+        # observability block (utils/compile_cache.stats)
+        from foundationdb_tpu.utils import compile_cache as _cc
+
+        _cc.record_compile(
+            f"resolver_warm/{backend}/txns={kcfg.max_txns}", dt
+        )
         from foundationdb_tpu.utils.trace import SEV_INFO, TraceEvent
 
         TraceEvent("ResolverWarmCompile", severity=SEV_INFO).detail(
@@ -481,7 +503,12 @@ class ResolverRole:
 
     def _resolve_now(self, req) -> ResolveTransactionBatchReply:
         if self._backend == "native":
+            import time as _time
+
+            t0 = _time.perf_counter()
             verdicts = self._cs.resolve(req.transactions, req.version)
+            self._kernel_metrics.kernel.sample(_time.perf_counter() - t0)
+            self._kernel_metrics.counters.add("resolveBatches")
             committed = [TransactionResult(int(v)) for v in verdicts]
             ckr: dict[int, list[int]] = {}
         else:
@@ -507,9 +534,11 @@ class ResolverRole:
             "compute_time_dist": self.compute_time.as_dict(),
             "resolver_latency_dist": self.resolver_latency.as_dict(),
         }
-        metrics = getattr(self._cs, "metrics", None)
-        if metrics is not None:
-            qos["kernel"] = metrics.qos()
+        # the kernel panel is ALWAYS present (fdbtop pins it): jitted
+        # backends report their conflict set's stage metrics, native
+        # the role-owned block (compute seconds + process-global
+        # compile-cache counters)
+        qos["kernel"] = self._kernel_metrics.qos()
         return {
             "role": "resolver",
             "version": self.version,

@@ -270,6 +270,12 @@ def _merge_bands(bands_list) -> dict[str, int]:
     return out
 
 
+def _compile_cache_section() -> dict[str, Any]:
+    from foundationdb_tpu.utils import compile_cache
+
+    return compile_cache.stats()
+
+
 def _kernel_section(resolver) -> dict[str, Any]:
     cs = resolver.conflict_set
     metrics = getattr(cs, "metrics", None)
@@ -370,6 +376,11 @@ def cluster_status(cluster) -> dict[str, Any]:
                 f"resolver{r.resolver_id}": _kernel_section(r)
                 for r in cluster.resolvers
             },
+            # process-global compile observability (ISSUE 10): the
+            # persistent-cache hit/miss counters, backend-compile
+            # seconds, and per-signature compile times — the "why did
+            # that batch stall" panel for cold-jit pathologies
+            "compile_cache": _compile_cache_section(),
             "processes": {},
         }
     }

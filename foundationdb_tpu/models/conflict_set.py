@@ -89,12 +89,36 @@ class KernelStageMetrics:
         # sampled at the overflow-check syncs (no extra device fences)
         self.delta_occupancy = LatencySample("deltaLiveBoundaries")
         self.main_occupancy = LatencySample("mainLiveBoundaries")
+        # device-memory gauges (ISSUE 10): live-buffer + peak bytes on
+        # the dispatch device, sampled on the same overflow-check syncs
+        # (no extra fences); zero on backends that don't report (CPU)
+        self.device_bytes_in_use = 0
+        self.device_peak_bytes = 0
+
+    def sample_device_memory(self, device=None) -> None:
+        """Pull the device allocator's live/peak byte gauges — called
+        from the overflow-check sync the resolve paths already pay,
+        with the DISPATCH device (where the history state lives): on a
+        multi-device host, device 0's allocator says nothing about an
+        impending OOM on the device actually resolving batches.
+        Host-dependent values: they feed status/qos readers only, never
+        a CounterCollection the deterministic trace flush ships."""
+        from foundationdb_tpu.utils import perf as _perf
+
+        stats = _perf.device_memory_stats(device)
+        if stats:
+            self.device_bytes_in_use = stats.get("bytes_in_use", 0)
+            self.device_peak_bytes = max(
+                self.device_peak_bytes, stats.get("peak_bytes_in_use", 0)
+            )
 
     def as_dict(self) -> dict:
         out: dict = dict(self.counters.as_dict())
         for s in (self.compile, self.pack, self.transfer, self.kernel,
                   self.fence, self.delta_occupancy, self.main_occupancy):
             out[s.name] = s.as_dict()
+        out["deviceBytesInUse"] = self.device_bytes_in_use
+        out["devicePeakBytes"] = self.device_peak_bytes
         return out
 
     def qos(self) -> dict:
@@ -103,18 +127,36 @@ class KernelStageMetrics:
         per-dispatch cost the tpu-force p99 backup rides on), the share
         of resolve wall time inside the device stages, and tier fill —
         one small dict, not the full stage-sample dump (as_dict)."""
+        from foundationdb_tpu.utils import compile_cache as _cc
+
         batches = self.counters.get("resolveBatches")
         stage_total = (
             self.pack.total + self.transfer.total + self.kernel.total
             + self.fence.total
         )
+        cc = _cc.stats()
         return {
             "batches": batches,
             "kernel_seconds_per_batch": (
                 stage_total / batches if batches else 0.0
             ),
             "kernel_p99_seconds": self.kernel.quantile(0.99),
+            # per-stage p99s (the fdbtop kernel panel's columns)
+            "stage_p99_seconds": {
+                "pack": self.pack.quantile(0.99),
+                "transfer": self.transfer.quantile(0.99),
+                "kernel": self.kernel.quantile(0.99),
+                "fence": self.fence.quantile(0.99),
+            },
             "compile_seconds": self.compile.total,
+            # compile-cache observability (utils/compile_cache.py —
+            # process-global: the XLA compiler and its cache are too)
+            "compile_cache_hits": cc["cache_hits"],
+            "compile_cache_misses": cc["cache_misses"],
+            "last_compile_seconds": cc["last_compile_seconds"],
+            # device-memory gauges from the overflow-check syncs
+            "device_bytes_in_use": self.device_bytes_in_use,
+            "device_peak_bytes": self.device_peak_bytes,
             "delta_occupancy": self.delta_occupancy.max or 0.0,
             "main_occupancy": self.main_occupancy.max or 0.0,
             "compactions": self.counters.get("compactions"),
@@ -643,6 +685,41 @@ class TpuConflictSet:
         )
         jax.block_until_ready(outs.verdict)
 
+    def _state_device(self):
+        """The device holding the history state (= the dispatch
+        device); None when it can't be read (host numpy state, exotic
+        shardings) — device_memory_stats then falls back to device 0."""
+        leaf = (
+            self.state.main.overflow if self.tiered else self.state.overflow
+        )
+        try:
+            devices = leaf.devices()
+            return next(iter(devices)) if len(devices) == 1 else None
+        except Exception:
+            return None
+
+    def kernel_cost_analysis(self, stacked_args) -> dict:
+        """HLO cost-model extraction (utils/perf.cost_analysis_of) for
+        the group program this instance would dispatch on
+        `stacked_args`: FLOPs / bytes accessed per compiled resolver
+        kernel, recorded per bench run so hardware sessions can compare
+        achieved rates against the roofline. Lower+compile of a warm
+        signature is a persistent-cache hit, so this costs
+        de/serialization, not a compile. Empty dict on any failure."""
+        from foundationdb_tpu.utils import perf as _perf
+
+        cfg = self.config
+        ssl = getattr(cfg, "short_span_limit", 0)
+        unroll = getattr(cfg, "fixpoint_unroll", 3)
+        latch = getattr(cfg, "fixpoint_latch", False)
+        if self.tiered:
+            fn = _resolve_tiered_jit(
+                ssl, unroll, latch, getattr(cfg, "dedup_reads", 0)
+            )
+        else:
+            fn = _resolve_group_jit(ssl, unroll, latch)
+        return _perf.cost_analysis_of(fn, self.state, stacked_args)
+
     def _maybe_check_overflow(self) -> None:
         self._batches_since_check += 1
         if self._batches_since_check >= OVERFLOW_CHECK_INTERVAL:
@@ -664,6 +741,10 @@ class TpuConflictSet:
             self.metrics.delta_occupancy.sample(float(np.asarray(d_cnt)))
         else:
             tripped = bool(np.asarray(self.state.overflow))
+        # device-memory gauges ride the same sync (allocator stats are
+        # a host call, no fence; CPU backends report nothing and skip),
+        # sampled on the device holding the history state
+        self.metrics.sample_device_memory(self._state_device())
         if tripped:
             self._raise_overflow()
 

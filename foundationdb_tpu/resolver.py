@@ -169,6 +169,12 @@ class Resolver:
             self.conflict_set = None  # routed at first resolve
         else:
             self.conflict_set = make_conflict_set(config, backend)
+        # kernel-panel fallback (the wire ResolverRole owns the same
+        # shape): an unrouted or metrics-less conflict set still
+        # reports a zeroed qos.kernel block — REQUIRED_SENSORS pins it
+        from foundationdb_tpu.models.conflict_set import KernelStageMetrics
+
+        self._fallback_kernel_metrics = KernelStageMetrics()
         self.version = Notified(init_version)
         self.needed_version = Notified(-(2**62))
         self.check_needed_version = Trigger()
@@ -591,9 +597,15 @@ class Resolver:
                 if self.state_memory_limit else 0.0
             ),
         }
-        metrics = getattr(self.conflict_set, "metrics", None)
-        if metrics is not None:
-            out["kernel"] = metrics.qos()
+        # kernel panel: ALWAYS present so fdbtop/REQUIRED_SENSORS can
+        # pin it — an unrouted or metrics-less backend reports the
+        # zeroed fallback (which still carries the process-global
+        # compile-cache counters), never a missing key
+        metrics = (
+            getattr(self.conflict_set, "metrics", None)
+            or self._fallback_kernel_metrics
+        )
+        out["kernel"] = metrics.qos()
         return out
 
     # -- balancer endpoints (ResolverInterface metrics/split) -------------

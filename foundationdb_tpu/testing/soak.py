@@ -126,6 +126,26 @@ def plan_for_seed(seed: int, spec=None) -> SeedPlan:
     return SeedPlan(**derive_plan_fields(seed, spec))
 
 
+def signature_metrics(sig: tuple) -> dict:
+    """Name the positional fields of a run_seed signature tuple that
+    feed observability (the perf ledger's soak rows and scripts/
+    soak.py's progress lines — one decoder instead of magic indices).
+    `traced` entries are present only on trace=True runs."""
+    out = {
+        "seed": sig[0],
+        "committed": sig[1],
+        "aborted": sig[2],
+        "read_checks": sig[3],
+        "virtual_seconds": sig[4],
+        "epoch": sig[5],
+        "api": sig[7],
+    }
+    if len(sig) > 8:
+        out["trace_digest"] = sig[8]
+        out["traced_commits"] = sig[9]
+    return out
+
+
 def run_seed(seed: int, spec=None, collect_probes: bool = False,
              _inject_fault=None, _corrupt_api: bool = False,
              perturb: int = 0, _inject_race: bool = False,

@@ -10,6 +10,11 @@ drops that to de/serialization time.
 from __future__ import annotations
 
 import os
+import threading
+
+from foundationdb_tpu.utils.probes import code_probe, declare
+
+declare("perf.compile_cache_miss")
 
 _BASE = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))), ".jax_compile_cache")
@@ -50,7 +55,9 @@ def enable(path: str | None = None) -> str:
     """Turn on the persistent compilation cache; returns the cache dir.
 
     Safe to call multiple times and before/after backend init (the cache
-    is consulted at compile time, not backend-init time).
+    is consulted at compile time, not backend-init time). Also arms the
+    compile-observability listeners (`instrument()`), so every enabled
+    process carries hit/miss counters and compile seconds in `stats()`.
     """
     import jax
 
@@ -61,4 +68,98 @@ def enable(path: str | None = None) -> str:
     # over the default thresholds anyway, and tiny entries are harmless.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    instrument()
     return path
+
+
+# ---------------------------------------------------------------------------
+# Compile observability (ISSUE 10): JAX emits monitoring events for
+# persistent-cache hits/misses and backend-compile durations; this
+# module aggregates them into one process-global stats block that
+# KernelStageMetrics.qos() / cluster_status() / the perf ledger read.
+# Process-global on purpose — the XLA compiler and its cache are too.
+# These counters are wall-clock/host-dependent and deliberately stay
+# OUT of every CounterCollection the deterministic trace flush ships.
+
+_stats_lock = threading.Lock()
+_stats = {
+    "cache_hits": 0,
+    "cache_misses": 0,
+    "backend_compiles": 0,
+    "compile_seconds_total": 0.0,
+    "last_compile_seconds": 0.0,
+}
+#: explicit per-signature compile seconds (warm-compile paths that know
+#: what they compiled record here; the monitoring listener only knows
+#: durations, not signatures)
+_signatures: dict[str, float] = {}
+_instrumented = False
+
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+
+def _on_event(event: str, *a, **kw) -> None:
+    if event == _HIT_EVENT:
+        with _stats_lock:
+            _stats["cache_hits"] += 1
+    elif event == _MISS_EVENT:
+        with _stats_lock:
+            _stats["cache_misses"] += 1
+        code_probe(True, "perf.compile_cache_miss")
+
+
+def _on_duration(event: str, duration: float, *a, **kw) -> None:
+    if event.endswith("backend_compile_duration"):
+        with _stats_lock:
+            _stats["backend_compiles"] += 1
+            _stats["compile_seconds_total"] += float(duration)
+            _stats["last_compile_seconds"] = float(duration)
+
+
+def instrument() -> bool:
+    """Register the jax.monitoring listeners (idempotent). Returns
+    whether the listeners are armed — an older/newer JAX without the
+    monitoring API degrades to zeros, never an error."""
+    global _instrumented
+    if _instrumented:
+        return True
+    try:
+        from jax import monitoring
+
+        # resolve BOTH registrars before registering either: failing
+        # between the two would leave _instrumented False and a later
+        # enable() would register _on_event twice (double counts)
+        reg = monitoring.register_event_listener
+        reg_duration = monitoring.register_event_duration_secs_listener
+    except Exception:
+        return False
+    _instrumented = True  # before the calls: never re-register
+    reg(_on_event)
+    reg_duration(_on_duration)
+    return True
+
+
+def record_compile(signature: str, seconds: float) -> None:
+    """Per-signature compile seconds, recorded by the code paths that
+    know WHAT they compiled (ResolverRole warm compile, bench warm
+    loops). Keeps the most recent duration per signature."""
+    with _stats_lock:
+        _signatures[signature] = float(seconds)
+
+
+def stats() -> dict:
+    """One snapshot: cache hit/miss counters, backend-compile count and
+    seconds, and the per-signature compile-seconds map."""
+    with _stats_lock:
+        out = dict(_stats)
+        out["per_signature_compile_seconds"] = dict(_signatures)
+    return out
+
+
+def reset_stats() -> None:
+    """Test hook: zero the process-global counters."""
+    with _stats_lock:
+        for k in _stats:
+            _stats[k] = 0.0 if isinstance(_stats[k], float) else 0
+        _signatures.clear()

@@ -410,6 +410,11 @@ def main():
                          "\"ok\" AND >=1 complete cross-process "
                          "commit_debug timeline reconstructed")
     ap.add_argument("--json-out", default=None)
+    ap.add_argument("--perf-ledger", default=None,
+                    help="append the run's perf-ledger rows here "
+                         "(default: perf/history.jsonl)")
+    ap.add_argument("--no-perf", action="store_true",
+                    help="skip the perf-ledger append")
     ap.add_argument("--socket-dir", default=None,
                     help="wire mode: pin role sockets to this dir so an "
                          "external fdbtop can poll them mid-run")
@@ -444,6 +449,13 @@ def main():
             import tempfile as _tf
 
             args.trace_dir = _tf.mkdtemp(prefix="bench_pipe_smoke_")
+        if not args.perf_ledger and "FDBTPU_PERF_LEDGER" not in os.environ:
+            # smoke rows are still emitted (schema-valid, gate-checked
+            # by tests) but land next to the trace files, not in the
+            # committed history — a green CI run must not dirty it
+            args.perf_ledger = os.path.join(
+                args.trace_dir, "perf_smoke.jsonl"
+            )
     if args.spec5:
         args.mode = "wire"
         args.clients = 256 * 1024
@@ -481,6 +493,27 @@ def main():
     if args.json_out:
         with open(args.json_out, "a") as f:
             f.write(json.dumps(row) + "\n")
+    if not args.no_perf:
+        # canonical perf-ledger rows (one per backend), same converter
+        # the historical-artifact importer uses so fingerprint keys line
+        # up across PIPELINE_r0*.json and fresh runs
+        from foundationdb_tpu.utils import perf
+
+        fp = perf.device_fingerprint()
+        for rec in perf.pipeline_row_to_records(row, fingerprint=None):
+            # fingerprint.backend stays the RESOLVER backend (also in
+            # the workload key), but the HOST device identity — device
+            # kind/count, jax/jaxlib — must be real: without it a
+            # tpu-force wire run on a CPU laptop and one on a v5e
+            # would share a hardware comparability key
+            rec["fingerprint"].update(
+                {k: fp[k] for k in ("device_kind", "device_count",
+                                    "jax_version", "jaxlib_version",
+                                    "python_version", "machine")}
+            )
+            path = perf.append(rec, path=args.perf_ledger)
+        print(f"[perf] {len(results)} ledger row(s) appended to {path}",
+              flush=True)
     if args.smoke:
         bad = [
             b for b, r in results.items()

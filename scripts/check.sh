@@ -26,9 +26,19 @@ awk -v a="$t0" -v b="$t1" 'BEGIN {printf "flowcheck wall time: %.1fs\n", b - a}'
 echo "== kernel-parity smoke (tiny shapes: classic + tiered + dedup    =="
 echo "== fallback vs the Python oracle — seconds, compile-bound)       =="
 t0=$(date +%s.%N)
-JAX_PLATFORMS=cpu python scripts/kernel_smoke.py
+perf_row=$(mktemp /tmp/perfcheck_row.XXXXXX.jsonl)
+JAX_PLATFORMS=cpu python scripts/kernel_smoke.py --perf-out "$perf_row"
 t1=$(date +%s.%N)
 awk -v a="$t0" -v b="$t1" 'BEGIN {printf "kernel smoke wall time: %.1fs\n", b - a}'
+
+echo "== perf regression gate (the kernel_smoke structural row vs the  =="
+echo "== committed perf/history.jsonl baseline — exact compare,        =="
+echo "== exit-code enforced; see scripts/perfcheck.py)                 =="
+t0=$(date +%s.%N)
+JAX_PLATFORMS=cpu python scripts/perfcheck.py --check "$perf_row" --tier structural
+rm -f "$perf_row"
+t1=$(date +%s.%N)
+awk -v a="$t0" -v b="$t1" 'BEGIN {printf "perfcheck wall time: %.1fs\n", b - a}'
 
 echo "== spec + perturbation smoke (1 short seed per spec, then the same =="
 echo "== seed x 3 schedule perturbations, api workload + auditor on)    =="

@@ -66,8 +66,11 @@ HEADLINE = {
 REQUIRED_SENSORS = {
     "log": ("queue_bytes", "smoothed_queue_bytes", "input_bytes_per_s"),
     "storage": ("version_lag_versions", "input_bytes_per_s"),
+    # "kernel" is the r10 kernel panel: compile-cache hits/misses, last
+    # compile seconds, stage p99s (KernelStageMetrics.qos()) — present
+    # on EVERY resolver backend, native included
     "resolver": ("queue_depth", "queue_wait_dist", "compute_time_dist",
-                 "occupancy"),
+                 "occupancy", "kernel"),
     "commit_proxy": ("queued_requests", "inflight_batches", "batch_sizer"),
     "grv_proxy": ("queued_requests", "sheds", "budget_stale"),
     "ratekeeper": ("transactions_per_second_limit", "budget_limited_by",
@@ -212,12 +215,19 @@ def _row_metrics(role: str, block: dict) -> list[tuple[str, object]]:
             ("keys", q.get("keys", block.get("keys", 0))),
         ]
     if role == "resolver":
+        # the kernel panel: cache hit/miss + last compile seconds catch
+        # a cold-jit stall the moment it happens; the stage p99s say
+        # WHERE resolve wall time goes (pack/transfer/kernel/fence)
         k = q.get("kernel") or {}
+        stage = k.get("stage_p99_seconds") or {}
         return [
             ("occ", q.get("occupancy", 0.0)),
             ("qwait p99", q.get("queue_wait_dist", {}).get("p99", 0.0)),
-            ("compute p99", q.get("compute_time_dist", {}).get("p99", 0.0)),
-            ("kern s/b", k.get("kernel_seconds_per_batch", 0.0)),
+            ("kern p99", stage.get("kernel", 0.0)),
+            ("fence p99", stage.get("fence", 0.0)),
+            ("cc h/m", f"{k.get('compile_cache_hits', 0)}/"
+                       f"{k.get('compile_cache_misses', 0)}"),
+            ("compile s", k.get("last_compile_seconds", 0.0)),
         ]
     if role == "commit_proxy":
         bs = q.get("batch_sizer", {})

@@ -12,6 +12,7 @@ the deep adversarial coverage lives in the kernel parity lane
 (pytest -m kernel).
 """
 
+import argparse
 import os
 import sys
 import time
@@ -23,6 +24,15 @@ import numpy as np
 
 
 def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--perf-out", default=None,
+        help="emit the run's STRUCTURAL perf-ledger row (decision "
+             "counts, kernel counters, merge-row capacities — all "
+             "deterministic on any host) to this JSONL; the check.sh "
+             "perf lane feeds it to scripts/perfcheck.py",
+    )
+    args = ap.parse_args()
     t_start = time.perf_counter()
     from foundationdb_tpu.config import KernelConfig
     from foundationdb_tpu.models.conflict_set import (
@@ -95,10 +105,76 @@ def main() -> int:
     if failures:
         print(f"kernel smoke: {failures} FAILURES")
         return 1
+    if args.perf_out:
+        _emit_perf_row(args.perf_out, sets, want, tiered)
     print(f"kernel smoke: OK — {len(sets)} kernel paths x {n} batches "
           f"decision-identical to the oracle "
           f"({time.perf_counter() - t_start:.1f}s)")
     return 0
+
+
+def _emit_perf_row(path: str, sets: dict, want, tiered_cfg) -> None:
+    """The structural ledger row the check.sh perf lane gates on: every
+    value is deterministic given the seeded stream and tiny shapes —
+    decision counts protect commit/abort parity, the kernel counters
+    protect the dispatch/compaction/fallback structure, and the
+    merge-row capacities protect the r6 tiered design's working-set
+    math. A doubled merge-row count or a flipped verdict here fails
+    scripts/perfcheck.py before any hardware ever re-measures."""
+    from foundationdb_tpu.models.types import TransactionResult
+    from foundationdb_tpu.utils import perf
+
+    nrw = tiered_cfg.max_reads + tiered_cfg.max_writes
+    committed = sum(
+        sum(1 for v in r.verdicts if v == TransactionResult.COMMITTED)
+        for r in want
+    )
+    conflicted = sum(
+        sum(1 for v in r.verdicts if v == TransactionResult.CONFLICT)
+        for r in want
+    )
+    metrics = {
+        "committed": perf.metric(committed, "txns", "higher",
+                                 tier="structural"),
+        "conflicted": perf.metric(conflicted, "txns", "lower",
+                                  tier="structural"),
+        "merge_rows_tiered_cap": perf.metric(
+            tiered_cfg.delta_capacity + 2 * nrw, "rows", "lower",
+            tier="structural",
+        ),
+        "merge_rows_classic_cap": perf.metric(
+            tiered_cfg.history_capacity + 2 * nrw, "rows", "lower",
+            tier="structural",
+        ),
+    }
+    tags = {"classic": "classic", "tiered+dedup": "tiered_dedup",
+            "tiered(dedup-latch-fallback)": "dedup_latch"}
+    for name, cs in sets.items():
+        tag = tags.get(name, name.split("(")[0].replace("+", "_"))
+        c = cs.metrics.counters
+        metrics[f"{tag}_batches"] = perf.metric(
+            c.get("resolveBatches"), "count", "higher", tier="structural"
+        )
+        metrics[f"{tag}_compactions"] = perf.metric(
+            c.get("compactions"), "count", "lower", tier="structural"
+        )
+        metrics[f"{tag}_fallbacks"] = perf.metric(
+            c.get("latchTrips") + c.get("exactFallbacks"), "count",
+            "lower", tier="structural",
+        )
+    rec = perf.make_record(
+        "kernel_smoke", metrics,
+        workload={"batches": len(want), "txns_per_batch": 6,
+                  "paths": sorted(sets)},
+        knobs={"delta_capacity": tiered_cfg.delta_capacity,
+               "dedup_reads": tiered_cfg.dedup_reads,
+               "compact_interval": tiered_cfg.compact_interval},
+        # structural rows compare across hosts by design: the
+        # fingerprint records WHERE the row came from, the comparator
+        # keys on (source, workload, knobs) only
+    )
+    perf.append(rec, path=path)
+    print(f"kernel smoke: structural perf row -> {path}")
 
 
 if __name__ == "__main__":

@@ -42,6 +42,11 @@ def main() -> int:
     ap.add_argument("--spec", default="saturation")
     ap.add_argument("--json-out", default=None,
                     help="append both reports as JSON lines")
+    ap.add_argument("--perf-ledger", default=None,
+                    help="append the perf-ledger rows here "
+                         "(default: perf/history.jsonl)")
+    ap.add_argument("--no-perf", action="store_true",
+                    help="skip the perf-ledger append")
     args = ap.parse_args()
     quick = not args.full
 
@@ -84,6 +89,30 @@ def main() -> int:
         with open(args.json_out, "a") as f:
             for rep in reports:
                 f.write(json.dumps(rep) + "\n")
+    if not args.no_perf:
+        # canonical perf-ledger rows, one per admission direction: the
+        # ramp runs on the deterministic virtual clock, so every metric
+        # is structural (exact-compared by perfcheck). Same converter
+        # the SATURATION_r08.json importer uses. Smoke (quick) runs
+        # emit to a tempfile unless a ledger is named — the check.sh
+        # lane must not dirty the committed history on green runs.
+        from foundationdb_tpu.utils import perf
+
+        if (quick and not args.perf_ledger
+                and "FDBTPU_PERF_LEDGER" not in os.environ):
+            import tempfile
+
+            args.perf_ledger = os.path.join(
+                tempfile.mkdtemp(prefix="saturation_perf_"),
+                "history.jsonl",
+            )
+        host_fp = perf.device_fingerprint()
+        for rep in reports:
+            # (quick vs full ramps key apart naturally: the workload
+            # carries the ramp list + step seconds)
+            rec = perf.saturation_report_to_record(rep, fingerprint=host_fp)
+            path = perf.append(rec, path=args.perf_ledger)
+        print(f"[perf] {len(reports)} ledger row(s) appended to {path}")
     print("saturation gate ok" if rc == 0 else "saturation gate FAILED")
     return rc
 

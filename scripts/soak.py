@@ -90,9 +90,41 @@ def _one(args):
     return seed, sig, time.perf_counter() - t0, check_determinism, hits
 
 
+def _emit_perf_row(spec_name: str, seeds: list, perturb: int,
+                   totals: dict, traced_commits: int) -> None:
+    """One canonical perf-ledger row for a traced sweep (utils/perf.py):
+    outcome totals across a FIXED (spec, seed set, perturb) plan are
+    deterministic, so they land in the structural tier and perfcheck
+    exact-compares them — a traced sweep whose committed/aborted totals
+    drift without a spec change is a behavior change, not noise."""
+    from foundationdb_tpu.utils import perf
+
+    metrics = {
+        name: perf.metric(v, "count", direction, tier="structural")
+        for name, v, direction in (
+            ("committed", totals["committed"], "higher"),
+            ("aborted", totals["aborted"], "lower"),
+            ("read_checks", totals["read_checks"], "higher"),
+            ("api_acked", totals["api_acked"], "higher"),
+            ("traced_commits", traced_commits, "higher"),
+        )
+    }
+    rec = perf.emit(
+        "soak", metrics,
+        workload={
+            "spec": spec_name,
+            "seeds": [seeds[0], seeds[-1]] if seeds else [],
+            "n_seeds": len(seeds),
+            "perturb": perturb,
+        },
+    )
+    print(f"[perf] soak ledger row appended "
+          f"(committed={rec['metrics']['committed']['value']})")
+
+
 def sweep(spec_name: str, seeds: list, jobs: int, probe_gate: bool,
           perturb: int = 0, trace: bool = False,
-          status_probe: bool = False) -> int:
+          status_probe: bool = False, inline: bool = False) -> int:
     """Run one spec's seed sweep; returns the number of failures."""
     from foundationdb_tpu.testing.spec import load_spec
     from foundationdb_tpu.utils import probes as _probes
@@ -107,7 +139,13 @@ def sweep(spec_name: str, seeds: list, jobs: int, probe_gate: bool,
     failures = []
     done = 0
     committed = aborted = rechecks = det_checked = 0
-    api_acked = api_reads = 0
+    api_acked = api_reads = traced_commits = 0
+    # per-seed probe snapshots aggregate LOCALLY, not straight into the
+    # probes global: inline (--profile-dir) mode runs run_seed in THIS
+    # process, and each seed's collect_probes reset would wipe whatever
+    # an eager merge had accumulated (pool mode resets only workers).
+    # The local total folds into the global once, after the last seed.
+    probe_agg: dict = {}
     # Worker RSS grows across seeds (~20GB by seed ~2000 once the
     # backup workload added a second cluster per seed), so workers must
     # recycle. max_tasks_per_child forces the SPAWN context, whose
@@ -115,20 +153,57 @@ def sweep(spec_name: str, seeds: list, jobs: int, probe_gate: bool,
     # CHUNK instead: a fresh fork-context pool every 400 seeds bounds
     # worker lifetime with no start-method change.
     CHUNK = 400
+
+    class _InlineFuture:
+        """Run one work item in THIS process (--profile-dir: a worker
+        pool's device activity is invisible to the parent's jax
+        profiler). Same .result() surface as the pool future."""
+
+        def __init__(self, w):
+            try:
+                self._result, self._err = _one(w), None
+            except Exception as e:  # surfaced via result(), like a pool
+                self._result, self._err = None, e
+
+        def result(self):
+            if self._err is not None:
+                raise self._err
+            return self._result
+
+    import contextlib
+
     for lo in range(0, len(work), CHUNK):
-        with ProcessPoolExecutor(max_workers=jobs) as pool:
-            futs = {pool.submit(_one, w): w[0] for w in work[lo:lo + CHUNK]}
-            for fut in as_completed(futs):
-                seed = futs[fut]
+        with (contextlib.nullcontext() if inline
+              else ProcessPoolExecutor(max_workers=jobs)) as pool:
+            if inline:
+                # a LAZY generator: each seed runs as the loop reaches
+                # it, so progress lines stay live and a crash surfaces
+                # immediately instead of after the whole chunk
+                pairs = (
+                    (_InlineFuture(w), w[0]) for w in work[lo:lo + CHUNK]
+                )
+            else:
+                futs = {
+                    pool.submit(_one, w): w[0] for w in work[lo:lo + CHUNK]
+                }
+                pairs = ((f, futs[f]) for f in as_completed(futs))
+            for fut, seed in pairs:
                 try:
                     s, sig, dt, det, hits = fut.result()
-                    _probes.merge(hits)
+                    from foundationdb_tpu.testing.soak import (
+                        signature_metrics,
+                    )
+
+                    sm = signature_metrics(sig)
+                    for k, v in hits.items():
+                        probe_agg[k] = probe_agg.get(k, 0) + v
                     done += 1
-                    committed += sig[1]
-                    aborted += sig[2]
-                    rechecks += sig[3]
+                    committed += sm["committed"]
+                    aborted += sm["aborted"]
+                    rechecks += sm["read_checks"]
+                    traced_commits += sm.get("traced_commits", 0)
                     det_checked += int(det)
-                    api_sig = sig[7]
+                    api_sig = sm["api"]
                     if api_sig is not None:
                         api_acked += api_sig[0]
                         api_reads += api_sig[7]
@@ -148,6 +223,11 @@ def sweep(spec_name: str, seeds: list, jobs: int, probe_gate: bool,
                     failures.append((seed, repr(e)))
                     print(f"seed {seed:5d} FAILED: {e!r}", flush=True)
     wall = time.perf_counter() - t0
+    # fold the locally-aggregated hits into the global ONCE (an inline
+    # run's last seed left its own hits there — reset first so the
+    # aggregate is the single source and nothing double-counts)
+    _probes.reset()
+    _probes.merge(probe_agg)
     print(
         f"\n[{spec_name}] {done}/{len(seeds)} seeds passed in {wall:.0f}s "
         f"({jobs} jobs, {perturb} perturbation(s)/seed); "
@@ -190,6 +270,15 @@ def sweep(spec_name: str, seeds: list, jobs: int, probe_gate: bool,
         for s, e in failures:
             tag = f"seed {s}" if isinstance(s, int) else s
             print(f"  {tag}: {e}")
+    elif trace:
+        # traced sweeps are perf runs of record: outcome totals +
+        # traced-commit counts land in the ledger's structural tier
+        _emit_perf_row(
+            spec_name, seeds, perturb,
+            {"committed": committed, "aborted": aborted,
+             "read_checks": rechecks, "api_acked": api_acked},
+            traced_commits,
+        )
     return len(failures)
 
 
@@ -233,7 +322,17 @@ def main():
              "stage fails the seed) and the trace digest joins the "
              "determinism signature (bit-identical per seed/perturb)",
     )
+    ap.add_argument(
+        "--profile-dir", default=None,
+        help="capture a jax.profiler trace of the run (forces jobs=1 "
+             "in-process execution: a process pool's device work is "
+             "invisible to the parent's profiler)",
+    )
     args = ap.parse_args()
+    if args.profile_dir:
+        # the profiler sees THIS process only; a worker pool would
+        # produce an empty trace that looks like a measurement
+        args.jobs = 1
 
     from foundationdb_tpu.utils import probes as _probes
 
@@ -253,42 +352,59 @@ def main():
         from foundationdb_tpu.testing import soak
         from foundationdb_tpu.testing.spec import load_spec
 
+        from foundationdb_tpu.utils import perf as _perf
+
         failures = []
-        for name in list_specs():
-            # api=1.0: the lane's contract is that EVERY spec's smoke
-            # seed exercises the api model check, whatever the spec's
-            # own ensemble probability
-            spec = load_spec(name).with_overrides(
-                rounds=(6, 9), api_rounds=6, api=1.0
-            )
-            t0 = time.perf_counter()
-            try:
-                sig = soak.run_seed(args.start, spec=spec, trace=args.trace,
-                                    status_probe=args.status_probe)
-                # the perturbation smoke lane: K reorderings of the
-                # same smoke seed must all pass every gate
-                for pid in range(1, args.perturb + 1):
-                    _perturbed_rerun(args.start, spec, pid, name,
-                                     trace=args.trace,
-                                     status_probe=args.status_probe)
-                print(
-                    f"spec {name:16s} seed {args.start} ok in "
-                    f"{time.perf_counter() - t0:4.1f}s  "
-                    f"committed={sig[1]} api={sig[7]}"
-                    + (f"  [perturb x{args.perturb} OK]"
-                       if args.perturb else ""),
-                    flush=True,
+        with _perf.profile_trace(args.profile_dir):
+            for name in list_specs():
+                # api=1.0: the lane's contract is that EVERY spec's
+                # smoke seed exercises the api model check, whatever
+                # the spec's own ensemble probability
+                spec = load_spec(name).with_overrides(
+                    rounds=(6, 9), api_rounds=6, api=1.0
                 )
-            except Exception as e:
-                failures.append((name, repr(e)))
-                print(f"spec {name:16s} FAILED: {e!r}", flush=True)
+                t0 = time.perf_counter()
+                try:
+                    sig = soak.run_seed(
+                        args.start, spec=spec, trace=args.trace,
+                        status_probe=args.status_probe,
+                    )
+                    # the perturbation smoke lane: K reorderings of the
+                    # same smoke seed must all pass every gate
+                    for pid in range(1, args.perturb + 1):
+                        _perturbed_rerun(args.start, spec, pid, name,
+                                         trace=args.trace,
+                                         status_probe=args.status_probe)
+                    print(
+                        f"spec {name:16s} seed {args.start} ok in "
+                        f"{time.perf_counter() - t0:4.1f}s  "
+                        f"committed={sig[1]} api={sig[7]}"
+                        + (f"  [perturb x{args.perturb} OK]"
+                           if args.perturb else ""),
+                        flush=True,
+                    )
+                except Exception as e:
+                    failures.append((name, repr(e)))
+                    print(f"spec {name:16s} FAILED: {e!r}", flush=True)
+        if args.profile_dir:
+            print(f"[perf] jax.profiler trace captured in "
+                  f"{args.profile_dir}")
         if failures:
             sys.exit(1)
         return
 
     seeds = list(range(args.start, args.start + args.seeds))
-    if sweep(args.spec, seeds, args.jobs, args.probe_gate, args.perturb,
-             trace=args.trace, status_probe=args.status_probe):
+    from foundationdb_tpu.utils import perf as _perf
+
+    with _perf.profile_trace(args.profile_dir):
+        failures = sweep(
+            args.spec, seeds, args.jobs, args.probe_gate, args.perturb,
+            trace=args.trace, status_probe=args.status_probe,
+            inline=bool(args.profile_dir),
+        )
+    if args.profile_dir:
+        print(f"[perf] jax.profiler trace captured in {args.profile_dir}")
+    if failures:
         sys.exit(1)
 
 

@@ -49,7 +49,10 @@ ROLE_QOS_KEYS = {
                 "mvcc_window_versions"},
     "resolver": {"queue_depth", "queue_depth_dist", "queue_wait_dist",
                  "compute_time_dist", "resolver_latency_dist",
-                 "state_pressure", "occupancy"},
+                 "state_pressure", "occupancy",
+                 # the r10 kernel panel (compile-cache counters, last
+                 # compile seconds, stage p99s) — every backend
+                 "kernel"},
     "commit_proxy": {"inflight_batches", "queued_requests",
                      "batches_started", "batch_sizer"},
     "grv_proxy": {"queued_requests", "batch_sizer", "throttled_tags",
@@ -226,7 +229,11 @@ def test_fdbtop_check_status_gate_both_directions():
                     "version_lag_versions": 0, "input_bytes_per_s": 0.0}},
                 "resolver0": {"role": "resolver", "qos": {
                     "queue_depth": 0, "queue_wait_dist": {},
-                    "compute_time_dist": {}, "occupancy": 0.0}},
+                    "compute_time_dist": {}, "occupancy": 0.0,
+                    "kernel": {"compile_cache_hits": 0,
+                               "compile_cache_misses": 0,
+                               "last_compile_seconds": 0.0,
+                               "stage_p99_seconds": {}}}},
                 "proxy0": {"role": "commit_proxy", "qos": {
                     "queued_requests": 0, "inflight_batches": 0,
                     "batch_sizer": {}}},

@@ -523,6 +523,25 @@ def test_every_declared_probe_fires():
     ]))
     assert broken  # committed txn never attached to a batch
 
+    # -- perf-ledger probes (ISSUE 10) ------------------------------------
+    # regression gate: a candidate whose structural metric doubled
+    # against its own baseline must trip the comparator; compile-cache
+    # miss: the monitoring listener's miss event path (the same hook
+    # jax.monitoring drives on a persistent-cache miss)
+    from foundationdb_tpu.utils import compile_cache, perf
+
+    base = perf.make_record(
+        "probe_drive",
+        {"rows": perf.metric(100, "rows", "lower", tier="structural")},
+    )
+    cand = perf.make_record(
+        "probe_drive",
+        {"rows": perf.metric(200, "rows", "lower", tier="structural")},
+    )
+    rep = perf.compare(cand, [base], tier="structural")
+    assert rep["regressions"] == ["rows"]
+    compile_cache._on_event(compile_cache._MISS_EVENT)
+
     assert probes.missed() == [], (
         f"declared CODE_PROBEs never fired: {probes.missed()}\n"
         f"fired: { {k: v for k, v in probes.snapshot().items() if v} }"
