@@ -360,7 +360,6 @@ class ResolverRole:
             self._kernel_metrics = KernelStageMetrics()
         elif backend in ("cpu", "tpu", "tpu-force"):
             from foundationdb_tpu.config import KernelConfig
-            from foundationdb_tpu.models.conflict_set import make_conflict_set
 
             cfg_env = os.environ.get("RESOLVER_KERNEL", "")
             kcfg = KernelConfig(
@@ -371,8 +370,21 @@ class ResolverRole:
                 history_capacity=1 << 16,
                 window_versions=window,
             ) if not cfg_env else eval(cfg_env)  # noqa: S307 (operator-supplied)
+            if getattr(kcfg, "n_shards", 0) > 1:
+                # the mesh-sharded tiered kernel needs its devices
+                # BEFORE the first backend init in this role process —
+                # which happens during the conflict_set IMPORT below
+                # (ops/keys.py runs an eager op at module scope), so the
+                # virtual-device flag must land before that import. On a
+                # real TPU slice the devices already exist.
+                from foundationdb_tpu.parallel.mesh import (
+                    ensure_host_device_count,
+                )
+
+                ensure_host_device_count(kcfg.n_shards)
             from foundationdb_tpu.models.conflict_set import (
                 KernelStageMetrics,
+                make_conflict_set,
             )
 
             self._cs = make_conflict_set(kcfg, backend)

@@ -95,6 +95,23 @@ class KernelConfig:
     #: delta_capacity for at least this many batches' boundaries
     #: (<= 2*max_writes each, window-trimmed).
     compact_interval: int = 8
+    #: > 1 runs the MESH-SHARDED tiered kernel (parallel/sharding.py):
+    #: conflict history (main + delta tier) is partitioned by key range
+    #: across an n_shards-device mesh axis, every device clips the
+    #: replicated packed batch to its partition, probes/merges its own
+    #: tiers, and the per-shard verdicts min-combine on device
+    #: (`lax.pmin`; conflict-read bitmasks via `lax.psum`) inside ONE
+    #: compiled shard_map program — one pod slice acting as n_shards
+    #: reference resolvers with no host round-trip between them.
+    #: Per-shard history semantics are EXACTLY the reference's
+    #: multi-resolver deployment (each shard merges its locally
+    #: committed writes — phantom commits included), so decisions match
+    #: the multi-resolver CPU path bit-for-bit. Requires the tiered
+    #: path (delta_capacity > 0). 0/1 = single-device kernel.
+    n_shards: int = 0
+    #: Mesh axis name the sharded kernel partitions over (must match
+    #: the Mesh handed to TpuConflictSet; parallel.mesh.AXIS default).
+    shard_axis: str = "resolver"
 
     def __post_init__(self):
         if self.max_key_bytes % 4 != 0:
@@ -111,6 +128,13 @@ class KernelConfig:
         if self.dedup_reads and not self.delta_capacity:
             raise ValueError("dedup_reads requires the tiered path "
                              "(delta_capacity > 0)")
+        if self.n_shards < 0:
+            raise ValueError("n_shards must be >= 0")
+        if self.n_shards > 1 and not self.delta_capacity:
+            raise ValueError("the mesh-sharded kernel is tiered-only: "
+                             "n_shards > 1 requires delta_capacity > 0 "
+                             "(the classic sharded path is "
+                             "parallel.sharding.ShardedConflictSet)")
 
     # ---- derived shapes -------------------------------------------------
 

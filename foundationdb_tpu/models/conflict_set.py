@@ -86,9 +86,18 @@ class KernelStageMetrics:
         self.kernel = LatencySample("kernelSeconds")
         self.fence = LatencySample("fenceSeconds")
         # tier occupancy (tiered kernel): live boundary rows per tier,
-        # sampled at the overflow-check syncs (no extra device fences)
+        # sampled at the overflow-check syncs (no extra device fences).
+        # On a MESH-SHARDED instance the samples are the WORST shard's
+        # counts (per-shard tiers fill independently; the panel wants
+        # the one closest to overflow).
         self.delta_occupancy = LatencySample("deltaLiveBoundaries")
         self.main_occupancy = LatencySample("mainLiveBoundaries")
+        # mesh-sharded kernel (ISSUE 11): shard count + the measured
+        # per-group collective (pmin/psum combine) seconds, sampled
+        # from the combine-only probe program on the overflow-check
+        # syncs — the fdbtop kernel panel's per-shard columns
+        self.shard_count = 1
+        self.collective = LatencySample("collectiveSeconds")
         # device-memory gauges (ISSUE 10): live-buffer + peak bytes on
         # the dispatch device, sampled on the same overflow-check syncs
         # (no extra fences); zero on backends that don't report (CPU)
@@ -115,8 +124,10 @@ class KernelStageMetrics:
     def as_dict(self) -> dict:
         out: dict = dict(self.counters.as_dict())
         for s in (self.compile, self.pack, self.transfer, self.kernel,
-                  self.fence, self.delta_occupancy, self.main_occupancy):
+                  self.fence, self.delta_occupancy, self.main_occupancy,
+                  self.collective):
             out[s.name] = s.as_dict()
+        out["shardCount"] = self.shard_count
         out["deviceBytesInUse"] = self.device_bytes_in_use
         out["devicePeakBytes"] = self.device_peak_bytes
         return out
@@ -135,6 +146,8 @@ class KernelStageMetrics:
             + self.fence.total
         )
         cc = _cc.stats()
+        d_occ = self.delta_occupancy.max or 0.0
+        m_occ = self.main_occupancy.max or 0.0
         return {
             "batches": batches,
             "kernel_seconds_per_batch": (
@@ -157,12 +170,32 @@ class KernelStageMetrics:
             # device-memory gauges from the overflow-check syncs
             "device_bytes_in_use": self.device_bytes_in_use,
             "device_peak_bytes": self.device_peak_bytes,
-            "delta_occupancy": self.delta_occupancy.max or 0.0,
-            "main_occupancy": self.main_occupancy.max or 0.0,
+            "delta_occupancy": d_occ,
+            "main_occupancy": m_occ,
             "compactions": self.counters.get("compactions"),
             "fallbacks": (
                 self.counters.get("latchTrips")
                 + self.counters.get("exactFallbacks")
+            ),
+            # mesh-sharded kernel columns (fdbtop per-shard panel;
+            # zeros/1 on single-device backends so REQUIRED_SENSORS
+            # pins them on every backend). The worst_shard_* keys ALIAS
+            # the occupancy values above — sharded instances sample the
+            # worst shard's counts into the same LatencySamples, so one
+            # source value feeds both names and they cannot drift. The
+            # collective share is measured combine-probe seconds over
+            # per-batch resolve seconds.
+            "shards": self.shard_count,
+            "worst_shard_delta_occupancy": d_occ,
+            "worst_shard_main_occupancy": m_occ,
+            "collective_time_share": (
+                min(
+                    1.0,
+                    (self.collective.total / self.collective.count)
+                    / (stage_total / batches),
+                )
+                if self.collective.count and batches and stage_total
+                else 0.0
             ),
         }
 
@@ -309,9 +342,23 @@ class TpuConflictSet:
     folds delta into main every `config.compact_interval` batches (a
     fused group counts its G). The classic single-tier mega-sort path
     (ops/group.py) serves delta_capacity == 0 unchanged.
+
+    With `config.n_shards > 1` the tiered path runs MESH-SHARDED
+    (parallel/sharding.py, ISSUE 11): both tiers are partitioned by key
+    range across an n_shards-device mesh axis via NamedSharding, every
+    dispatch is ONE compiled shard_map program (per-device clip + local
+    tiered scan + pmin/psum verdict combine), and compaction / rebase /
+    the dedup latch / overflow accounting are per-shard state with
+    any-shard collective reductions. Pass `mesh=` to pin the device
+    mesh (tests use the virtual CPU mesh); by default one is built from
+    the default backend's devices. `shard_boundaries` are the
+    n_shards-1 interior split keys (default: even byte-prefix split).
+    Decisions match the reference's multi-resolver deployment exactly
+    (per-shard local merges, min() combine — see parallel/sharding.py).
     """
 
-    def __init__(self, config: KernelConfig, base_version: int = 0):
+    def __init__(self, config: KernelConfig, base_version: int = 0, *,
+                 mesh=None, shard_boundaries=None):
         self.config = config
         self.base_version = base_version
         # Guard the production path against the known large-m flattened
@@ -324,14 +371,47 @@ class TpuConflictSet:
         if jax.default_backend() != "cpu":
             _rm.flat_gather_selftest(config.history_capacity)
         self.tiered = getattr(config, "delta_capacity", 0) > 0
-        self.state = _D.init(config) if self.tiered else H.init(config)
+        self.sharded = getattr(config, "n_shards", 0) > 1
+        #: set on sharded instances (the staging thread replicates
+        #: against it; None = plain single-device device_put)
+        self._batch_sharding = None
+        self._mesh = None
+        #: always-on stage telemetry (see KernelStageMetrics)
+        self.metrics = KernelStageMetrics()
+        if self.sharded:
+            # config validation already pinned tiered-only
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+
+            from foundationdb_tpu.parallel import mesh as _mesh_mod
+            from foundationdb_tpu.parallel import sharding as _sh
+
+            axis = getattr(config, "shard_axis", _mesh_mod.AXIS)
+            self._mesh = mesh if mesh is not None else _mesh_mod.resolver_mesh(
+                config.n_shards, axis=axis
+            )
+            if self._mesh.shape.get(axis) != config.n_shards:
+                raise ValueError(
+                    f"mesh axis {axis!r} has {self._mesh.shape.get(axis)} "
+                    f"device(s); config.n_shards is {config.n_shards}"
+                )
+            boundaries = (
+                list(shard_boundaries) if shard_boundaries is not None
+                else _sh.default_boundaries(config.n_shards)
+            )
+            self.shard_boundaries = boundaries
+            self.state, self._part_lo, self._part_hi = (
+                _sh.init_sharded_tiered(config, self._mesh, boundaries)
+            )
+            self._batch_sharding = NamedSharding(self._mesh, _P())
+            self.metrics.shard_count = config.n_shards
+            self._collective_probe_warm = False
+        else:
+            self.state = _D.init(config) if self.tiered else H.init(config)
         self._batches_since_check = 0
         self._batches_since_compact = 0
         self._prewarmed_exact: set = set()
         self._resolve = _RESOLVE
         self._rebase = _REBASE
-        #: always-on stage telemetry (see KernelStageMetrics)
-        self.metrics = KernelStageMetrics()
 
     # -- ConflictBatch-equivalent API -----------------------------------
 
@@ -439,6 +519,22 @@ class TpuConflictSet:
             overflow=outs.overflow[0],
         )
 
+    def _tiered_jit(self, ssl, unroll, latch, dedup):
+        """The compiled tiered kernel for this instance: the module
+        single-device jit, or — on a sharded instance — the mesh
+        shard_map program with this instance's partition bound (ONE
+        compiled program per group: clip + per-shard scan + pmin/psum
+        combine; see parallel/sharding.tiered_sharded_jit)."""
+        if not self.sharded:
+            return _resolve_tiered_jit(ssl, unroll, latch, dedup)
+        from foundationdb_tpu.parallel import sharding as _sh
+
+        fn = _sh.tiered_sharded_jit(
+            self._mesh, ssl, unroll, latch, dedup,
+            axis=getattr(self.config, "shard_axis", _sh.AXIS),
+        )
+        return lambda st, args: fn(st, args, self._part_lo, self._part_hi)
+
     def _dispatch_tiered(self, stacked_args, check_latch: bool = True):
         """Dispatch one stacked group on the tiered kernel, honoring the
         latch contract (fixpoint latch OR dedup overflow both surface as
@@ -465,11 +561,11 @@ class TpuConflictSet:
             )
             if shape_key not in self._prewarmed_exact:
                 self._prewarmed_exact.add(shape_key)
-                _resolve_tiered_jit(ssl, unroll, False, 0)(
+                self._tiered_jit(ssl, unroll, False, 0)(
                     self.state, stacked_args
                 )
         t0 = time.perf_counter()
-        state2, outs = _resolve_tiered_jit(ssl, unroll, latch, dedup)(
+        state2, outs = self._tiered_jit(ssl, unroll, latch, dedup)(
             self.state, stacked_args
         )
         self.metrics.counters.add("groupDispatches")
@@ -478,7 +574,7 @@ class TpuConflictSet:
         ):
             self.metrics.counters.add("latchTrips")
             self.metrics.counters.add("exactFallbacks")
-            state2, outs = _resolve_tiered_jit(ssl, unroll, False, 0)(
+            state2, outs = self._tiered_jit(ssl, unroll, False, 0)(
                 self.state, stacked_args
             )
         self.metrics.kernel.sample(time.perf_counter() - t0)
@@ -504,7 +600,14 @@ class TpuConflictSet:
             return
         self._batches_since_compact = 0
         self.metrics.counters.add("compactions")
-        self.state = _COMPACT(self.state)
+        if self.sharded:
+            from foundationdb_tpu.parallel import sharding as _sh
+
+            self.state = _sh.compact_sharded_jit(
+                self._mesh, axis=getattr(self.config, "shard_axis", _sh.AXIS)
+            )(self.state)
+        else:
+            self.state = _COMPACT(self.state)
 
     def resolve_group_args(self, stacked_args, check_latch: bool = True):
         """Resolve K stacked batches via the GROUP kernel (ops/group.py):
@@ -617,7 +720,15 @@ class TpuConflictSet:
                     t0 = time.perf_counter()
                     host = pack_fn(item)
                     t1 = time.perf_counter()
-                    staged = jax.device_put(host)
+                    # sharded instances replicate the packed chunk over
+                    # the mesh here, on the staging thread — the
+                    # compute thread's dispatch then finds every shard's
+                    # copy already in flight (same overlap contract as
+                    # the single-device async copy)
+                    if self._batch_sharding is not None:
+                        staged = jax.device_put(host, self._batch_sharding)
+                    else:
+                        staged = jax.device_put(host)
                     # pack + copy-issue stage timings, off the compute
                     # thread (the copy itself overlaps compute; its true
                     # cost shows up in the fenced transfer metric of
@@ -673,7 +784,7 @@ class TpuConflictSet:
             if not (getattr(self.config, "fixpoint_latch", False)
                     or getattr(self.config, "dedup_reads", 0)):
                 return
-            _, outs = _resolve_tiered_jit(ssl, unroll, False, 0)(
+            _, outs = self._tiered_jit(ssl, unroll, False, 0)(
                 self.state, stacked_args
             )
             jax.block_until_ready(outs.verdict)
@@ -684,6 +795,28 @@ class TpuConflictSet:
             self.state, stacked_args
         )
         jax.block_until_ready(outs.verdict)
+
+    def _sample_collective(self) -> None:
+        """Time one fenced dispatch of the combine-only probe program
+        (the pmin/psum round the sharded kernel pays per group) on the
+        sync the overflow check already forced — the measured collective
+        cost behind qos()'s collective_time_share. First call compiles;
+        that run is discarded, not sampled."""
+        from foundationdb_tpu.parallel import sharding as _sh
+
+        cfg = self.config
+        fn = _sh.collective_probe_jit(
+            self._mesh, cfg.max_txns,
+            axis=getattr(cfg, "shard_axis", _sh.AXIS),
+        )
+        v = jnp.zeros((cfg.max_txns,), jnp.int32)
+        r = jnp.zeros((cfg.max_reads,), jnp.int32)
+        if not self._collective_probe_warm:
+            self._collective_probe_warm = True
+            jax.block_until_ready(fn(v, r))
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(v, r))
+        self.metrics.collective.sample(time.perf_counter() - t0)
 
     def _state_device(self):
         """The device holding the history state (= the dispatch
@@ -712,6 +845,17 @@ class TpuConflictSet:
         ssl = getattr(cfg, "short_span_limit", 0)
         unroll = getattr(cfg, "fixpoint_unroll", 3)
         latch = getattr(cfg, "fixpoint_latch", False)
+        if self.sharded:
+            from foundationdb_tpu.parallel import sharding as _sh
+
+            fn = _sh.tiered_sharded_jit(
+                self._mesh, ssl, unroll, latch,
+                getattr(cfg, "dedup_reads", 0),
+                axis=getattr(cfg, "shard_axis", _sh.AXIS),
+            )
+            return _perf.cost_analysis_of(
+                fn, self.state, stacked_args, self._part_lo, self._part_hi
+            )
         if self.tiered:
             fn = _resolve_tiered_jit(
                 ssl, unroll, latch, getattr(cfg, "dedup_reads", 0)
@@ -730,7 +874,17 @@ class TpuConflictSet:
         (either tier's, on the tiered path — a latched delta overflow
         survives compaction by folding into main.overflow)."""
         self._batches_since_check = 0
-        if self.tiered:
+        if self.sharded:
+            # any-shard overflow; occupancy samples take the WORST
+            # shard's live counts (the fdbtop per-shard panel input)
+            tripped = bool(np.asarray(self.state.main.overflow).any()) or (
+                bool(np.asarray(self.state.delta.overflow).any())
+            )
+            m_cnt, d_cnt = _D.boundary_counts_per_shard(self.state)
+            self.metrics.main_occupancy.sample(float(np.asarray(m_cnt).max()))
+            self.metrics.delta_occupancy.sample(float(np.asarray(d_cnt).max()))
+            self._sample_collective()
+        elif self.tiered:
             tripped = bool(np.asarray(self.state.main.overflow)) or bool(
                 np.asarray(self.state.delta.overflow)
             )

@@ -150,6 +150,42 @@ def _main_stale(main: H.VersionHistory, main_tab, rb, re, rsnap, rvalid,
     return (vmax > rsnap) & rvalid, ok
 
 
+def batch_body(main: H.VersionHistory, main_tab, carry, xs, b: int, *,
+               short_span_limit: int = 0, fixpoint_unroll: int = 3,
+               fixpoint_latch: bool = False, dedup_reads: int = 0):
+    """One batch of the tiered scan: probe the immutable main tier,
+    resolve against (and merge committed writes into) the delta tier
+    via the exact group kernel at G=1.
+
+    carry = (delta, trip); xs = one batch's device_args leaves; b = the
+    static txn capacity. Shared verbatim by the single-device scan
+    (`resolve_group_tiered`) and the mesh-sharded kernel
+    (parallel/sharding.py), which runs this same body per shard on the
+    partition-clipped batch — the two paths cannot drift.
+    """
+    delta, trip = carry
+    # per-read snapshots (padding rows carry read_txn == b)
+    snap_pad = jnp.concatenate([
+        xs["snapshot"].astype(jnp.int32),
+        jnp.full((1,), VERSION_NEG, jnp.int32),
+    ])
+    rsnap = snap_pad[jnp.clip(xs["read_txn"], 0, b)]
+    stale_main, dedup_ok = _main_stale(
+        main, main_tab, xs["read_begin"], xs["read_end"],
+        rsnap, xs["read_valid"], dedup_reads,
+    )
+    g1 = jax.tree.map(lambda v: v[None], xs)
+    delta2, out = G.resolve_group(
+        delta, g1,
+        short_span_limit=short_span_limit,
+        fixpoint_unroll=fixpoint_unroll,
+        fixpoint_latch=fixpoint_latch,
+        extra_stale=stale_main[None],
+    )
+    trip2 = trip | out.unconverged[0] | ~dedup_ok
+    return (delta2, trip2), jax.tree.map(lambda v: v[0], out)
+
+
 def resolve_group_tiered(state: TieredState, g: dict, *,
                          short_span_limit: int = 0,
                          fixpoint_unroll: int = 3,
@@ -175,29 +211,15 @@ def resolve_group_tiered(state: TieredState, g: dict, *,
     # main is immutable for the whole group: ONE table build amortizes
     # across all G batches' probes
     main_tab = rangemax.build(state.main.main_ver, op="max")
-    snap_pad_fill = jnp.full((1,), VERSION_NEG, jnp.int32)
 
     def body(carry, xs):
-        delta, trip = carry
-        # per-read snapshots (padding rows carry read_txn == b)
-        snap_pad = jnp.concatenate(
-            [xs["snapshot"].astype(jnp.int32), snap_pad_fill]
-        )
-        rsnap = snap_pad[jnp.clip(xs["read_txn"], 0, b)]
-        stale_main, dedup_ok = _main_stale(
-            state.main, main_tab, xs["read_begin"], xs["read_end"],
-            rsnap, xs["read_valid"], dedup_reads,
-        )
-        g1 = jax.tree.map(lambda v: v[None], xs)
-        delta2, out = G.resolve_group(
-            delta, g1,
+        return batch_body(
+            state.main, main_tab, carry, xs, b,
             short_span_limit=short_span_limit,
             fixpoint_unroll=fixpoint_unroll,
             fixpoint_latch=fixpoint_latch,
-            extra_stale=stale_main[None],
+            dedup_reads=dedup_reads,
         )
-        trip2 = trip | out.unconverged[0] | ~dedup_ok
-        return (delta2, trip2), jax.tree.map(lambda v: v[0], out)
 
     (delta_f, trip), outs = jax.lax.scan(
         body, (state.delta, jnp.asarray(False)), g
@@ -332,3 +354,12 @@ def boundary_counts(state: TieredState):
     """(main, delta) live-boundary counts — the bench ledger's
     merge-row accounting."""
     return H.boundary_count(state.main), H.boundary_count(state.delta)
+
+
+def boundary_counts_per_shard(state: TieredState):
+    """([S] main, [S] delta) live-boundary counts of a SHARD-STACKED
+    tiered state (leading shard axis on every leaf) — the fdbtop kernel
+    panel's worst-shard tier-occupancy input. vmap of the single-tier
+    counter so the liveness rule has one source of truth."""
+    per_shard = jax.vmap(H.boundary_count)
+    return per_shard(state.main), per_shard(state.delta)

@@ -68,6 +68,24 @@ def cpu_mesh(n: int, axis: str = AXIS):
     return jax.sharding.Mesh(np.array(cpu_devices(n)), (axis,))
 
 
+def resolver_mesh(n: int, axis: str = AXIS):
+    """An n-device `resolver` mesh on the DEFAULT backend — the mesh
+    TpuConflictSet builds when `config.n_shards > 1` and no explicit
+    mesh is passed. On a CPU-backend host (sim/CI) this is the virtual
+    CPU mesh (`--xla_force_host_platform_device_count`); on a real TPU
+    slice it takes the first n accelerator devices."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return cpu_mesh(n, axis)
+    devs = jax.devices()
+    if len(devs) < n:
+        raise RuntimeError(
+            f"resolver mesh needs {n} device(s); this host has {len(devs)}"
+        )
+    return jax.sharding.Mesh(np.array(devs[:n]), (axis,))
+
+
 # Set in children of run_in_cpu_subprocess: a child that still can't get
 # its CPU devices must fail loudly, not respawn itself forever.
 _SUBPROCESS_SENTINEL = "_FDBTPU_CPU_SUBPROCESS"

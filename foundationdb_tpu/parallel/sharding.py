@@ -40,6 +40,29 @@ from foundationdb_tpu.parallel.mesh import AXIS
 from foundationdb_tpu.utils import packing
 
 
+def _shard_map(f, *, mesh, in_specs, out_specs):
+    """`shard_map` across jax versions (>= 0.5 promoted it out of
+    experimental and renamed check_rep -> check_vma). Replication
+    checking is OFF: the group kernel's residual while_loop has no
+    replication rule, and every output's cross-shard agreement is
+    established explicitly by the pmin/psum combines."""
+    try:
+        sm = jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as sm
+
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+    try:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    except TypeError:
+        # the transition generation: promoted to jax.shard_map but the
+        # flag still has its experimental name
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
 class ShardedVerdict(NamedTuple):
     verdict: jnp.ndarray            # [B] int32 — min-combined across shards
     hist_conflict_read: jnp.ndarray  # [NR] bool — OR across shards
@@ -172,6 +195,271 @@ def make_partition(
     return lo, hi
 
 
+# ---------------------------------------------------------------------------
+# The MESH-SHARDED DELTA-TIERED kernel (ISSUE 11): the production tiered
+# path (ops/delta.py — the kernel TpuConflictSet._dispatch_tiered runs)
+# made mesh-native. Conflict history is partitioned by key range across
+# the `resolver` mesh axis: each device holds one shard's MAIN range-max
+# tier + DELTA tier, clips the replicated packed group to its partition
+# (the device-side ResolutionRequestBuilder split), probes its own main
+# tier and resolves/merges against its own delta tier locally via the
+# shared per-batch body (ops/delta.batch_body — the single-device scan
+# runs the IDENTICAL code), and the per-shard verdict / conflict-read /
+# overflow bitmasks combine with `pmin`/`psum`/`pmax` collectives inside
+# the SAME compiled shard_map program. One dispatch per group; no host
+# round-trip between shards.
+#
+# Semantics are the reference's multi-resolver deployment, exactly like
+# ShardedConflictSet above: each shard merges its LOCALLY committed
+# writes into its delta tier (phantom commits included), verdicts
+# min-combine (determineCommittedTransactions). Decisions are therefore
+# bit-identical to N independent tiered resolvers over the same
+# partition AND to the multi-resolver CPU oracle; a 1-shard mesh
+# degenerates to the single-device tiered kernel bit-for-bit.
+
+
+def default_boundaries(n_shards: int) -> list[bytes]:
+    """Even byte-prefix partition of the keyspace: the n_shards-1
+    interior split keys. Balance is workload-dependent (callers with a
+    key-sample pass explicit boundaries — the ResolutionBalancer's
+    job); correctness never depends on it."""
+    if not 1 <= n_shards <= 256:
+        raise ValueError(f"n_shards must be in [1, 256], got {n_shards}")
+    return [bytes([(256 * (i + 1)) // n_shards]) for i in range(n_shards - 1)]
+
+
+def _tiered_spec_state(axis: str = AXIS):
+    from foundationdb_tpu.ops import delta as D
+
+    hist = H.VersionHistory(
+        main_keys=P(axis), main_ver=P(axis), oldest=P(axis),
+        overflow=P(axis),
+    )
+    return D.TieredState(main=hist, delta=hist)
+
+
+def init_sharded_tiered(config: KernelConfig, mesh: Mesh,
+                        boundaries: Sequence[bytes]):
+    """(stacked sharded TieredState, part_lo, part_hi) for a mesh.
+
+    Every leaf carries a leading shard axis laid out with
+    NamedSharding(mesh, P(AXIS)) — device i holds shard i's tiers and
+    partition bounds; nothing is replicated but the batch."""
+    from foundationdb_tpu.ops import delta as D
+
+    axis = config.shard_axis
+    if axis not in mesh.axis_names:
+        raise ValueError(f"mesh must have a {axis!r} axis")
+    n_shards = mesh.shape[axis]
+    if len(boundaries) != n_shards - 1:
+        raise ValueError(
+            f"{n_shards} shards need {n_shards - 1} interior boundaries, "
+            f"got {len(boundaries)}"
+        )
+    if list(boundaries) != sorted(set(boundaries)):
+        raise ValueError("shard boundaries must be strictly ascending")
+    lo, hi = make_partition(boundaries, config)
+    shard = NamedSharding(mesh, P(axis))
+    part_lo = jax.device_put(lo, shard)
+    part_hi = jax.device_put(hi, shard)
+    single = D.init(config)
+    stacked = jax.tree.map(
+        lambda x: np.broadcast_to(
+            np.asarray(x), (n_shards,) + np.asarray(x).shape
+        ).copy(),
+        single,
+    )
+    state = jax.tree.map(lambda x: jax.device_put(x, shard), stacked)
+    return state, part_lo, part_hi
+
+
+def _shard_resolve_group_tiered(state, g: dict, lo, hi, *,
+                                short_span_limit: int,
+                                fixpoint_unroll: int,
+                                fixpoint_latch: bool,
+                                dedup_reads: int,
+                                axis: str = AXIS):
+    """Per-device body: the tiered group scan on the clipped batch plus
+    the cross-shard combine. Leading shard axis squeezed on entry."""
+    from foundationdb_tpu.ops import delta as D
+    from foundationdb_tpu.ops import group as G
+
+    state = jax.tree.map(lambda x: x[0], state)
+    lo = lo[0]
+    hi = hi[0]
+    gn, b = g["txn_valid"].shape
+
+    # device-side ResolutionRequestBuilder: every batch in the stack
+    # clipped to this shard's [lo, hi) partition
+    local = jax.vmap(lambda bt: clip_batch(bt, lo, hi))(g)
+    # main is immutable for the whole group: one table build per shard
+    from foundationdb_tpu.ops import rangemax as _rm
+
+    main_tab = _rm.build(state.main.main_ver, op="max")
+
+    def body(carry, xs):
+        return D.batch_body(
+            state.main, main_tab, carry, xs, b,
+            short_span_limit=short_span_limit,
+            fixpoint_unroll=fixpoint_unroll,
+            fixpoint_latch=fixpoint_latch,
+            dedup_reads=dedup_reads,
+        )
+
+    (delta_f, trip), outs = jax.lax.scan(
+        body, (state.delta, jnp.asarray(False)), local
+    )
+
+    # ---- cross-shard combine: ONE collective round per group ----------
+    # min() verdict combine (determineCommittedTransactions) on ICI.
+    verdict = jax.lax.pmin(outs.verdict, axis)  # [G, B]
+    # conflict-read bitmask: OR across shards as a psum of hits (the
+    # design brief's cross-resolver psum merge)
+    hist_read = (
+        jax.lax.psum(outs.hist_conflict_read.astype(jnp.int32), axis) > 0
+    )
+    first = jnp.where(
+        outs.intra_first_range < 0, INT32_POS, outs.intra_first_range
+    )
+    first = jax.lax.pmin(first, axis)
+    first = jnp.where(first == INT32_POS, -1, first)
+    # overflow accounting: any-shard reduction of (per-batch delta latch
+    # | this shard's main tier latch)
+    overflow = (
+        jax.lax.pmax(
+            (outs.overflow | state.main.overflow).astype(jnp.int32), axis
+        ) > 0
+    )
+    # dedup/fixpoint latch: ANY shard tripping refuses the whole group
+    trip_any = jax.lax.pmax(trip.astype(jnp.int32), axis) > 0
+
+    # decision counts from the COMBINED verdict (a local count would
+    # count phantom commits): TransactionResult CONFLICT=0 / TOO_OLD=1 /
+    # COMMITTED=3, padding masked by txn_valid
+    valid = g["txn_valid"]
+    committed = jnp.sum(
+        ((verdict == 3) & valid).astype(jnp.int32), axis=1
+    )
+    conflicted = jnp.sum(
+        ((verdict == 0) & valid).astype(jnp.int32), axis=1
+    )
+    too_old = jnp.sum(
+        ((verdict == 1) & valid).astype(jnp.int32), axis=1
+    )
+
+    new_state = D.TieredState(main=state.main, delta=delta_f)
+    if fixpoint_latch or dedup_reads:
+        # a tripped latch must leave every shard's tiers untouched: the
+        # host re-runs the whole group on the exact kernel against the
+        # same input state (the tiered kernel's latch discipline, with
+        # the trip reduced across shards so all devices agree)
+        new_state = jax.tree.map(
+            lambda old, new: jnp.where(trip_any, old, new),
+            D.TieredState(main=state.main, delta=state.delta), new_state,
+        )
+    new_state = jax.tree.map(lambda x: x[None], new_state)
+    return new_state, G.GroupVerdict(
+        verdict=verdict,
+        hist_conflict_read=hist_read,
+        intra_first_range=first,
+        committed_count=committed,
+        conflict_count=conflicted,
+        too_old_count=too_old,
+        overflow=overflow,
+        unconverged=jnp.broadcast_to(trip_any, (gn,)),
+    )
+
+
+def _shard_compact(state):
+    """Per-device compaction: fold this shard's delta into its main
+    (ops/delta.compact verbatim — no cross-shard dependency)."""
+    from foundationdb_tpu.ops import delta as D
+
+    single = jax.tree.map(lambda x: x[0], state)
+    return jax.tree.map(lambda x: x[None], D.compact(single))
+
+
+# One compiled program per (mesh, static-switch tuple): shared across
+# TpuConflictSet instances like the module-level single-device jits.
+_TIERED_SHARD_JITS: dict = {}
+_COMPACT_SHARD_JITS: dict = {}
+_COLLECTIVE_PROBE_JITS: dict = {}
+
+
+def tiered_sharded_jit(mesh: Mesh, short_span_limit: int,
+                       fixpoint_unroll: int, fixpoint_latch: bool,
+                       dedup_reads: int, axis: str = AXIS):
+    """The compiled mesh-sharded tiered group kernel: ONE shard_map
+    program per dispatch (clip + scan + pmin/psum combine), compiled
+    once per (mesh, static switches) — the scan body is G-independent
+    exactly like the single-device tiered kernel."""
+    key = (mesh, short_span_limit, fixpoint_unroll, fixpoint_latch,
+           dedup_reads, axis)
+    fn = _TIERED_SHARD_JITS.get(key)
+    if fn is None:
+        spec_state = _tiered_spec_state(axis)
+        body = partial(
+            _shard_resolve_group_tiered,
+            short_span_limit=short_span_limit,
+            fixpoint_unroll=fixpoint_unroll,
+            fixpoint_latch=fixpoint_latch,
+            dedup_reads=dedup_reads,
+            axis=axis,
+        )
+        # no donation: the latch fallback re-dispatches the same input
+        # state on the exact program (the single-device tiered jits
+        # share this contract)
+        fn = jax.jit(
+            _shard_map(
+                body, mesh=mesh,
+                in_specs=(spec_state, P(), P(axis), P(axis)),
+                out_specs=(spec_state, P()),
+            )
+        )
+        _TIERED_SHARD_JITS[key] = fn
+    return fn
+
+
+def compact_sharded_jit(mesh: Mesh, axis: str = AXIS):
+    key = (mesh, axis)
+    fn = _COMPACT_SHARD_JITS.get(key)
+    if fn is None:
+        spec_state = _tiered_spec_state(axis)
+        fn = jax.jit(
+            _shard_map(
+                _shard_compact, mesh=mesh,
+                in_specs=(spec_state,), out_specs=spec_state,
+            )
+        )
+        _COMPACT_SHARD_JITS[key] = fn
+    return fn
+
+
+def collective_probe_jit(mesh: Mesh, n: int, axis: str = AXIS):
+    """A combine-only program (the pmin + psum + pmax round the sharded
+    kernel runs per group, on verdict-shaped arrays): its fenced wall
+    time is the measured per-group collective cost, sampled by
+    TpuConflictSet on the overflow-check syncs so the fdbtop kernel
+    panel can report the collective share of resolve time."""
+    key = (mesh, n, axis)
+    fn = _COLLECTIVE_PROBE_JITS.get(key)
+    if fn is None:
+
+        def probe(v, r):
+            a = jax.lax.pmin(v, axis)
+            s = jax.lax.psum(r, axis)
+            m = jax.lax.pmax(v, axis)
+            return jnp.sum(a) + jnp.sum(s) + jnp.sum(m)
+
+        fn = jax.jit(
+            _shard_map(
+                probe, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            )
+        )
+        _COLLECTIVE_PROBE_JITS[key] = fn
+    return fn
+
+
 class ShardedConflictSet:
     """TpuConflictSet over an n-shard resolver mesh axis.
 
@@ -214,7 +502,7 @@ class ShardedConflictSet:
 
         spec_state = jax.tree.map(lambda _: P(AXIS), single)
         self._resolve = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 _shard_resolve,
                 mesh=mesh,
                 in_specs=(spec_state, P(), P(AXIS), P(AXIS)),
@@ -223,7 +511,7 @@ class ShardedConflictSet:
             donate_argnums=0,
         )
         self._resolve_group = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 _shard_resolve_group,
                 mesh=mesh,
                 in_specs=(spec_state, P(), P(AXIS), P(AXIS)),

@@ -20,8 +20,30 @@ _BASE = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))), ".jax_compile_cache")
 
 
+def _host_feature_lines() -> str:
+    """The host identity XLA:CPU AOT entries are sensitive to: ISA
+    feature lines PLUS the CPU model name. The model name matters —
+    XLA derives microarchitecture tuning pseudo-features from it
+    (`prefer-no-gather`/`prefer-no-scatter`), so two hosts with
+    byte-identical cpuinfo FLAGS can still produce incompatible AOT
+    entries (the MULTICHIP_r05 cpu_aot_loader mismatch spam). MHz /
+    bogomips lines stay out: per-boot noise would invalidate the cache
+    on every restart of the same host."""
+    import platform
+
+    lines = set()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features", "model name")):
+                    lines.add(line.strip())
+    except OSError:
+        pass
+    return "|".join(sorted(lines)) or platform.processor()
+
+
 def _machine_tag() -> str:
-    """Short hash of the host's CPU feature set.
+    """Short hash of the host's CPU identity.
 
     XLA:CPU cache entries embed AOT machine code; loading an entry
     compiled on a host with different ISA features risks SIGILL (the
@@ -31,24 +53,84 @@ def _machine_tag() -> str:
     import hashlib
     import platform
 
-    # ISA feature lines only ("flags" on x86, "Features" on arm) — the
-    # rest of cpuinfo has per-boot noise (MHz, bogomips) that would
-    # invalidate the cache on every restart of the same host.
-    feature_lines = set()
-    try:
-        with open("/proc/cpuinfo") as f:
-            for line in f:
-                if line.startswith(("flags", "Features")):
-                    feature_lines.add(line.strip())
-    except OSError:
-        pass
-    seed = "|".join(sorted(feature_lines)) or platform.processor()
     return hashlib.md5(
-        (platform.machine() + ":" + seed).encode()
+        (platform.machine() + ":" + _host_feature_lines()).encode()
     ).hexdigest()[:8]
 
 
 _DEFAULT = _BASE + "." + _machine_tag()
+
+#: sentinel recording which host populated a cache dir (the scrub key)
+_FINGERPRINT_NAME = "HOST_FINGERPRINT"
+
+
+def _host_fingerprint() -> str:
+    import hashlib
+    import platform
+
+    return hashlib.md5(
+        (platform.machine() + ":" + _host_feature_lines()).encode()
+    ).hexdigest()
+
+
+def scrub_on_host_mismatch(path: str) -> bool:
+    """Drop a persistent-cache dir's entries when its recorded host
+    fingerprint doesn't match THIS host; stamp the current fingerprint
+    either way. Returns whether a scrub happened.
+
+    The dir-name tag can't protect a pinned dir ($FDBTPU_COMPILE_CACHE)
+    or a dir baked into a migrating container: loading another
+    machine's XLA:CPU AOT entries spams machine-feature-mismatch errors
+    on stderr — which polluted the multichip lane's JSON `tail`
+    (MULTICHIP_r05) — and risks SIGILL. Scrubbing trades one warm cache
+    for a clean, safe run on the new host."""
+    marker = os.path.join(path, _FINGERPRINT_NAME)
+    want = _host_fingerprint()
+    try:
+        with open(marker) as f:
+            have = f.read().strip()
+    except OSError:
+        have = None
+    try:
+        entries = [n for n in os.listdir(path) if n != _FINGERPRINT_NAME]
+    except OSError:
+        entries = []
+    scrubbed = False
+    # An UNSTAMPED dir that already holds entries cannot be proven
+    # local: a container baked before the marker existed carries
+    # another machine's AOT entries with no stamp at all — exactly the
+    # migrating scenario this scrub exists for. Conservatively scrub
+    # (one re-warm beats a SIGILL risk); an empty dir just gets
+    # stamped.
+    if (have is not None and have != want) or (have is None and entries):
+        import shutil
+
+        for name in os.listdir(path):
+            if name == _FINGERPRINT_NAME:
+                continue
+            victim = os.path.join(path, name)
+            try:
+                if os.path.isdir(victim):
+                    shutil.rmtree(victim, ignore_errors=True)
+                else:
+                    os.remove(victim)
+            except OSError:
+                pass  # a straggler entry keeps its warning; never fatal
+        scrubbed = True
+        from foundationdb_tpu.utils.trace import SEV_WARN, TraceEvent
+
+        TraceEvent("CompileCacheScrubbed", severity=SEV_WARN).detail(
+            "Path", path
+        ).detail("RecordedFingerprint", have or "unstamped").detail(
+            "HostFingerprint", want
+        ).log()
+    if have != want:
+        try:
+            with open(marker, "w") as f:
+                f.write(want + "\n")
+        except OSError:
+            pass
+    return scrubbed
 
 
 def enable(path: str | None = None) -> str:
@@ -58,11 +140,15 @@ def enable(path: str | None = None) -> str:
     is consulted at compile time, not backend-init time). Also arms the
     compile-observability listeners (`instrument()`), so every enabled
     process carries hit/miss counters and compile seconds in `stats()`.
+    A dir whose recorded host fingerprint mismatches this machine is
+    scrubbed first (see `scrub_on_host_mismatch`) — stale cross-host
+    XLA:CPU AOT entries must never load.
     """
     import jax
 
     path = path or os.environ.get("FDBTPU_COMPILE_CACHE", _DEFAULT)
     os.makedirs(path, exist_ok=True)
+    scrub_on_host_mismatch(path)
     jax.config.update("jax_compilation_cache_dir", path)
     # Cache everything: the kernel's many specializations are each well
     # over the default thresholds anyway, and tiny entries are harmless.

@@ -40,6 +40,17 @@ rm -f "$perf_row"
 t1=$(date +%s.%N)
 awk -v a="$t0" -v b="$t1" 'BEGIN {printf "perfcheck wall time: %.1fs\n", b - a}'
 
+echo "== shard_smoke (mesh-sharded tiered kernel on an 8-virtual-device  =="
+echo "== CPU mesh: sharded-vs-multi-resolver-oracle parity at widths     =="
+echo "== 1/2/4/8 + structural scaling-ledger rows gated by perfcheck)    =="
+t0=$(date +%s.%N)
+shard_row=$(mktemp /tmp/shardcheck_row.XXXXXX.jsonl)
+JAX_PLATFORMS=cpu python scripts/shard_smoke.py --perf-out "$shard_row"
+JAX_PLATFORMS=cpu python scripts/perfcheck.py --check "$shard_row" --tier structural
+rm -f "$shard_row"
+t1=$(date +%s.%N)
+awk -v a="$t0" -v b="$t1" 'BEGIN {printf "shard_smoke wall time: %.1fs\n", b - a}'
+
 echo "== spec + perturbation smoke (1 short seed per spec, then the same =="
 echo "== seed x 3 schedule perturbations, api workload + auditor on)    =="
 # --perturb runs the unperturbed base seed first, so one lane covers both

@@ -68,9 +68,16 @@ REQUIRED_SENSORS = {
     "storage": ("version_lag_versions", "input_bytes_per_s"),
     # "kernel" is the r10 kernel panel: compile-cache hits/misses, last
     # compile seconds, stage p99s (KernelStageMetrics.qos()) — present
-    # on EVERY resolver backend, native included
+    # on EVERY resolver backend, native included. Dotted keys descend
+    # into nested blocks: the r11 per-shard columns (mesh shard count,
+    # worst-shard tier occupancy, measured collective time share) are
+    # pinned on every backend too — single-device kernels report
+    # shards=1 / zeros, never a missing key.
     "resolver": ("queue_depth", "queue_wait_dist", "compute_time_dist",
-                 "occupancy", "kernel"),
+                 "occupancy", "kernel", "kernel.shards",
+                 "kernel.worst_shard_delta_occupancy",
+                 "kernel.worst_shard_main_occupancy",
+                 "kernel.collective_time_share"),
     "commit_proxy": ("queued_requests", "inflight_batches", "batch_sizer"),
     "grv_proxy": ("queued_requests", "sheds", "budget_stale"),
     "ratekeeper": ("transactions_per_second_limit", "budget_limited_by",
@@ -228,6 +235,13 @@ def _row_metrics(role: str, block: dict) -> list[tuple[str, object]]:
             ("cc h/m", f"{k.get('compile_cache_hits', 0)}/"
                        f"{k.get('compile_cache_misses', 0)}"),
             ("compile s", k.get("last_compile_seconds", 0.0)),
+            # the r11 mesh-sharded columns: shard count, the worst
+            # shard's delta-tier fill (the one closest to overflow) and
+            # the measured collective (pmin/psum combine) share of
+            # per-batch resolve time
+            ("shards", k.get("shards", 1)),
+            ("worst Δocc", k.get("worst_shard_delta_occupancy", 0.0)),
+            ("coll %", round(100 * k.get("collective_time_share", 0.0), 1)),
         ]
     if role == "commit_proxy":
         bs = q.get("batch_sizer", {})
@@ -326,7 +340,15 @@ def check_status(status: dict, require: list[str]) -> list[str]:
             problems.append(f"{name}: empty qos block")
             continue
         for key in REQUIRED_SENSORS.get(block.get("role", ""), ()):
-            if key not in qos:
+            # dotted keys descend into nested blocks (kernel.shards)
+            node = qos
+            missing = False
+            for part in key.split("."):
+                if not isinstance(node, dict) or part not in node:
+                    missing = True
+                    break
+                node = node[part]
+            if missing:
                 problems.append(f"{name}: qos missing sensor {key!r}")
     if "performance_limited_by" not in status.get("cluster", {}).get(
         "qos", {}

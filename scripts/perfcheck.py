@@ -121,6 +121,73 @@ def do_import(out: str, force: bool) -> int:
 
 
 # ---------------------------------------------------------------------------
+# --scaling: the per-chip scaling curve from the ledger.
+
+
+def do_scaling(history: list, source: str = None, window: int = 8) -> int:
+    """Group ledger rows by device count at a fixed fingerprint and
+    print the per-chip scaling curve: txn/s, txn/s per device, and
+    parallel efficiency vs the smallest device count (1-chip when a
+    1-chip row exists). Replaces eyeballing MULTICHIP_r*.json tails:
+    every multichip/shard run lands a fingerprinted row, and this view
+    reads the curve straight off the ledger."""
+    import json as _json
+
+    from foundationdb_tpu.utils import perf
+
+    groups: dict = {}
+    for r in history:
+        if source and r.get("source") != source:
+            continue
+        m = r.get("metrics", {})
+        if "txn_s" not in m:
+            continue
+        wl = dict(r.get("workload", {}))
+        fp = r.get("fingerprint") or {}
+        # the device count is the VARYING axis: strip it from the
+        # grouping key, read it from the workload (virtual-device rows
+        # record their mesh width there — the host flag pins the
+        # fingerprint's device_count at the max) or the fingerprint
+        n = wl.pop("n_devices", None) or wl.pop("n_shards", None)
+        if n is None:
+            n = fp.get("device_count")
+        if not n:
+            continue
+        key = (
+            r.get("source"),
+            _json.dumps(wl, sort_keys=True),
+            _json.dumps(r.get("knobs", {}), sort_keys=True),
+            fp.get("backend"), fp.get("device_kind"),
+            fp.get("jaxlib_version"),
+        )
+        groups.setdefault(key, {}).setdefault(int(n), []).append(
+            float(m["txn_s"]["value"])
+        )
+    groups = {k: v for k, v in groups.items() if len(v) > 1}
+    if not groups:
+        print("perfcheck --scaling: no ledger group spans more than one "
+              "device count (need txn_s rows at >= 2 widths; run "
+              "scripts/shard_smoke.py --perf-out perf/history.jsonl)")
+        return 0
+    for key, by_n in sorted(groups.items(), key=str):
+        src, wl, knobs, backend, kind, jaxlib = key
+        print(f"== {src} {wl}")
+        print(f"   knobs {knobs} [{backend}/{kind}/jaxlib {jaxlib}] ==")
+        base = None
+        for n in sorted(by_n):
+            samples = by_n[n][-window:]
+            med = perf._median(samples)
+            per_dev = med / n
+            if base is None:
+                base = per_dev
+            eff = per_dev / base if base else 0.0
+            print(f"  {n:>3} device(s) {med:>14.1f} txn/s "
+                  f"{per_dev:>14.1f} txn/s/device  efficiency {eff:5.2f}  "
+                  f"(median of {len(samples)})")
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # --check: candidate rows vs the history's baseline windows.
 
 
@@ -180,6 +247,11 @@ def main() -> int:
                       help="latest row per (source, workload) vs its "
                            "baseline window — the hardware re-measure "
                            "checklist's view")
+    mode.add_argument("--scaling", action="store_true",
+                      help="group txn_s rows by device count at a fixed "
+                           "fingerprint and print the per-chip scaling "
+                           "curve (txn/s per device, efficiency vs the "
+                           "smallest width)")
     ap.add_argument("--history", default=None,
                     help="ledger path (default perf/history.jsonl)")
     ap.add_argument("--tier", default="structural",
@@ -205,6 +277,9 @@ def main() -> int:
         return do_import(history_path, args.force)
 
     history = perf.load_history(history_path)
+
+    if args.scaling:
+        return do_scaling(history, args.source, args.window)
 
     if args.list:
         by_key: dict = {}

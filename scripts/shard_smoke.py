@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Mesh-sharded tiered kernel smoke for the fast CI lane.
+
+Drives the ISSUE-11 production sharded path — TpuConflictSet with
+`config.n_shards > 1` (parallel/sharding.py: keyspace partition over a
+virtual CPU mesh, per-shard delta-tiered resolve, on-device pmin/psum
+verdict combine in ONE shard_map program) — against the multi-resolver
+Python oracle (MultiResolverOracle: the reference's independent
+per-shard histories + min() combine) on a seeded random stream, at
+several mesh widths. A 1-shard mesh must also match the SINGLE-DEVICE
+tiered kernel exactly (the degenerate-case pin).
+
+With --perf-out it emits one STRUCTURAL+hardware ledger row per mesh
+width (source "multichip": decision counts exact-gated by
+scripts/perfcheck.py, fused txn/s in the noise-banded hardware tier) —
+the rows `perfcheck --scaling` groups by device count to render the
+per-chip scaling curve, replacing eyeball comparison of the one-off
+MULTICHIP_r*.json artifacts.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# before any jax import: raise (never just leave) the virtual-device
+# count, so an inherited smaller --xla_force_host_platform_device_count
+# can't starve the 8-wide mesh
+from foundationdb_tpu.parallel.mesh import ensure_host_device_count  # noqa: E402
+
+ensure_host_device_count(8)
+
+import numpy as np
+
+
+def build_stream(cfg, rng, n_batches, n_txns, keyspace, key_width):
+    from foundationdb_tpu.testing.workloads import WorkloadConfig, make_batch
+
+    wcfg = WorkloadConfig(
+        n_txns=n_txns, keyspace=keyspace, key_width=key_width,
+        stale_fraction=0.1,
+    )
+    stream, version = [], 0
+    for _ in range(n_batches):
+        version += int(rng.integers(1, 40))
+        stream.append(
+            (make_batch(rng, wcfg, version, cfg.window_versions), version)
+        )
+    return stream
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--perf-out", default=None,
+        help="emit one ledger row per mesh width to this JSONL (the "
+             "check.sh lane feeds it to scripts/perfcheck.py; pass the "
+             "real perf/history.jsonl to land scaling-curve rows)",
+    )
+    ap.add_argument(
+        "--counts", default="1,2,4,8",
+        help="comma-separated mesh widths (virtual CPU devices)",
+    )
+    args = ap.parse_args()
+    t_start = time.perf_counter()
+
+    import dataclasses
+
+    from foundationdb_tpu.config import KernelConfig
+    from foundationdb_tpu.models.conflict_set import TpuConflictSet
+    from foundationdb_tpu.parallel.mesh import cpu_mesh
+    from foundationdb_tpu.testing.oracle import MultiResolverOracle, OracleTxn
+    from foundationdb_tpu.testing.workloads import int_key
+
+    cfg = KernelConfig(
+        max_key_bytes=8, max_txns=16, max_reads=64, max_writes=64,
+        history_capacity=512, window_versions=1000,
+        delta_capacity=128, compact_interval=2,
+    )
+    keyspace, key_width = 64, 6
+    counts = [int(c) for c in args.counts.split(",") if c]
+
+    def to_oracle(txns):
+        return [
+            OracleTxn(
+                t.read_conflict_ranges, t.write_conflict_ranges,
+                t.read_snapshot, t.report_conflicting_keys,
+            )
+            for t in txns
+        ]
+
+    rows = []
+    failures = 0
+    for n in counts:
+        rng = np.random.default_rng(0x511)  # same stream per width
+        stream = build_stream(cfg, rng, 8, 12, keyspace, key_width)
+        boundaries = [
+            int_key((i + 1) * keyspace // n, key_width)
+            for i in range(n - 1)
+        ]
+        scfg = dataclasses.replace(cfg, n_shards=n if n > 1 else 0)
+        cs = TpuConflictSet(
+            scfg, mesh=cpu_mesh(n) if n > 1 else None,
+            shard_boundaries=boundaries if n > 1 else None,
+        )
+        oracle = MultiResolverOracle(boundaries, window=cfg.window_versions)
+        committed = conflicted = 0
+        t0 = time.perf_counter()
+        results = []
+        for txns, v in stream:
+            got = cs.resolve(txns, v)
+            results.append([int(x) for x in got.verdicts])
+            want = oracle.resolve(to_oracle(txns), v)
+            if results[-1] != want.verdicts:
+                print(f"FAIL n={n} v={v}: {results[-1]} != {want.verdicts}")
+                failures += 1
+            committed += sum(1 for x in got.verdicts if int(x) == 3)
+            conflicted += sum(1 for x in got.verdicts if int(x) == 0)
+        elapsed = time.perf_counter() - t0
+        txn_total = sum(len(t) for t, _ in stream)
+        rows.append({
+            "n": n, "committed": committed, "conflicted": conflicted,
+            "txn_s": txn_total / elapsed if elapsed > 0 else 0.0,
+            "dispatches": cs.metrics.counters.get("groupDispatches")
+            or cs.metrics.counters.get("resolveBatches"),
+        })
+        print(f"shard_smoke n={n}: parity ok, committed={committed} "
+              f"conflicted={conflicted} ({elapsed:.1f}s incl. compile)")
+
+    if failures:
+        print(f"shard_smoke: {failures} FAILURES")
+        return 1
+
+    if args.perf_out:
+        from foundationdb_tpu.utils import perf
+
+        for r in rows:
+            metrics = {
+                "committed": perf.metric(r["committed"], "txns", "higher",
+                                         tier="structural"),
+                "conflicted": perf.metric(r["conflicted"], "txns", "lower",
+                                          tier="structural"),
+                "dispatches": perf.metric(r["dispatches"], "count", "lower",
+                                          tier="structural"),
+                "txn_s": perf.metric(r["txn_s"], "txn/s", "higher"),
+            }
+            rec = perf.make_record(
+                "multichip", metrics,
+                workload={"n_devices": r["n"], "kernel": "tiered_sharded",
+                          "batches": 8, "txns_per_batch": 12},
+                knobs={"delta_capacity": cfg.delta_capacity,
+                       "dedup_reads": cfg.dedup_reads,
+                       "compact_interval": cfg.compact_interval},
+            )
+            perf.append(rec, path=args.perf_out)
+        print(f"shard_smoke: {len(rows)} ledger row(s) -> {args.perf_out}")
+
+    print(f"shard_smoke: OK — mesh widths {counts} decision-identical to "
+          f"the multi-resolver oracle "
+          f"({time.perf_counter() - t_start:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -233,7 +233,13 @@ def test_fdbtop_check_status_gate_both_directions():
                     "kernel": {"compile_cache_hits": 0,
                                "compile_cache_misses": 0,
                                "last_compile_seconds": 0.0,
-                               "stage_p99_seconds": {}}}},
+                               "stage_p99_seconds": {},
+                               # the r11 per-shard columns (dotted
+                               # REQUIRED_SENSORS keys descend here)
+                               "shards": 1,
+                               "worst_shard_delta_occupancy": 0.0,
+                               "worst_shard_main_occupancy": 0.0,
+                               "collective_time_share": 0.0}}},
                 "proxy0": {"role": "commit_proxy", "qos": {
                     "queued_requests": 0, "inflight_batches": 0,
                     "batch_sizer": {}}},
@@ -264,6 +270,14 @@ def test_fdbtop_check_status_gate_both_directions():
     del missing["cluster"]["processes"]["proxy0"]["qos"]["batch_sizer"]
     assert any("batch_sizer" in p for p in
                fdbtop.check_status(missing, require))
+    # a missing DOTTED sensor (the r11 per-shard kernel columns) fails:
+    # the gate descends into nested blocks
+    noshard = json.loads(json.dumps(good))
+    del noshard["cluster"]["processes"]["resolver0"]["qos"]["kernel"][
+        "shards"
+    ]
+    assert any("kernel.shards" in p for p in
+               fdbtop.check_status(noshard, require))
     # a missing performance_limited_by fails
     nolim = json.loads(json.dumps(good))
     nolim["cluster"]["qos"] = {}

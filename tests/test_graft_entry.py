@@ -72,11 +72,26 @@ def test_cpu_subprocess_env_is_hermetic(monkeypatch):
 
 
 @pytest.mark.kernel
-def test_dryrun_end_to_end():
+def test_dryrun_end_to_end(tmp_path, monkeypatch):
     """The real thing: exactly what the driver runs, asserting rc=0.
 
     Cheap because the child's tiny-shape compiles hit the persistent
-    per-machine compile cache after the first run.
+    per-machine compile cache after the first run. The perf ledger is
+    redirected to a tempfile (the env rides into the hermetic child):
+    a DRIVER dryrun must land its fingerprinted multichip row in
+    perf/history.jsonl, a TEST run must not dirty the committed
+    history — and the row's shape is pinned here either way.
     """
+    ledger = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("FDBTPU_PERF_LEDGER", ledger)
     G = _graft()
     G.dryrun_multichip(8)
+    import json
+
+    rows = [json.loads(x) for x in open(ledger)]
+    assert len(rows) == 1 and rows[0]["source"] == "multichip"
+    assert rows[0]["workload"]["n_devices"] == 8
+    assert rows[0]["workload"]["kernel"] == "tiered_sharded"
+    assert rows[0]["metrics"]["ok"]["value"] == 1
+    assert rows[0]["metrics"]["txn_s"]["tier"] == "hardware"
+    assert rows[0]["metrics"]["committed"]["tier"] == "structural"

@@ -509,3 +509,108 @@ def test_compile_cache_stats_surface(tmp_path, monkeypatch):
                 "last_compile_seconds", "stage_p99_seconds",
                 "device_bytes_in_use", "device_peak_bytes"):
         assert key in qos
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 11: the per-chip scaling view + the compile-cache host scrub.
+
+
+def _scaling_row(n_devices: int, txn_s: float, committed: int = 10):
+    return perf.make_record(
+        "multichip",
+        {
+            "committed": perf.metric(committed, "txns", "higher",
+                                     tier="structural"),
+            "txn_s": perf.metric(txn_s, "txn/s", "higher"),
+        },
+        workload={"n_devices": n_devices, "kernel": "tiered_sharded",
+                  "batches": 8, "txns_per_batch": 12},
+        knobs={"delta_capacity": 128},
+        fingerprint={
+            "backend": "cpu", "device_kind": "cpu", "device_count": 8,
+            "jax_version": "x", "jaxlib_version": "y",
+            "python_version": "z", "machine": "m",
+        },
+    )
+
+
+def test_perfcheck_scaling_renders_curve(tmp_path):
+    """--scaling groups txn_s rows by device count at a fixed
+    fingerprint and prints txn/s per device + efficiency vs the
+    smallest width."""
+    hist = str(tmp_path / "hist.jsonl")
+    for n, rate in ((1, 1000.0), (2, 1800.0), (4, 3000.0), (8, 4400.0)):
+        perf.append(_scaling_row(n, rate), path=hist)
+    r = _perfcheck("--scaling", "--history", hist)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert "efficiency" in out
+    for n in (1, 2, 4, 8):
+        assert f"{n} device(s)" in out
+    # efficiency vs the 1-chip row: 2 devices at 1800 -> 0.90
+    assert "efficiency  0.90" in out
+    # a single-width-only ledger renders the empty-state hint
+    hist2 = str(tmp_path / "hist2.jsonl")
+    perf.append(_scaling_row(8, 4400.0), path=hist2)
+    r2 = _perfcheck("--scaling", "--history", hist2)
+    assert r2.returncode == 0
+    assert "no ledger group" in r2.stdout
+
+
+def test_perfcheck_scaling_splits_on_knobs(tmp_path):
+    """A knob change is a different experiment: rows with different
+    knob fingerprints must land in different scaling groups."""
+    hist = str(tmp_path / "hist.jsonl")
+    perf.append(_scaling_row(1, 1000.0), path=hist)
+    perf.append(_scaling_row(2, 1800.0), path=hist)
+    other = _scaling_row(2, 900.0)
+    other["knobs"] = {"delta_capacity": 512}
+    perf.append(other, path=hist)
+    r = _perfcheck("--scaling", "--history", hist)
+    assert r.returncode == 0, r.stderr
+    # only the delta_capacity=128 group spans two widths; the 512 row
+    # alone cannot form a curve
+    assert r.stdout.count("==") >= 1
+    assert '"delta_capacity": 512' not in r.stdout
+
+
+def test_compile_cache_scrub_on_host_mismatch(tmp_path):
+    """A persistent-cache dir stamped by a DIFFERENT host — or holding
+    entries with NO stamp at all (a container baked before the marker
+    existed: it cannot be proven local) — is scrubbed, so stale
+    XLA:CPU AOT entries never load (the MULTICHIP_r05 stderr-pollution
+    fix); a dir stamped by THIS host is left alone; an EMPTY unstamped
+    dir is just stamped."""
+    from foundationdb_tpu.utils import compile_cache as cc
+
+    d = tmp_path / "cache"
+    d.mkdir()
+    marker = d / "HOST_FINGERPRINT"
+    # empty unstamped dir: stamp, nothing to scrub
+    assert cc.scrub_on_host_mismatch(str(d)) is False
+    assert marker.read_text().strip() == cc._host_fingerprint()
+    # this host's stamp: untouched
+    (d / "entry_a").write_bytes(b"aot blob")
+    assert cc.scrub_on_host_mismatch(str(d)) is False
+    assert (d / "entry_a").exists()
+    # unstamped (legacy/pre-marker) dir WITH entries: provenance
+    # unknown -> conservative scrub + stamp
+    marker.unlink()
+    assert cc.scrub_on_host_mismatch(str(d)) is True
+    assert not (d / "entry_a").exists()
+    assert marker.read_text().strip() == cc._host_fingerprint()
+    # another host's stamp: entries scrubbed, marker re-stamped
+    (d / "entry_a").write_bytes(b"aot blob")
+    (d / "subdir").mkdir()
+    (d / "subdir" / "entry_b").write_bytes(b"aot blob 2")
+    marker.write_text("0" * 32 + "\n")
+    assert cc.scrub_on_host_mismatch(str(d)) is True
+    assert not (d / "entry_a").exists()
+    assert not (d / "subdir").exists()
+    assert marker.read_text().strip() == cc._host_fingerprint()
+    # enable() routes through the scrub and still configures the cache
+    marker.write_text("0" * 32 + "\n")
+    (d / "entry_c").write_bytes(b"stale")
+    path = cc.enable(str(d))
+    assert path == str(d)
+    assert not (d / "entry_c").exists()
