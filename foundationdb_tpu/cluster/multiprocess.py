@@ -308,6 +308,22 @@ TOKEN_GET_RATE_INFO = 0x0502
 # Role servers.
 
 
+def _decode_alloc_count(txns) -> int:
+    """Per-batch count of the Python objects a per-transaction frame
+    decode materializes — the columnar path's structural ZERO on jitted
+    backends, ledger-gated by bench_pipeline (resolve_decode_allocs_
+    per_txn). Mirrors r_commit_transaction's allocation sites exactly:
+    per txn the CommitTransaction + its two range lists; per conflict
+    range the tuple + two bytes keys; per mutation the Mutation + two
+    bytes params."""
+    n = 0
+    for t in txns:
+        n += 3 + 3 * (
+            len(t.read_conflict_ranges) + len(t.write_conflict_ranges)
+        ) + 3 * len(t.mutations)
+    return n
+
+
 class ResolverRole:
     """Wire-served resolver: version-chained conflict resolution.
 
@@ -335,6 +351,21 @@ class ResolverRole:
         from foundationdb_tpu.utils.metrics import TimerSmoother
 
         self._waiting = 0  # requests parked on the version chain
+        # -- columnar-vs-object structural accounting (r12): the
+        # "two copies" claim as gated numbers, surfaced in status() and
+        # landed in the perf ledger by bench_pipeline. `copies` counts
+        # full key-data materializations between the wire frame payload
+        # and the conflict backend's input (each site documented where
+        # it increments); `decode_allocs` counts per-transaction Python
+        # objects the decode materialized (the columnar path's
+        # structural zero on jitted backends).
+        self.path_stats = {
+            "columnar_batches": 0,
+            "object_batches": 0,
+            "txns": 0,
+            "copies": 0,
+            "decode_allocs": 0,
+        }
         self.queue_depth = LatencySample("queueDepth")
         self.queue_wait_latency = LatencySample("queueWaitLatency")
         self.compute_time = LatencySample("computeTime")
@@ -497,6 +528,17 @@ class ResolverRole:
                         f"version {req.version} already resolved and expired"
                     )
                 return reply
+            if req.debug_id is not None:
+                from foundationdb_tpu.utils import commit_debug as _cdbg
+                from foundationdb_tpu.utils import trace as _tr
+
+                # past the version-chain wait (the reference's orderer):
+                # the next mark is ColumnarDecode, so the waterfall's
+                # columnar_decode stage brackets exactly the frame ->
+                # kernel-tensor work
+                _tr.g_trace_batch.add_event(
+                    "CommitDebug", req.debug_id, _cdbg.RESOLVER_AFTER_ORDERER
+                )
             t_compute = _time.perf_counter()
             reply = self._resolve_now(req)
             dt_compute = _time.perf_counter() - t_compute
@@ -513,18 +555,85 @@ class ResolverRole:
             cond.notify_all()
             return reply
 
+    def _trace_columnar_decode(self, req) -> None:
+        """The Resolver.resolveBatch.ColumnarDecode micro-event: fired
+        the moment the columnar frame has become the backend's input
+        (kernel tensors on jitted backends, reconstructed objects on
+        the object fallback) — with AfterOrderer as the opening mark,
+        the waterfall's columnar_decode stage is exactly the decode."""
+        if req.debug_id is None:
+            return
+        from foundationdb_tpu.utils import commit_debug as _cdbg
+        from foundationdb_tpu.utils import trace as _tr
+
+        _tr.g_trace_batch.add_event(
+            "CommitDebug", req.debug_id, _cdbg.RESOLVER_COLUMNAR_DECODE
+        )
+
+    def _columnar_to_objects(self, req) -> list:
+        """The object fallback shared by every object-consuming backend
+        (native skip list, CPU oracle): reconstruct exact transactions
+        from the lossless blob — ONE blob -> objects copy, allocations
+        counted honestly — and mark the decode stage. One helper so the
+        ledger-gated accounting can never diverge between backends."""
+        from foundationdb_tpu.utils import packing as _packing
+
+        txns = _packing.columnar_to_transactions(req.cols)
+        self.path_stats["copies"] += 1
+        self.path_stats["decode_allocs"] += _decode_alloc_count(txns)
+        self._trace_columnar_decode(req)
+        return txns
+
     def _resolve_now(self, req) -> ResolveTransactionBatchReply:
+        columnar = isinstance(req, codec.ResolveBatchColumnar)
+        stats = self.path_stats
+        if columnar:
+            stats["columnar_batches"] += 1
+            stats["txns"] += req.cols.n_txns
+        else:
+            stats["object_batches"] += 1
+            stats["txns"] += len(req.transactions)
+            # the object frame already materialized per-txn objects
+            # inside codec.decode (the transport dispatch): one
+            # payload -> objects copy plus the per-txn allocations
+            stats["copies"] += 1
+            stats["decode_allocs"] += _decode_alloc_count(req.transactions)
         if self._backend == "native":
             import time as _time
 
+            txns = (
+                self._columnar_to_objects(req) if columnar
+                else req.transactions
+            )
             t0 = _time.perf_counter()
-            verdicts = self._cs.resolve(req.transactions, req.version)
+            verdicts = self._cs.resolve(txns, req.version)
             self._kernel_metrics.kernel.sample(_time.perf_counter() - t0)
             self._kernel_metrics.counters.add("resolveBatches")
             committed = [TransactionResult(int(v)) for v in verdicts]
             ckr: dict[int, list[int]] = {}
         else:
-            res = self._cs.resolve(req.transactions, req.version)
+            jitted = hasattr(self._cs, "pack_columnar_batch")
+            if columnar and jitted:
+                # THE columnar win: wire bytes -> device tensors with
+                # TWO copies total — the blob -> padded-tensor scatter
+                # (pack_columnar_batch) and the host -> device transfer
+                # inside the dispatch. No per-txn objects ever exist.
+                batch = self._cs.pack_columnar_batch(req.cols, req.version)
+                self._trace_columnar_decode(req)
+                stats["copies"] += 2
+                res = self._cs.resolve_columnar_packed(req.cols, batch)
+            elif columnar:
+                # CPU-oracle backend: object-consuming fallback
+                res = self._cs.resolve(
+                    self._columnar_to_objects(req), req.version
+                )
+            else:
+                if jitted:
+                    # object path on a jitted backend: pack_batch
+                    # re-flattens the decoded objects (+1) and the
+                    # dispatch transfers (+1) on top of the decode copy
+                    stats["copies"] += 2
+                res = self._cs.resolve(req.transactions, req.version)
             committed = res.verdicts
             ckr = res.conflicting_key_ranges
         return ResolveTransactionBatchReply(
@@ -551,6 +660,9 @@ class ResolverRole:
         # the role-owned block (compute seconds + process-global
         # compile-cache counters)
         qos["kernel"] = self._kernel_metrics.qos()
+        # columnar-vs-object frame accounting (r12): bench_pipeline
+        # reads this to land the structural copy/alloc metrics
+        qos["resolve_path"] = dict(self.path_stats)
         return {
             "role": "resolver",
             "version": self.version,
@@ -1605,6 +1717,19 @@ class PipelineFailedError(Exception):
 _RESOLVE_STRIP = os.environ.get("RESOLVE_STRIP", "1") != "0"
 
 
+def _resolve_columnar_default() -> bool:
+    """A/B toggle for the resolve-hop FRAME (r12): 1 (default) = the
+    columnar ResolveBatchColumnar frame — conflict metadata packed ONCE
+    at the proxy as flat little-endian arrays + one key blob, decoded
+    resolver-side with np.frombuffer straight into kernel tensors; 0 =
+    the per-transaction object frame (the escape hatch, and the PR-11
+    baseline path for A/B runs). Columnar applies only to the STRIPPED
+    conflict-metadata hop: with RESOLVE_STRIP=0 (full transactions
+    incl. mutations on the wire) the object frame always runs. Read at
+    pipeline construction so one process can A/B both paths."""
+    return os.environ.get("RESOLVE_COLUMNAR", "1") != "0"
+
+
 class ProxyPipeline:
     """Sequencer + commit proxy over wire-connected roles.
 
@@ -1640,6 +1765,7 @@ class ProxyPipeline:
         ratekeeper: transport.RpcConnection = None,
         rate_fetch_interval: float = 0.25,
         max_grv_queue: int = None,
+        resolve_columnar: bool = None,
     ):
         from foundationdb_tpu.cluster.batching import AdaptiveBatchSizer
         from foundationdb_tpu.utils.knobs import SERVER_KNOBS as _K
@@ -1647,6 +1773,18 @@ class ProxyPipeline:
         self.resolvers = resolvers
         self.tlog = tlog
         self.storage = storage
+        # columnar resolve frame (r12): pack the batch's conflict
+        # metadata ONCE into flat arrays + one key blob at batch-build
+        # time (the layout the resolver's kernel packer consumes), so
+        # the resolve hop is wire bytes -> device tensors with two
+        # copies total. None = the RESOLVE_COLUMNAR env default; the
+        # object frame still runs with RESOLVE_STRIP=0 (mutations must
+        # travel) regardless.
+        self._columnar = (
+            _resolve_columnar_default()
+            if resolve_columnar is None
+            else bool(resolve_columnar)
+        ) and _RESOLVE_STRIP
         # -- admission control (the wire GRV front door): the budget is
         # fetched from the ratekeeper role over GetRateInfo and enforced
         # as an arrival-spacing token bucket with a burst cap; requests
@@ -2152,28 +2290,48 @@ class ProxyPipeline:
         # snapshot, per-txn debug id — never the data mutations, which
         # stay proxy-side for the tlog push (the resolver's verdict
         # doesn't read them): mutation bytes off the wire roughly
-        # halves resolve encode+decode for write-heavy batches.
-        req = ResolveTransactionBatchRequest(
-            prev_version=prev_version,
-            version=version,
-            last_received_version=prev_version,
-            transactions=(
-                [
-                    CommitTransaction(
-                        read_conflict_ranges=t.read_conflict_ranges,
-                        write_conflict_ranges=t.write_conflict_ranges,
-                        read_snapshot=t.read_snapshot,
-                        report_conflicting_keys=t.report_conflicting_keys,
-                        debug_id=t.debug_id,
-                    )
-                    for t in txns
-                ]
-                if _RESOLVE_STRIP
-                else txns
-            ),
-            debug_id=dbg,
-            span=span.context.as_tuple() if span is not None else None,
-        )
+        # halves resolve encode+decode for write-heavy batches. On the
+        # columnar path (default) that metadata packs ONCE into the
+        # flat interval-array layout the resolver kernel consumes —
+        # per-txn counts + versions + one joined key blob — instead of
+        # per-txn objects the resolver would re-flatten.
+        if self._columnar:
+            from foundationdb_tpu.utils import packing as _packing
+
+            req = codec.ResolveBatchColumnar(
+                prev_version=prev_version,
+                version=version,
+                last_received_version=prev_version,
+                cols=_packing.pack_columnar(txns),
+                debug_id=dbg,
+                span=span.context.as_tuple() if span is not None else None,
+            )
+            if dbg is not None:
+                _tr.g_trace_batch.add_event(
+                    "CommitDebug", dbg, _cdbg.PROXY_COLUMNAR_PACK
+                )
+        else:
+            req = ResolveTransactionBatchRequest(
+                prev_version=prev_version,
+                version=version,
+                last_received_version=prev_version,
+                transactions=(
+                    [
+                        CommitTransaction(
+                            read_conflict_ranges=t.read_conflict_ranges,
+                            write_conflict_ranges=t.write_conflict_ranges,
+                            read_snapshot=t.read_snapshot,
+                            report_conflicting_keys=t.report_conflicting_keys,
+                            debug_id=t.debug_id,
+                        )
+                        for t in txns
+                    ]
+                    if _RESOLVE_STRIP
+                    else txns
+                ),
+                debug_id=dbg,
+                span=span.context.as_tuple() if span is not None else None,
+            )
         t_resolve = loop.time()
         replies = await asyncio.gather(
             *(r.call(TOKEN_RESOLVE, req) for r in self.resolvers)

@@ -68,6 +68,7 @@ class KernelStageMetrics:
             [
                 "resolveBatches",
                 "groupDispatches",
+                "columnarBatches",
                 "stagedChunks",
                 "compactions",
                 "latchTrips",
@@ -425,6 +426,64 @@ class TpuConflictSet:
         and the conflicting-key-range report, and merges committed writes
         into history at `version`.
         """
+        self._maybe_rebase(version)
+        t0 = time.perf_counter()
+        batch = packing.pack_batch(
+            transactions, version, self.base_version, self.config
+        )
+        self.metrics.pack.sample(time.perf_counter() - t0)
+        return self._dispatch_and_assemble(
+            batch,
+            report=[t.report_conflicting_keys for t in transactions],
+            begin_key_of_row=lambda r: transactions[
+                int(batch.read_txn[r])
+            ].read_conflict_ranges[int(batch.read_index[r])][0],
+        )
+
+    # -- columnar path (r12: the wire-to-kernel resolve hop) -------------
+
+    def pack_columnar_batch(
+        self, cols: packing.ColumnarBatch, version: int
+    ) -> packing.PackedBatch:
+        """Rebase + decode a columnar wire batch straight into kernel
+        tensors (packing.pack_batch_columnar — byte-identical to
+        pack_batch on the equivalent transaction list, so decisions are
+        identical by construction). No per-txn Python objects. Split
+        from resolve_columnar so the wire ResolverRole can bracket
+        exactly this stage with its ColumnarDecode trace event."""
+        self._maybe_rebase(version)
+        t0 = time.perf_counter()
+        batch = packing.pack_batch_columnar(
+            cols, version, self.base_version, self.config
+        )
+        self.metrics.pack.sample(time.perf_counter() - t0)
+        self.metrics.counters.add("columnarBatches")
+        return batch
+
+    def resolve_columnar_packed(
+        self, cols: packing.ColumnarBatch, batch: packing.PackedBatch
+    ) -> BatchResult:
+        """Dispatch + reply assembly for a pack_columnar_batch result.
+        The conflicting-key report's begin keys slice out of the blob
+        lazily — only the (rare) rows the kernel flagged are touched."""
+        return self._dispatch_and_assemble(
+            batch,
+            report=[
+                bool(int(f) & packing.COLUMNAR_FLAG_REPORT)
+                for f in cols.flags
+            ],
+            begin_key_of_row=lambda r: packing.columnar_key(cols, r),
+        )
+
+    def resolve_columnar(
+        self, cols: packing.ColumnarBatch, version: int
+    ) -> BatchResult:
+        """Columnar twin of resolve(): flat wire columns in, BatchResult
+        out, never materializing per-transaction objects."""
+        batch = self.pack_columnar_batch(cols, version)
+        return self.resolve_columnar_packed(cols, batch)
+
+    def _maybe_rebase(self, version: int) -> None:
         if version - self.base_version > REBASE_THRESHOLD:
             delta = version - self.base_version - (1 << 20)
             if self.tiered:
@@ -434,12 +493,12 @@ class TpuConflictSet:
             self.base_version += delta
             self.metrics.counters.add("rebases")
 
-        t0 = time.perf_counter()
-        batch = packing.pack_batch(
-            transactions, version, self.base_version, self.config
-        )
+    def _dispatch_and_assemble(
+        self, batch: packing.PackedBatch, report, begin_key_of_row
+    ) -> BatchResult:
+        """The shared tail of resolve()/resolve_columnar(): dispatch the
+        packed batch (tiered or classic) and assemble the BatchResult."""
         t1 = time.perf_counter()
-        self.metrics.pack.sample(t1 - t0)
         self.metrics.counters.add("resolveBatches")
         if self.tiered:
             out = self._resolve_args_tiered(batch.device_args())
@@ -447,7 +506,7 @@ class TpuConflictSet:
             self.state, out = self._resolve(self.state, batch.device_args())
             self.metrics.kernel.sample(time.perf_counter() - t1)
         t2 = time.perf_counter()
-        result = self._build_result(transactions, batch, out)
+        result = self._assemble_result(batch, out, report, begin_key_of_row)
         self.metrics.fence.sample(time.perf_counter() - t2)
         return result
 
@@ -904,8 +963,18 @@ class TpuConflictSet:
 
     # -- reply assembly --------------------------------------------------
 
-    def _build_result(self, transactions, batch, out: C.BatchVerdict) -> BatchResult:
-        n = len(transactions)
+    def _assemble_result(
+        self, batch, out: C.BatchVerdict, report, begin_key_of_row
+    ) -> BatchResult:
+        """Shared reply assembly for the object and columnar paths.
+
+        `report[t]` = the txn asked for the conflicting-key report;
+        `begin_key_of_row(r)` = flat read row r's range BEGIN key bytes
+        (object path: through the transaction list; columnar: sliced
+        from the frame's key blob) — only the rows the kernel flagged
+        as history hits are ever touched.
+        """
+        n = batch.n_txns
         verdict = np.asarray(out.verdict)[:n]
         # Same device sync the verdict read just paid: refuse to externalize
         # decisions computed against a truncated history (ADVICE r1 — the
@@ -923,10 +992,11 @@ class TpuConflictSet:
             if hist_read[r]:
                 t = int(batch.read_txn[r])
                 idx = int(batch.read_index[r])
-                begin = transactions[t].read_conflict_ranges[idx][0]
-                hist_hits_by_txn.setdefault(t, []).append((begin, idx))
-        for t, tr in enumerate(transactions):
-            if not tr.report_conflicting_keys:
+                hist_hits_by_txn.setdefault(t, []).append(
+                    (begin_key_of_row(r), idx)
+                )
+        for t in range(n):
+            if not report[t]:
                 continue
             if verdicts[t] != TransactionResult.CONFLICT:
                 continue

@@ -45,9 +45,17 @@ BATCH_GETTING_VERSION = "CommitProxy.commitBatch.GettingCommitVersion"
 BATCH_GOT_VERSION = "CommitProxy.commitBatch.GotCommitVersion"
 BATCH_AFTER_RESOLUTION = "CommitProxy.commitBatch.AfterResolution"
 BATCH_AFTER_LOG_PUSH = "CommitProxy.commitBatch.AfterLogPush"
+#: columnar wire path (r12): the proxy finished packing the batch's
+#: conflict metadata into the columnar frame (flat arrays + key blob)
+PROXY_COLUMNAR_PACK = "CommitProxy.commitBatch.ColumnarPack"
 RESOLVER_BEFORE = "Resolver.resolveBatch.Before"
 RESOLVER_AFTER_QUEUE = "Resolver.resolveBatch.AfterQueueSizeCheck"
 RESOLVER_AFTER_ORDERER = "Resolver.resolveBatch.AfterOrderer"
+#: columnar wire path (r12): the resolver turned the frame into the
+#: conflict backend's input (kernel tensors / reconstructed objects);
+#: with AfterOrderer as the opening mark, the waterfall's
+#: columnar_decode stage brackets exactly the decode
+RESOLVER_COLUMNAR_DECODE = "Resolver.resolveBatch.ColumnarDecode"
 RESOLVER_AFTER = "Resolver.resolveBatch.After"
 TLOG_BEFORE_WAIT = "TLog.tLogCommit.BeforeWaitForVersion"
 TLOG_AFTER_COMMIT = "TLog.tLogCommit.AfterTLogCommit"
@@ -110,6 +118,13 @@ class Timeline:
         stage("grv", GRV_BEFORE, GRV_AFTER)
         stage("batching", COMMIT_BEFORE, BATCH_BEFORE)
         stage("get_version", BATCH_BEFORE, BATCH_GOT_VERSION)
+        # columnar wire path (r12): proxy-side pack and resolver-side
+        # decode attributed explicitly inside the resolution window —
+        # absent on object-path runs, so an --aggregate A/B shows
+        # exactly where the microseconds went
+        stage("columnar_pack", BATCH_GOT_VERSION, PROXY_COLUMNAR_PACK)
+        stage("columnar_decode", RESOLVER_AFTER_ORDERER,
+              RESOLVER_COLUMNAR_DECODE)
         stage("resolution", BATCH_GOT_VERSION, BATCH_AFTER_RESOLUTION)
         stage("logging", BATCH_AFTER_RESOLUTION, BATCH_AFTER_LOG_PUSH)
         stage("reply", BATCH_AFTER_LOG_PUSH, COMMIT_AFTER)

@@ -15,6 +15,7 @@ version fits comfortably in 31 bits; `Resolver` re-bases periodically.
 from __future__ import annotations
 
 import dataclasses
+from typing import Any
 
 import numpy as np
 
@@ -58,33 +59,60 @@ def pack_keys(
     padded matrix through cumsum offsets, instead of a per-key
     frombuffer loop — the loop dominated host packing at bench batch
     sizes (tests/test_packing.py pins byte-identical output against the
-    loop version, _pack_keys_reference).
+    loop version, _pack_keys_reference). The scatter itself lives in
+    pack_keys_from_blob so the columnar wire decode (r12) runs the SAME
+    code over the frame's already-joined blob — the two paths cannot
+    produce different matrices.
     """
     n = len(keys)
+    w = max_key_bytes // 4 + 1
+    if n == 0:
+        return np.zeros((n, w), np.uint32)
+    lens = np.fromiter((len(k) for k in keys), np.int64, count=n)
+    cat = np.frombuffer(b"".join(keys), np.uint8)
+    return pack_keys_from_blob(
+        cat, np.cumsum(lens) - lens, lens, max_key_bytes, round_up=round_up
+    )
+
+
+def pack_keys_from_blob(
+    cat: np.ndarray,
+    starts: np.ndarray,
+    lens: np.ndarray,
+    max_key_bytes: int,
+    *,
+    round_up: bool = False,
+) -> np.ndarray:
+    """pack_keys over an already-joined key blob: key i occupies
+    ``cat[starts[i] : starts[i] + lens[i]]`` (uint8 view, possibly a
+    zero-copy view of a wire frame payload).
+
+    This is the columnar resolve frame's decode-to-kernel scatter —
+    and the body pack_keys itself delegates to, so the object path and
+    the columnar path are byte-identical by construction, not by test
+    alone. Long keys (> max_key_bytes) degrade conservatively exactly
+    like pack_key: only the first max_key_bytes bytes are taken and the
+    length word saturates (max, or max+1 for round_up end keys).
+    """
+    n = len(lens)
     w = max_key_bytes // 4 + 1
     out = np.zeros((n, w), np.uint32)
     if n == 0:
         return out
-    lens_raw = np.fromiter((len(k) for k in keys), np.int64, count=n)
-    over = lens_raw > max_key_bytes
-    kept = np.minimum(lens_raw, max_key_bytes)
-    lens = np.where(
-        over, max_key_bytes + 1 if round_up else max_key_bytes, lens_raw
+    lens = np.asarray(lens, np.int64)
+    starts = np.asarray(starts, np.int64)
+    over = lens > max_key_bytes
+    kept = np.minimum(lens, max_key_bytes)
+    out_lens = np.where(
+        over, max_key_bytes + 1 if round_up else max_key_bytes, lens
     )
-    if over.any():
-        blob = b"".join(
-            k if len(k) <= max_key_bytes else k[:max_key_bytes] for k in keys
-        )
-    else:
-        blob = b"".join(keys)
-    cat = np.frombuffer(blob, np.uint8)
     buf = np.zeros((n, max_key_bytes), np.uint8)
     rows = np.repeat(np.arange(n), kept)
-    offs = np.concatenate([[0], np.cumsum(kept)[:-1]])
-    cols = np.arange(cat.shape[0]) - np.repeat(offs, kept)
-    buf[rows, cols] = cat
+    offs = np.cumsum(kept) - kept
+    cols = np.arange(int(kept.sum())) - np.repeat(offs, kept)
+    buf[rows, cols] = cat[np.repeat(starts, kept) + cols]
     out[:, :-1] = buf.view(">u4").astype(np.uint32).reshape(n, w - 1)
-    out[:, -1] = lens.astype(np.uint32)
+    out[:, -1] = out_lens.astype(np.uint32)
     return out
 
 
@@ -364,6 +392,281 @@ def pack_batch_reference(
         write_txn=_col(w_txn, nw, fill=b),
         write_valid=_col([True] * nwrite, nw, bool),
     )
+
+
+# ---------------------------------------------------------------------------
+# Columnar resolve batch (r12 — the wire-to-kernel path): one batch's
+# conflict metadata as flat columns, packed ONCE at the proxy in the
+# layout pack_batch already consumes (per-txn counts + one joined key
+# blob + versions), so the resolver decodes wire bytes straight into
+# kernel tensors without ever materializing per-transaction objects.
+
+#: The columnar frame's array layout — ONE constant shared by the wire
+#: encoder and decoder (wire/codec.py w_/r_resolve_columnar) so dtypes
+#: and endianness can never drift: every column is a packed
+#: little-endian fixed-width vector with NO padding or alignment (the
+#: decoder reads with np.frombuffer at raw byte offsets; numpy handles
+#: unaligned access). Array lengths derive from the frame header's
+#: (n_txns, n_reads, n_writes) counts. The key blob follows as one
+#: u32-length-prefixed contiguous slice.
+COLUMNAR_LAYOUT = (
+    ("snapshots", "<i8", "n_txns"),
+    ("read_counts", "<u4", "n_txns"),
+    ("write_counts", "<u4", "n_txns"),
+    ("flags", "<u1", "n_txns"),
+    ("key_lens", "<u4", "n_keys"),  # n_keys = 2*n_reads + 2*n_writes
+)
+
+#: flags bit 0: the txn asked for the conflicting-key-range report
+COLUMNAR_FLAG_REPORT = 1
+
+#: canonical key order inside key_lens / key_blob: all read-range begin
+#: keys, then read ends, then write begins, then write ends — four
+#: contiguous runs so each kernel column packs with ONE vectorized
+#: scatter over its slice of the blob
+_KEY_ORDER_DOC = ("read_begin", "read_end", "write_begin", "write_end")
+
+
+@dataclasses.dataclass
+class ColumnarBatch:
+    """One resolve batch as flat columns (host side of the columnar
+    wire frame; see COLUMNAR_LAYOUT for the wire dtypes).
+
+    Versions are ABSOLUTE here (the proxy doesn't know the resolver's
+    rebase base); pack_batch_columnar does the same vectorized
+    offset/clamp pass pack_batch does. Keys are carried LOSSLESSLY in
+    the blob — truncation of over-length keys happens only in the
+    kernel packer, so the object-path fallback (native skip list / CPU
+    oracle via columnar_to_transactions) sees exact bytes.
+    """
+
+    n_txns: int
+    n_reads: int               # sum(read_counts) — cross-checked on decode
+    n_writes: int              # sum(write_counts)
+    snapshots: np.ndarray      # <i8 [n_txns] absolute read_snapshot
+    read_counts: np.ndarray    # <u4 [n_txns]
+    write_counts: np.ndarray   # <u4 [n_txns]
+    flags: np.ndarray          # <u1 [n_txns] (COLUMNAR_FLAG_REPORT)
+    key_lens: np.ndarray       # <u4 [2*n_reads + 2*n_writes], canonical order
+    key_blob: Any              # bytes | memoryview, sum(key_lens) bytes
+
+    def __eq__(self, other):
+        if not isinstance(other, ColumnarBatch):
+            return NotImplemented
+        return (
+            self.n_txns == other.n_txns
+            and self.n_reads == other.n_reads
+            and self.n_writes == other.n_writes
+            and np.array_equal(self.snapshots, other.snapshots)
+            and np.array_equal(self.read_counts, other.read_counts)
+            and np.array_equal(self.write_counts, other.write_counts)
+            and np.array_equal(self.flags, other.flags)
+            and np.array_equal(self.key_lens, other.key_lens)
+            and bytes(self.key_blob) == bytes(other.key_blob)
+        )
+
+
+def pack_columnar(transactions) -> ColumnarBatch:
+    """Proxy-side columnar pack: CommitTransaction list -> flat columns,
+    ONCE per batch at batch-build time (the per-key work is one bytes
+    join; everything per-txn is bulk numpy). The resolver side never
+    re-flattens: pack_batch_columnar scatters the blob straight into
+    kernel tensors."""
+    n = len(transactions)
+    r_lists = [t.read_conflict_ranges for t in transactions]
+    w_lists = [t.write_conflict_ranges for t in transactions]
+    if n:
+        read_counts = np.fromiter(
+            (len(x) for x in r_lists), np.uint32, count=n
+        )
+        write_counts = np.fromiter(
+            (len(x) for x in w_lists), np.uint32, count=n
+        )
+        snapshots = np.fromiter(
+            (t.read_snapshot for t in transactions), np.int64, count=n
+        )
+        flags = np.fromiter(
+            (
+                COLUMNAR_FLAG_REPORT if t.report_conflicting_keys else 0
+                for t in transactions
+            ),
+            np.uint8,
+            count=n,
+        )
+    else:
+        read_counts = write_counts = np.zeros((0,), np.uint32)
+        snapshots = np.zeros((0,), np.int64)
+        flags = np.zeros((0,), np.uint8)
+    # canonical key order (_KEY_ORDER_DOC): four contiguous runs
+    keys: list[bytes] = []
+    for lists, side in ((r_lists, 0), (r_lists, 1), (w_lists, 0), (w_lists, 1)):
+        keys.extend(rg[side] for lst in lists for rg in lst)
+    nread, nwrite = int(read_counts.sum()), int(write_counts.sum())
+    key_lens = (
+        np.fromiter((len(k) for k in keys), np.uint32, count=len(keys))
+        if keys
+        else np.zeros((0,), np.uint32)
+    )
+    return ColumnarBatch(
+        n_txns=n,
+        n_reads=nread,
+        n_writes=nwrite,
+        snapshots=snapshots,
+        read_counts=read_counts,
+        write_counts=write_counts,
+        flags=flags,
+        key_lens=key_lens,
+        key_blob=b"".join(keys),
+    )
+
+
+def pack_batch_columnar(
+    cols: ColumnarBatch,
+    version: int,
+    base_version: int,
+    config: KernelConfig,
+) -> PackedBatch:
+    """Columnar twin of pack_batch: flat columns -> kernel tensors.
+
+    Byte-identical to ``pack_batch(txns, ...)`` whenever
+    ``cols == pack_columnar(txns)`` (pinned in tests/test_packing.py)
+    — the per-txn columns come from the same repeat/cumsum formulas and
+    the key matrices from the same pack_keys_from_blob scatter, so the
+    columnar wire path and the object path cannot diverge in what the
+    kernel sees. No per-transaction Python objects are materialized.
+    """
+    cfg = config
+    b, nr, nw, w = cfg.max_txns, cfg.max_reads, cfg.max_writes, cfg.key_words
+    n = cols.n_txns
+    if n > b:
+        raise ValueError(f"{n} txns > max_txns {b}")
+
+    txn_valid = np.zeros((b,), bool)
+    snapshot = np.full((b,), VERSION_NEG, np.int32)
+    has_reads = np.zeros((b,), bool)
+    if n:
+        txn_valid[:n] = True
+        off = cols.snapshots.astype(np.int64) - base_version
+        high = off >= 2**31
+        if high.any():
+            bad = int(off[high][0])
+            raise OverflowError(f"version offset {bad} overflows int32; rebase")
+        snapshot[:n] = np.where(
+            off <= int(VERSION_NEG), int(VERSION_NEG), off
+        ).astype(np.int32)
+        r_counts = cols.read_counts.astype(np.int64)
+        w_counts = cols.write_counts.astype(np.int64)
+        has_reads[:n] = r_counts > 0
+    else:
+        r_counts = w_counts = np.zeros((0,), np.int64)
+
+    nread = int(r_counts.sum())
+    nwrite = int(w_counts.sum())
+    if nread > nr:
+        raise ValueError(f"{nread} read ranges > max_reads {nr}")
+    if nwrite > nw:
+        raise ValueError(f"{nwrite} write ranges > max_writes {nw}")
+
+    ids = np.arange(n, dtype=np.int32)
+    r_txn = np.repeat(ids, r_counts)
+    w_txn = np.repeat(ids, w_counts)
+    r_starts = np.cumsum(r_counts) - r_counts if n else r_counts
+    r_idx = (np.arange(nread) - np.repeat(r_starts, r_counts)).astype(np.int32)
+
+    cat = np.frombuffer(cols.key_blob, np.uint8)
+    lens = np.asarray(cols.key_lens, np.int64)
+    starts = np.cumsum(lens) - lens
+
+    def _col_keys(lo, m, cap, round_up):
+        out = np.zeros((cap, w), np.uint32)
+        if m:
+            out[:m] = pack_keys_from_blob(
+                cat, starts[lo : lo + m], lens[lo : lo + m],
+                cfg.max_key_bytes, round_up=round_up,
+            )
+        return out
+
+    rb = _col_keys(0, nread, nr, False)
+    re = _col_keys(nread, nread, nr, True)
+    wb = _col_keys(2 * nread, nwrite, nw, False)
+    we = _col_keys(2 * nread + nwrite, nwrite, nw, True)
+
+    def _col(vals, cap, dtype=np.int32, fill=0):
+        out = np.full((cap,), fill, dtype)
+        out[: len(vals)] = vals
+        return out
+
+    return PackedBatch(
+        version=_clamp_version(version, base_version),
+        new_oldest=_clamp_version(version - cfg.window_versions, base_version),
+        n_txns=n,
+        n_reads=nread,
+        n_writes=nwrite,
+        txn_valid=txn_valid,
+        snapshot=snapshot,
+        has_reads=has_reads,
+        read_begin=rb,
+        read_end=re,
+        read_txn=_col(r_txn, nr, fill=b),
+        read_index=_col(r_idx, nr),
+        read_valid=_col([True] * nread, nr, bool),
+        write_begin=wb,
+        write_end=we,
+        write_txn=_col(w_txn, nw, fill=b),
+        write_valid=_col([True] * nwrite, nw, bool),
+    )
+
+
+def columnar_key(cols: ColumnarBatch, index: int) -> bytes:
+    """Key `index` (canonical order) sliced out of the blob — used by
+    the conflicting-key-range report assembly, which only touches the
+    (rare) rows the kernel flagged."""
+    lens = cols.key_lens
+    start = int(np.asarray(lens[:index], np.int64).sum())
+    return bytes(
+        memoryview(cols.key_blob)[start : start + int(lens[index])]
+    )
+
+
+def columnar_to_transactions(cols: ColumnarBatch) -> list:
+    """Columnar frame -> per-txn CommitTransaction objects: the OBJECT
+    fallback for conflict backends that consume byte keys directly (the
+    native skip list, the CPU oracle). Keys are exact — the blob
+    carries full bytes, truncation only ever happens in the kernel
+    packer — so decisions match the object wire path bit for bit."""
+    from foundationdb_tpu.models.types import CommitTransaction
+
+    lens = np.asarray(cols.key_lens, np.int64)
+    ends = np.cumsum(lens)
+    starts = ends - lens
+    view = memoryview(cols.key_blob)
+    keys = [bytes(view[s:e]) for s, e in zip(starts, ends)]
+    nread, nwrite = cols.n_reads, cols.n_writes
+    rb, re_ = keys[:nread], keys[nread : 2 * nread]
+    wb = keys[2 * nread : 2 * nread + nwrite]
+    we = keys[2 * nread + nwrite :]
+    out = []
+    ri = wi = 0
+    for t in range(cols.n_txns):
+        rc = int(cols.read_counts[t])
+        wc = int(cols.write_counts[t])
+        out.append(
+            CommitTransaction(
+                read_conflict_ranges=list(
+                    zip(rb[ri : ri + rc], re_[ri : ri + rc])
+                ),
+                write_conflict_ranges=list(
+                    zip(wb[wi : wi + wc], we[wi : wi + wc])
+                ),
+                read_snapshot=int(cols.snapshots[t]),
+                report_conflicting_keys=bool(
+                    int(cols.flags[t]) & COLUMNAR_FLAG_REPORT
+                ),
+            )
+        )
+        ri += rc
+        wi += wc
+    return out
 
 
 def stack_device_args(batches) -> dict:

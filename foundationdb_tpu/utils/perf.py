@@ -591,6 +591,33 @@ def pipeline_row_to_records(row: dict, *, imported_from: str = None,
         if "ops" in res:
             metrics["ops"] = metric(res["ops"], "ops", "higher",
                                     tier=count_tier)
+        # columnar wire path (r12): the resolver role's copy/alloc
+        # accounting is STRUCTURAL — path-determined ratios (copies
+        # per batch, decode allocs per txn), deterministic regardless
+        # of batching/timing — so the "two copies" claim is gated
+        # exactly by perfcheck, not asserted in prose. Only present on
+        # runs that report it (keeps the historical --import
+        # byte-stable: PIPELINE_r0x rows predate the metric).
+        if "resolve_copies_per_batch" in res:
+            metrics["resolve_copies_per_batch"] = metric(
+                res["resolve_copies_per_batch"], "copies", "lower",
+                tier="structural",
+            )
+        if "resolve_decode_allocs_per_txn" in res:
+            metrics["resolve_decode_allocs_per_txn"] = metric(
+                res["resolve_decode_allocs_per_txn"], "allocs", "lower",
+                tier="structural",
+            )
+        knobs = {
+            "batch": row.get("batch"),
+            "kernel_txns": row.get("kernel_txns"),
+            "kernel": row.get("kernel"),
+        }
+        if row.get("resolve_path"):
+            # frame A/B knob: keys columnar and object rows apart in
+            # the baseline fingerprint (absent on pre-r12 rows and
+            # cluster-mode rows, so their keys are unchanged)
+            knobs["resolve_path"] = row["resolve_path"]
         recs.append(make_record(
             "bench_pipeline", metrics,
             workload={
@@ -601,11 +628,7 @@ def pipeline_row_to_records(row: dict, *, imported_from: str = None,
                 "records": row.get("records"),
                 "resolver_backend": backend,
             },
-            knobs={
-                "batch": row.get("batch"),
-                "kernel_txns": row.get("kernel_txns"),
-                "kernel": row.get("kernel"),
-            },
+            knobs=knobs,
             fingerprint=this_fp, imported_from=imported_from,
         ))
     return recs

@@ -21,15 +21,18 @@ import struct
 import threading
 from typing import Any, Callable
 
+import numpy as np
+
 from foundationdb_tpu.models.types import (
     CommitTransaction,
     ResolveTransactionBatchReply,
     ResolveTransactionBatchRequest,
     TransactionResult,
 )
+from foundationdb_tpu.utils.packing import COLUMNAR_LAYOUT, ColumnarBatch
 
 #: Bumped whenever any wire layout changes; checked at connect time.
-PROTOCOL_VERSION = 0x0FDB_7E50_0006  # 0004: span context; 0005: lock_aware txn flag; 0006: per-txn debug_id + span
+PROTOCOL_VERSION = 0x0FDB_7E50_0007  # 0004: span context; 0005: lock_aware txn flag; 0006: per-txn debug_id + span; 0007: columnar resolve frame
 
 
 class CodecError(ValueError):
@@ -443,6 +446,160 @@ def r_resolve_reply(
 
 
 # ---------------------------------------------------------------------------
+# Columnar resolve frame (r12): the resolve hop's conflict metadata as
+# flat fixed-width little-endian arrays + ONE contiguous key blob — the
+# exact layout utils/packing.pack_batch consumes, packed once at the
+# proxy (packing.pack_columnar) and decoded resolver-side with
+# np.frombuffer over the zero-copy frame payload (no per-transaction
+# objects). Dtypes/endianness are pinned by packing.COLUMNAR_LAYOUT,
+# the ONE constant this encoder and decoder both iterate.
+
+
+class ResolveBatchColumnar:
+    """Columnar twin of ResolveTransactionBatchRequest: same version-
+    chain header (prev_version / version / last_received_version,
+    proxy_id, debug_id, span), conflict metadata as a
+    packing.ColumnarBatch instead of per-txn objects. Carries no
+    mutations and no txn_state_transactions — the proxy falls back to
+    the object frame for state batches or RESOLVE_STRIP=0 runs."""
+
+    __slots__ = (
+        "prev_version",
+        "version",
+        "last_received_version",
+        "proxy_id",
+        "debug_id",
+        "span",
+        "cols",
+    )
+
+    def __init__(
+        self,
+        prev_version: int,
+        version: int,
+        last_received_version: int,
+        cols: ColumnarBatch,
+        proxy_id: str | None = None,
+        debug_id: str | None = None,
+        span: tuple | None = None,
+    ):
+        self.prev_version = prev_version
+        self.version = version
+        self.last_received_version = last_received_version
+        self.cols = cols
+        self.proxy_id = proxy_id
+        self.debug_id = debug_id
+        self.span = span
+
+    def __eq__(self, other):
+        if not isinstance(other, ResolveBatchColumnar):
+            return NotImplemented
+        return (
+            self.prev_version == other.prev_version
+            and self.version == other.version
+            and self.last_received_version == other.last_received_version
+            and self.proxy_id == other.proxy_id
+            and self.debug_id == other.debug_id
+            and self.span == other.span
+            and self.cols == other.cols
+        )
+
+    def __repr__(self):
+        return (
+            f"ResolveBatchColumnar(version={self.version}, "
+            f"n_txns={self.cols.n_txns}, n_reads={self.cols.n_reads}, "
+            f"n_writes={self.cols.n_writes})"
+        )
+
+
+def w_resolve_columnar(out: WriteBuffer, r: ResolveBatchColumnar) -> None:
+    cols = r.cols
+    w_i64(out, r.prev_version)
+    w_i64(out, r.version)
+    w_i64(out, r.last_received_version)
+    w_u32(out, cols.n_txns)
+    w_u32(out, cols.n_reads)
+    w_u32(out, cols.n_writes)
+    for name, dt, _dim in COLUMNAR_LAYOUT:
+        arr = np.ascontiguousarray(getattr(cols, name), dtype=np.dtype(dt))
+        out.put_raw(memoryview(arr).cast("B"))
+    # the key blob: one u32-length-prefixed contiguous slice
+    w_bytes(out, cols.key_blob)
+    w_str(out, r.proxy_id)
+    w_str(out, r.debug_id)
+    tid, sid = r.span if r.span else (0, 0)
+    w_u64(out, tid)
+    w_u64(out, sid)
+
+
+def r_resolve_columnar(
+    buf: memoryview, off: int
+) -> tuple[ResolveBatchColumnar, int]:
+    prev, off = r_i64(buf, off)
+    ver, off = r_i64(buf, off)
+    last, off = r_i64(buf, off)
+    n_txns, off = r_u32(buf, off)
+    n_reads, off = r_u32(buf, off)
+    n_writes, off = r_u32(buf, off)
+    n_keys = 2 * (n_reads + n_writes)
+    arrays: dict[str, np.ndarray] = {}
+    for name, dt, dim in COLUMNAR_LAYOUT:
+        count = n_txns if dim == "n_txns" else n_keys
+        dtype = np.dtype(dt)
+        nbytes = count * dtype.itemsize
+        # bounds BEFORE any allocation: a forged header count must fail
+        # cheaply, never size an array from attacker-controlled ints
+        if off + nbytes > len(buf):
+            raise CodecError(f"truncated columnar array {name!r}")
+        arrays[name] = np.frombuffer(buf, dtype=dtype, count=count, offset=off)
+        off += nbytes
+    blob_len, off = r_u32(buf, off)
+    if off + blob_len > len(buf):
+        raise CodecError("truncated columnar key blob")
+    blob = buf[off : off + blob_len]  # zero-copy payload slice
+    off += blob_len
+    proxy_id, off = r_str(buf, off)
+    debug_id, off = r_str(buf, off)
+    tid, off = r_u64(buf, off)
+    sid, off = r_u64(buf, off)
+    # internal-consistency validation (defensive decode): the per-txn
+    # counts must sum to the header totals and the key lengths must
+    # tile the blob exactly — every downstream offset is a cumsum over
+    # key_lens, so these two checks make out-of-bounds slices
+    # unrepresentable rather than caught late.
+    rsum = int(np.asarray(arrays["read_counts"], np.int64).sum())
+    wsum = int(np.asarray(arrays["write_counts"], np.int64).sum())
+    if rsum != n_reads or wsum != n_writes:
+        raise CodecError(
+            f"columnar count mismatch: header ({n_reads}, {n_writes}) vs "
+            f"column sums ({rsum}, {wsum})"
+        )
+    if int(np.asarray(arrays["key_lens"], np.int64).sum()) != blob_len:
+        raise CodecError(
+            f"columnar key blob length {blob_len} != sum(key_lens)"
+        )
+    cols = ColumnarBatch(
+        n_txns=n_txns,
+        n_reads=n_reads,
+        n_writes=n_writes,
+        key_blob=blob,
+        **arrays,
+    )
+    return (
+        ResolveBatchColumnar(
+            prev_version=prev,
+            version=ver,
+            last_received_version=last,
+            cols=cols,
+            proxy_id=proxy_id,
+            debug_id=debug_id,
+            span=(tid, sid) if (tid or sid) else None,
+        ),
+        off,
+    )
+
+
+# ---------------------------------------------------------------------------
 # Registry: type id <-> (encoder, decoder). Ids are stable wire contract
 # (the FileIdentifier analog); never reuse an id for a different layout.
 
@@ -462,6 +619,7 @@ register(
     0x0102, ResolveTransactionBatchRequest, w_resolve_request, r_resolve_request
 )
 register(0x0103, ResolveTransactionBatchReply, w_resolve_reply, r_resolve_reply)
+register(0x0104, ResolveBatchColumnar, w_resolve_columnar, r_resolve_columnar)
 
 
 def encode_into(out: WriteBuffer, msg: Any) -> None:

@@ -210,11 +210,16 @@ async def _run_wire(backend: str, args) -> dict:
             rk_conn = None
             if getattr(args, "ratekeeper", False):
                 rk_conn = await mp.connect(procs[3].address)
+            # resolve-hop frame A/B (r12): --resolve-path pins the
+            # columnar vs object frame per run; None = RESOLVE_COLUMNAR
+            # env default (columnar)
+            rp = getattr(args, "resolve_path", None)
             pipe = mp.ProxyPipeline(
                 [resolver], tlog, storage,
                 batch_interval=0.001, max_batch=args.batch,
                 trace=bool(trace_dir),
                 ratekeeper=rk_conn,
+                resolve_columnar=(None if rp is None else rp == "columnar"),
             )
             pipe.start()
             status_server = None
@@ -315,6 +320,27 @@ async def _run_wire(backend: str, args) -> dict:
                 assert got.get(key, 0) == cnt, (
                     f"{key}: storage={got.get(key, 0)} committed={cnt}"
                 )
+
+            # columnar-vs-object structural accounting from the resolver
+            # role (status qos.resolve_path): full key-data copies per
+            # batch between wire payload and conflict-backend input, and
+            # per-txn Python objects materialized by decode — the
+            # "two copies" claim as ledger-gated numbers (perfcheck),
+            # deterministic ratios regardless of batching/timing.
+            st = await resolver.call(
+                mp.TOKEN_STATUS, mp.StatusRequest(pad=0)
+            )
+            ps = json.loads(st.payload)["qos"]["resolve_path"]
+            n_batches = ps["columnar_batches"] + ps["object_batches"]
+            stats["resolve_copies_per_batch"] = round(
+                ps["copies"] / max(1, n_batches), 3
+            )
+            stats["resolve_decode_allocs_per_txn"] = round(
+                ps["decode_allocs"] / max(1, ps["txns"]), 3
+            )
+            stats["resolve_path"] = (
+                "columnar" if ps["columnar_batches"] else "object"
+            )
             hold = float(getattr(args, "hold", 0) or 0)
             if hold:
                 # keep the cluster (and status sockets) alive so an
@@ -377,6 +403,139 @@ async def _run_wire(backend: str, args) -> dict:
     }
 
 
+def emit_row(args, results: dict) -> dict:
+    """Build + print the run's JSON row, append --json-out, and land
+    one perf-ledger record per backend (the shared tail of normal runs
+    and each smoke sub-run)."""
+    row = {
+        "metric": "pipeline_commit_txn_s",
+        "spec": "config5_ycsb_a",
+        "mode": args.mode,
+        "inflight": args.clients,
+        "ops_per_client": args.ops,
+        "records": args.records,
+        "batch": args.batch,
+        "kernel_txns": args.kernel_txns,
+        "kernel": "classic" if args.classic_kernel else "tiered",
+        "backends": results,
+    }
+    # the resolve-hop frame, as OBSERVED by the resolver role's
+    # path_stats (wire mode only) — never re-derived from env/args, so
+    # the ledger's fingerprint knob cannot mislabel a run if the
+    # pipeline's frame-selection policy grows a new fallback
+    observed = {
+        r["resolve_path"] for r in results.values() if "resolve_path" in r
+    }
+    if len(observed) == 1:
+        row["resolve_path"] = observed.pop()
+    print(json.dumps(row))
+    if args.json_out:
+        with open(args.json_out, "a") as f:
+            f.write(json.dumps(row) + "\n")
+    if not args.no_perf:
+        # canonical perf-ledger rows (one per backend), same converter
+        # the historical-artifact importer uses so fingerprint keys line
+        # up across PIPELINE_r0*.json and fresh runs
+        from foundationdb_tpu.utils import perf
+
+        fp = perf.device_fingerprint()
+        for rec in perf.pipeline_row_to_records(row, fingerprint=None):
+            # fingerprint.backend stays the RESOLVER backend (also in
+            # the workload key), but the HOST device identity — device
+            # kind/count, jax/jaxlib — must be real: without it a
+            # tpu-force wire run on a CPU laptop and one on a v5e
+            # would share a hardware comparability key
+            rec["fingerprint"].update(
+                {k: fp[k] for k in ("device_kind", "device_count",
+                                    "jax_version", "jaxlib_version",
+                                    "python_version", "machine")}
+            )
+            path = perf.append(rec, path=args.perf_ledger)
+        print(f"[perf] {len(results)} ledger row(s) appended to {path}",
+              flush=True)
+    return row
+
+
+def run_smoke(args) -> int:
+    """The check.sh lane, now with the columnar A/B (r12):
+
+    1. native + columnar frame, traced: consistency ok + >=1
+       cross-process commit_debug timeline (the original contract).
+    2. native + object frame at identical shapes: DECISION PARITY —
+       committed/read/op counts must match run 1 exactly (clients draw
+       from per-client seeded rngs, so both runs submit the same
+       transactions; a frame that changed any verdict changes the
+       counts).
+    3. tpu-force + columnar at a tiny kernel (--kernel-txns 64): the
+       structural two-copies row — resolve_copies_per_batch == 2 and
+       resolve_decode_allocs_per_txn == 0 — asserted here AND gated by
+       the perfcheck lane against the committed perf history.
+    """
+    args.mode = "wire"
+    args.clients = 32
+    args.ops = 2
+    if not args.trace_dir:
+        import tempfile as _tf
+
+        args.trace_dir = _tf.mkdtemp(prefix="bench_pipe_smoke_")
+    if not args.perf_ledger and "FDBTPU_PERF_LEDGER" not in os.environ:
+        # smoke rows are still emitted (schema-valid, gate-checked by
+        # tests) but land next to the trace files, not in the committed
+        # history — a green CI run must not dirty it
+        args.perf_ledger = os.path.join(args.trace_dir, "perf_smoke.jsonl")
+
+    def sub(backend, resolve_path, *, traced, kernel_txns=None):
+        a = argparse.Namespace(**vars(args))
+        a.resolve_path = resolve_path
+        if not traced:
+            a.trace_dir = None
+        if kernel_txns is not None:
+            a.kernel_txns = kernel_txns
+        print(f"== smoke {backend} / {resolve_path} frame ==", flush=True)
+        res = asyncio.run(_run_wire(backend, a))
+        emit_row(a, {backend: res})
+        return res
+
+    r_col = sub("native", "columnar", traced=True)
+    r_obj = sub("native", "object", traced=False)
+    r_tpu = sub("tpu-force", "columnar", traced=False, kernel_txns=64)
+
+    failures = []
+    if r_col.get("consistency") != "ok" or r_obj.get("consistency") != "ok" \
+            or r_tpu.get("consistency") != "ok":
+        failures.append("consistency not ok")
+    if (r_col.get("traced_timelines", 0) < 1
+            or r_col.get("traced_cross_process", 0) < 1):
+        failures.append("no cross-process commit_debug timeline")
+    if r_col.get("resolve_path") != "columnar" \
+            or r_obj.get("resolve_path") != "object":
+        failures.append(
+            f"frame routing: {r_col.get('resolve_path')} / "
+            f"{r_obj.get('resolve_path')}"
+        )
+    for k in ("committed", "reads", "ops"):
+        if r_col.get(k) != r_obj.get(k):
+            failures.append(
+                f"columnar/object {k} parity: "
+                f"{r_col.get(k)} vs {r_obj.get(k)}"
+            )
+    if r_tpu.get("resolve_copies_per_batch") != 2.0:
+        failures.append(
+            "columnar copies per batch "
+            f"{r_tpu.get('resolve_copies_per_batch')} != 2"
+        )
+    if r_tpu.get("resolve_decode_allocs_per_txn") != 0.0:
+        failures.append(
+            "columnar decode allocs "
+            f"{r_tpu.get('resolve_decode_allocs_per_txn')} != 0"
+        )
+    if failures:
+        print(f"bench_pipeline smoke FAILED: {failures}")
+        return 1
+    print("bench_pipeline smoke ok (columnar A/B parity + two-copies row)")
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("legacy", nargs="*", type=int,
@@ -396,6 +555,12 @@ def main():
                          "native,tpu-force (wire)")
     ap.add_argument("--classic-kernel", action="store_true",
                     help="tpu backends use the classic (non-tiered) kernel")
+    ap.add_argument("--resolve-path", choices=("columnar", "object"),
+                    default=None,
+                    help="wire mode: resolve-hop frame A/B — columnar "
+                         "(pack once at the proxy, decode straight into "
+                         "kernel tensors; default) vs the per-txn object "
+                         "frame (the RESOLVE_COLUMNAR=0 escape hatch)")
     ap.add_argument("--spec5", action="store_true",
                     help="BASELINE.md:36 config-5 preset: wire mode, 256K "
                          "in-flight, both backends")
@@ -441,21 +606,7 @@ def main():
         # clamp to the floor instead of tracking the admission rate
         args.serve_status = True
     if args.smoke:
-        args.mode = "wire"
-        args.clients = 32
-        args.ops = 2
-        args.backends = args.backends or "native"
-        if not args.trace_dir:
-            import tempfile as _tf
-
-            args.trace_dir = _tf.mkdtemp(prefix="bench_pipe_smoke_")
-        if not args.perf_ledger and "FDBTPU_PERF_LEDGER" not in os.environ:
-            # smoke rows are still emitted (schema-valid, gate-checked
-            # by tests) but land next to the trace files, not in the
-            # committed history — a green CI run must not dirty it
-            args.perf_ledger = os.path.join(
-                args.trace_dir, "perf_smoke.jsonl"
-            )
+        return run_smoke(args)
     if args.spec5:
         args.mode = "wire"
         args.clients = 256 * 1024
@@ -477,54 +628,7 @@ def main():
         results[backend] = res
         print(json.dumps({backend: res}), flush=True)
 
-    row = {
-        "metric": "pipeline_commit_txn_s",
-        "spec": "config5_ycsb_a",
-        "mode": args.mode,
-        "inflight": args.clients,
-        "ops_per_client": args.ops,
-        "records": args.records,
-        "batch": args.batch,
-        "kernel_txns": args.kernel_txns,
-        "kernel": "classic" if args.classic_kernel else "tiered",
-        "backends": results,
-    }
-    print(json.dumps(row))
-    if args.json_out:
-        with open(args.json_out, "a") as f:
-            f.write(json.dumps(row) + "\n")
-    if not args.no_perf:
-        # canonical perf-ledger rows (one per backend), same converter
-        # the historical-artifact importer uses so fingerprint keys line
-        # up across PIPELINE_r0*.json and fresh runs
-        from foundationdb_tpu.utils import perf
-
-        fp = perf.device_fingerprint()
-        for rec in perf.pipeline_row_to_records(row, fingerprint=None):
-            # fingerprint.backend stays the RESOLVER backend (also in
-            # the workload key), but the HOST device identity — device
-            # kind/count, jax/jaxlib — must be real: without it a
-            # tpu-force wire run on a CPU laptop and one on a v5e
-            # would share a hardware comparability key
-            rec["fingerprint"].update(
-                {k: fp[k] for k in ("device_kind", "device_count",
-                                    "jax_version", "jaxlib_version",
-                                    "python_version", "machine")}
-            )
-            path = perf.append(rec, path=args.perf_ledger)
-        print(f"[perf] {len(results)} ledger row(s) appended to {path}",
-              flush=True)
-    if args.smoke:
-        bad = [
-            b for b, r in results.items()
-            if r.get("consistency") != "ok"
-            or r.get("traced_timelines", 0) < 1
-            or r.get("traced_cross_process", 0) < 1
-        ]
-        if bad:
-            print(f"bench_pipeline smoke FAILED for {bad}")
-            return 1
-        print("bench_pipeline smoke ok")
+    emit_row(args, results)
     return 0
 
 

@@ -64,9 +64,14 @@ t1=$(date +%s.%N)
 awk -v a="$t0" -v b="$t1" 'BEGIN {printf "commit_debug smoke wall time: %.1fs\n", b - a}'
 
 echo "== bench_pipeline smoke (tiny traced wire run over real role    =="
-echo "== processes: consistency ok + >=1 cross-process timeline)       =="
+echo "== processes: consistency ok + >=1 cross-process timeline, plus  =="
+echo "== the columnar A/B — object-frame decision parity and the       =="
+echo "== structural two-copies row gated by perfcheck)                 =="
 t0=$(date +%s.%N)
-JAX_PLATFORMS=cpu python scripts/bench_pipeline.py --smoke
+pipe_row=$(mktemp /tmp/pipecheck_row.XXXXXX.jsonl)
+JAX_PLATFORMS=cpu python scripts/bench_pipeline.py --smoke --perf-ledger "$pipe_row"
+JAX_PLATFORMS=cpu python scripts/perfcheck.py --check "$pipe_row" --tier structural
+rm -f "$pipe_row"
 t1=$(date +%s.%N)
 awk -v a="$t0" -v b="$t1" 'BEGIN {printf "bench_pipeline smoke wall time: %.1fs\n", b - a}'
 

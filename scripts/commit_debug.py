@@ -127,8 +127,9 @@ def main() -> int:
             f"{len(records)} events -> {len(timelines)} committed "
             f"transaction timeline(s), {len(violations)} violation(s)"
         )
-        order = ["grv", "batching", "get_version", "resolution",
-                 "logging", "reply", "total"]
+        order = ["grv", "batching", "get_version", "columnar_pack",
+                 "resolution", "columnar_decode", "logging", "reply",
+                 "total"]
         stages = [s for s in order if s in wf] + sorted(
             set(wf) - set(order)
         )

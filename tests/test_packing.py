@@ -144,3 +144,108 @@ def test_pack_batch_error_parity():
     for fn in (packing.pack_batch, packing.pack_batch_reference):
         with pytest.raises(OverflowError, match="rebase"):
             fn(overflow, 100, 0, config)
+
+
+# ---------------------------------------------------------------------------
+# Columnar packer (r12): the wire-to-kernel path must be byte-identical
+# to pack_batch — three packers, one contract.
+
+
+def random_report_txn(rng, snap_lo=-2000, snap_hi=5000):
+    t = random_txn(rng, snap_lo, snap_hi)
+    t.report_conflicting_keys = bool(rng.random() < 0.5)
+    return t
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_pack_batch_columnar_byte_identical_random(seed):
+    rng = np.random.default_rng(100 + seed)
+    config = small_config()
+    n = int(rng.integers(0, config.max_txns + 1))
+    txns = [random_report_txn(rng) for _ in range(n)]
+    version = int(rng.integers(1000, 100000))
+    base = int(rng.integers(0, 1000))
+    cols = packing.pack_columnar(txns)
+    assert_batches_identical(
+        packing.pack_batch(txns, version, base, config),
+        packing.pack_batch_columnar(cols, version, base, config),
+    )
+
+
+def test_pack_batch_columnar_empty_and_edges():
+    config = small_config()
+    assert_batches_identical(
+        packing.pack_batch([], 100, 0, config),
+        packing.pack_batch_columnar(packing.pack_columnar([]), 100, 0, config),
+    )
+    # blind writes, read-only txns, long keys past max_key_bytes, and
+    # snapshots clamped at the VERSION_NEG floor
+    txns = [
+        CommitTransaction([], [(b"w" * 20, b"w" * 30)], read_snapshot=1),
+        CommitTransaction([(b"", b"\x00")], [], read_snapshot=-(2**33)),
+        CommitTransaction(
+            [(b"a", b"a" * 25), (b"b", b"c")], [(b"q", b"r")],
+            read_snapshot=4000, report_conflicting_keys=True,
+        ),
+    ]
+    assert_batches_identical(
+        packing.pack_batch(txns, 100, 0, config),
+        packing.pack_batch_columnar(
+            packing.pack_columnar(txns), 100, 0, config
+        ),
+    )
+
+
+def test_pack_batch_columnar_error_parity():
+    config = small_config(max_txns=4, max_reads=4, max_writes=4)
+    crowded = [
+        CommitTransaction([(b"a", b"b")] * 3, [(b"a", b"b")], read_snapshot=1)
+        for _ in range(2)
+    ]
+    with pytest.raises(ValueError, match="max_reads"):
+        packing.pack_batch_columnar(
+            packing.pack_columnar(crowded), 100, 0, config
+        )
+    overflow = [CommitTransaction([], [], read_snapshot=2**40)]
+    with pytest.raises(OverflowError, match="rebase"):
+        packing.pack_batch_columnar(
+            packing.pack_columnar(overflow), 100, 0, config
+        )
+
+
+@pytest.mark.parametrize("round_up", [False, True])
+def test_pack_keys_from_blob_byte_identical(round_up):
+    rng = np.random.default_rng(9)
+    keys = [random_key(rng) for _ in range(64)]
+    lens = np.array([len(k) for k in keys], np.int64)
+    cat = np.frombuffer(b"".join(keys), np.uint8)
+    got = packing.pack_keys_from_blob(
+        cat, np.cumsum(lens) - lens, lens, 8, round_up=round_up
+    )
+    want = packing._pack_keys_reference(keys, 8, round_up=round_up)
+    np.testing.assert_array_equal(got, want)
+    # and from a NON-tight blob (keys at arbitrary offsets, the wire
+    # frame's shape when sliced views land mid-payload)
+    pad = b"\xff" * 3
+    blob2 = pad + pad.join(keys)
+    starts2 = np.empty_like(lens)
+    off = len(pad)
+    for i, k in enumerate(keys):
+        starts2[i] = off
+        off += len(k) + len(pad)
+    got2 = packing.pack_keys_from_blob(
+        np.frombuffer(blob2, np.uint8), starts2, lens, 8, round_up=round_up
+    )
+    np.testing.assert_array_equal(got2, want)
+
+
+def test_columnar_to_transactions_roundtrip():
+    rng = np.random.default_rng(11)
+    txns = [random_report_txn(rng) for _ in range(20)]
+    back = packing.columnar_to_transactions(packing.pack_columnar(txns))
+    assert len(back) == len(txns)
+    for t0, t1 in zip(txns, back):
+        assert t0.read_conflict_ranges == t1.read_conflict_ranges
+        assert t0.write_conflict_ranges == t1.write_conflict_ranges
+        assert t0.read_snapshot == t1.read_snapshot
+        assert t0.report_conflicting_keys == t1.report_conflicting_keys

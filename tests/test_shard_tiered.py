@@ -168,6 +168,34 @@ def test_sharded_tiered_matches_multi_resolver_oracle(n_shards):
     assert run_verdicts(dev, stream) == oracle_verdicts(oracle, stream)
 
 
+def test_sharded_columnar_matches_multi_resolver_oracle():
+    """The r12 acceptance pin: the COLUMNAR wire frame driven through a
+    2-shard mesh (proxy-side pack_columnar -> codec roundtrip ->
+    resolve_columnar, exactly the wire ResolverRole's path) must match
+    the multi-resolver oracle AND the object-path sharded instance
+    batch for batch — pack once, shard the same arrays over the mesh.
+    """
+    from foundationdb_tpu.wire import codec
+
+    rng = np.random.default_rng(12)
+    boundaries = even_boundaries(2)
+    cfg = tiered_config(n_shards=2)
+    dev_obj = make_sharded(cfg, boundaries)
+    dev_col = make_sharded(cfg, boundaries)
+    oracle = MultiResolverOracle(boundaries, window=cfg.window_versions)
+    stream = gen_stream(rng, 6)
+    got_obj = run_verdicts(dev_obj, stream)
+    got_col = []
+    for txns, ver in stream:
+        msg = codec.decode(codec.encode(codec.ResolveBatchColumnar(
+            prev_version=-1, version=ver, last_received_version=-1,
+            cols=packing.pack_columnar(txns),
+        )))
+        res = dev_col.resolve_columnar(msg.cols, ver)
+        got_col.append([int(v) for v in res.verdicts])
+    assert got_col == got_obj == oracle_verdicts(oracle, stream)
+
+
 @pytest.mark.parametrize("n_shards", [2, 4])
 def test_sharded_tiered_matches_classic_sharded(n_shards):
     """Same reference multi-resolver semantics, different machinery:
