@@ -36,7 +36,7 @@ from foundationdb_tpu.cluster.multiprocess import spawn_role
 @dataclasses.dataclass
 class RoleSpec:
     name: str
-    kind: str                      # resolver | tlog | storage | ratekeeper
+    kind: str  # resolver | tlog | storage | ratekeeper | worker | controller
     socket_dir: str
     index: int = 0
     backend: str = "native"
@@ -47,6 +47,15 @@ class RoleSpec:
     #: ratekeeper: comma list of peer role sockets whose StatusRequest
     #: sensors feed the admission law
     peers: Optional[str] = None
+    #: worker/ratekeeper: the cluster controller's socket — under the
+    #: controller, the monitor is the DUMB process babysitter (restart
+    #: dead processes, nothing else); recruitment and recovery belong
+    #: to the controller (cluster/multiprocess.py ClusterControllerRole)
+    controller: Optional[str] = None
+    #: controller: JSON file with the declarative topology
+    cluster_conf: Optional[str] = None
+    #: controller: persisted-epoch file (the coordinated-state analog)
+    state_file: Optional[str] = None
 
     @property
     def address(self) -> str:
@@ -76,6 +85,9 @@ def parse_conf(path: str) -> dict[str, RoleSpec]:
             storage_engine=sec.get("storage_engine", "memory"),
             encrypt=sec.getboolean("encrypt", False),
             peers=sec.get("peers", None),
+            controller=sec.get("controller", None),
+            cluster_conf=sec.get("cluster_conf", None),
+            state_file=sec.get("state_file", None),
         )
         if spec.address in addresses:
             raise ValueError(
@@ -138,6 +150,13 @@ class Monitor:
             # would crash-loop on the ENCRYPTION_MODE marker
             encrypt=spec.encrypt,
             peers=spec.peers.split(",") if spec.peers else None,
+            controller=spec.controller,
+            # the conf NAME is the worker's stable identity: a restarted
+            # worker re-registers as itself and the controller sees the
+            # same worker with an empty role map (role died with it)
+            worker_id=spec.name if spec.kind == "worker" else None,
+            cluster_conf=spec.cluster_conf,
+            state_file=spec.state_file,
         )
         self.children[spec.name] = _Child(
             spec=spec, proc=proc, started_at=time.monotonic(),

@@ -126,14 +126,24 @@ _WRITERS["kvlist"] = _w_kvlist
 _READERS["kvlist"] = _r_kvlist
 
 
-def _message(type_id: int, name: str, fields: list[tuple[str, str]]):
-    cls = dataclasses.make_dataclass(name, [f for f, _ in fields])
+def _message(type_id: int, name: str, fields: list[tuple]):
+    # a field is (name, kind) or (name, kind, default); wire layout is
+    # the field order either way (defaults are a constructor nicety for
+    # fields appended to an existing message, e.g. TLogPush.epoch)
+    cls = dataclasses.make_dataclass(
+        name,
+        [
+            f[0] if len(f) == 2 else (f[0], "object", f[2])
+            for f in fields
+        ],
+    )
+    kinds = [(f[0], f[1]) for f in fields]
 
-    def enc(out, m, _fields=fields):
+    def enc(out, m, _fields=kinds):
         for f, kind in _fields:
             _WRITERS[kind](out, getattr(m, f))
 
-    def dec(buf, off, _fields=fields, _cls=cls):
+    def dec(buf, off, _fields=kinds, _cls=cls):
         vals = []
         for _f, kind in _fields:
             v, off = _READERS[kind](buf, off)
@@ -149,7 +159,13 @@ Pong = _message(0x0202, "Pong", [("payload", "bytes")])
 TLogPush = _message(
     0x0210,
     "TLogPush",
-    [("version", "i64"), ("prev_version", "i64"), ("mutations", "mutlist")],
+    # epoch (default 0 = unfenced): generation fencing — after a
+    # recovery locks the log at epoch E, pushes carrying an older epoch
+    # are rejected with the retryable stale-epoch error (the
+    # reference's tlog epoch lock). Appended with a default so legacy
+    # single-generation callers/WAL replay are unchanged.
+    [("version", "i64"), ("prev_version", "i64"), ("mutations", "mutlist"),
+     ("epoch", "i64", 0)],
 )
 TLogPushReply = _message(0x0211, "TLogPushReply", [("durable_version", "i64")])
 TLogPeek = _message(0x0212, "TLogPeek", [("after_version", "i64")])
@@ -206,7 +222,12 @@ StorageApply = _message(
     0x0220, "StorageApply", [("version", "i64"), ("mutations", "mutlist")]
 )
 StorageApplyReply = _message(
-    0x0221, "StorageApplyReply", [("durable_version", "i64")]
+    0x0221, "StorageApplyReply",
+    # durable=1 only when the store write-ahead-logs its applies (has a
+    # data_dir): the proxy applier pops the tlog ONLY on durable acks —
+    # popping against a memory-only store would erase the one durable
+    # copy of committed mutations (code review r13)
+    [("durable_version", "i64"), ("durable", "u8", 0)],
 )
 StorageGet = _message(
     0x0222, "StorageGet", [("key", "bytes"), ("version", "i64")]
@@ -297,15 +318,119 @@ StatusReply = _message(0x0241, "StatusReply", [("payload", "str")])
 GetRateInfoRequest = _message(0x0242, "GetRateInfoRequest", [("pad", "u8")])
 GetRateInfoReply = _message(0x0243, "GetRateInfoReply", [("payload", "str")])
 
+# ---------------------------------------------------------------------------
+# Wire-cluster lifecycle frames (the worker / cluster-controller shape:
+# fdbserver/worker.actor.cpp's RegisterWorkerRequest + the
+# Initialize*Request streams). Control-plane payloads are JSON
+# documents for the same reason StatusReply is: topology and
+# recruitment descriptors are status-schema slices, not hot-path
+# messages, and a field-by-field layout would ossify the conf.
+
+_WRITERS["txn"] = codec.w_commit_transaction
+_READERS["txn"] = codec.r_commit_transaction
+
+# worker -> controller: "I exist, here is my socket" (re-sent on a
+# cadence; doubles as the worker's liveness beacon)
+RegisterWorker = _message(
+    0x0250, "RegisterWorker", [("payload", "str")]
+)
+RegisterWorkerReply = _message(
+    0x0251, "RegisterWorkerReply", [("payload", "str")]
+)
+# controller -> worker: host this role at this generation (the
+# Initialize*Request analog; kind/epoch/config in the JSON payload)
+InitializeRole = _message(0x0252, "InitializeRole", [("payload", "str")])
+InitializeRoleReply = _message(
+    0x0253, "InitializeRoleReply", [("payload", "str")]
+)
+# anyone -> controller: the current generation's topology (epoch,
+# recovery state, role -> worker socket map)
+TopologyRequest = _message(0x0254, "TopologyRequest", [("pad", "u8")])
+TopologyReply = _message(0x0255, "TopologyReply", [("payload", "str")])
+# controller -> tlog: lock the log at a new epoch (recovery step 1) —
+# returns the durable version the recovery version derives from; all
+# later pushes at an older epoch are fenced
+TLogLock = _message(0x0256, "TLogLock", [("epoch", "i64")])
+TLogLockReply = _message(
+    0x0257, "TLogLockReply",
+    [("epoch", "i64"), ("durable_version", "i64")],
+)
+# client -> proxy worker (the NativeAPI front door over the wire):
+# GRV, versioned point read, and commit — so the commit/GRV proxies
+# are killable OS processes like every other role
+ClientGrvRequest = _message(0x0258, "ClientGrvRequest", [("pad", "u8")])
+ClientGrvReply = _message(0x0259, "ClientGrvReply", [("version", "i64")])
+ClientCommitRequest = _message(
+    0x025A, "ClientCommitRequest", [("txn", "txn")]
+)
+ClientCommitReply = _message(
+    0x025B, "ClientCommitReply", [("version", "i64")]
+)
+ClientReadRequest = _message(
+    0x025C, "ClientReadRequest", [("key", "bytes"), ("version", "i64")]
+)
+ClientReadReply = _message(
+    0x025D, "ClientReadReply", [("value", "optbytes")]
+)
+# controller -> storage (recovery): replay the locked tlog's tail above
+# your durable version BEFORE the new generation opens — the old
+# generation's apply queue died with its proxy, and the first new-
+# generation apply would otherwise jump storage.version past the
+# missing tail forever (found by the first chaos run: 375 committed
+# keys missing post-recovery).
+StorageCatchUp = _message(
+    0x025E, "StorageCatchUp", [("tlog_address", "str")]
+)
+StorageCatchUpReply = _message(
+    0x025F, "StorageCatchUpReply", [("version", "i64")]
+)
+# proxy applier -> tlog: storage has durably applied through `version`
+# — the log prefix at or below it is dead weight (recovery replays it
+# for nothing; the drill measured tlog re-init time growing with run
+# length) and is popped, the reference's pop-on-storage-durable.
+TLogPop = _message(
+    0x0260, "TLogPop", [("version", "i64"), ("epoch", "i64", 0)]
+)
+TLogPopReply = _message(
+    0x0261, "TLogPopReply", [("durable_version", "i64")]
+)
+
 TOKEN_TLOG_VERSION = 0x0203
 TOKEN_STORAGE_VERSION = 0x0304
 TOKEN_RESOLVER_VERSION = 0x0102
 TOKEN_STATUS = 0x0501
 TOKEN_GET_RATE_INFO = 0x0502
+TOKEN_TLOG_LOCK = 0x0205
+TOKEN_TLOG_POP = 0x0206
+# lifecycle control plane
+TOKEN_REGISTER_WORKER = 0x0601
+TOKEN_INIT_ROLE = 0x0602
+TOKEN_TOPOLOGY = 0x0603
+# client front door (proxy worker)
+TOKEN_CLIENT_GRV = 0x0701
+TOKEN_CLIENT_COMMIT = 0x0702
+TOKEN_CLIENT_READ = 0x0703
+TOKEN_STORAGE_CATCHUP = 0x0307
 
 
 # ---------------------------------------------------------------------------
 # Role servers.
+
+
+def _fence_epoch(req, role) -> None:
+    """Generation fencing shared by every fenced endpoint: unless the
+    request carries `role`'s exact epoch, count the reject and raise
+    the retryable stale-epoch error (cluster/generation.py). Requests
+    without an epoch field fence as epoch 0 — the unfenced legacy
+    deployment matches an unfenced role."""
+    req_epoch = getattr(req, "epoch", 0)
+    if req_epoch != role.epoch:
+        from foundationdb_tpu.cluster.generation import stale_epoch_message
+
+        role.stale_epoch_rejects += 1
+        raise transport.RemoteError(
+            stale_epoch_message(req_epoch, role.epoch)
+        )
 
 
 def _decode_alloc_count(txns) -> int:
@@ -335,9 +460,17 @@ class ResolverRole:
     version) replay the recorded reply (:515-530).
     """
 
-    def __init__(self, backend: str = "native", window: int = 5_000_000):
+    def __init__(self, backend: str = "native", window: int = 5_000_000,
+                 epoch: int = 0):
         self.version = -1
         self.window = window
+        #: generation fencing: a recruited resolver belongs to ONE
+        #: recovery generation; batches carrying any other epoch are
+        #: rejected retryably (cluster/generation.py). 0 = unfenced
+        #: standalone deployment (legacy spawn_role without a
+        #: controller) — requests default to epoch 0 and match.
+        self.epoch = epoch
+        self.stale_epoch_rejects = 0
         self._cond: asyncio.Condition | None = None
         self._replies: dict[int, ResolveTransactionBatchReply] = {}
         self._backend = backend
@@ -477,6 +610,11 @@ class ResolverRole:
         return self._cond
 
     async def resolve(self, req: ResolveTransactionBatchRequest):
+        # generation fence FIRST, before the version-chain wait: a
+        # stale-generation batch must bounce immediately (its proxy is
+        # dead or fenced), never park on a version chain the new
+        # generation restarted far above it
+        _fence_epoch(req, self)
         # span context propagated ACROSS the process boundary: the
         # request's (trace_id, span_id) pair arrived over the UDS wire
         # (wire/codec.py), and this role's resolveBatch span chains to
@@ -663,17 +801,26 @@ class ResolverRole:
         # columnar-vs-object frame accounting (r12): bench_pipeline
         # reads this to land the structural copy/alloc metrics
         qos["resolve_path"] = dict(self.path_stats)
+        qos["stale_epoch_rejects"] = self.stale_epoch_rejects
         return {
             "role": "resolver",
             "version": self.version,
             "backend": self._backend,
+            "epoch": self.epoch,
             "qos": qos,
         }
 
 
 def _looks_sealed(blob: bytes) -> bool:
-    from foundationdb_tpu.crypto.blob_cipher import is_encrypted
-
+    try:
+        from foundationdb_tpu.crypto.blob_cipher import is_encrypted
+    except ImportError:
+        # crypto stack not installed (the header sniff is defense in
+        # depth BEHIND the fsynced ENCRYPTION_MODE marker, which is
+        # still enforced): without `cryptography` this host can never
+        # have sealed a record, so nothing local can look sealed — and
+        # a dir copied from an encrypted host still trips the marker.
+        return False
     return is_encrypted(blob)
 
 
@@ -707,6 +854,32 @@ def _check_encryption_marker(data_dir: str, encryption) -> None:
         )
 
 
+def _decode_tlog_record(blob: bytes):
+    """Decode one tlog WAL record, accepting the pre-epoch layout.
+
+    The wire is protected by the PROTOCOL_VERSION handshake, but disk
+    records are not version-gated: a data dir written before the epoch
+    field (protocol 0007) holds 3-field TLogPush frames, and the
+    cross-version restart discipline (tests/fixtures/ondisk_r*) says a
+    newer build must open them. Legacy records replay at epoch 0 — the
+    recovery lock re-fences the log before any new-generation push."""
+    try:
+        return codec.decode(blob)
+    except codec.CodecError:
+        buf = memoryview(blob)
+        tid, off = codec.r_u16(buf, 0)
+        if tid != 0x0210:
+            raise
+        version, off = codec.r_i64(buf, off)
+        prev, off = codec.r_i64(buf, off)
+        muts, off = _r_mutlist(buf, off)
+        if off != len(buf):
+            raise
+        return TLogPush(
+            version=version, prev_version=prev, mutations=muts, epoch=0
+        )
+
+
 class TLogRole:
     """Wire-served transaction log: version-ordered append + peek.
 
@@ -717,10 +890,17 @@ class TLogRole:
     entries via the crc-checked recovery scan.
     """
 
-    def __init__(self, data_dir: str | None = None, encryption=None):
+    def __init__(self, data_dir: str | None = None, encryption=None,
+                 epoch: int = 0):
         self.entries: list[tuple[int, list]] = []  # (version, mutations)
         self.version = -1
         self._dq = None
+        #: generation fencing (the reference's tlog epoch lock): after
+        #: lock(E), pushes at an older epoch are rejected retryably —
+        #: no old in-flight batch can slip in a commit post-recovery.
+        #: 0 = unfenced legacy deployment.
+        self.epoch = epoch
+        self.stale_epoch_rejects = 0
         # -- saturation sensors (the Ratekeeper's TLogQueueInfo inputs):
         # retained queue bytes through a wall-clock smoother — this is
         # a real OS process, the reference's Smoother(timer()) shape
@@ -734,6 +914,9 @@ class TLogRole:
         # (code review r5); whole records are sealed here (no ordering
         # constraint on tlog frames, unlike LSM keys)
         self._enc = encryption if data_dir else None
+        #: disk-queue seq per pushed version: the pop boundary lookup
+        self._seq_by_version: list[tuple[int, int]] = []
+        self._data_dir = data_dir
         if data_dir:
             from foundationdb_tpu.native import DiskQueue
 
@@ -750,16 +933,43 @@ class TLogRole:
                     raise RuntimeError(
                         "sealed tlog record but encryption is disabled"
                     )
-                rec = codec.decode(blob)
+                rec = _decode_tlog_record(blob)
                 self.entries.append((rec.version, list(rec.mutations)))
                 self.version = max(self.version, rec.version)
+                self._seq_by_version.append((rec.version, _seq))
+            # the popped-version marker: a fully-popped log must still
+            # restart at its durable HEAD version — the recovery
+            # version derives from it, and a regressed version would
+            # let a new generation allocate versions below committed
+            # data (found by the save-and-kill restart test)
+            self.version = max(self.version, self._read_popped_marker())
             self._queue_bytes = sum(
                 8 + len(m.param1) + len(m.param2)
                 for _v, ms in self.entries for m in ms
             )
             self.smoothed_queue_bytes.set_total(self._queue_bytes)
 
+    async def lock(self, req: "TLogLock") -> "TLogLockReply":
+        """The recovery lock (recovery step 1, the coordinated-state +
+        tlog epoch lock): advance to the new generation — every push
+        still carrying an older epoch is fenced from here on — and
+        return the durable version the recovery version derives from."""
+        if req.epoch < self.epoch:
+            from foundationdb_tpu.cluster.generation import (
+                stale_epoch_message,
+            )
+
+            raise transport.RemoteError(
+                stale_epoch_message(req.epoch, self.epoch)
+            )
+        self.epoch = req.epoch
+        return TLogLockReply(epoch=self.epoch, durable_version=self.version)
+
     async def push(self, req: TLogPush) -> TLogPushReply:
+        # generation fence: a locked log rejects the old generation's
+        # pushes (and a not-yet-locked log rejects a future
+        # generation's — the recovery always locks first)
+        _fence_epoch(req, self)
         if req.version <= self.version:
             # duplicate push: idempotent ack (proxy retry after lost reply)
             return TLogPushReply(durable_version=self.version)
@@ -773,11 +983,12 @@ class TLogRole:
             blob = codec.encode(req)
             if self._enc is not None:
                 blob = self._enc.seal(blob)
-            self._dq.push(blob)
+            seq = self._dq.push(blob)
             if self._dq.commit() is None:
                 # fsync/pwrite failed: the data is NOT durable — refuse
                 # the ack rather than lie (tLogCommit discipline)
                 raise transport.RemoteError("tlog disk commit failed")
+            self._seq_by_version.append((req.version, seq))
         self.entries.append((req.version, list(req.mutations)))
         self.version = req.version
         nb = sum(
@@ -795,6 +1006,7 @@ class TLogRole:
         return {
             "role": "log",
             "version": self.version,
+            "epoch": self.epoch,
             "qos": {
                 "queue_mutations": sum(
                     len(ms) for _v, ms in self.entries
@@ -807,8 +1019,86 @@ class TLogRole:
                     self.smoothed_input_bytes.smooth_rate()
                 ),
                 "entries": len(self.entries),
+                "stale_epoch_rejects": self.stale_epoch_rejects,
             },
         }
+
+    async def pop(self, req: "TLogPop") -> "TLogPopReply":
+        """Pop the log prefix at or below `version` (storage has it
+        durably): retained entries, queue bytes, AND the disk queue
+        shrink, so a restart's recovery scan replays only the tail
+        between storage-durable and the head — the reference tlog's
+        pop-on-storage-durable discipline. `self.version` (the
+        recovery-version source) is unaffected."""
+        _fence_epoch(req, self)
+        import bisect
+
+        cut = bisect.bisect_right(
+            self.entries, req.version, key=lambda e: e[0]
+        )
+        if cut:
+            dropped = self.entries[:cut]
+            self.entries = self.entries[cut:]
+            self._queue_bytes -= sum(
+                8 + len(m.param1) + len(m.param2)
+                for _v, ms in dropped for m in ms
+            )
+            self.smoothed_queue_bytes.set_total(self._queue_bytes)
+        if self._dq is not None and self._seq_by_version:
+            last_seq = None
+            kept = []
+            for v, s in self._seq_by_version:
+                if v <= req.version:
+                    last_seq = s
+                else:
+                    kept.append((v, s))
+            if last_seq is not None:
+                if not kept:
+                    # the pop empties the retained queue: persist the
+                    # durable HEAD version FIRST — a restart of a
+                    # fully-popped log must come back at the head,
+                    # never -1 (the recovery version derives from it
+                    # and must not regress below committed data).
+                    # Marker-then-pop: a crash between the two leaves
+                    # both sources present (max() is unaffected). With
+                    # a surviving tail the scan restores the head on
+                    # its own, so the fsync is skipped — no per-drain
+                    # disk sync while the applier lags the head.
+                    await asyncio.get_event_loop().run_in_executor(
+                        None, self._write_popped_marker, self.version
+                    )
+                self._dq.pop(last_seq + 1)
+                self._dq.commit()
+                self._seq_by_version = kept
+        return TLogPopReply(durable_version=self.version)
+
+    def _marker_path(self) -> str:
+        return os.path.join(self._data_dir, "POPPED_VERSION")
+
+    def _read_popped_marker(self) -> int:
+        try:
+            with open(self._marker_path()) as f:
+                return int(f.read().strip())
+        except (FileNotFoundError, ValueError):
+            return -1
+
+    def _write_popped_marker(self, version: int) -> None:
+        tmp = self._marker_path() + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{version}\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._marker_path())
+
+    def close_disk(self) -> None:
+        """Release the disk queue (a replaced role must not hold the
+        files a re-initialized successor on the same worker reopens)."""
+        if self._dq is not None:
+            try:
+                self._dq.close()
+            except Exception:
+                pass
+            self._dq = None
 
     async def peek(self, req: TLogPeek) -> TLogPeekReply:
         i = self._first_after(req.after_version)
@@ -930,6 +1220,33 @@ class StorageRole:
 
     # -- durable-version checkpointing (storageserver durableVersion
     # discipline: persist at a version, replay the tlog tail on restart) --
+
+    async def aclose_disk(self) -> None:
+        """close_disk serialized with the WAL lock: an in-flight
+        apply's _log_apply_durably runs on an EXECUTOR thread inside
+        the native queue — freeing the handles under it would be a
+        use-after-free. (A live-but-slow store can be replaced on its
+        own worker: heartbeat misses under fsync load + singleton
+        re-recruit.)"""
+        async with self._log_lock_lazy():
+            self.close_disk()
+
+    def close_disk(self) -> None:
+        """Release the WAL + LSM handles (a replaced role must not hold
+        the files a re-initialized successor on the same worker
+        reopens)."""
+        if self._dq is not None:
+            try:
+                self._dq.close()
+            except Exception:
+                pass
+            self._dq = None
+        if self._lsm is not None:
+            try:
+                self._lsm.close()
+            except Exception:
+                pass
+            self._lsm = None
 
     def _ckpt_path(self) -> str:
         return os.path.join(self._data_dir, "storage.ckpt")
@@ -1149,7 +1466,8 @@ class StorageRole:
         for r in reqs:
             rep = await self._apply_logged(r)
         return rep if rep is not None else StorageApplyReply(
-            durable_version=self.version
+            durable_version=self.version,
+            durable=1 if self._dq is not None else 0,
         )
 
     async def _log_durably(self, reqs: list) -> None:
@@ -1217,10 +1535,22 @@ class StorageRole:
                                 None, install
                             )
                 cond.notify_all()
-            return StorageApplyReply(durable_version=self.version)
+            return StorageApplyReply(
+                durable_version=self.version,
+                durable=1 if self._dq is not None else 0,
+            )
 
     async def get_version(self, req: RoleVersionReq) -> RoleVersionReply:
         return RoleVersionReply(version=self.version)
+
+    async def catch_up(self, req: "StorageCatchUp") -> "StorageCatchUpReply":
+        """Recovery catch-up (controller-driven): replay the locked
+        tlog's tail above our durable version NOW, before the new
+        generation's first apply can advance our version past it. The
+        pull is idempotent per version, so a straggler apply from the
+        dying generation racing this is harmless."""
+        await self.catch_up_from_tlog(req.tlog_address)
+        return StorageCatchUpReply(version=self.version)
 
     def status(self) -> dict:
         """StatusRequest payload: apply bandwidth, batch-size
@@ -1377,7 +1707,8 @@ class RatekeeperRole:
     THIS process applies its own decay (ProxyPipeline._rate_fetcher),
     so a dead ratekeeper never freezes the cluster at full speed."""
 
-    def __init__(self, peers: list[str], *, interval: float = 0.25):
+    def __init__(self, peers: list[str], *, interval: float = 0.25,
+                 controller: str | None = None):
         import time as _time
 
         from foundationdb_tpu.cluster.ratekeeper import AdmissionController
@@ -1389,6 +1720,17 @@ class RatekeeperRole:
         self._task: asyncio.Task | None = None
         self.polls = 0
         self.poll_failures = 0
+        # -- live peer discovery (the frozen-peer-list bugfix): with a
+        # cluster controller configured, the peer set RE-RESOLVES from
+        # the controller's live topology every control cycle, so a
+        # re-recruited resolver's occupancy feed rejoins the admission
+        # law the cycle after recovery instead of never. The static
+        # `peers` list remains the controller-less fallback (and the
+        # bootstrap set while the controller is still recruiting).
+        self._controller_addr = controller
+        self._controller_conns: dict = {}  # _cached_call cache
+        self.peer_refreshes = 0
+        self.topology_epoch = 0
         #: last cycle's observed GRV admission rate (the law's
         #: actualTps input) — surfaced in status so the wire feedback
         #: path is testable end to end
@@ -1396,6 +1738,20 @@ class RatekeeperRole:
 
     async def start(self) -> None:
         self._task = asyncio.ensure_future(self._poll_loop())
+
+    async def stop(self) -> None:
+        """Cancel the poll loop and close every cached peer/controller
+        connection — a worker re-recruiting over this role must not
+        leak one socket per polled peer per recovery."""
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+        await _close_all(self._conns)
+        await _close_all(self._controller_conns)
 
     async def _poll_one(self, path: str) -> dict:
         import json as _json
@@ -1410,10 +1766,50 @@ class RatekeeperRole:
         )
         return _json.loads(reply.payload)
 
+    async def _refresh_peers(self) -> None:
+        """Re-resolve the peer list from the controller topology (one
+        TopologyRequest per control cycle). Failures keep the last
+        known peer set — a dead controller degrades to static peers,
+        and the law's own staleness decay covers dead sensors."""
+        import json as _json
+
+        if self._controller_addr is None:
+            return
+        try:
+            reply = await _cached_call(
+                self._controller_conns, self._controller_addr,
+                TOKEN_TOPOLOGY, TopologyRequest(pad=0),
+                timeout=2.0, retries=1,
+            )
+            topo = _json.loads(reply.payload)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            return
+        peers = sorted(
+            {
+                entry["address"]
+                for entry in topo.get("roles", {}).values()
+                if entry.get("kind") != "ratekeeper"
+            }
+        )
+        if peers and peers != self.peers:
+            # drop cached connections to peers that left the topology
+            for gone in set(self._conns) - set(peers):
+                conn = self._conns.pop(gone)
+                try:
+                    await conn.close()
+                except Exception:
+                    pass
+            self.peers = peers
+            self.peer_refreshes += 1
+        self.topology_epoch = int(topo.get("epoch", 0))
+
     async def _poll_loop(self) -> None:
         from foundationdb_tpu.cluster.status import _QOS_SLOT
 
         while True:
+            await self._refresh_peers()
             slots: dict = {
                 "tlogs": {}, "storages": {}, "resolvers": {},
                 "proxies": {},
@@ -1479,9 +1875,1121 @@ class RatekeeperRole:
                 "peer_polls": self.polls,
                 "peer_poll_failures": self.poll_failures,
                 "peers": len(self.peers),
+                "peer_refreshes": self.peer_refreshes,
+                "topology_epoch": self.topology_epoch,
                 "observed_grv_per_s": self.observed_grv_per_s,
             },
         }
+
+
+# ---------------------------------------------------------------------------
+# Wire-cluster lifecycle: the worker / cluster-controller shape.
+#
+# The reference runs ONE binary (`fdbserver`) whose worker dispatch loop
+# (fdbserver/worker.actor.cpp:2305-2811) can host any role in response
+# to the cluster controller's Initialize*Request streams, and the
+# ClusterController rebuilds the transaction system as a unit in a new
+# generation on any failure (ClusterRecovery.actor.cpp). The classes
+# below are that deployment shape for this framework: WorkerRole hosts
+# any role behind a token dispatch, ClusterControllerRole recruits a
+# declarative topology onto registered workers, heartbeats them, and
+# runs the cluster/generation.py recovery walk on any transaction-path
+# death — the same state machine the sim ClusterController
+# (cluster/recovery.py) walks, so sim and wire cannot drift.
+
+
+async def _cached_call(conns: dict, address, token: int, msg, *,
+                       timeout: float = 30.0, retries: int = 2,
+                       delay: float = 0.05, on_fail=None):
+    """One RPC over a cached connection: lazily connect, call, and on
+    ANY failure invalidate the cache entry (closing the connection)
+    and run `on_fail(address)` before re-raising — the shared
+    connect/call/invalidate contract of every control-plane caller
+    (controller → worker, ratekeeper/client → controller)."""
+    try:
+        conn = conns.get(address)
+        if conn is None:
+            conn = transport.RpcConnection(address, tls=_tls_from_env())
+            await conn.connect(retries=retries, delay=delay)
+            conns[address] = conn
+        return await conn.call(token, msg, timeout=timeout)
+    except Exception:
+        old = conns.pop(address, None)
+        if old is not None:
+            try:
+                await old.close()
+            except Exception:
+                pass
+        if on_fail is not None:
+            on_fail(address)
+        raise
+
+
+async def _close_all(conns: dict) -> None:
+    for conn in list(conns.values()):
+        try:
+            await conn.close()
+        except Exception:
+            pass
+    conns.clear()
+
+
+class ProxyRole:
+    """The commit+GRV proxy as a recruitable, killable worker role.
+
+    Wraps ProxyPipeline behind the client front-door RPCs
+    (ClientGrv/ClientCommit/ClientRead), so clients reach the commit
+    path over the wire like every other hop and a kill -9 of the proxy
+    is survivable: the controller recruits a replacement in the next
+    generation and the NEW proxy's first batch carries the conservative
+    whole-keyspace blind write (cluster/generation.py), aborting every
+    in-flight transaction whose snapshot predates recovery."""
+
+    def __init__(self, spec: dict):
+        self.spec = spec
+        self.epoch = int(spec.get("epoch", 0))
+        self.start_version = int(spec.get("start_version", 0))
+        self.recovered = False
+        self.pipeline: ProxyPipeline | None = None
+        self._conns: list[transport.RpcConnection] = []
+
+    async def start(self) -> None:
+        topo = self.spec["topology"]
+        resolvers = [await connect(a) for a in topo["resolvers"]]
+        tlog = await connect(topo["tlog"])
+        storage = await connect(topo["storage"])
+        rk = None
+        if topo.get("ratekeeper"):
+            rk = await connect(topo["ratekeeper"])
+        self._conns = [*resolvers, tlog, storage] + ([rk] if rk else [])
+        self.pipeline = ProxyPipeline(
+            resolvers,
+            tlog,
+            storage,
+            batch_interval=float(self.spec.get("batch_interval", 0.002)),
+            max_batch=int(self.spec.get("max_batch", 512)),
+            start_version=self.start_version,
+            epoch=self.epoch,
+            ratekeeper=rk,
+            trace=bool(self.spec.get("trace", False)),
+        )
+        self.pipeline.start()
+        if self.spec.get("recover", True):
+            # the recovery transaction: the new generation's FIRST
+            # batch is the conservative whole-keyspace blind write —
+            # it pushes the log (and storage) past the recovery
+            # version so reads don't stall, and registers the write
+            # that aborts every pre-recovery snapshot
+            from foundationdb_tpu.cluster.generation import (
+                conservative_recovery_transaction,
+            )
+
+            await self.pipeline.commit(
+                conservative_recovery_transaction(self.start_version)
+            )
+        self.recovered = True
+
+    async def stop(self) -> None:
+        if self.pipeline is not None:
+            await self.pipeline.stop()
+        for c in self._conns:
+            try:
+                await c.close()
+            except Exception:
+                pass
+        self._conns = []
+
+    async def client_grv(self, _req: "ClientGrvRequest") -> "ClientGrvReply":
+        try:
+            v = await self.pipeline.get_read_version()
+        except GrvThrottledError:
+            # marker-carrying RemoteError: ClusterClient re-raises the
+            # typed retryable error client-side
+            raise transport.RemoteError("grv_throttled")
+        return ClientGrvReply(version=v)
+
+    async def client_commit(
+        self, req: "ClientCommitRequest"
+    ) -> "ClientCommitReply":
+        try:
+            v = await self.pipeline.commit(req.txn)
+        except NotCommittedError as e:
+            raise transport.RemoteError(f"not_committed: {e}")
+        return ClientCommitReply(version=v)
+
+    async def client_read(self, req: "ClientReadRequest") -> "ClientReadReply":
+        v = await self.pipeline.read(req.key, req.version)
+        return ClientReadReply(value=v)
+
+    def status(self) -> dict:
+        block = _pipeline_status_blocks(self.pipeline)
+        payload = block["proxy0"]
+        payload["grv_proxy"] = block["grv_proxy0"]
+        payload["epoch"] = self.epoch
+        payload["recovered"] = self.recovered
+        return payload
+
+
+class WorkerRole:
+    """One process that can host any role behind a dispatch loop — the
+    fdbserver worker. Every role token is registered up front against a
+    dispatcher that routes to the currently hosted role object;
+    InitializeRole (the Initialize*Request analog) installs or REPLACES
+    a role at a given generation, which is exactly what recovery needs:
+    re-initializing a resolver builds a brand-new ResolverRole with
+    EMPTY conflict state. A background beacon registers this worker
+    with the cluster controller (RegisterWorker) on a cadence — it
+    doubles as the liveness signal and re-announces after a monitor
+    restart."""
+
+    BEACON_INTERVAL = 0.5
+
+    def __init__(self, worker_id: str, address: str,
+                 controller: str | None = None):
+        self.worker_id = worker_id
+        self.address = address
+        self.controller = controller
+        self.roles: dict[str, object] = {}  # kind -> hosted role object
+        self.role_epochs: dict[str, int] = {}
+        self.initializations = 0
+        self._reg_task: asyncio.Task | None = None
+        self._reg_conn: transport.RpcConnection | None = None
+
+    async def start(self) -> None:
+        if self.controller:
+            self._reg_task = asyncio.ensure_future(self._register_loop())
+
+    async def _register_loop(self) -> None:
+        import json as _json
+
+        while True:
+            try:
+                conn = self._reg_conn
+                if conn is None:
+                    conn = transport.RpcConnection(
+                        self.controller, tls=_tls_from_env()
+                    )
+                    await conn.connect(retries=1)
+                    self._reg_conn = conn
+                await conn.call(
+                    TOKEN_REGISTER_WORKER,
+                    RegisterWorker(payload=_json.dumps({
+                        "worker_id": self.worker_id,
+                        "address": self.address,
+                        "pid": os.getpid(),
+                        "roles": dict(self.role_epochs),
+                    })),
+                    timeout=2.0,
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                conn = self._reg_conn
+                self._reg_conn = None
+                if conn is not None:
+                    try:
+                        await conn.close()
+                    except Exception:
+                        pass
+            await asyncio.sleep(self.BEACON_INTERVAL)
+
+    def role(self, kind: str):
+        r = self.roles.get(kind)
+        if r is None:
+            # retryable: the controller hasn't recruited this role here
+            # (or a monitor-restarted worker lost it — the controller's
+            # heartbeat sees the mismatch and recovers)
+            raise transport.RemoteError(
+                f"worker_not_initialized: no {kind} hosted on "
+                f"{self.worker_id}"
+            )
+        return r
+
+    async def init_role(self, req: "InitializeRole") -> "InitializeRoleReply":
+        import json as _json
+
+        spec = _json.loads(req.payload)
+        kind = spec["kind"]
+        epoch = int(spec.get("epoch", 0))
+        old = self.roles.pop(kind, None)
+        self.role_epochs.pop(kind, None)
+        if isinstance(old, (ProxyRole, RatekeeperRole)):
+            await old.stop()
+        elif isinstance(old, StorageRole):
+            # storage WAL writes run on executor threads: close under
+            # the log lock (use-after-free in the native queue
+            # otherwise) — and BEFORE the successor (possibly on this
+            # same worker) re-opens the data dir
+            await old.aclose_disk()
+        elif old is not None and hasattr(old, "close_disk"):
+            # the tlog's disk ops all run on the event loop; a plain
+            # close cannot interleave with a push
+            old.close_disk()
+        role, info = await self._build_role(kind, epoch, spec)
+        self.roles[kind] = role
+        self.role_epochs[kind] = epoch
+        self.initializations += 1
+        from foundationdb_tpu.utils.trace import SEV_INFO, TraceEvent
+
+        TraceEvent("WorkerRoleInitialized", severity=SEV_INFO).detail(
+            "WorkerId", self.worker_id
+        ).detail("Kind", kind).detail("Epoch", epoch).log()
+        return InitializeRoleReply(payload=_json.dumps({
+            "ok": True, "kind": kind, "epoch": epoch,
+            "worker_id": self.worker_id, **info,
+        }))
+
+    async def _build_role(self, kind: str, epoch: int, spec: dict):
+        if kind == "resolver":
+            if spec.get("resolver_kernel"):
+                os.environ["RESOLVER_KERNEL"] = spec["resolver_kernel"]
+            role = ResolverRole(
+                backend=spec.get("backend", "native"), epoch=epoch
+            )
+            return role, {}
+        if kind == "tlog":
+            role = TLogRole(data_dir=spec.get("data_dir"), epoch=epoch)
+            return role, {"durable_version": role.version}
+        if kind == "storage":
+            role = StorageRole(
+                data_dir=spec.get("data_dir"),
+                engine=spec.get("storage_engine", "memory"),
+            )
+            if spec.get("tlog_address"):
+                await role.catch_up_from_tlog(spec["tlog_address"])
+            return role, {"durable_version": role.version}
+        if kind == "ratekeeper":
+            role = RatekeeperRole(
+                spec.get("peers") or [],
+                controller=spec.get("controller") or self.controller,
+            )
+            await role.start()
+            return role, {}
+        if kind == "proxy":
+            role = ProxyRole(spec)
+            await role.start()
+            return role, {"recovered": role.recovered}
+        raise transport.RemoteError(f"unknown role kind {kind!r}")
+
+    def status(self) -> dict:
+        base = {
+            "worker_id": self.worker_id,
+            "hosted": sorted(self.roles),
+            "role_epochs": dict(self.role_epochs),
+            "initializations": self.initializations,
+        }
+        if len(self.roles) == 1:
+            # the common one-role-per-worker shape: report AS the
+            # hosted role so fdbtop / the ratekeeper / the controller
+            # heartbeat read the role's sensors straight off the
+            # worker's socket
+            (kind, role), = self.roles.items()
+            block = role.status()
+            block.update(base)
+            return block
+        return {"role": "worker", "idle": not self.roles, **base,
+                "qos": {"hosted": sorted(self.roles),
+                        **{k: r.status().get("qos", {})
+                           for k, r in self.roles.items()}}}
+
+    def register_tokens(self, server: transport.RpcServer) -> None:
+        """The dispatch loop: every role token routes through the
+        hosted-role map, so one worker binary serves whatever it is
+        recruited as (the fdbserver shape)."""
+
+        def route(kind: str, method: str):
+            async def handler(req, _kind=kind, _method=method):
+                return await getattr(self.role(_kind), _method)(req)
+
+            return handler
+
+        server.register(TOKEN_INIT_ROLE, self.init_role)
+        server.register(TOKEN_RESOLVE, route("resolver", "resolve"))
+
+        async def resolver_version(_req: RoleVersionReq) -> RoleVersionReply:
+            return RoleVersionReply(version=self.role("resolver").version)
+
+        server.register(TOKEN_RESOLVER_VERSION, resolver_version)
+        server.register(TOKEN_TLOG_PUSH, route("tlog", "push"))
+        server.register(TOKEN_TLOG_PEEK, route("tlog", "peek"))
+        server.register(TOKEN_TLOG_PEEK_BATCH, route("tlog", "peek_batch"))
+        server.register(TOKEN_TLOG_VERSION, route("tlog", "get_version"))
+        server.register(TOKEN_TLOG_LOCK, route("tlog", "lock"))
+        server.register(TOKEN_TLOG_POP, route("tlog", "pop"))
+        server.register(TOKEN_STORAGE_APPLY, route("storage", "apply"))
+        server.register(
+            TOKEN_STORAGE_APPLY_BATCH, route("storage", "apply_batch")
+        )
+        server.register(TOKEN_STORAGE_GET, route("storage", "get"))
+        server.register(TOKEN_STORAGE_GET_BATCH, route("storage", "get_batch"))
+        server.register(TOKEN_STORAGE_SNAPSHOT, route("storage", "snapshot"))
+        server.register(TOKEN_STORAGE_VERSION, route("storage", "get_version"))
+        server.register(TOKEN_STORAGE_CATCHUP, route("storage", "catch_up"))
+        server.register(
+            TOKEN_GET_RATE_INFO, route("ratekeeper", "get_rate_info")
+        )
+        server.register(TOKEN_CLIENT_GRV, route("proxy", "client_grv"))
+        server.register(TOKEN_CLIENT_COMMIT, route("proxy", "client_commit"))
+        server.register(TOKEN_CLIENT_READ, route("proxy", "client_read"))
+
+
+class ClusterControllerRole:
+    """The cluster state owner: recruits a declarative topology onto
+    registered workers, heartbeats them over the StatusRequest
+    plumbing, and on any transaction-path death runs the reference
+    recovery walk (cluster/generation.py GenerationState — the SAME
+    state machine the sim ClusterController drives): bump the
+    generation, lock the durable tlog and take the recovery version
+    from it, recruit NEW resolvers with EMPTY conflict state, recruit
+    the new proxy generation whose first batch is the conservative
+    whole-keyspace blind write, and re-open for business. Storage and
+    the tlog's durable state survive recovery untouched; a dead
+    controller is itself survivable — the monitor restarts it, it
+    re-learns workers from their beacons and (epoch persisted in the
+    state file) always recovers into a strictly newer generation."""
+
+    #: consecutive heartbeat misses before a role is declared dead — a
+    #: kill -9'd worker fails its poll in milliseconds (connection
+    #: refused), so detection stays fast; the margin is for a LIVE
+    #: worker whose event loop stalls a poll under load
+    HEARTBEAT_MISSES = 3
+    #: a worker whose beacon is older than this is not live
+    WORKER_TTL = 3.0
+
+    def __init__(self, conf: dict, *, state_file: str | None = None,
+                 check_interval: float = 0.25):
+        import time as _time
+
+        from foundationdb_tpu.cluster.generation import GenerationState
+
+        self.conf = conf
+        self.check_interval = check_interval
+        self.state_file = state_file
+        self.gen = GenerationState(
+            epoch=self._load_epoch(), clock=_time.time
+        )
+        self.workers: dict[str, dict] = {}  # id -> beacon info
+        self.assignments: dict[str, dict] = {}  # role name -> placement
+        self.recoveries_completed = 0
+        self.last_recovery_s: float | None = None
+        self.last_recovery_reason: str | None = None
+        self._needs_recovery = True  # initial recruitment IS a recovery
+        self._recovery_reason = "initial_recruitment"
+        self._miss_counts: dict[str, int] = {}
+        self._conns: dict[str, transport.RpcConnection] = {}
+        self._task: asyncio.Task | None = None
+
+    # -- epoch persistence (the coordinated-state analog) ---------------
+
+    def _load_epoch(self) -> int:
+        import json as _json
+
+        if self.state_file and os.path.exists(self.state_file):
+            try:
+                with open(self.state_file) as f:
+                    return int(_json.load(f).get("epoch", 0))
+            except Exception:
+                return 0
+        return 0
+
+    def _persist_epoch(self, epoch: int) -> None:
+        import json as _json
+
+        if not self.state_file:
+            return
+        tmp = self.state_file + ".tmp"
+        with open(tmp, "w") as f:
+            _json.dump({"epoch": epoch}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.state_file)
+
+    # -- RPC surface -----------------------------------------------------
+
+    async def register_worker(
+        self, req: "RegisterWorker"
+    ) -> "RegisterWorkerReply":
+        import json as _json
+        import time as _time
+
+        info = _json.loads(req.payload)
+        self.workers[info["worker_id"]] = {
+            **info, "last_seen": _time.monotonic(),
+        }
+        return RegisterWorkerReply(payload=_json.dumps(
+            {"ok": True, "epoch": self.gen.epoch}
+        ))
+
+    def topology_doc(self) -> dict:
+        return {
+            "epoch": self.gen.epoch,
+            "state": self.gen.status,
+            "recovery_version": self.gen.recovery_version,
+            "recoveries_completed": self.recoveries_completed,
+            "roles": {
+                name: {
+                    "kind": a["kind"],
+                    "address": a["address"],
+                    "worker": a["worker_id"],
+                    "epoch": a["epoch"],
+                    "pid": self.workers.get(a["worker_id"], {}).get("pid"),
+                }
+                for name, a in self.assignments.items()
+            },
+        }
+
+    async def topology(self, _req: "TopologyRequest") -> "TopologyReply":
+        import json as _json
+
+        return TopologyReply(payload=_json.dumps(self.topology_doc()))
+
+    def status(self) -> dict:
+        import time as _time
+
+        now = _time.monotonic()
+        return {
+            "role": "cluster_controller",
+            "epoch": self.gen.epoch,
+            "qos": {
+                "epoch": self.gen.epoch,
+                "recovery_state": self.gen.status,
+                "recovery_version": self.gen.recovery_version,
+                "recoveries_completed": self.recoveries_completed,
+                "last_recovery_s": self.last_recovery_s,
+                "last_recovery_reason": self.last_recovery_reason,
+                "workers_registered": len(self.workers),
+                "workers_live": len(self._live_workers()),
+                "roles_recruited": len(self.assignments),
+                "recovery_timeline": self.gen.timeline_dicts(),
+                "workers": {
+                    wid: {
+                        "pid": w.get("pid"),
+                        "age_s": round(now - w["last_seen"], 3),
+                        "roles": w.get("roles", {}),
+                    }
+                    for wid, w in self.workers.items()
+                },
+            },
+        }
+
+    # -- recruitment planning --------------------------------------------
+
+    def _role_names(self) -> list[tuple[str, str]]:
+        """(role name, kind) pairs of the declarative topology, in
+        recruitment order: durable log first (the recovery version
+        source), then storage, resolvers, ratekeeper, proxy last (its
+        init commits the recovery transaction)."""
+        names = [("tlog0", "tlog"), ("storage0", "storage")]
+        for i in range(int(self.conf.get("resolvers", 1))):
+            names.append((f"resolver{i}", "resolver"))
+        if self.conf.get("ratekeeper", True):
+            names.append(("ratekeeper0", "ratekeeper"))
+        names.append(("proxy0", "proxy"))
+        return names
+
+    def _live_workers(self) -> dict[str, dict]:
+        import time as _time
+
+        now = _time.monotonic()
+        return {
+            wid: w for wid, w in self.workers.items()
+            if now - w["last_seen"] <= self.WORKER_TTL
+        }
+
+    def _plan(self) -> dict[str, dict]:
+        """Assign each role a live worker (one role per worker, so a
+        kill -9 takes out exactly one role). Placement preference:
+        (1) the current assignment when its worker is still live;
+        (2) a live worker whose BEACON already reports hosting the
+        kind — the re-adoption path: a restarted controller has no
+        assignment memory, and recruiting a durable role away from the
+        worker that still holds its disk queue open would double-open
+        the data dir (found by the controller-kill chaos scenario);
+        (3) an idle live worker; (4) any live worker. Raises if the
+        live worker set cannot host the topology — the caller retries
+        after the monitor has restarted the dead workers."""
+        live = self._live_workers()
+        taken: set[str] = set()
+        plan: dict[str, dict] = {}
+        for name, kind in self._role_names():
+            cur = self.assignments.get(name)
+            wid = None
+            if cur and cur["worker_id"] in live \
+                    and cur["worker_id"] not in taken:
+                wid = cur["worker_id"]
+            if wid is None:
+                for cand in sorted(live):
+                    if cand not in taken \
+                            and kind in (live[cand].get("roles") or {}):
+                        wid = cand
+                        break
+            if wid is None:
+                for cand in sorted(live):
+                    if cand not in taken \
+                            and not (live[cand].get("roles") or {}):
+                        wid = cand
+                        break
+            if wid is None:
+                for cand in sorted(live):
+                    if cand not in taken:
+                        wid = cand
+                        break
+            if wid is None:
+                raise RuntimeError(
+                    f"not enough live workers: need "
+                    f"{len(self._role_names())}, have {len(live)}"
+                )
+            taken.add(wid)
+            plan[name] = {
+                "kind": kind,
+                "worker_id": wid,
+                "address": live[wid]["address"],
+                "epoch": self.gen.epoch,
+            }
+        return plan
+
+    def _hosted_epoch(self, worker_id: str, kind: str) -> int:
+        """The epoch a surviving role was initialized at, from its
+        worker's beacon — what heartbeats will compare against."""
+        w = self._live_workers().get(worker_id) or {}
+        return int((w.get("roles") or {}).get(kind, 0))
+
+    def _suspect_worker(self, address: str) -> None:
+        """Drop a worker we failed to reach from the registry: its
+        beacon ages in every ~0.5s, so a LIVE worker re-appears almost
+        immediately, while a kill -9 corpse stops poisoning the
+        recruitment plan NOW instead of after the beacon TTL (found by
+        the first chaos run: recovery retried into the dead worker for
+        a full TTL before re-planning)."""
+        for wid, w in list(self.workers.items()):
+            if w.get("address") == address:
+                self.workers.pop(wid, None)
+
+    async def _worker_call(self, address: str, token: int, msg,
+                           *, timeout: float = 30.0):
+        return await _cached_call(
+            self._conns, address, token, msg,
+            timeout=timeout, on_fail=self._suspect_worker,
+        )
+
+    async def _init_role(self, placement: dict, spec: dict, *,
+                         timeout: float = 120.0) -> dict:
+        import json as _json
+
+        reply = await self._worker_call(
+            placement["address"], TOKEN_INIT_ROLE,
+            InitializeRole(payload=_json.dumps({
+                "kind": placement["kind"],
+                "epoch": placement["epoch"],
+                **spec,
+            })),
+            timeout=timeout,
+        )
+        return _json.loads(reply.payload)
+
+    # -- the recovery walk ----------------------------------------------
+
+    async def _recover(self) -> None:
+        import time as _time
+
+        from foundationdb_tpu.cluster import generation as gen
+
+        t0 = _time.monotonic()
+        reason = self._recovery_reason
+        epoch = self.gen.begin_recovery(floor=self._load_epoch())
+        self._persist_epoch(epoch)
+        # wait until the monitor has restarted enough workers to host
+        # the topology (the beacons re-announce them)
+        while True:
+            try:
+                plan = self._plan()
+                break
+            except RuntimeError:
+                await asyncio.sleep(self.check_interval)
+        conf = self.conf
+        self.gen.transition(gen.LOCKING_OLD_TRANSACTION_SERVERS,
+                            Reason=reason)
+        # 1. The durable log: keep it where it lives (or re-host it
+        #    from its data dir), then LOCK it at the new epoch — old-
+        #    generation pushes are fenced from here on, and the lock
+        #    reply carries the durable version recovery derives from.
+        tlog = plan["tlog0"]
+        if self._worker_hosts(tlog["worker_id"], "tlog"):
+            # survivor (current assignment OR a restarted controller's
+            # beacon re-adoption): keep the epoch it was INITIALIZED at
+            # — the worker's role_epochs is what heartbeats compare,
+            # and the fencing epoch advances via the lock below (a
+            # re-stamped assignment here made every later heartbeat a
+            # mismatch and cascaded spurious recoveries)
+            tlog["epoch"] = self._hosted_epoch(tlog["worker_id"], "tlog")
+        else:
+            await self._init_role(tlog, {
+                "data_dir": conf.get("tlog_data_dir"),
+            })
+        lock = await self._worker_call(
+            tlog["address"], TOKEN_TLOG_LOCK, TLogLock(epoch=epoch)
+        )
+        recovery_version = gen.recovery_version_for(lock.durable_version)
+        self.gen.recovery_version = recovery_version
+        self.gen.transition(gen.RECRUITING_TRANSACTION_SERVERS,
+                            RecoveryVersion=recovery_version)
+        # 2. Storage's durable state survives recovery, but its APPLY
+        #    FEED died with the old proxy: it must replay the locked
+        #    tlog's tail BEFORE the new generation's first apply can
+        #    advance its version past the gap. A dead storage is
+        #    re-hosted from its durable dir (the init catch-up does the
+        #    same replay).
+        storage = plan["storage0"]
+        if self._worker_hosts(storage["worker_id"], "storage"):
+            storage["epoch"] = self._hosted_epoch(
+                storage["worker_id"], "storage"
+            )
+            await self._worker_call(
+                storage["address"], TOKEN_STORAGE_CATCHUP,
+                StorageCatchUp(tlog_address=tlog["address"]),
+            )
+        else:
+            await self._init_role(storage, {
+                "data_dir": conf.get("storage_data_dir"),
+                "storage_engine": conf.get("storage_engine", "memory"),
+                "tlog_address": tlog["address"],
+            })
+        # 3. NEW resolvers, EMPTY conflict state — always rebuilt, even
+        #    on surviving workers (resolvers are stateless across
+        #    recoveries; correctness comes from the conservative abort).
+        #    Each boots with the empty batch at the recovery version so
+        #    the new proxy's version chain finds them ready.
+        resolver_places = [
+            p for n, p in sorted(plan.items()) if p["kind"] == "resolver"
+        ]
+        for place in resolver_places:
+            await self._init_role(place, {
+                "backend": conf.get("backend", "native"),
+                "resolver_kernel": conf.get("resolver_kernel"),
+            })
+            await self._worker_call(
+                place["address"], TOKEN_RESOLVE,
+                ResolveTransactionBatchRequest(
+                    prev_version=-1,
+                    version=recovery_version,
+                    last_received_version=-1,
+                    epoch=epoch,
+                ),
+            )
+        # 4. Ratekeeper: a singleton, re-recruited only if dead (it
+        #    re-resolves peers from our topology each control cycle).
+        topo_addrs = {
+            "resolvers": [p["address"] for p in resolver_places],
+            "tlog": tlog["address"],
+            "storage": storage["address"],
+        }
+        if "ratekeeper0" in plan:
+            rk = plan["ratekeeper0"]
+            if self._worker_hosts(rk["worker_id"], "ratekeeper"):
+                # survivor keeps its init epoch
+                rk["epoch"] = self._hosted_epoch(
+                    rk["worker_id"], "ratekeeper"
+                )
+            else:
+                await self._init_role(rk, {
+                    "peers": [tlog["address"], storage["address"],
+                              *topo_addrs["resolvers"]],
+                })
+            topo_addrs["ratekeeper"] = rk["address"]
+        # 5. The new proxy generation: its start() commits the
+        #    conservative recovery transaction as the FIRST batch.
+        self.gen.transition(gen.RECOVERY_TRANSACTION)
+        proxy = plan["proxy0"]
+        info = await self._init_role(proxy, {
+            "topology": topo_addrs,
+            "start_version": recovery_version,
+            "recover": True,
+            "batch_interval": conf.get("batch_interval", 0.002),
+            "max_batch": conf.get("max_batch", 512),
+            "trace": bool(conf.get("trace", False)),
+        })
+        if not info.get("recovered"):
+            raise RuntimeError(f"proxy recruitment did not recover: {info}")
+        self.gen.transition(gen.ACCEPTING_COMMITS)
+        self.assignments = plan
+        self._miss_counts.clear()
+        self.recoveries_completed += 1
+        self.last_recovery_s = round(_time.monotonic() - t0, 3)
+        self.last_recovery_reason = reason
+        self.gen.transition(
+            gen.FULLY_RECOVERED,
+            RecoverySeconds=self.last_recovery_s,
+            Reason=reason,
+        )
+
+    def _worker_hosts(self, worker_id: str, kind: str) -> bool:
+        """True if the worker's latest beacon reports hosting `kind` —
+        a monitor-restarted worker re-registers with an EMPTY role map,
+        which is how the controller learns a kill -9 took the role with
+        it even though the socket answers again."""
+        w = self._live_workers().get(worker_id)
+        return bool(w) and kind in (w.get("roles") or {})
+
+    # -- heartbeat + supervision loop ------------------------------------
+
+    async def _heartbeat(self) -> list[str]:
+        """One heartbeat pass over the recruited topology (concurrent
+        StatusRequest polls; reusing the StatusRequest plumbing means
+        heartbeats double as sensor reads). A role is dead after
+        HEARTBEAT_MISSES consecutive misses, where a miss is a failed
+        poll OR a worker that answers but no longer hosts the role at
+        the recruited epoch (restarted corpse)."""
+        import json as _json
+
+        async def poll(name: str, a: dict):
+            try:
+                reply = await self._worker_call(
+                    a["address"], TOKEN_STATUS, StatusRequest(pad=0),
+                    timeout=2.0,
+                )
+                block = _json.loads(reply.payload)
+            except Exception:
+                return name, False
+            hosted = block.get("role_epochs") or {}
+            return name, hosted.get(a["kind"]) == a["epoch"]
+
+        results = await asyncio.gather(
+            *(poll(n, a) for n, a in self.assignments.items())
+        )
+        dead = []
+        for name, ok in results:
+            if ok:
+                self._miss_counts[name] = 0
+                continue
+            self._miss_counts[name] = self._miss_counts.get(name, 0) + 1
+            if self._miss_counts[name] >= self.HEARTBEAT_MISSES:
+                dead.append(name)
+        return dead
+
+    async def run(self) -> None:
+        from foundationdb_tpu.utils.trace import (
+            SEV_WARN_ALWAYS,
+            TraceEvent,
+        )
+
+        while True:
+            try:
+                if self._needs_recovery:
+                    await self._recover()
+                    self._needs_recovery = False
+                else:
+                    dead = await self._heartbeat()
+                    txn_dead = [
+                        n for n in dead
+                        if self.assignments[n]["kind"]
+                        in ("proxy", "resolver", "tlog")
+                    ]
+                    for name in dead:
+                        TraceEvent(
+                            "ControllerRoleDead", severity=SEV_WARN_ALWAYS
+                        ).detail("Role", name).detail(
+                            "Kind", self.assignments[name]["kind"]
+                        ).detail("Epoch", self.gen.epoch).log()
+                        # the dead role's worker is suspect until its
+                        # beacon re-announces it (a kill -9 corpse must
+                        # not be re-planned into the next generation)
+                        self.workers.pop(
+                            self.assignments[name]["worker_id"], None
+                        )
+                    if txn_dead:
+                        # the transaction system recovers AS A UNIT —
+                        # never patched (the reference's key recovery
+                        # property)
+                        self._needs_recovery = True
+                        self._recovery_reason = ",".join(sorted(txn_dead))
+                    else:
+                        for name in dead:
+                            await self._rerecruit_singleton(name)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                TraceEvent(
+                    "ControllerLoopError", severity=SEV_WARN_ALWAYS
+                ).detail("Error", repr(e)).log()
+            await asyncio.sleep(self.check_interval)
+
+    async def _rerecruit_singleton(self, name: str) -> None:
+        """Non-transaction-path roles (storage, ratekeeper) re-recruit
+        alone, no generation bump — the reference re-replicates /
+        re-recruits singletons without a recovery."""
+        kind = self.assignments[name]["kind"]
+        live = self._live_workers()
+        used = {
+            a["worker_id"] for n, a in self.assignments.items() if n != name
+        }
+        # RE-ADOPT first: a live worker whose beacon still reports
+        # hosting the kind is a slow-but-alive instance that missed
+        # its polls, not a corpse — recruiting a durable role onto a
+        # DIFFERENT worker while it still holds the data dir open
+        # would double-open the WAL (code review r13). The beacon
+        # re-announces within ~0.5s, so by the time the miss threshold
+        # trips, a live instance is visible here.
+        for wid in sorted(live):
+            if wid not in used and kind in (live[wid].get("roles") or {}):
+                self.assignments[name] = {
+                    "kind": kind, "worker_id": wid,
+                    "address": live[wid]["address"],
+                    "epoch": self._hosted_epoch(wid, kind),
+                }
+                self._miss_counts[name] = 0
+                return
+        wid = next(
+            (w for w in sorted(live) if w not in used), None
+        )
+        if wid is None:
+            return  # monitor hasn't restarted a worker yet; next pass
+        place = {
+            "kind": kind, "worker_id": wid,
+            "address": live[wid]["address"], "epoch": self.gen.epoch,
+        }
+        conf = self.conf
+        if kind == "storage":
+            tlog = self.assignments.get("tlog0")
+            await self._init_role(place, {
+                "data_dir": conf.get("storage_data_dir"),
+                "storage_engine": conf.get("storage_engine", "memory"),
+                "tlog_address": tlog["address"] if tlog else None,
+            })
+        elif kind == "ratekeeper":
+            await self._init_role(place, {"peers": []})
+        else:
+            return
+        self.assignments[name] = place
+        self._miss_counts[name] = 0
+
+
+class ClusterRecoveringError(Exception):
+    """The cluster is between generations; retry after recovery."""
+
+
+class CommitUnknownError(Exception):
+    """The commit's fate is unknown (connection/generation lost mid-
+    flight) — the commit_unknown_result contract: the transaction may
+    or may not have committed; only an idempotent replay or a readback
+    can tell."""
+
+
+class ClusterClient:
+    """Client-side lifecycle handle: discovers the proxy generation
+    through the controller topology and survives recoveries. GRV and
+    reads retry transparently across generations (they are stateless);
+    commit is ONE attempt — a connection lost mid-commit surfaces
+    CommitUnknownError (the reference's commit_unknown_result) because
+    the batch may have logged before the crash."""
+
+    def __init__(self, controller_address: str, *,
+                 recovery_timeout: float = 60.0):
+        self.controller_address = controller_address
+        self.recovery_timeout = recovery_timeout
+        self._ctrl_conns: dict = {}  # _cached_call cache (controller)
+        self._proxy: transport.RpcConnection | None = None
+        #: strong refs to detached close() tasks (the loop only keeps
+        #: weak task refs — without this a close could be GC'd unrun)
+        self._closing: set = set()
+        self.epoch = 0
+        self.proxy_address: str | None = None
+        self.refreshes = 0
+
+    async def connect(self) -> None:
+        await self._refresh()
+
+    async def close(self) -> None:
+        await _close_all(self._ctrl_conns)
+        if self._proxy is not None:
+            try:
+                await self._proxy.close()
+            except Exception:
+                pass
+        self._proxy = None
+        if self._closing:
+            await asyncio.gather(
+                *list(self._closing), return_exceptions=True
+            )
+
+    def _drop_proxy(self) -> None:
+        """Forget the current proxy connection, CLOSING it — error
+        paths must not leak one transport per generation change."""
+        conn = self._proxy
+        self._proxy = None
+        if conn is not None:
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                return
+            t = loop.create_task(conn.close())
+            # detached close: the loop holds only weak task refs —
+            # anchor it until done or it can be GC'd before running
+            self._closing.add(t)
+            t.add_done_callback(self._closing.discard)
+
+    async def topology(self) -> dict:
+        import json as _json
+
+        reply = await _cached_call(
+            self._ctrl_conns, self.controller_address,
+            TOKEN_TOPOLOGY, TopologyRequest(pad=0), timeout=2.0,
+        )
+        return _json.loads(reply.payload)
+
+    async def _refresh(self) -> dict:
+        """Poll the controller until the cluster is fully recovered and
+        the proxy front door answers; reconnect to it. Bounded by
+        recovery_timeout."""
+        import time as _time
+
+        from foundationdb_tpu.cluster import generation as gen
+
+        deadline = _time.monotonic() + self.recovery_timeout
+        old = self._proxy
+        self._proxy = None
+        if old is not None:
+            try:
+                await old.close()
+            except Exception:
+                pass
+        while True:
+            topo = None
+            try:
+                topo = await self.topology()
+            except Exception:
+                pass
+            if topo and topo.get("state") == gen.FULLY_RECOVERED:
+                proxy = next(
+                    (e for e in topo.get("roles", {}).values()
+                     if e["kind"] == "proxy"),
+                    None,
+                )
+                if proxy is not None:
+                    conn = None
+                    try:
+                        conn = transport.RpcConnection(
+                            proxy["address"], tls=_tls_from_env()
+                        )
+                        await conn.connect(retries=2, delay=0.05)
+                        # liveness probe: the socket may be a corpse the
+                        # controller hasn't noticed yet
+                        await conn.call(
+                            TOKEN_CLIENT_GRV, ClientGrvRequest(pad=0),
+                            timeout=5.0,
+                        )
+                        alive = True
+                    except transport.RemoteError as e:
+                        # a throttled front door IS alive
+                        alive = "grv_throttled" in str(e)
+                    except Exception:
+                        alive = False
+                    if alive:
+                        self._proxy = conn
+                        self.proxy_address = proxy["address"]
+                        self.epoch = int(topo["epoch"])
+                        self.refreshes += 1
+                        return topo
+                    if conn is not None:
+                        try:
+                            await conn.close()
+                        except Exception:
+                            pass
+            if _time.monotonic() > deadline:
+                raise ClusterRecoveringError(
+                    f"no recovered generation within "
+                    f"{self.recovery_timeout}s (topology: "
+                    f"{topo and topo.get('state')})"
+                )
+            await asyncio.sleep(0.1)
+
+    async def _retryable_call(self, token: int, msg, *,
+                              timeout: float = 30.0):
+        """GRV/read path: retry through generation changes until the
+        recovery timeout. Typed retryable errors (grv_throttled) pass
+        through to the caller's backoff."""
+        import time as _time
+
+        deadline = _time.monotonic() + self.recovery_timeout
+        while True:
+            conn = self._proxy
+            try:
+                if conn is None:
+                    await self._refresh()
+                    conn = self._proxy
+                return await conn.call(token, msg, timeout=timeout)
+            except transport.RemoteError as e:
+                s = str(e)
+                if "grv_throttled" in s:
+                    raise GrvThrottledError()
+                if "not_committed" in s:
+                    raise NotCommittedError(s)
+                # stale epoch / failed pipeline / uninitialized worker:
+                # the generation is changing under us
+                self._drop_proxy()
+            except (transport.TransportError, ConnectionError,
+                    asyncio.TimeoutError):
+                self._drop_proxy()
+            if _time.monotonic() > deadline:
+                raise ClusterRecoveringError(
+                    f"rpc {token:#x} found no live generation within "
+                    f"{self.recovery_timeout}s"
+                )
+            await asyncio.sleep(0.05)
+
+    async def get_read_version(self) -> int:
+        reply = await self._retryable_call(
+            TOKEN_CLIENT_GRV, ClientGrvRequest(pad=0)
+        )
+        return reply.version
+
+    async def read(self, key: bytes, version: int) -> Optional[bytes]:
+        reply = await self._retryable_call(
+            TOKEN_CLIENT_READ, ClientReadRequest(key=key, version=version)
+        )
+        return reply.value
+
+    async def commit(self, txn: CommitTransaction, *,
+                     timeout: float = 30.0) -> int:
+        """ONE commit attempt. NotCommittedError = definitely aborted
+        (safe to retry at a fresh snapshot); CommitUnknownError = the
+        request was SENT and the generation/connection died mid-flight
+        (only a readback can tell); ClusterRecoveringError = the
+        request was never sent (no recovered generation reachable) —
+        definitely not committed, safe to retry outright."""
+        conn = self._proxy
+        if conn is None:
+            # connection setup failures happen BEFORE anything is
+            # sent: surface the retryable recovering error, never
+            # "unknown" — callers must not pay readback cost for a
+            # commit that provably never left this process
+            await self._refresh()
+            conn = self._proxy
+        try:
+            reply = await conn.call(
+                TOKEN_CLIENT_COMMIT, ClientCommitRequest(txn=txn),
+                timeout=timeout,
+            )
+            return reply.version
+        except transport.RemoteError as e:
+            s = str(e)
+            if "not_committed" in s:
+                raise NotCommittedError(s)
+            if "grv_throttled" in s:
+                raise GrvThrottledError()
+            self._drop_proxy()
+            from foundationdb_tpu.cluster.generation import is_stale_epoch
+
+            if is_stale_epoch(s):
+                # a generation-fence rejection happens BEFORE anything
+                # is appended (resolver and tlog both fence ahead of
+                # the log), so this commit provably did not land —
+                # retryable, no readback needed
+                raise ClusterRecoveringError(s)
+            raise CommitUnknownError(s)
+        except (transport.TransportError, ConnectionError,
+                asyncio.TimeoutError) as e:
+            self._drop_proxy()
+            raise CommitUnknownError(repr(e))
 
 
 async def _serve_role(
@@ -1494,7 +3002,16 @@ async def _serve_role(
     encrypt: bool = False,
     trace_file: str | None = None,
     peers: list[str] | None = None,
+    controller: str | None = None,
+    worker_id: str | None = None,
+    cluster_conf: str | None = None,
+    state_file: str | None = None,
 ) -> None:
+    if role_name == "controller" and not trace_file:
+        # monitor-spawned controllers have no per-role conf line for
+        # tracing; the env var is how the chaos drill captures the
+        # recovery epoch timeline (MasterRecoveryState events) durably
+        trace_file = os.environ.get("FDBTPU_CONTROLLER_TRACE")
     if trace_file:
         # per-process trace sink (the reference's one-trace-file-per-
         # fdbserver): micro-events and spans land in a JSONL file that
@@ -1544,6 +3061,8 @@ async def _serve_role(
         server.register(TOKEN_TLOG_PEEK, role.peek)
         server.register(TOKEN_TLOG_PEEK_BATCH, role.peek_batch)
         server.register(TOKEN_TLOG_VERSION, role.get_version)
+        server.register(TOKEN_TLOG_LOCK, role.lock)
+        server.register(TOKEN_TLOG_POP, role.pop)
     elif role_name == "storage":
         role = StorageRole(
             data_dir=data_dir, engine=storage_engine, encryption=encryption
@@ -1556,10 +3075,30 @@ async def _serve_role(
         server.register(TOKEN_STORAGE_GET_BATCH, role.get_batch)
         server.register(TOKEN_STORAGE_SNAPSHOT, role.snapshot)
         server.register(TOKEN_STORAGE_VERSION, role.get_version)
+        server.register(TOKEN_STORAGE_CATCHUP, role.catch_up)
     elif role_name == "ratekeeper":
-        role = RatekeeperRole(peers or [])
+        role = RatekeeperRole(peers or [], controller=controller)
         server.register(TOKEN_GET_RATE_INFO, role.get_rate_info)
         await role.start()
+    elif role_name == "worker":
+        role = WorkerRole(
+            worker_id or os.path.basename(str(address)),
+            str(address),
+            controller=controller,
+        )
+        role.register_tokens(server)
+        await role.start()
+    elif role_name == "controller":
+        import json as _json
+
+        conf: dict = {}
+        if cluster_conf:
+            with open(cluster_conf) as f:
+                conf = _json.load(f)
+        role = ClusterControllerRole(conf, state_file=state_file)
+        server.register(TOKEN_REGISTER_WORKER, role.register_worker)
+        server.register(TOKEN_TOPOLOGY, role.topology)
+        role._task = asyncio.ensure_future(role.run())
     else:
         raise ValueError(f"unknown role {role_name!r}")
 
@@ -1607,6 +3146,10 @@ def spawn_role(
     encrypt: bool = False,
     trace_file: str | None = None,
     peers: list[str] | None = None,
+    controller: str | None = None,
+    worker_id: str | None = None,
+    cluster_conf: str | None = None,
+    state_file: str | None = None,
 ) -> RoleProcess:
     """Start one role as a child OS process serving a UDS in socket_dir.
 
@@ -1644,6 +3187,14 @@ def spawn_role(
         # ratekeeper: the role sockets whose StatusRequest sensors feed
         # the admission law
         cmd += ["--peers", ",".join(peers)]
+    if controller:
+        cmd += ["--controller", controller]
+    if worker_id:
+        cmd += ["--worker-id", worker_id]
+    if cluster_conf:
+        cmd += ["--cluster-conf", cluster_conf]
+    if state_file:
+        cmd += ["--state-file", state_file]
     if tlog_address:
         cmd += ["--tlog-address", tlog_address]
     if storage_engine != "memory":
@@ -1766,6 +3317,7 @@ class ProxyPipeline:
         rate_fetch_interval: float = 0.25,
         max_grv_queue: int = None,
         resolve_columnar: bool = None,
+        epoch: int = 0,
     ):
         from foundationdb_tpu.cluster.batching import AdaptiveBatchSizer
         from foundationdb_tpu.utils.knobs import SERVER_KNOBS as _K
@@ -1773,6 +3325,11 @@ class ProxyPipeline:
         self.resolvers = resolvers
         self.tlog = tlog
         self.storage = storage
+        #: this proxy generation's recovery epoch, stamped on every
+        #: resolve frame and tlog push — resolvers/tlogs of another
+        #: generation reject them retryably (stale_epoch), so a fenced
+        #: old proxy can never slip a commit in after recovery
+        self.epoch = epoch
         # columnar resolve frame (r12): pack the batch's conflict
         # metadata ONCE into flat arrays + one key blob at batch-build
         # time (the layout the resolver's kernel packer consumes), so
@@ -2145,7 +3702,7 @@ class ProxyPipeline:
             while self._apply_queue:
                 q, self._apply_queue = self._apply_queue, []
                 try:
-                    await self.storage.call(
+                    apply_rep = await self.storage.call(
                         TOKEN_STORAGE_APPLY_BATCH,
                         StorageApplyBatch(
                             versions=[v for v, _m in q],
@@ -2167,6 +3724,31 @@ class ProxyPipeline:
                                 "CommitDebug", _cdbg.version_id(v),
                                 _cdbg.STORAGE_APPLIED,
                             )
+                # storage holds this prefix DURABLY (reply durable=1 —
+                # the store write-ahead-logs its applies): pop the
+                # tlog so its disk queue stays tail-sized (restart
+                # recovery cost ∝ tail, not history). A memory-only
+                # store never earns a pop: the tlog would be the only
+                # durable copy of committed mutations. Advisory — a
+                # pop failure (e.g. a mid-recovery fence) must never
+                # fail the pipeline — and LAST in the drain round, so
+                # a teardown cancellation parked here can't eat the
+                # batch's trace events above.
+                if not getattr(apply_rep, "durable", 0):
+                    continue
+                try:
+                    await self.tlog.call(
+                        TOKEN_TLOG_POP,
+                        TLogPop(
+                            version=self.applied_version,
+                            epoch=self.epoch,
+                        ),
+                        timeout=5.0,
+                    )
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    pass
 
     async def _batcher(self) -> None:
         from foundationdb_tpu.cluster.batching import commit_txn_bytes
@@ -2302,6 +3884,7 @@ class ProxyPipeline:
                 prev_version=prev_version,
                 version=version,
                 last_received_version=prev_version,
+                epoch=self.epoch,
                 cols=_packing.pack_columnar(txns),
                 debug_id=dbg,
                 span=span.context.as_tuple() if span is not None else None,
@@ -2315,6 +3898,7 @@ class ProxyPipeline:
                 prev_version=prev_version,
                 version=version,
                 last_received_version=prev_version,
+                epoch=self.epoch,
                 transactions=(
                     [
                         CommitTransaction(
@@ -2367,6 +3951,7 @@ class ProxyPipeline:
                 version=version,
                 prev_version=prev_version,
                 mutations=mutations,
+                epoch=self.epoch,
             ),
         )
         log_s = loop.time() - t_log
@@ -2525,6 +4110,19 @@ def main() -> None:
     ap.add_argument("--peers", default=None,
                     help="ratekeeper: comma list of peer role sockets "
                          "to poll StatusRequest sensors from")
+    ap.add_argument("--controller", default=None,
+                    help="worker/ratekeeper: the cluster controller's "
+                         "socket (workers register + ratekeeper "
+                         "re-resolves peers from its topology)")
+    ap.add_argument("--worker-id", default=None,
+                    help="worker: stable identity in RegisterWorker")
+    ap.add_argument("--cluster-conf", default=None,
+                    help="controller: JSON file with the declarative "
+                         "topology (resolvers, backend, data dirs)")
+    ap.add_argument("--state-file", default=None,
+                    help="controller: persisted epoch (the coordinated-"
+                         "state analog) so a restarted controller "
+                         "always recovers into a newer generation")
     args = ap.parse_args()
     asyncio.run(
         _serve_role(
@@ -2537,6 +4135,10 @@ def main() -> None:
             encrypt=args.encrypt,
             trace_file=args.trace_file,
             peers=args.peers.split(",") if args.peers else None,
+            controller=args.controller,
+            worker_id=args.worker_id,
+            cluster_conf=args.cluster_conf,
+            state_file=args.state_file,
         )
     )
 

@@ -34,6 +34,8 @@ from foundationdb_tpu.utils.probes import code_probe, declare
 
 declare("recovery.epoch_lock_failed", "recovery.completed",
         "recovery.leadership_lost")
+from foundationdb_tpu.cluster import generation as gen
+from foundationdb_tpu.cluster.generation import GenerationState
 from foundationdb_tpu.cluster.grv_proxy import GrvProxy
 from foundationdb_tpu.cluster.sequencer import Sequencer
 from foundationdb_tpu.models.types import ResolveTransactionBatchRequest
@@ -44,13 +46,20 @@ from foundationdb_tpu.utils.trace import TraceEvent
 
 
 class ClusterController:
-    """Failure watcher + recovery driver (the CC's recovery loop)."""
+    """Failure watcher + recovery driver (the CC's recovery loop).
+
+    The generation/epoch state machine is SHARED with the wire cluster
+    controller (cluster/generation.py — the wire twin lives in
+    cluster/multiprocess.py ClusterControllerRole): same recovery-state
+    vocabulary, same recovery-version rule, same conservative-abort
+    range, same MasterRecoveryState trace shape — so the sim and wire
+    recoveries cannot drift."""
 
     def __init__(self, cluster, *, check_interval: float = 0.05,
                  cc_id: str = "cc0"):
         self.cluster = cluster
         self.check_interval = check_interval
-        self.epoch = 1
+        self.gen = GenerationState(epoch=1, clock=cluster.sched.now)
         self.counters = CounterCollection("CCMetrics", ["recoveries", "checks"])
         self._task = None
         self._recovering = False
@@ -63,6 +72,14 @@ class ClusterController:
             lease=50 * check_interval,
         )
         self.lease = None
+
+    @property
+    def epoch(self) -> int:
+        return self.gen.epoch
+
+    @epoch.setter
+    def epoch(self, value: int) -> None:
+        self.gen.epoch = value
 
     def start(self) -> None:
         self._task = self.cluster.sched.spawn(
@@ -123,17 +140,17 @@ class ClusterController:
                 self._recovering = False
                 return self.epoch
             self.lease = bumped
-            self.epoch = max(self.epoch + 1, bumped.epoch)
+            # the shared state machine: bump to max(epoch+1, quorum
+            # epoch) and emit reading_transaction_system_state
+            self.gen.begin_recovery(floor=bumped.epoch - 1)
             self.counters.add("recoveries")
-            TraceEvent("MasterRecoveryState").detail("Epoch", self.epoch).detail(
-                "StatusCode", "reading_transaction_system_state"
-            ).log()
 
             # 1. Stop the old generation and LOCK the log system: pushes
             #    from the old epoch now fail with tlog_stopped, so no old
             #    in-flight batch can slip in a commit after this point
             #    (the reference's coordinated-state lock + tlog epoch
             #    lock). Their clients get commit_unknown_result.
+            self.gen.transition(gen.LOCKING_OLD_TRANSACTION_SERVERS)
             for p in cluster.commit_proxies:
                 p.stop()
             cluster.grv_proxy.stop()
@@ -143,11 +160,12 @@ class ClusterController:
             # 2. Recovery version: strictly above anything the old
             #    generation could have allocated, plus a safety gap
             #    (lastEpochEnd + MAX_VERSIONS_IN_FLIGHT in the reference)
-            #    so old and new versions can never collide.
-            recovery_version = (
-                max(cluster.tlog.version.get(), cluster.sequencer.version)
-                + 1_000_000
+            #    so old and new versions can never collide — the rule is
+            #    the shared generation.recovery_version_for.
+            recovery_version = gen.recovery_version_for(
+                cluster.tlog.version.get(), cluster.sequencer.version
             )
+            self.gen.recovery_version = recovery_version
             # Complete the old epoch at the recovery version so the first
             # new-generation push chains (lastEpochEnd).
             cluster.tlog.lock(self.epoch, recovery_version)
@@ -156,6 +174,8 @@ class ClusterController:
             )
 
             # 3. New resolvers, empty conflict state.
+            self.gen.transition(gen.RECRUITING_TRANSACTION_SERVERS,
+                                RecoveryVersion=recovery_version)
             cfg = cluster.config
             cluster.resolvers = [
                 Resolver(
@@ -189,8 +209,10 @@ class ClusterController:
             for p in cluster.commit_proxies:
                 p.last_received_version = recovery_version
                 # Conservative abort of pre-recovery snapshots: the first
-                # batch writes the whole keyspace.
-                p.conservative_writes.append((b"", b"\xff\xff"))
+                # batch writes the whole keyspace (the shared range —
+                # the wire ProxyRole commits the same write as its
+                # recovery transaction).
+                p.conservative_writes.append(gen.CONSERVATIVE_ABORT_RANGE)
                 p.start()
             cluster.grv_proxy = GrvProxy(
                 sched, cluster.sequencer, ratekeeper=cluster.ratekeeper
@@ -206,14 +228,14 @@ class ClusterController:
             #    recovery version — without it, reads at the new read
             #    version would stall until the first client commit
             #    (the reference's recoveryTransactionVersion commit).
+            self.gen.transition(gen.RECOVERY_TRANSACTION)
             from foundationdb_tpu.models.types import CommitTransaction
 
             await cluster.commit_proxies[0].commit(CommitTransaction()).future
 
             code_probe(True, "recovery.completed")
-            TraceEvent("MasterRecoveryState").detail("Epoch", self.epoch).detail(
-                "StatusCode", "fully_recovered"
-            ).log()
+            self.gen.transition(gen.ACCEPTING_COMMITS)
+            self.gen.transition(gen.FULLY_RECOVERED)
             return self.epoch
         finally:
             self._recovering = False

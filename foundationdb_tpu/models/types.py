@@ -72,6 +72,13 @@ class ResolveTransactionBatchRequest:
     txn_state_transactions: list[int] = dataclasses.field(default_factory=list)
     proxy_id: Optional[str] = None  # stands in for the reply endpoint address
     debug_id: Optional[str] = None
+    # Generation fencing (the wire-cluster lifecycle): the proxy
+    # generation's recovery epoch. A resolver serving generation E
+    # rejects any batch whose epoch differs with a retryable
+    # stale-epoch error (cluster/generation.py) — pre-recovery traffic
+    # is fenced by epoch, not by luck. 0 = unfenced (standalone/sim
+    # deployments without a cluster controller).
+    epoch: int = 0
     # OTEL-style span context (trace_id, span_id) — the reference threads
     # a SpanContext on every request (ResolverInterface.h:129)
     span: Optional[tuple] = None

@@ -32,7 +32,7 @@ from foundationdb_tpu.models.types import (
 from foundationdb_tpu.utils.packing import COLUMNAR_LAYOUT, ColumnarBatch
 
 #: Bumped whenever any wire layout changes; checked at connect time.
-PROTOCOL_VERSION = 0x0FDB_7E50_0007  # 0004: span context; 0005: lock_aware txn flag; 0006: per-txn debug_id + span; 0007: columnar resolve frame
+PROTOCOL_VERSION = 0x0FDB_7E50_0008  # 0005: lock_aware txn flag; 0006: per-txn debug_id + span; 0007: columnar resolve frame; 0008: generation epoch on resolve/push frames
 
 
 class CodecError(ValueError):
@@ -317,6 +317,7 @@ def w_resolve_request(out: WriteBuffer, r: ResolveTransactionBatchRequest) -> No
     w_i64(out, r.prev_version)
     w_i64(out, r.version)
     w_i64(out, r.last_received_version)
+    w_i64(out, r.epoch)
     w_u32(out, len(r.transactions))
     for t in r.transactions:
         w_commit_transaction(out, t)
@@ -337,6 +338,7 @@ def r_resolve_request(
     prev, off = r_i64(buf, off)
     ver, off = r_i64(buf, off)
     last, off = r_i64(buf, off)
+    epoch, off = r_i64(buf, off)
     n, off = r_u32(buf, off)
     txns = []
     for _ in range(n):
@@ -356,6 +358,7 @@ def r_resolve_request(
             prev_version=prev,
             version=ver,
             last_received_version=last,
+            epoch=epoch,
             transactions=txns,
             txn_state_transactions=state_idx,
             proxy_id=proxy_id,
@@ -467,6 +470,7 @@ class ResolveBatchColumnar:
         "prev_version",
         "version",
         "last_received_version",
+        "epoch",
         "proxy_id",
         "debug_id",
         "span",
@@ -482,10 +486,12 @@ class ResolveBatchColumnar:
         proxy_id: str | None = None,
         debug_id: str | None = None,
         span: tuple | None = None,
+        epoch: int = 0,
     ):
         self.prev_version = prev_version
         self.version = version
         self.last_received_version = last_received_version
+        self.epoch = epoch
         self.cols = cols
         self.proxy_id = proxy_id
         self.debug_id = debug_id
@@ -498,6 +504,7 @@ class ResolveBatchColumnar:
             self.prev_version == other.prev_version
             and self.version == other.version
             and self.last_received_version == other.last_received_version
+            and self.epoch == other.epoch
             and self.proxy_id == other.proxy_id
             and self.debug_id == other.debug_id
             and self.span == other.span
@@ -517,6 +524,7 @@ def w_resolve_columnar(out: WriteBuffer, r: ResolveBatchColumnar) -> None:
     w_i64(out, r.prev_version)
     w_i64(out, r.version)
     w_i64(out, r.last_received_version)
+    w_i64(out, r.epoch)
     w_u32(out, cols.n_txns)
     w_u32(out, cols.n_reads)
     w_u32(out, cols.n_writes)
@@ -538,6 +546,7 @@ def r_resolve_columnar(
     prev, off = r_i64(buf, off)
     ver, off = r_i64(buf, off)
     last, off = r_i64(buf, off)
+    epoch, off = r_i64(buf, off)
     n_txns, off = r_u32(buf, off)
     n_reads, off = r_u32(buf, off)
     n_writes, off = r_u32(buf, off)
@@ -590,6 +599,7 @@ def r_resolve_columnar(
             prev_version=prev,
             version=ver,
             last_received_version=last,
+            epoch=epoch,
             cols=cols,
             proxy_id=proxy_id,
             debug_id=debug_id,

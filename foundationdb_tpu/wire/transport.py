@@ -168,6 +168,45 @@ class RpcServer:
     async def start(self) -> None:
         ssl_ctx = self.tls.server_context() if self.tls else None
         if isinstance(self.address, str):
+            # A kill -9'd role leaves its bound socket file behind, and
+            # bind() on an existing path fails with EADDRINUSE — a
+            # re-spawned role on the same path would crash-loop (or a
+            # client could connect to the corpse). Unlink a CORPSE
+            # before bind — but only a corpse: probe-connect first, and
+            # if somebody accepts (or even hangs — a stalled server
+            # still owns its identity), fail loudly instead of silently
+            # hijacking a live role's socket.
+            import os as _os
+
+            if _os.path.exists(self.address):
+                probe_w = None
+                try:
+                    _pr, probe_w = await asyncio.wait_for(
+                        asyncio.open_unix_connection(path=self.address),
+                        timeout=0.5,
+                    )
+                except asyncio.TimeoutError:
+                    # MUST precede the OSError clause: on 3.11+
+                    # TimeoutError IS an OSError subclass and would
+                    # unlink a hung-but-live server's socket. A probe
+                    # that hangs means somebody owns the identity —
+                    # refuse, don't steal.
+                    raise TransportError(
+                        f"{self.address} probe timed out (owner alive "
+                        "but not accepting); refusing to steal the "
+                        "socket"
+                    )
+                except (ConnectionError, FileNotFoundError, OSError):
+                    try:
+                        _os.unlink(self.address)
+                    except FileNotFoundError:
+                        pass
+                else:
+                    probe_w.close()
+                    raise TransportError(
+                        f"{self.address} is already served by a live "
+                        "process; refusing to steal the socket"
+                    )
             self._server = await asyncio.start_unix_server(
                 self._serve_conn, path=self.address, ssl=ssl_ctx
             )

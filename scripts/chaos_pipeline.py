@@ -1,0 +1,493 @@
+#!/usr/bin/env python
+"""Kill -9 chaos drill for the wire-cluster lifecycle subsystem.
+
+A controller + worker cluster (cluster/multiprocess.py: WorkerRole,
+ClusterControllerRole) is supervised by the Monitor (the dumb process
+babysitter); a YCSB-flavored workload runs through the ClusterClient
+front door while a random (or chosen) role's WORKER PROCESS is killed
+with SIGKILL mid-run. The gate: the controller detects the death,
+recovers the transaction system into a new generation (the
+cluster/generation.py walk: lock the durable tlog, recruit EMPTY
+resolvers, conservative whole-keyspace blind write), the monitor
+restarts the corpse, the workload keeps flowing, and the post-run
+exact-count consistency check passes — with the recovery epoch
+timeline reconstructable from the controller's trace file.
+
+Modes:
+  python scripts/chaos_pipeline.py --smoke          # check.sh lane:
+      tiny cluster, kill one resolver mid-run, gate recovery +
+      consistency, land the recovery ledger row (perfcheck-gated)
+  python scripts/chaos_pipeline.py --kill tlog      # one scenario
+  python scripts/chaos_pipeline.py --drill          # the acceptance
+      drill: proxy, resolver, tlog, ratekeeper each killed mid-load
+      on a fresh cluster, SLO gated (admitted-txn p99 <= 0.5s,
+      post-kill goodput >= 70% of the pre-kill peak)
+  python scripts/chaos_pipeline.py --kill controller  # the controller
+      itself: monitor restarts it; persisted epoch guarantees it
+      recovers into a strictly newer generation
+
+Consistency under chaos: every client write targets a UNIQUE key, so a
+commit whose fate is unknown (connection lost mid-flight — the
+commit_unknown_result contract) is resolved by readback: key present
+== committed. Every DEFINITE commit's key must be present; the
+exact-count check needs no versionstamp machinery because keys never
+collide.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+KILLABLE = ("proxy", "resolver", "tlog", "ratekeeper", "controller")
+
+
+def _pctl(samples, q):
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(len(s) * q))]
+
+
+def _write_confs(d: str, args) -> tuple[str, str]:
+    """The declarative cluster conf + the monitor conf: controller +
+    enough workers to host the topology plus one spare (the killed
+    worker's replacement until the monitor restarts the corpse)."""
+    cluster_conf = {
+        "resolvers": args.resolvers,
+        "backend": "native",
+        "tlog_data_dir": os.path.join(d, "tlog-data"),
+        "storage_data_dir": os.path.join(d, "storage-data"),
+        "ratekeeper": True,
+        "trace": False,
+    }
+    cpath = os.path.join(d, "cluster.json")
+    with open(cpath, "w") as f:
+        json.dump(cluster_conf, f)
+    n_roles = args.resolvers + 4  # tlog, storage, ratekeeper, proxy
+    n_workers = n_roles + 1
+    ctrl_addr = os.path.join(d, "controller0.sock")
+    lines = [
+        "[role.controller]",
+        "kind = controller",
+        f"socket_dir = {d}",
+        f"cluster_conf = {cpath}",
+        f"state_file = {os.path.join(d, 'epoch.json')}",
+    ]
+    for i in range(n_workers):
+        lines += [
+            f"[role.worker{i}]",
+            "kind = worker",
+            f"socket_dir = {d}",
+            f"index = {i}",
+            f"controller = {ctrl_addr}",
+        ]
+    mpath = os.path.join(d, "monitor.conf")
+    with open(mpath, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return mpath, ctrl_addr
+
+
+class _MonitorThread:
+    """Monitor as the dumb babysitter, driven from a thread (the CLI
+    run_forever installs signal handlers, which only work on the main
+    thread — the supervision loop itself is just start_all + poll)."""
+
+    def __init__(self, conf_path: str):
+        from foundationdb_tpu.cluster.monitor import Monitor
+
+        self.monitor = Monitor(conf_path, log=lambda *_: None)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        self.monitor.start_all()
+        while not self._stop.is_set():
+            self.monitor.poll_once()
+            time.sleep(0.1)
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=10)
+        self.monitor.stop_all()
+
+    def controller_pid(self):
+        child = self.monitor.children.get("controller")
+        return child.proc.proc.pid if child else None
+
+
+async def _controller_status(mp, ctrl_addr: str) -> dict:
+    conn = mp.transport.RpcConnection(ctrl_addr)
+    await conn.connect(retries=2, delay=0.05)
+    try:
+        reply = await conn.call(
+            mp.TOKEN_STATUS, mp.StatusRequest(pad=0), timeout=5.0
+        )
+        return json.loads(reply.payload)
+    finally:
+        await conn.close()
+
+
+async def _run_scenario(kill_kind: str, args) -> dict:
+    from foundationdb_tpu.cluster import generation as gen
+    from foundationdb_tpu.cluster import multiprocess as mp
+    from foundationdb_tpu.models.types import CommitTransaction
+    from foundationdb_tpu.wire.codec import Mutation
+
+    d = tempfile.mkdtemp(prefix=f"chaos_{kill_kind}_")
+    mon_conf, ctrl_addr = _write_confs(d, args)
+    # the controller's trace file: MasterRecoveryState events land here
+    # — the recovery epoch timeline's durable form. The monitor spawns
+    # the controller, so the trace path rides an env var the child
+    # reads at startup (same mechanism as RESOLVER_KERNEL).
+    trace_path = os.path.join(d, "controller-trace.jsonl")
+    os.environ["FDBTPU_CONTROLLER_TRACE"] = trace_path
+    mon = _MonitorThread(mon_conf)
+    mon.start()
+    stats = {
+        "committed": 0, "unknown": 0, "conflicted": 0,
+        "grv_throttled": 0, "recovering_waits": 0,
+    }
+    lat: list[float] = []
+    commit_times: list[float] = []  # (monotonic stamp per commit)
+    definite: list[bytes] = []
+    unknown: list[bytes] = []
+    kill_at = args.duration * 0.4
+    killed = {"pid": None, "at": None, "kind": kill_kind}
+    try:
+        client = mp.ClusterClient(
+            ctrl_addr, recovery_timeout=args.recovery_bound
+        )
+        await client.connect()
+        topo = await client.topology()
+        epoch0 = client.epoch
+        t_start = time.monotonic()
+        stop = t_start + args.duration
+
+        async def one_client(cid: int):
+            seq = 0
+            while time.monotonic() < stop:
+                seq += 1
+                key = b"chaos-%d-%d" % (cid, seq)
+                t0 = time.monotonic()
+                try:
+                    rv = await client.get_read_version()
+                    txn = CommitTransaction(
+                        write_conflict_ranges=[(key, key + b"\x00")],
+                        read_conflict_ranges=[(key, key + b"\x00")],
+                        read_snapshot=rv,
+                        mutations=[Mutation(0, key, b"x")],
+                    )
+                    await client.commit(txn)
+                    now = time.monotonic()
+                    stats["committed"] += 1
+                    definite.append(key)
+                    lat.append(now - t0)
+                    commit_times.append(now)
+                except mp.GrvThrottledError:
+                    stats["grv_throttled"] += 1
+                    await asyncio.sleep(0.01)
+                except mp.NotCommittedError:
+                    # unique keys never truly conflict — this is the
+                    # conservative recovery abort hitting an in-flight
+                    # pre-recovery snapshot, exactly as designed
+                    stats["conflicted"] += 1
+                except mp.CommitUnknownError:
+                    stats["unknown"] += 1
+                    unknown.append(key)
+                except mp.ClusterRecoveringError:
+                    stats["recovering_waits"] += 1
+                    await asyncio.sleep(0.1)
+
+        async def killer():
+            await asyncio.sleep(kill_at)
+            if kill_kind == "controller":
+                pid = mon.controller_pid()
+            else:
+                t = await client.topology()
+                entry = next(
+                    (e for e in t["roles"].values()
+                     if e["kind"] == kill_kind), None
+                )
+                pid = entry and entry.get("pid")
+            if pid:
+                os.kill(pid, signal.SIGKILL)
+                killed["pid"] = pid
+                killed["at"] = time.monotonic() - t_start
+                print(f"[chaos] SIGKILL {kill_kind} pid={pid} at "
+                      f"t+{killed['at']:.1f}s", flush=True)
+            # watch for the recovery LIVE so time-to-recover includes
+            # death detection, not just the controller's recovery walk.
+            # A killed ratekeeper is a singleton re-recruit (the
+            # reference recruits a new one with NO generation bump) —
+            # its recovery condition is a replacement in the topology;
+            # every transaction-path/controller kill must produce a
+            # strictly newer fully-recovered generation.
+            t_kill = time.monotonic()
+            while time.monotonic() - t_kill < args.recovery_bound:
+                try:
+                    t = await client.topology()
+                    if kill_kind == "ratekeeper":
+                        entry = next(
+                            (e for e in t["roles"].values()
+                             if e["kind"] == "ratekeeper"), None
+                        )
+                        ok = (entry and entry.get("pid")
+                              and entry["pid"] != pid
+                              and t["state"] == gen.FULLY_RECOVERED)
+                    else:
+                        ok = (t["epoch"] > epoch0
+                              and t["state"] == gen.FULLY_RECOVERED)
+                    if ok:
+                        killed["recovered_after_s"] = round(
+                            time.monotonic() - t_kill, 3
+                        )
+                        return
+                except Exception:
+                    pass
+                await asyncio.sleep(0.1)
+
+        await asyncio.gather(
+            killer(), *(one_client(c) for c in range(args.clients))
+        )
+        wall = time.monotonic() - t_start
+
+        if killed.get("recovered_after_s") is None:
+            raise RuntimeError(
+                f"no recovery observed within {args.recovery_bound}s "
+                f"after killing {kill_kind}"
+            )
+        status = await _controller_status(mp, ctrl_addr)
+        q = status["qos"]
+
+        # --- post-recovery liveness + exact-count consistency --------
+        await client.connect()  # re-resolve the recovered generation
+        rv = await client.get_read_version()
+        missing = 0
+        for key in definite:
+            if await client.read(key, rv) != b"x":
+                missing += 1
+        resolved_committed = 0
+        for key in unknown:
+            if await client.read(key, rv) == b"x":
+                resolved_committed += 1
+        consistency_ok = missing == 0
+        await client.close()
+
+        # --- the recovery timeline, reconstructed from the trace -----
+        timeline = []
+        if os.path.exists(trace_path):
+            from foundationdb_tpu.utils import commit_debug as cd
+
+            timeline = gen.recovery_timeline_from_trace(
+                cd.load_jsonl([trace_path])
+            )
+        if kill_kind == "ratekeeper":
+            # singleton re-recruit: no epoch bump — the timeline must
+            # still hold the (initial) recruitment walk
+            post_kill = timeline
+        else:
+            post_kill = [e for e in timeline if e["epoch"] > epoch0]
+        timeline_ok = any(
+            e["status"] == gen.FULLY_RECOVERED for e in post_kill
+        )
+
+        # --- SLO math --------------------------------------------------
+        k_at = t_start + (killed["at"] or kill_at)
+        pre = [t for t in commit_times if t_start + 1.0 <= t < k_at]
+        post = [t for t in commit_times if t >= k_at]
+        pre_window = max(1e-6, k_at - (t_start + 1.0))
+        post_window = max(1e-6, (t_start + wall) - k_at)
+        peak = len(pre) / pre_window
+        post_rate = len(post) / post_window
+        killed["cleanup_ok"] = True
+        return {
+            "kill": kill_kind,
+            "killed_pid": killed["pid"],
+            "epoch_before": epoch0,
+            "epoch_after": q["epoch"],
+            "recovery_state": q["recovery_state"],
+            "controller_recoveries": q["recoveries_completed"],
+            # kill -> recovered generation observed, detection included
+            "recovery_time_s": killed.get("recovered_after_s"),
+            # the controller's own recovery-walk seconds (lock ->
+            # fully_recovered), for comparison
+            "recovery_walk_s": q["last_recovery_s"],
+            "recovery_reason": q["last_recovery_reason"],
+            "recovered": int(
+                killed.get("recovered_after_s") is not None
+                and q["recovery_state"] == gen.FULLY_RECOVERED
+            ),
+            "consistency_ok": int(consistency_ok),
+            "missing_keys": missing,
+            "unknown_resolved_committed": resolved_committed,
+            "timeline_ok": int(timeline_ok),
+            "timeline": post_kill[-12:],
+            "wall_s": round(wall, 2),
+            "commit_p50_ms": round(_pctl(lat, 0.50) * 1e3, 1),
+            "commit_p99_ms": round(_pctl(lat, 0.99) * 1e3, 1),
+            "peak_txn_s": round(peak, 1),
+            "post_kill_txn_s": round(post_rate, 1),
+            "goodput_ratio": round(post_rate / peak, 3) if peak else 0.0,
+            **stats,
+        }
+    finally:
+        mon.stop()
+        os.environ.pop("FDBTPU_CONTROLLER_TRACE", None)
+        # keep the scenario dir only when debugging (or on failure —
+        # an exception skips this via the flag below never being set)
+        if killed.get("cleanup_ok") and not os.environ.get("CHAOS_KEEP"):
+            import shutil
+
+            shutil.rmtree(d, ignore_errors=True)
+
+
+def _emit_ledger(args, results: list[dict]) -> None:
+    """One perf-ledger row for the run: scenario recoveries + the
+    consistency bit are STRUCTURAL (deterministic on any host — the
+    drill either recovered every scenario or it didn't); time-to-
+    recover and the SLO numbers are hardware-tier wall clock."""
+    from foundationdb_tpu.utils import perf
+
+    n = len(results)
+    rec = perf.emit(
+        "chaos_pipeline",
+        {
+            "recoveries_completed": perf.metric(
+                sum(r["recovered"] for r in results), "count",
+                direction="higher", tier="structural",
+            ),
+            "consistency_ok": perf.metric(
+                int(all(r["consistency_ok"] for r in results)), "bool",
+                direction="higher", tier="structural",
+            ),
+            "timeline_ok": perf.metric(
+                int(all(r["timeline_ok"] for r in results)), "bool",
+                direction="higher", tier="structural",
+            ),
+            "recovery_time_s": perf.metric(
+                round(max(r["recovery_time_s"] or 0.0 for r in results), 3),
+                "s", direction="lower", tier="hardware",
+            ),
+            "commit_p99_ms": perf.metric(
+                round(max(r["commit_p99_ms"] for r in results), 1),
+                "ms", direction="lower", tier="hardware",
+            ),
+            "goodput_ratio": perf.metric(
+                round(min(r["goodput_ratio"] for r in results), 3),
+                "ratio", direction="higher", tier="hardware",
+            ),
+        },
+        workload={
+            "scenarios": [r["kill"] for r in results],
+            "clients": args.clients,
+            "duration_s": args.duration,
+            "resolvers": args.resolvers,
+        },
+        knobs={"mode": "drill" if n > 1 else "single"},
+        ledger=args.perf_ledger,
+    )
+    print(f"[perf] chaos ledger row appended "
+          f"({rec['metrics']['recoveries_completed']['value']}/{n} "
+          "scenarios recovered)", flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--kill", choices=KILLABLE, default="resolver",
+                    help="which role's worker process gets SIGKILL")
+    ap.add_argument("--smoke", action="store_true",
+                    help="check.sh lane: tiny cluster, kill one "
+                         "resolver, gate recovery + consistency + the "
+                         "ledger row")
+    ap.add_argument("--drill", action="store_true",
+                    help="the acceptance drill: each transaction-path "
+                         "role killed mid-load on a fresh cluster, SLO "
+                         "gated")
+    ap.add_argument("--clients", type=int, default=24)
+    ap.add_argument("--duration", type=float, default=12.0)
+    ap.add_argument("--resolvers", type=int, default=1)
+    ap.add_argument("--recovery-bound", type=float, default=30.0,
+                    help="max seconds from kill to fully_recovered")
+    ap.add_argument("--slo-p99-s", type=float, default=0.5)
+    ap.add_argument("--slo-goodput", type=float, default=0.70)
+    ap.add_argument("--json-out", default=None)
+    ap.add_argument("--perf-ledger", default=None,
+                    help="append the run's ledger row here (default: "
+                         "perf/history.jsonl)")
+    ap.add_argument("--no-perf", action="store_true")
+    args = ap.parse_args()
+
+    if args.smoke:
+        scenarios = ["resolver"]
+        args.clients = min(args.clients, 12)
+        args.duration = min(args.duration, 8.0)
+    elif args.drill:
+        scenarios = ["proxy", "resolver", "tlog", "ratekeeper"]
+    else:
+        scenarios = [args.kill]
+
+    results = []
+    failures = []
+    for kind in scenarios:
+        print(f"== chaos scenario: kill -9 {kind} ==", flush=True)
+        res = asyncio.run(_run_scenario(kind, args))
+        results.append(res)
+        print(json.dumps(
+            {k: v for k, v in res.items() if k != "timeline"}
+        ), flush=True)
+        for row in res["timeline"]:
+            print(f"    epoch {row['epoch']:>3}  {row['status']}",
+                  flush=True)
+        if not res["recovered"]:
+            failures.append(f"{kind}: no recovered generation")
+        if not res["consistency_ok"]:
+            failures.append(
+                f"{kind}: {res['missing_keys']} committed key(s) missing"
+            )
+        if not res["timeline_ok"]:
+            failures.append(f"{kind}: recovery timeline not in trace")
+        if res["committed"] == 0:
+            failures.append(f"{kind}: nothing committed")
+        if (res["recovery_time_s"] or args.recovery_bound) \
+                > args.recovery_bound:
+            failures.append(
+                f"{kind}: recovery took {res['recovery_time_s']}s"
+            )
+        if args.drill:
+            if res["commit_p99_ms"] > args.slo_p99_s * 1e3:
+                failures.append(
+                    f"{kind}: p99 {res['commit_p99_ms']}ms > SLO"
+                )
+            if res["goodput_ratio"] < args.slo_goodput:
+                failures.append(
+                    f"{kind}: goodput ratio {res['goodput_ratio']} < "
+                    f"{args.slo_goodput}"
+                )
+
+    if args.json_out:
+        with open(args.json_out, "a") as f:
+            for r in results:
+                f.write(json.dumps(r) + "\n")
+    if not args.no_perf:
+        _emit_ledger(args, results)
+    if failures:
+        print(f"chaos_pipeline FAILED: {failures}", flush=True)
+        return 1
+    print(f"chaos_pipeline ok ({len(results)} scenario(s): "
+          f"{', '.join(r['kill'] for r in results)})", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,6 +75,18 @@ rm -f "$pipe_row"
 t1=$(date +%s.%N)
 awk -v a="$t0" -v b="$t1" 'BEGIN {printf "bench_pipeline smoke wall time: %.1fs\n", b - a}'
 
+echo "== chaos smoke (wire-cluster lifecycle: controller + workers under =="
+echo "== the monitor, kill -9 one resolver mid-run — gate on a recovered =="
+echo "== generation, exact-count consistency, the trace-reconstructable  =="
+echo "== recovery timeline, and the structural recovery ledger row)      =="
+t0=$(date +%s.%N)
+chaos_row=$(mktemp /tmp/chaoscheck_row.XXXXXX.jsonl)
+JAX_PLATFORMS=cpu python scripts/chaos_pipeline.py --smoke --perf-ledger "$chaos_row"
+JAX_PLATFORMS=cpu python scripts/perfcheck.py --check "$chaos_row" --tier structural
+rm -f "$chaos_row"
+t1=$(date +%s.%N)
+awk -v a="$t0" -v b="$t1" 'BEGIN {printf "chaos smoke wall time: %.1fs\n", b - a}'
+
 echo "== saturation smoke (short overload ramp via the saturation spec: =="
 echo "== admission ON must hold the p99/goodput SLO, OFF must violate)  =="
 t0=$(date +%s.%N)

@@ -100,6 +100,11 @@ def main() -> int:
                          "across ALL reconstructed txns + a power-of-two "
                          "latency histogram per stage (one-command "
                          "before/after comparisons)")
+    ap.add_argument("--recovery", action="store_true",
+                    help="print only the recovery epoch timeline "
+                         "(MasterRecoveryState events — sim and wire "
+                         "controllers emit the same shape via "
+                         "cluster/generation.py)")
     args = ap.parse_args()
 
     if args.smoke:
@@ -110,6 +115,20 @@ def main() -> int:
     from foundationdb_tpu.utils import commit_debug as cd
 
     records = cd.load_jsonl(args.files)
+    if args.recovery:
+        from foundationdb_tpu.cluster.generation import (
+            recovery_timeline_from_trace,
+        )
+
+        rows = recovery_timeline_from_trace(records)
+        if args.json:
+            print(json.dumps(rows))
+        else:
+            print(f"{len(rows)} recovery transition(s)")
+            for r in rows:
+                print(f"  t={r['time']:.3f}  epoch {r['epoch']:>3}  "
+                      f"{r['status']}")
+        return 0 if rows else 1
     index = cd.TraceIndex(records)
     timelines = index.timelines()
     violations = cd.check_chains(index)

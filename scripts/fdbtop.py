@@ -59,6 +59,9 @@ HEADLINE = {
     # the admission budget as a live sparkline: watching the limit dip
     # and recover IS watching the control loop work
     "ratekeeper": ("transactions_per_second_limit", "tps lim"),
+    # the generation counter: a recovery is a visible +1 step
+    "cluster_controller": ("epoch", "epoch"),
+    "worker": ("initializations", "inits"),
 }
 
 #: sensors every role's qos block must carry (the --smoke/--require
@@ -82,6 +85,11 @@ REQUIRED_SENSORS = {
     "grv_proxy": ("queued_requests", "sheds", "budget_stale"),
     "ratekeeper": ("transactions_per_second_limit", "budget_limited_by",
                    "budget_stale"),
+    # wire-cluster lifecycle: the controller's generation + recovery
+    # surface (the chaos drill reads the same fields)
+    "cluster_controller": ("epoch", "recovery_state",
+                           "recoveries_completed", "workers_live",
+                           "recovery_timeline"),
 }
 
 
@@ -264,6 +272,18 @@ def _row_metrics(role: str, block: dict) -> list[tuple[str, object]]:
             ("by", limited.get("name", "?")),
             ("stale", int(bool(q.get("budget_stale")))),
             ("polls", q.get("peer_polls", q.get("control_loops", 0))),
+        ]
+    if role == "cluster_controller":
+        return [
+            ("state", q.get("recovery_state", "?")),
+            ("recoveries", q.get("recoveries_completed", 0)),
+            ("last s", q.get("last_recovery_s") or 0.0),
+            ("workers", f"{q.get('workers_live', 0)}/"
+                        f"{q.get('workers_registered', 0)}"),
+        ]
+    if role == "worker":
+        return [
+            ("hosted", ",".join(q.get("hosted", [])) or "idle"),
         ]
     return [("version", block.get("version", 0))]
 

@@ -352,9 +352,12 @@ def test_wire_status_and_fdbtop_poll(tmp_path):
         assert roles == {"resolver", "log", "storage",
                          "commit_proxy", "grv_proxy"}
         assert "performance_limited_by" in doc["cluster"]["qos"]
-        # the tlog accumulated real queue bytes from the workload
-        tq = doc["cluster"]["processes"]["tlog0"]["qos"]
-        assert tq["queue_bytes"] > 0
+        # the tlog saw the workload's pushes (the RETAINED queue may
+        # legitimately be empty here: the applier pops the log as
+        # storage acks durability — PR 13's tail-sized restart rule)
+        tblock = doc["cluster"]["processes"]["tlog0"]
+        assert tblock["version"] > 0
+        assert tblock["qos"]["queue_bytes"] >= 0
         # 3) fdbtop's own polling path over the socket dir (the
         #    --once --json engine), proxy0.sock GRV split included
         conns = {}

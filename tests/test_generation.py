@@ -1,0 +1,105 @@
+"""The shared generation/epoch state machine (cluster/generation.py):
+the one module both the sim ClusterController and the wire
+ClusterControllerRole drive, so sim and wire recovery cannot drift."""
+
+import pytest
+
+from foundationdb_tpu.cluster import generation as gen
+
+
+def test_recovery_version_rule():
+    assert gen.recovery_version_for(0) == gen.RECOVERY_VERSION_GAP
+    assert gen.recovery_version_for(5, 9, 2) == 9 + gen.RECOVERY_VERSION_GAP
+    # -1 (an empty tlog's version) never drags the version negative
+    assert gen.recovery_version_for(-1) == gen.RECOVERY_VERSION_GAP
+
+
+def test_conservative_recovery_transaction_shape():
+    txn = gen.conservative_recovery_transaction(1_000_000)
+    # the whole-keyspace blind write: no reads (always commits), one
+    # write range covering everything, snapshot at the recovery version
+    assert txn.read_conflict_ranges == []
+    assert txn.write_conflict_ranges == [gen.CONSERVATIVE_ABORT_RANGE]
+    assert txn.read_snapshot == 1_000_000
+    assert gen.CONSERVATIVE_ABORT_RANGE == (b"", b"\xff\xff")
+    txn.validate()
+
+
+def test_stale_epoch_marker_roundtrip():
+    msg = gen.stale_epoch_message(3, 7)
+    assert gen.is_stale_epoch(msg)
+    assert gen.is_stale_epoch(RuntimeError(msg))
+    assert not gen.is_stale_epoch("connection lost")
+
+
+def test_generation_state_walk_and_timeline():
+    clock = iter(range(100))
+    g = gen.GenerationState(epoch=1, clock=lambda: float(next(clock)))
+    assert g.status == gen.FULLY_RECOVERED
+    assert g.begin_recovery() == 2
+    for s in gen.RECOVERY_STATES[1:]:
+        g.transition(s)
+    assert g.status == gen.FULLY_RECOVERED
+    rows = g.timeline_dicts()
+    assert [r["status"] for r in rows] == list(gen.RECOVERY_STATES)
+    assert all(r["epoch"] == 2 for r in rows)
+    # floor: a restarted controller with a persisted epoch always bumps
+    # strictly past it
+    assert g.begin_recovery(floor=10) == 11
+    with pytest.raises(ValueError):
+        g.transition("not_a_state")
+
+
+def test_timeline_cap_bounds_memory():
+    g = gen.GenerationState(epoch=1, clock=lambda: 0.0, timeline_cap=4)
+    for _ in range(5):
+        g.begin_recovery()
+    assert len(g.timeline) == 4
+
+
+def test_recovery_timeline_from_trace_records():
+    records = [
+        {"Type": "MasterRecoveryState", "Time": 2.0, "Epoch": 2,
+         "StatusCode": gen.FULLY_RECOVERED},
+        {"Type": "SomethingElse", "Time": 1.5},
+        {"Type": "MasterRecoveryState", "Time": 1.0, "Epoch": 2,
+         "StatusCode": gen.READING_TRANSACTION_SYSTEM_STATE},
+    ]
+    rows = gen.recovery_timeline_from_trace(records)
+    assert [r["status"] for r in rows] == [
+        gen.READING_TRANSACTION_SYSTEM_STATE, gen.FULLY_RECOVERED
+    ]
+    assert rows[0]["time"] == 1.0 and rows[1]["epoch"] == 2
+
+
+def test_sim_controller_emits_shared_timeline():
+    """The sim ClusterController walks the SHARED state machine: after
+    a recovery, its GenerationState timeline holds the canonical walk
+    at the bumped epoch — the same rows the wire controller serves in
+    its status block."""
+    from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+
+    sched, cluster, db = open_cluster(
+        ClusterConfig(n_commit_proxies=2, n_resolvers=2, n_storage=2)
+    )
+    try:
+        async def body():
+            txn = db.create_transaction()
+            txn.set(b"k", b"v")
+            await txn.commit()
+            p = cluster.commit_proxies[0]
+            p.failed = RuntimeError("chaos")
+            p.stop()
+            await sched.delay(1.0)
+
+        sched.run_until(sched.spawn(body()).done)
+        cc = cluster.controller
+        assert cc.epoch == 2
+        walk = [
+            r["status"] for r in cc.gen.timeline_dicts()
+            if r["epoch"] == 2
+        ]
+        assert walk == list(gen.RECOVERY_STATES)
+        assert cc.gen.recovery_version > 0
+    finally:
+        cluster.stop()

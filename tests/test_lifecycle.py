@@ -1,0 +1,368 @@
+"""Wire-cluster lifecycle: cluster controller, worker recruitment, and
+generation-bumped recovery (ISSUE 13).
+
+The acceptance surface of the subsystem:
+* a ClusterControllerRole recruits a declarative topology onto
+  registered WorkerRole processes, a kill -9 of a transaction-path
+  worker triggers the cluster/generation.py recovery walk, the
+  workload resumes in a strictly newer generation, and a pre-recovery
+  snapshot aborts conservatively;
+* the wire conservative-abort first batch produces the SAME
+  commit/abort decisions as the sim recovery on an identical in-flight
+  transaction set (oracle comparison, both resolver backends);
+* the wire RatekeeperRole re-resolves its peer list from the
+  controller's live topology (the frozen-peer-list bugfix), so a
+  re-recruited resolver's occupancy feed rejoins the admission law.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import time
+
+import pytest
+
+from foundationdb_tpu.cluster import generation as gen
+from foundationdb_tpu.cluster import multiprocess as mp
+from foundationdb_tpu.models.types import CommitTransaction, TransactionResult
+from foundationdb_tpu.wire import transport
+from foundationdb_tpu.wire.codec import Mutation
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# Controller + worker recruitment and kill -9 recovery.
+
+
+def test_controller_recruits_and_recovers_from_kill(tmp_path):
+    d = str(tmp_path)
+    conf = {
+        "resolvers": 1,
+        "backend": "native",
+        "tlog_data_dir": os.path.join(d, "tlog-data"),
+        "storage_data_dir": os.path.join(d, "storage-data"),
+        "ratekeeper": False,  # keep the test cluster minimal + fast
+    }
+    conf_path = os.path.join(d, "cluster.json")
+    with open(conf_path, "w") as f:
+        json.dump(conf, f)
+    ctrl = mp.spawn_role("controller", d, cluster_conf=conf_path,
+                         state_file=os.path.join(d, "epoch.json"))
+    workers = [
+        mp.spawn_role("worker", d, index=i, controller=ctrl.address,
+                      worker_id=f"w{i}")
+        for i in range(5)
+    ]
+    try:
+        async def scenario():
+            client = mp.ClusterClient(ctrl.address, recovery_timeout=45)
+            await client.connect()
+            assert client.epoch >= 1
+            epoch0 = client.epoch
+
+            # pre-recovery commits
+            for i in range(3):
+                rv = await client.get_read_version()
+                v = await client.commit(CommitTransaction(
+                    write_conflict_ranges=[(b"k%d" % i, b"k%d\x00" % i)],
+                    read_snapshot=rv,
+                    mutations=[Mutation(0, b"k%d" % i, b"v%d" % i)],
+                ))
+            assert await client.read(b"k1", v) == b"v1"
+            stale_rv = await client.get_read_version()
+
+            # kill -9 the resolver's worker process
+            topo = await client.topology()
+            res = next(e for e in topo["roles"].values()
+                       if e["kind"] == "resolver")
+            os.kill(res["pid"], signal.SIGKILL)
+
+            # the controller recovers into a strictly newer generation
+            deadline = time.monotonic() + 45
+            while time.monotonic() < deadline:
+                try:
+                    topo = await client.topology()
+                    if (topo["epoch"] > epoch0
+                            and topo["state"] == gen.FULLY_RECOVERED):
+                        break
+                except Exception:
+                    pass
+                await asyncio.sleep(0.2)
+            else:
+                raise AssertionError(f"no recovery observed: {topo}")
+            assert topo["recovery_version"] > v
+
+            # post-recovery: commits flow (ride through unknowns — the
+            # client may still hold the fenced generation's connection)
+            for _ in range(10):
+                try:
+                    rv = await client.get_read_version()
+                    v2 = await client.commit(CommitTransaction(
+                        write_conflict_ranges=[(b"post", b"post\x00")],
+                        read_snapshot=rv,
+                        mutations=[Mutation(0, b"post", b"yes")],
+                    ))
+                    break
+                except mp.CommitUnknownError:
+                    await asyncio.sleep(0.1)
+            else:
+                raise AssertionError("no post-recovery commit landed")
+            # durable data survived the recovery
+            assert await client.read(b"k1", v2) == b"v1"
+            # conservative abort: pre-recovery snapshot with a read
+            # conflict range must NOT commit
+            with pytest.raises(mp.NotCommittedError):
+                await client.commit(CommitTransaction(
+                    read_conflict_ranges=[(b"k0", b"k0\x00")],
+                    write_conflict_ranges=[(b"k0", b"k0\x00")],
+                    read_snapshot=stale_rv,
+                    mutations=[Mutation(0, b"k0", b"stale")],
+                ))
+
+            # the recovery timeline is reconstructable from the
+            # controller's status (the trace-file twin is pinned by the
+            # chaos smoke lane)
+            conn = transport.RpcConnection(ctrl.address)
+            await conn.connect()
+            st = json.loads((await conn.call(
+                mp.TOKEN_STATUS, mp.StatusRequest(pad=0)
+            )).payload)
+            await conn.close()
+            q = st["qos"]
+            assert q["recovery_state"] == gen.FULLY_RECOVERED
+            assert q["recoveries_completed"] >= 2  # recruitment + kill
+            walk = [r["status"] for r in q["recovery_timeline"]
+                    if r["epoch"] == q["epoch"]]
+            assert walk[-len(gen.RECOVERY_STATES):] == list(
+                gen.RECOVERY_STATES
+            )
+            await client.close()
+
+        run(scenario())
+    finally:
+        for p in [ctrl, *workers]:
+            p.stop()
+
+
+# ---------------------------------------------------------------------------
+# Sim/wire recovery parity (satellite): identical in-flight set, same
+# commit/abort decisions.
+
+
+def _inflight_set(stale_rv: int, fresh_rv: int) -> list[CommitTransaction]:
+    """An in-flight mix around a recovery: stale readers (must abort),
+    stale blind writes (no reads — commit), fresh readers (commit)."""
+    mk = lambda rs, ws, snap: CommitTransaction(  # noqa: E731
+        read_conflict_ranges=rs, write_conflict_ranges=ws,
+        read_snapshot=snap,
+    )
+    kr = lambda k: [(k, k + b"\x00")]  # noqa: E731
+    return [
+        mk(kr(b"a"), kr(b"a"), stale_rv),      # stale RMW -> abort
+        mk([], kr(b"b"), stale_rv),            # stale blind write -> commit
+        mk(kr(b"c"), [], stale_rv),            # stale read-only -> abort
+        mk(kr(b"d"), kr(b"d"), fresh_rv),      # fresh RMW -> commit
+        mk([], kr(b"e"), fresh_rv),            # fresh blind write -> commit
+        mk(kr(b"\xfe"), kr(b"\xfe"), stale_rv),  # stale, high key -> abort
+    ]
+
+
+def _sim_recovery_decisions(txns_for):
+    """Run the ACTUAL sim recovery (cluster/recovery.py) and push the
+    in-flight set through the new generation's proxy."""
+    from foundationdb_tpu.cluster.commit_proxy import NotCommitted
+    from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
+
+    sched, cluster, db = open_cluster(
+        ClusterConfig(n_commit_proxies=1, n_resolvers=1, n_storage=1)
+    )
+    try:
+        out = {}
+
+        async def body():
+            txn = db.create_transaction()
+            txn.set(b"seed", b"s")
+            await txn.commit()
+            stale_rv = await db.create_transaction().get_read_version()
+            p = cluster.commit_proxies[0]
+            p.failed = RuntimeError("chaos")
+            p.stop()
+            await sched.delay(1.0)
+            assert cluster.controller.epoch == 2
+            fresh_rv = await db.create_transaction().get_read_version()
+            decisions = []
+            for t in txns_for(stale_rv, fresh_rv):
+                try:
+                    await cluster.commit_proxies[0].commit(t).future
+                    decisions.append("commit")
+                except NotCommitted:
+                    decisions.append("abort")
+            out["decisions"] = decisions
+            out["rv"] = cluster.controller.gen.recovery_version
+
+        sched.run_until(sched.spawn(body()).done)
+        return out["decisions"], out["rv"]
+    finally:
+        cluster.stop()
+
+
+@pytest.mark.parametrize("backend", ["native", "cpu"])
+def test_sim_wire_recovery_parity(backend):
+    """The wire conservative-abort first batch (generation.
+    conservative_recovery_transaction through a real ResolverRole, the
+    class the wire serves) decides an identical in-flight set exactly
+    like the sim recovery — for the native skip list AND the kernel
+    backend."""
+    sim_decisions, _sim_rv = _sim_recovery_decisions(_inflight_set)
+
+    # wire side: a freshly recruited resolver (EMPTY state, the
+    # recovery contract) + the conservative first batch, then the same
+    # in-flight set in one batch
+    os.environ["RESOLVER_KERNEL"] = (
+        "KernelConfig(max_key_bytes=16, max_txns=64, max_reads=256, "
+        "max_writes=256, history_capacity=65536, "
+        "window_versions=5000000)"
+    )
+    try:
+        role = mp.ResolverRole(backend=backend, epoch=2)
+    finally:
+        os.environ.pop("RESOLVER_KERNEL", None)
+    from foundationdb_tpu.models.types import ResolveTransactionBatchRequest
+
+    recovery_version = 2_000_000
+    stale_rv, fresh_rv = 1_000, recovery_version + 1_000
+
+    async def wire():
+        # boot (the controller's empty batch at the recovery version)
+        await role.resolve(ResolveTransactionBatchRequest(
+            prev_version=-1, version=recovery_version,
+            last_received_version=-1, epoch=2,
+        ))
+        # the recovery transaction: conservative whole-keyspace write
+        rep = await role.resolve(ResolveTransactionBatchRequest(
+            prev_version=recovery_version,
+            version=recovery_version + 1_000,
+            last_received_version=recovery_version, epoch=2,
+            transactions=[
+                gen.conservative_recovery_transaction(recovery_version)
+            ],
+        ))
+        assert rep.committed[0] == TransactionResult.COMMITTED
+        # the identical in-flight set, one batch
+        rep = await role.resolve(ResolveTransactionBatchRequest(
+            prev_version=recovery_version + 1_000,
+            version=recovery_version + 2_000,
+            last_received_version=recovery_version + 1_000, epoch=2,
+            transactions=_inflight_set(stale_rv, fresh_rv),
+        ))
+        return [
+            "commit" if v == TransactionResult.COMMITTED else "abort"
+            for v in rep.committed
+        ]
+
+    wire_decisions = run(wire())
+    assert wire_decisions == sim_decisions, (
+        f"sim {sim_decisions} != wire[{backend}] {wire_decisions}"
+    )
+    # and the expected shape, so a bug in BOTH paths can't hide
+    assert sim_decisions == [
+        "abort", "commit", "abort", "commit", "commit", "abort"
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Ratekeeper peer re-resolution (satellite): peers follow the
+# controller's live topology; a re-recruited resolver's occupancy feed
+# rejoins the admission law.
+
+
+def test_ratekeeper_peers_follow_topology(tmp_path):
+    """A RatekeeperRole with a controller re-resolves peers every
+    control cycle: after the topology swaps the resolver address, the
+    budget recovers from the saturated old resolver's clamp because the
+    NEW resolver's (idle) occupancy feed replaces it — the pin for
+    'budget recovers after a resolver is re-recruited'."""
+
+    async def scenario():
+        busy = {"occupancy": 1.5}
+
+        async def topo_payload(state):
+            return mp.TopologyReply(payload=json.dumps(state))
+
+        # fake resolver servers: one saturated, one idle
+        async def resolver_status(occ):
+            return mp.StatusReply(payload=json.dumps({
+                "role": "resolver",
+                "qos": {"occupancy": occ, "queue_depth": 0},
+            }))
+
+        sock_a = str(tmp_path / "resA.sock")
+        sock_b = str(tmp_path / "resB.sock")
+        ctrl_sock = str(tmp_path / "ctrl.sock")
+        srv_a = transport.RpcServer(sock_a)
+        srv_a.register(
+            mp.TOKEN_STATUS, lambda _r: resolver_status(busy["occupancy"])
+        )
+        srv_b = transport.RpcServer(sock_b)
+        srv_b.register(mp.TOKEN_STATUS, lambda _r: resolver_status(0.0))
+        topo_state = {
+            "epoch": 1,
+            "roles": {"resolver0": {"kind": "resolver", "address": sock_a}},
+        }
+        ctrl = transport.RpcServer(ctrl_sock)
+        ctrl.register(mp.TOKEN_TOPOLOGY, lambda _r: topo_payload(topo_state))
+        for s in (srv_a, srv_b, ctrl):
+            await s.start()
+
+        rk = mp.RatekeeperRole([], interval=0.05, controller=ctrl_sock)
+        await rk.start()
+        try:
+            # cycle 1..n: peers resolve from topology -> the saturated
+            # resolver clamps the budget
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                info = rk.law.rate_info()
+                by = info.get("budget_limited_by") or {}
+                if rk.peers == [sock_a] and "resolver" in str(
+                    by.get("name", "")
+                ):
+                    break
+                await asyncio.sleep(0.05)
+            assert rk.peers == [sock_a]
+            clamped = rk.law.rate_info()["transactions_per_second_limit"]
+
+            # recovery: the topology swaps in a re-recruited resolver
+            topo_state["epoch"] = 2
+            topo_state["roles"] = {
+                "resolver0": {"kind": "resolver", "address": sock_b}
+            }
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if rk.peers == [sock_b] and rk.topology_epoch == 2:
+                    budget = rk.law.rate_info()[
+                        "transactions_per_second_limit"
+                    ]
+                    if budget > clamped * 1.5:
+                        break
+                await asyncio.sleep(0.05)
+            assert rk.peers == [sock_b], "peer list did not re-resolve"
+            assert rk.peer_refreshes >= 1
+            budget = rk.law.rate_info()["transactions_per_second_limit"]
+            assert budget > clamped * 1.5, (
+                f"budget did not recover: {clamped} -> {budget}"
+            )
+        finally:
+            await rk.stop()
+            assert not rk._conns and not rk._controller_conns
+            for s in (srv_a, srv_b, ctrl):
+                await s.close()
+
+    run(scenario())

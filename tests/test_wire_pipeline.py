@@ -584,9 +584,10 @@ def test_columnar_inconsistent_frames_rejected():
         cols=packing.pack_columnar(txns),
     )
     raw = bytearray(codec.encode(msg))
-    # payload layout: u16 type id, 3*i64 header, then n_txns/n_reads/
-    # n_writes as u32 at these offsets
-    off_ntxns, off_nreads, off_nwrites = 26, 30, 34
+    # payload layout: u16 type id, 4*i64 header (prev/version/last/
+    # epoch — epoch since protocol 0008), then n_txns/n_reads/n_writes
+    # as u32 at these offsets
+    off_ntxns, off_nreads, off_nwrites = 34, 38, 42
     for off, delta in [
         (off_ntxns, 1), (off_ntxns, -1),
         (off_nreads, 1), (off_nreads, -1),
@@ -607,7 +608,7 @@ def test_columnar_inconsistent_frames_rejected():
         # the only place the blob bytes appear; easier to just flip a
         # key_lens entry (first key_lens array byte after the flags)
         n = msg.cols.n_txns
-        off_lens = 38 + 8 * n + 4 * n + 4 * n + n  # first key_lens entry
+        off_lens = 46 + 8 * n + 4 * n + 4 * n + n  # first key_lens entry
         bad = bytearray(raw)
         v = struct.unpack_from("<I", bad, off_lens)[0]
         struct.pack_into("<I", bad, off_lens, v + 1)
@@ -648,8 +649,8 @@ def test_corrupt_columnar_frame_does_not_crash_role():
             # corrupt the n_reads header count and ship the raw payload
             payload = bytearray(codec.encode(msg))
             struct.pack_into(
-                "<I", payload, 30,
-                struct.unpack_from("<I", payload, 30)[0] + 3,
+                "<I", payload, 38,
+                struct.unpack_from("<I", payload, 38)[0] + 3,
             )
             reqid = conn._next_id
             conn._next_id += 1
