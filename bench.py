@@ -73,11 +73,22 @@ def main():
     version_step = 200_000
     window = 1_000_000  # floor rises after 5 batches -> steady-state GC
     snapshot_lag = 2 * version_step  # spans ~2 batches: history conflicts real
+    # BASELINE configs 1-3 plus the YCSB letter suite (ISSUE 14 —
+    # workload breadth: B/C/D are zipf point mixes at different write
+    # rates / recency, E is the range-scan-heavy profile the router
+    # used to exile to the CPU skiplist; with the sorted-endpoint sweep
+    # configured it stays on device and this bench re-measures that
+    # routing every run)
     gen_kw = {
         "uniform": {},
         "zipf": {"zipf": 1.1, "keyspace": 10_000_000},  # hot-key contention
         "range": {"range_len": 500},  # wide scans vs point-ish writes
+        "ycsb_b": {"zipf": 1.1, "keyspace": 10_000_000},
+        "ycsb_c": {"zipf": 1.1, "keyspace": 10_000_000},
+        "ycsb_d": {"keyspace": 10_000_000},
+        "ycsb_e": {"zipf": 1.1, "scan_max": 100},
     }[mode]
+    ycsb = mode.startswith("ycsb")
     # Fixpoint unroll depth per contention profile: measured convergence
     # depth (scripts/iters_model.py: uniform 3, zipf 6, range 12) plus
     # margin. fixpoint_latch drops the residual while_loop (~50ms/group
@@ -95,9 +106,17 @@ def main():
     # the latch with margin; a trip falls back to the exact kernel
     # (loud, never wrong — the warm pass checks before any timed pass,
     # and prewarm_exact makes the swap compile-free).
-    unroll = {"uniform": 3, "zipf": 8, "range": 14}[mode]
+    unroll = {"uniform": 3, "zipf": 8, "range": 14, "ycsb_b": 8,
+              "ycsb_c": 3, "ycsb_d": 8, "ycsb_e": 14}[mode]
     latch = mode != "uniform"
     kernel = os.environ.get("BENCH_KERNEL", "tiered")
+    # ycsb_e arms the ISSUE-14 device-native range path: the
+    # sorted-endpoint sweep probe + spill-and-compact pressure handling
+    # (both tiered-only; BENCH_SWEEP=0 ablates back to the probe path)
+    sweep = (
+        mode == "ycsb_e" and kernel == "tiered"
+        and os.environ.get("BENCH_SWEEP", "1") != "0"
+    )
 
     import jax
 
@@ -148,21 +167,52 @@ def main():
         fixpoint_latch=latch,
         delta_capacity=delta_cap if kernel == "tiered" else 0,
         compact_interval=compact_interval,
+        range_sweep=sweep,
+        delta_spill=sweep,
     )
     import dataclasses as _dc
 
+    from foundationdb_tpu.testing.benchgen import ycsb_batch
+
     rng = np.random.default_rng(0)
     batches = []
+    # ycsb_d read-latest insert frontier — from the MODE's keyspace
+    # (gen_kw overrides the module default for the zipf-family modes)
+    frontier = gen_kw.get("keyspace", keyspace) // 2
     for i in range(n_batches):
         version = (i + 1) * version_step
         kw = {"keyspace": keyspace, **gen_kw}
-        batches.append(
-            skiplist_style_batch(
+        if ycsb:
+            b = ycsb_batch(
+                rng, config, n_txns, mode, version=version, key_bytes=8,
+                snapshot_lag=snapshot_lag, insert_frontier=frontier, **kw,
+            )
+            frontier += b.n_writes
+        else:
+            b = skiplist_style_batch(
                 rng, config, n_txns, version=version,
                 key_bytes=8, snapshot_lag=snapshot_lag, **kw,
             )
-        )
+        batches.append(b)
     log(f"generated {n_batches} batches of {n_txns} txns")
+
+    # the router re-measure (ISSUE 14): the stream's classified profile
+    # and the backend the config-aware router would choose — ycsb_e must
+    # classify range_heavy and STAY on device when the sweep is
+    # configured (the no-fallback acceptance direction)
+    from foundationdb_tpu.models.conflict_set import (
+        backend_for_profile,
+        profile_batch,
+    )
+
+    stream_profile = profile_batch(batches[0])
+    routed_backend = backend_for_profile(stream_profile, config)
+    log(f"contention profile: {stream_profile} -> routed {routed_backend}")
+    if sweep:
+        assert stream_profile == "range_heavy", stream_profile
+        assert routed_backend == "tpu", (
+            "range_heavy must stay on device with the sweep configured"
+        )
 
     # Device-side read dedup (tiered only): size the distinct-range cap
     # from the ACTUAL stream — the max per-batch distinct (begin, end)
@@ -170,7 +220,9 @@ def main():
     # common (zipf); a uniform stream's distinct count ~= its point
     # count, so dedup would add sorts for nothing and stays off.
     dedup = 0
-    if kernel == "tiered":
+    if kernel == "tiered" and not sweep:
+        # (sweep-configured streams skip dedup: the endpoint sweep has
+        # no per-range searches to dedup and the knobs are exclusive)
         max_uniq = 0
         for b in batches:
             pairs = np.concatenate(
@@ -457,6 +509,35 @@ def main():
     )
     log(f"ablation ledger: {json.dumps(ledger)}")
 
+    # ---- structural decision + range-path accounting (ISSUE 14) ---------
+    # One more clean pass over the pre-staged groups, untimed: total
+    # commit/abort decisions plus the sweep/spill counters — all
+    # deterministic given the seeded stream, so the perfcheck lane gates
+    # them exactly on any host (a flipped verdict or a silently
+    # re-routed probe path fails CI before hardware ever re-measures).
+    cs_m = TpuConflictSet(config)
+    decisions = {"committed": 0, "conflicted": 0, "too_old": 0}
+    for dg in dev_groups:
+        o = cs_m.resolve_group_args(dg, check_latch=False)
+        decisions["committed"] += int(np.asarray(o.committed_count).sum())
+        decisions["conflicted"] += int(np.asarray(o.conflict_count).sum())
+        decisions["too_old"] += int(np.asarray(o.too_old_count).sum())
+    cs_m.check_overflow()
+    _c = cs_m.metrics.counters
+    structural = {
+        **decisions,
+        "spills": _c.get("spills"),
+        "sweep_groups": _c.get("sweepGroups"),
+        "compactions": _c.get("compactions"),
+    }
+    if getattr(config, "range_sweep", False):
+        from foundationdb_tpu.ops.delta import sweep_rows_per_group
+
+        structural["sweep_rows_per_group"] = sweep_rows_per_group(
+            config.history_capacity, fuse, config.max_reads
+        )
+    log(f"structural: {json.dumps(structural)}")
+
     # ---- phase 4: per-batch latency probe -------------------------------
     del dev_groups  # release phase-3 staging before re-staging
     dev_batches = [jax.device_put(b.device_args()) for b in batches]
@@ -589,7 +670,12 @@ def main():
         "kernel": kernel,
         "delta_capacity": config.delta_capacity,
         "dedup_reads": config.dedup_reads,
+        "range_sweep": config.range_sweep,
+        "delta_spill": config.delta_spill,
         "compact_interval": config.compact_interval,
+        "profile": stream_profile,
+        "routed_backend": routed_backend,
+        "structural": structural,
         "fused_dispatch": fuse,
         "batches": n_batches,
         "p50_ms": round(p50 * 1e3, 1),

@@ -122,8 +122,10 @@ class Monitor:
         self.log = log
         self.children: dict[str, _Child] = {}
         self.restarts: dict[str, int] = {}
+        self.death_notifies = 0
         self._stop = False
         self._want_reload = False
+        self._child_died = False  # SIGCHLD flag: poll now, don't wait
 
     # -- lifecycle -------------------------------------------------------
 
@@ -189,7 +191,50 @@ class Monitor:
                 continue
             self.log(f"[monitor] {name} died rc={rc}; restarting in "
                      f"{child.backoff:.1f}s")
+            # PUSH-ON-DEATH (ISSUE 14): tell the controller NOW — one
+            # supervision poll of detection latency instead of the
+            # controller waiting out HEARTBEAT_MISSES status polls
+            # (the PR-13 drill's detection-dominated ~1s)
+            self._notify_death(child.spec, rc)
             child.restart_at = now + child.backoff
+
+    def _notify_death(self, spec: RoleSpec, rc) -> None:
+        """Best-effort WorkerDeath push to the controller the dead
+        worker was registered with. Failure degrades to the heartbeat
+        backstop (a dead controller will learn from beacons once the
+        monitor restarts it); the call is bounded so a hung controller
+        cannot stall supervision of the other children."""
+        if not spec.controller or spec.kind == "controller":
+            return
+        import asyncio
+        import json
+
+        from foundationdb_tpu.cluster import multiprocess as mp
+
+        async def _send():
+            conn = mp.transport.RpcConnection(spec.controller)
+            await conn.connect(retries=1, delay=0.05)
+            try:
+                await conn.call(
+                    mp.TOKEN_WORKER_DEATH,
+                    mp.WorkerDeath(payload=json.dumps({
+                        "worker_id": spec.name,
+                        "kind": spec.kind,
+                        "address": spec.address,
+                        "rc": rc,
+                    })),
+                    timeout=2.0,
+                )
+            finally:
+                await conn.close()
+
+        try:
+            asyncio.run(asyncio.wait_for(_send(), 2.5))
+            self.death_notifies += 1
+            self.log(f"[monitor] pushed {spec.name} death to controller")
+        except Exception as e:
+            self.log(f"[monitor] death push failed (heartbeat backstop "
+                     f"will catch it): {e!r}")
 
     def reload(self) -> None:
         """Re-read the conf: launch new sections, stop removed ones, and
@@ -227,6 +272,14 @@ class Monitor:
         signal.signal(
             signal.SIGTERM, lambda *_: setattr(self, "_stop", True)
         )
+        # SIGCHLD: a dead child triggers an IMMEDIATE supervision pass
+        # (the push-on-death latency is then one signal delivery, not a
+        # poll interval). The handler only sets a flag — fdbmonitor's
+        # serialize-signals-into-the-loop discipline.
+        signal.signal(
+            signal.SIGCHLD,
+            lambda *_: setattr(self, "_child_died", True),
+        )
         try:
             while not self._stop:
                 if self._want_reload:
@@ -239,8 +292,16 @@ class Monitor:
                         # behavior on an unparseable reload)
                         self.log(f"[monitor] reload failed, keeping old "
                                  f"conf: {e}")
+                self._child_died = False
                 self.poll_once()
-                time.sleep(poll_interval)
+                # sliced sleep: SIGHUP/SIGTERM/SIGCHLD all cut it short
+                deadline = time.monotonic() + poll_interval
+                while (
+                    time.monotonic() < deadline
+                    and not (self._stop or self._want_reload
+                             or self._child_died)
+                ):
+                    time.sleep(0.02)
         finally:
             self.stop_all()  # never orphan children, even on a crash
 

@@ -394,6 +394,17 @@ TLogPop = _message(
 TLogPopReply = _message(
     0x0261, "TLogPopReply", [("durable_version", "i64")]
 )
+# monitor -> controller: PUSH-ON-DEATH (ISSUE 14): the supervising
+# monitor reaps a dead worker child (SIGCHLD) and tells the controller
+# IMMEDIATELY, so death detection costs one supervision poll instead of
+# HEARTBEAT_MISSES consecutive status polls — the PR-13 drill measured
+# time-to-recover detection-dominated (~1s of heartbeat misses).
+# Heartbeats remain the backstop for deaths the monitor cannot see
+# (wedged-but-alive processes, a dead monitor).
+WorkerDeath = _message(0x0262, "WorkerDeath", [("payload", "str")])
+WorkerDeathReply = _message(
+    0x0263, "WorkerDeathReply", [("payload", "str")]
+)
 
 TOKEN_TLOG_VERSION = 0x0203
 TOKEN_STORAGE_VERSION = 0x0304
@@ -406,6 +417,7 @@ TOKEN_TLOG_POP = 0x0206
 TOKEN_REGISTER_WORKER = 0x0601
 TOKEN_INIT_ROLE = 0x0602
 TOKEN_TOPOLOGY = 0x0603
+TOKEN_WORKER_DEATH = 0x0604
 # client front door (proxy worker)
 TOKEN_CLIENT_GRV = 0x0701
 TOKEN_CLIENT_COMMIT = 0x0702
@@ -2273,11 +2285,19 @@ class ClusterControllerRole:
         self.recoveries_completed = 0
         self.last_recovery_s: float | None = None
         self.last_recovery_reason: str | None = None
+        #: monitor push-on-death notifications received (ISSUE 14) —
+        #: the chaos smoke pins that the push path, not the heartbeat
+        #: backstop, is what detects a SIGKILL'd worker
+        self.death_notifications = 0
         self._needs_recovery = True  # initial recruitment IS a recovery
         self._recovery_reason = "initial_recruitment"
         self._miss_counts: dict[str, int] = {}
         self._conns: dict[str, transport.RpcConnection] = {}
         self._task: asyncio.Task | None = None
+        #: set by worker_death to cut the supervision loop's sleep short
+        #: — a pushed death starts the recovery walk on the next loop
+        #: iteration, not up to check_interval later
+        self._wake = asyncio.Event()
 
     # -- epoch persistence (the coordinated-state analog) ---------------
 
@@ -2320,6 +2340,47 @@ class ClusterControllerRole:
             {"ok": True, "epoch": self.gen.epoch}
         ))
 
+    async def worker_death(self, req: "WorkerDeath") -> "WorkerDeathReply":
+        """Monitor push-on-death (ISSUE 14): the monitor reaped this
+        worker's process, so every role it hosted is dead NOW — no need
+        to wait out HEARTBEAT_MISSES failed polls. Transaction-path
+        roles flag the recovery walk immediately (reason "push:<roles>"
+        — the chaos smoke pins the prefix); singletons get their miss
+        count pre-loaded so the next supervision pass re-recruits on
+        its FIRST failed poll. The wake event cuts the loop's sleep."""
+        import json as _json
+
+        from foundationdb_tpu.utils.trace import SEV_WARN_ALWAYS, TraceEvent
+
+        info = _json.loads(req.payload)
+        wid = info.get("worker_id")
+        self.death_notifications += 1
+        self.workers.pop(wid, None)
+        dead = sorted(
+            n for n, a in self.assignments.items()
+            if a["worker_id"] == wid
+        )
+        txn_dead = [
+            n for n in dead
+            if self.assignments[n]["kind"] in ("proxy", "resolver", "tlog")
+        ]
+        TraceEvent(
+            "WorkerDeathPushed", severity=SEV_WARN_ALWAYS
+        ).detail("Worker", wid).detail(
+            "Roles", ",".join(dead) or "none"
+        ).detail("Epoch", self.gen.epoch).log()
+        if txn_dead and not self._needs_recovery:
+            self._needs_recovery = True
+            self._recovery_reason = "push:" + ",".join(txn_dead)
+        for n in dead:
+            # singletons (and txn roles, harmlessly): one more failed
+            # poll — not three — declares them dead in the heartbeat
+            self._miss_counts[n] = self.HEARTBEAT_MISSES
+        self._wake.set()
+        return WorkerDeathReply(payload=_json.dumps(
+            {"ok": True, "roles": dead}
+        ))
+
     def topology_doc(self) -> dict:
         return {
             "epoch": self.gen.epoch,
@@ -2357,6 +2418,7 @@ class ClusterControllerRole:
                 "recoveries_completed": self.recoveries_completed,
                 "last_recovery_s": self.last_recovery_s,
                 "last_recovery_reason": self.last_recovery_reason,
+                "death_notifications": self.death_notifications,
                 "workers_registered": len(self.workers),
                 "workers_live": len(self._live_workers()),
                 "roles_recruited": len(self.assignments),
@@ -2696,10 +2758,14 @@ class ClusterControllerRole:
                         self.workers.pop(
                             self.assignments[name]["worker_id"], None
                         )
-                    if txn_dead:
+                    if txn_dead and not self._needs_recovery:
                         # the transaction system recovers AS A UNIT —
                         # never patched (the reference's key recovery
-                        # property)
+                        # property). Guarded like worker_death's flag:
+                        # a push that landed while this heartbeat pass
+                        # was in flight already set the reason, and the
+                        # in-flight results must not overwrite its
+                        # "push:" attribution (the chaos gate pins it)
                         self._needs_recovery = True
                         self._recovery_reason = ",".join(sorted(txn_dead))
                     else:
@@ -2711,7 +2777,16 @@ class ClusterControllerRole:
                 TraceEvent(
                     "ControllerLoopError", severity=SEV_WARN_ALWAYS
                 ).detail("Error", repr(e)).log()
-            await asyncio.sleep(self.check_interval)
+            # interruptible sleep: a pushed worker death (worker_death)
+            # wakes the loop immediately instead of up to a full
+            # check_interval later
+            try:
+                await asyncio.wait_for(
+                    self._wake.wait(), self.check_interval
+                )
+            except asyncio.TimeoutError:
+                pass
+            self._wake.clear()
 
     async def _rerecruit_singleton(self, name: str) -> None:
         """Non-transaction-path roles (storage, ratekeeper) re-recruit
@@ -3098,6 +3173,7 @@ async def _serve_role(
         role = ClusterControllerRole(conf, state_file=state_file)
         server.register(TOKEN_REGISTER_WORKER, role.register_worker)
         server.register(TOKEN_TOPOLOGY, role.topology)
+        server.register(TOKEN_WORKER_DEATH, role.worker_death)
         role._task = asyncio.ensure_future(role.run())
     else:
         raise ValueError(f"unknown role {role_name!r}")

@@ -86,6 +86,31 @@ class KernelConfig:
     #: unconverged latch — state unchanged, host re-dispatches the exact
     #: kernel — never a silent wrong answer. Tiered path only.
     dedup_reads: int = 0
+    #: True compiles the tiered kernel's main-tier probe as a SORTED-
+    #: ENDPOINT SWEEP (ops/delta.sweep_read_ranks): the whole group's
+    #: read endpoints co-sort with the immutable main tier's boundary
+    #: rows ONCE per group, il/ir ranks fall out of a running main-row
+    #: count (searchsorted-right/left semantics from the sort order),
+    #: and every batch's probe is then one O(1) range-max table query —
+    #: no per-read binary searches against carried state, no bounded
+    #: probe window, no dedup latch. Wide scans (range_heavy streams)
+    #: cost O((M + G*R) log) streaming sorted work per GROUP instead of
+    #: per-covered-block probes per batch, which is what lets
+    #: backend_for_profile keep range_heavy on the device. Tiered path
+    #: only; mutually exclusive with dedup_reads (they compile the same
+    #: probe differently — pick per contention profile).
+    range_sweep: bool = False
+    #: True raises delta-capacity pressure handling from latch-and-raise
+    #: to SPILL-AND-COMPACT: before a dispatch whose conservative
+    #: boundary bound (2*max_writes per batch since the last fold) could
+    #: overflow the delta tier, the host dispatches the compaction
+    #: program (ops/delta.compact — delta folds into MAIN on device) and
+    #: then the group, all asynchronously — no device sync, no
+    #: HistoryOverflowError, no host exact-kernel re-dispatch. A stream
+    #: sized past delta_capacity completes on device; the latch+raise
+    #: remains only as the misconfiguration backstop (a SINGLE group's
+    #: bound exceeding delta_capacity cannot be spilled around).
+    delta_spill: bool = False
     #: Tiered path: host folds delta into main after at least this many
     #: BATCHES have resolved since the last compaction (TpuConflictSet
     #: auto-compaction; a fused group of G batches counts G). Counting
@@ -127,6 +152,18 @@ class KernelConfig:
             raise ValueError("dedup_reads cannot exceed max_reads")
         if self.dedup_reads and not self.delta_capacity:
             raise ValueError("dedup_reads requires the tiered path "
+                             "(delta_capacity > 0)")
+        if self.range_sweep and not self.delta_capacity:
+            raise ValueError("range_sweep requires the tiered path "
+                             "(delta_capacity > 0)")
+        if self.range_sweep and self.dedup_reads:
+            raise ValueError(
+                "range_sweep and dedup_reads compile the same main-tier "
+                "probe differently (sweep ranks vs dedup'd binary "
+                "searches) — configure one per contention profile"
+            )
+        if self.delta_spill and not self.delta_capacity:
+            raise ValueError("delta_spill requires the tiered path "
                              "(delta_capacity > 0)")
         if self.n_shards < 0:
             raise ValueError("n_shards must be >= 0")

@@ -39,6 +39,13 @@ from foundationdb_tpu.ops import conflict as C
 from foundationdb_tpu.ops import history as H
 from foundationdb_tpu.utils import packing
 from foundationdb_tpu.utils.metrics import CounterCollection, LatencySample
+from foundationdb_tpu.utils.probes import code_probe, declare
+
+# ISSUE 14 rare-path coverage: the range-scan sweep probe actually
+# dispatching (vs silently falling back to the probe path) and the
+# pressure-driven spill fold actually replacing a latch+raise — both
+# expected by the range_heavy soak spec.
+declare("resolver.range_sweep", "resolver.delta_spill")
 
 # Rebase when offsets pass 2**30 (window is ~5e6; huge safety margin).
 REBASE_THRESHOLD = 1 << 30
@@ -71,6 +78,14 @@ class KernelStageMetrics:
                 "columnarBatches",
                 "stagedChunks",
                 "compactions",
+                # pressure-driven delta->MAIN folds (delta_spill): the
+                # compactions counter includes these; spills counts the
+                # pressure-triggered subset — the "no raise, no host
+                # re-dispatch" accounting the ISSUE-14 gate pins
+                "spills",
+                # groups dispatched through the sorted-endpoint sweep
+                # probe (range_sweep) — the range-path structural count
+                "sweepGroups",
                 "latchTrips",
                 "exactFallbacks",
                 "rebases",
@@ -174,6 +189,11 @@ class KernelStageMetrics:
             "delta_occupancy": d_occ,
             "main_occupancy": m_occ,
             "compactions": self.counters.get("compactions"),
+            # ISSUE 14: pressure spills (delta_spill) and sweep-probed
+            # groups (range_sweep) — the "router has nothing left to
+            # route away" accounting, zero on unconfigured instances
+            "spills": self.counters.get("spills"),
+            "sweep_groups": self.counters.get("sweepGroups"),
             "fallbacks": (
                 self.counters.get("latchTrips")
                 + self.counters.get("exactFallbacks")
@@ -300,12 +320,16 @@ def _resolve_group_jit(short_span_limit: int, fixpoint_unroll: int = 3,
 
 
 def _resolve_tiered_jit(short_span_limit: int, fixpoint_unroll: int = 3,
-                        fixpoint_latch: bool = False, dedup_reads: int = 0):
+                        fixpoint_latch: bool = False, dedup_reads: int = 0,
+                        range_sweep: bool = False):
     """One compiled TIERED group kernel per static-switch tuple
     (ops/delta.resolve_group_tiered). The scan body inside is
     G-independent, so the same tuple serves every group size with one
-    body compile."""
-    key = (short_span_limit, fixpoint_unroll, fixpoint_latch, dedup_reads)
+    body compile. `range_sweep` swaps the main-tier probe for the
+    per-group sorted-endpoint sweep (no per-read binary search, no
+    dedup latch)."""
+    key = (short_span_limit, fixpoint_unroll, fixpoint_latch, dedup_reads,
+           range_sweep)
     fn = _TIERED_JITS.get(key)
     if fn is None:
         import functools
@@ -315,6 +339,7 @@ def _resolve_tiered_jit(short_span_limit: int, fixpoint_unroll: int = 3,
             fixpoint_unroll=fixpoint_unroll,
             fixpoint_latch=fixpoint_latch,
             dedup_reads=dedup_reads,
+            range_sweep=range_sweep,
         ))
         _TIERED_JITS[key] = fn
     return fn
@@ -410,6 +435,12 @@ class TpuConflictSet:
             self.state = _D.init(config) if self.tiered else H.init(config)
         self._batches_since_check = 0
         self._batches_since_compact = 0
+        #: conservative live-boundary bound of the delta tier since the
+        #: last compaction (2*max_writes per dispatched batch): the
+        #: delta_spill pressure signal — host arithmetic only, so spill
+        #: decisions never cost a device sync (and are therefore
+        #: invariant across pipelined/sharded/compact_interval paths)
+        self._spill_bound_rows = 0
         self._prewarmed_exact: set = set()
         self._resolve = _RESOLVE
         self._rebase = _REBASE
@@ -578,18 +609,19 @@ class TpuConflictSet:
             overflow=outs.overflow[0],
         )
 
-    def _tiered_jit(self, ssl, unroll, latch, dedup):
+    def _tiered_jit(self, ssl, unroll, latch, dedup, sweep=False):
         """The compiled tiered kernel for this instance: the module
         single-device jit, or — on a sharded instance — the mesh
         shard_map program with this instance's partition bound (ONE
         compiled program per group: clip + per-shard scan + pmin/psum
         combine; see parallel/sharding.tiered_sharded_jit)."""
         if not self.sharded:
-            return _resolve_tiered_jit(ssl, unroll, latch, dedup)
+            return _resolve_tiered_jit(ssl, unroll, latch, dedup, sweep)
         from foundationdb_tpu.parallel import sharding as _sh
 
         fn = _sh.tiered_sharded_jit(
             self._mesh, ssl, unroll, latch, dedup,
+            range_sweep=sweep,
             axis=getattr(self.config, "shard_axis", _sh.AXIS),
         )
         return lambda st, args: fn(st, args, self._part_lo, self._part_hi)
@@ -607,6 +639,30 @@ class TpuConflictSet:
         unroll = getattr(cfg, "fixpoint_unroll", 3)
         latch = getattr(cfg, "fixpoint_latch", False)
         dedup = getattr(cfg, "dedup_reads", 0)
+        sweep = getattr(cfg, "range_sweep", False)
+        kb = int(stacked_args["version"].shape[0])
+        if getattr(cfg, "delta_spill", False):
+            # SPILL-AND-COMPACT (ISSUE 14): before a dispatch whose
+            # conservative boundary bound could overflow the delta tier
+            # (each batch adds at most 2*max_writes boundary rows; the
+            # host tracks the bound so no device sync is ever paid),
+            # fold delta into MAIN with the compaction program — an
+            # asynchronous device dispatch like any batch — instead of
+            # letting the in-kernel latch trip and raise. A stream
+            # sized past delta_capacity completes on device with zero
+            # host exact-kernel re-dispatches; only a SINGLE group
+            # whose own bound exceeds delta_capacity still reaches the
+            # latch+raise backstop (a configuration error spill cannot
+            # paper over).
+            add = 2 * cfg.max_writes * kb
+            if self._spill_bound_rows + add > cfg.delta_capacity:
+                self.compact_history()
+                self.metrics.counters.add("spills")
+                code_probe(True, "resolver.delta_spill")
+            self._spill_bound_rows += add
+        if sweep:
+            self.metrics.counters.add("sweepGroups")
+            code_probe(True, "resolver.range_sweep")
         if (latch or dedup) and check_latch:
             # prewarm the EXACT program at first sight of a shape, so a
             # latch/dedup trip swaps programs instead of paying an XLA
@@ -614,17 +670,19 @@ class TpuConflictSet:
             # discipline, applied automatically on the checked path;
             # pipelined callers pass check_latch=False and prewarm
             # explicitly). The exact kernel does not donate state, so
-            # one discarded execution is side-effect-free.
+            # one discarded execution is side-effect-free. The sweep is
+            # not a latch source, so the fallback program keeps it —
+            # same probe, exact fixpoint.
             shape_key = tuple(
                 (k, tuple(stacked_args[k].shape)) for k in sorted(stacked_args)
             )
             if shape_key not in self._prewarmed_exact:
                 self._prewarmed_exact.add(shape_key)
-                self._tiered_jit(ssl, unroll, False, 0)(
+                self._tiered_jit(ssl, unroll, False, 0, sweep)(
                     self.state, stacked_args
                 )
         t0 = time.perf_counter()
-        state2, outs = self._tiered_jit(ssl, unroll, latch, dedup)(
+        state2, outs = self._tiered_jit(ssl, unroll, latch, dedup, sweep)(
             self.state, stacked_args
         )
         self.metrics.counters.add("groupDispatches")
@@ -633,18 +691,17 @@ class TpuConflictSet:
         ):
             self.metrics.counters.add("latchTrips")
             self.metrics.counters.add("exactFallbacks")
-            state2, outs = self._tiered_jit(ssl, unroll, False, 0)(
+            state2, outs = self._tiered_jit(ssl, unroll, False, 0, sweep)(
                 self.state, stacked_args
             )
         self.metrics.kernel.sample(time.perf_counter() - t0)
         self.state = state2
-        k = int(outs.verdict.shape[0])
-        self._batches_since_check += k - 1
+        self._batches_since_check += kb - 1
         self._maybe_check_overflow()
         # auto-compaction counts BATCHES (a fused group counts G), so
         # per-batch resolve() callers pay the main-sized compaction at
         # the same cadence as the fused bench stream
-        self._batches_since_compact += k
+        self._batches_since_compact += kb
         interval = getattr(cfg, "compact_interval", 0)
         if interval and self._batches_since_compact >= interval:
             self.compact_history()
@@ -658,6 +715,7 @@ class TpuConflictSet:
         if not self.tiered:
             return
         self._batches_since_compact = 0
+        self._spill_bound_rows = 0
         self.metrics.counters.add("compactions")
         if self.sharded:
             from foundationdb_tpu.parallel import sharding as _sh
@@ -843,9 +901,10 @@ class TpuConflictSet:
             if not (getattr(self.config, "fixpoint_latch", False)
                     or getattr(self.config, "dedup_reads", 0)):
                 return
-            _, outs = self._tiered_jit(ssl, unroll, False, 0)(
-                self.state, stacked_args
-            )
+            _, outs = self._tiered_jit(
+                ssl, unroll, False, 0,
+                getattr(self.config, "range_sweep", False),
+            )(self.state, stacked_args)
             jax.block_until_ready(outs.verdict)
             return
         if not getattr(self.config, "fixpoint_latch", False):
@@ -910,6 +969,7 @@ class TpuConflictSet:
             fn = _sh.tiered_sharded_jit(
                 self._mesh, ssl, unroll, latch,
                 getattr(cfg, "dedup_reads", 0),
+                range_sweep=getattr(cfg, "range_sweep", False),
                 axis=getattr(cfg, "shard_axis", _sh.AXIS),
             )
             return _perf.cost_analysis_of(
@@ -917,7 +977,8 @@ class TpuConflictSet:
             )
         if self.tiered:
             fn = _resolve_tiered_jit(
-                ssl, unroll, latch, getattr(cfg, "dedup_reads", 0)
+                ssl, unroll, latch, getattr(cfg, "dedup_reads", 0),
+                getattr(cfg, "range_sweep", False),
             )
         else:
             fn = _resolve_group_jit(ssl, unroll, latch)
@@ -1086,8 +1147,11 @@ def stage_ledger(config: KernelConfig, batches, *, fuse: int,
         # for the window worst case — a capacity sized for the
         # compaction cadence would overflow with compaction off.
         occ_cap = occupancy_delta_capacity or config.history_capacity
+        # delta_spill off too: a pressure fold mid-pass would reset the
+        # very occupancy this pass exists to measure
         cs_occ = TpuConflictSet(
-            _dc.replace(config, compact_interval=0, delta_capacity=occ_cap)
+            _dc.replace(config, compact_interval=0, delta_capacity=occ_cap,
+                        delta_spill=False)
         )
         for dg in staged:
             cs_occ.resolve_group_args(dg, check_latch=False)
@@ -1212,6 +1276,92 @@ def make_conflict_set(config: KernelConfig, backend: str = None):
 # regimes are CHEAPLY detectable host-side from the packed batch.
 
 
+def _fold_key64(data, jj=None):
+    """Fold each key row of a [N, ncol] big-endian WORD array into one
+    int64 anchored at the first VARYING word — the ONE classifier core
+    `profile_batch` (packed uint32 words) and `profile_transactions`
+    (raw key bytes packed to words) both run, so the two can never
+    disagree on a keyspace again (ISSUE 14 satellite: one used to fold
+    the first varying word, the other stripped the BYTE-granularity
+    common prefix and read 8 bytes — a long shared prefix put the two
+    windows at different offsets and the span/dup thresholds diverged).
+
+    Keyspaces with a common prefix (subspaces, short keys) keep leading
+    words constant, so the span window anchors at the first word that
+    varies. The successor word joins the low slot only when it VARIES
+    in the sample: a constant successor — including the zero padding
+    past short keys, which is how the packed and raw representations
+    used to diverge — would scale every span by 2^32. (Duplicate
+    detection does NOT use this fold: _classify compares full key rows,
+    exactly — a fold window would collapse keys differing outside it.)
+
+    jj: optional (j, use_succ) from a previous call, so range END keys
+    fold through the same window as their BEGIN keys.
+    Returns (vals [N] int64, (j, use_succ)).
+    """
+    import numpy as np
+
+    ncol = data.shape[1]
+    if jj is None:
+        j = 0
+        while j < ncol - 1 and len(np.unique(data[:, j])) == 1:
+            j += 1
+        use_succ = j + 1 < ncol and len(np.unique(data[:, j + 1])) > 1
+        jj = (j, use_succ)
+    j, use_succ = jj
+    if use_succ:
+        hi, lo = data[:, j], data[:, j + 1]
+    else:
+        # the varying word is effectively the LAST one: it must occupy
+        # the LOW slot or every span/dup scales by 2^32
+        hi, lo = np.zeros(len(data), np.int64), data[:, j]
+    return (hi << 32) | lo, jj
+
+
+def _keys_to_words(keys, width: int):
+    """Raw key bytes -> [N, width] int64 big-endian uint32 words, zero-
+    padded — the same word layout utils/packing gives a PackedBatch's
+    key tensors (minus the length word), so _fold_key64 sees the
+    identical representation from both classifiers."""
+    import numpy as np
+
+    out = np.zeros((len(keys), width), np.int64)
+    for i, k in enumerate(keys):
+        padded = k.ljust(width * 4, b"\0")[: width * 4]
+        out[i] = np.frombuffer(padded, dtype=">u4").astype(np.int64)
+    return out
+
+
+#: classification thresholds shared by both classifiers (one source of
+#: truth): duplicate-write-key rate above DUP_HOT is hot-key contention
+#: (zipf-0.99 over 10M keys measures ~0.5+; uniform 64K/1M ~0.03), and
+#: a mean read span above SPAN_RANGE keyspace units is range-heavy
+#: (point reads span ~1-2; the range config's scans span hundreds).
+PROFILE_DUP_HOT = 0.25
+PROFILE_SPAN_RANGE = 32
+
+
+def _classify(wrows, rbvals, revals) -> str:
+    """Shared threshold logic: `wrows` is the [N, ncol] write-key WORD
+    array — duplicate detection is EXACT row uniqueness (a fold window
+    would collapse keys differing outside it into spurious hot_key;
+    zero padding keeps uniqueness identical between the packed and raw
+    representations) — while spans use the folded int64 window."""
+    import numpy as np
+
+    if len(wrows):
+        dup = 1.0 - len(np.unique(wrows, axis=0)) / len(wrows)
+        if dup > PROFILE_DUP_HOT:
+            return "hot_key"
+    if len(rbvals):
+        span = float(np.mean(np.minimum(
+            np.maximum(revals - rbvals, 0), 1 << 20
+        )))
+        if span > PROFILE_SPAN_RANGE:
+            return "range_heavy"
+    return "uniform"
+
+
 def profile_batch(batch, sample: int = 2048) -> str:
     """Classify a PackedBatch's contention regime: "uniform" |
     "hot_key" | "range_heavy". Host-side, O(sample)."""
@@ -1220,80 +1370,69 @@ def profile_batch(batch, sample: int = 2048) -> str:
     nw = max(1, batch.n_writes)
     nr = max(1, batch.n_reads)
 
-    def key64(arr, n, j=None):
-        # fold the first VARYING data word and its successor into one
-        # int64: keyspaces with a common prefix (subspaces, short keys)
-        # keep leading words constant, and folding constants would
-        # collapse every key to one value (a spurious "hot_key")
+    def words(arr, n):
         a = arr[: min(n, sample)].astype(np.int64)
-        data = a[:, :-1] if a.shape[1] > 1 else a
-        ncol = data.shape[1]
-        if j is None:
-            j = 0
-            while j < ncol - 1 and len(np.unique(data[:, j])) == 1:
-                j += 1
-        if j + 1 < ncol:
-            hi, lo = data[:, j], data[:, j + 1]
-        else:
-            # the varying word is the LAST one: it must occupy the LOW
-            # slot or every span/dup scales by 2^32
-            hi, lo = np.zeros(len(data), np.int64), data[:, j]
-        return (hi << 32) | lo, j
+        return a[:, :-1] if a.shape[1] > 1 else a  # drop the length word
 
-    ws, _ = key64(batch.write_begin, nw)
-    # duplicate-write-key rate in the sample (hot-key contention):
-    # zipf-0.99 over 10M keys measures ~0.5+; uniform 64K/1M ~0.03
-    dup = 1.0 - len(np.unique(ws)) / max(1, len(ws))
-    if dup > 0.25:
-        return "hot_key"
-    rb, j = key64(batch.read_begin, nr)
-    re, _ = key64(batch.read_end, nr, j)
-    # mean span of read ranges in keyspace units: point reads span ~1;
-    # the range-heavy config's scans span hundreds
-    span = float(np.mean(np.minimum(np.maximum(re - rb, 0), 1 << 20)))
-    if span > 32:
-        return "range_heavy"
-    return "uniform"
+    rb, jj = _fold_key64(words(batch.read_begin, nr))
+    re, _ = _fold_key64(words(batch.read_end, nr), jj)
+    return _classify(words(batch.write_begin, nw), rb, re)
 
 
 def profile_transactions(txns, sample: int = 512) -> str:
     """profile_batch for raw CommitTransaction lists (the resolver's
-    input shape). Host-side, O(sample)."""
-    import os
-
+    input shape). Host-side, O(sample). Packs the sampled keys into the
+    SAME big-endian word representation a PackedBatch carries and runs
+    the same _fold_key64 core, so a resolver that routed on raw
+    transactions and a bench that routed on the packed batch agree by
+    construction (pinned in tests/test_contention_router.py)."""
     writes = [
         r[0] for t in txns[:sample] for r in t.write_conflict_ranges
     ][:sample]
-    if len(writes) >= 16:
-        dup = 1.0 - len(set(writes)) / len(writes)
-        if dup > 0.25:
-            return "hot_key"
     reads = [
         r for t in txns[:sample] for r in t.read_conflict_ranges
     ][:sample]
+    if len(writes) < 16 and not reads:
+        return "uniform"
+    width = max(
+        [1] + [-(-len(k) // 4) for k in writes]
+        + [-(-len(b) // 4) for b, _ in reads]
+        + [-(-len(e) // 4) for _, e in reads]
+    )
+    # the same minimum-sample discipline as before the r14 unification:
+    # a <16-write sample gives a dup estimate too noisy to act on
+    wrows = _keys_to_words(writes if len(writes) >= 16 else [], width)
     if reads:
-        pref = len(os.path.commonprefix([b for b, _ in reads]))
-
-        def as_int(x: bytes) -> int:
-            return int.from_bytes(x[pref:pref + 8].ljust(8, b"\0"), "big")
-
-        spans = [max(0, as_int(e) - as_int(b)) for b, e in reads]
-        if sum(spans) / len(spans) > 32:
-            return "range_heavy"
-    return "uniform"
+        rbvals, jj = _fold_key64(
+            _keys_to_words([b for b, _ in reads], width)
+        )
+        revals, _ = _fold_key64(
+            _keys_to_words([e for _, e in reads], width), jj
+        )
+    else:
+        rbvals = revals = _keys_to_words([], width)[:, 0]
+    return _classify(wrows, rbvals, revals)
 
 
 def backend_for_profile(profile: str, config=None) -> str:
-    """The measured winner per regime (table above) — NARROWED when the
-    r6 tiered+dedup kernel is configured: hot-key streams are the
-    regime the delta tier (merge rows scale with distinct boundaries)
-    and the dedup probe (main-tier searches scale with distinct ranges)
-    attack head-on, so with both enabled the router keeps them on the
-    device and only range-heavy streams still route to the CPU
-    skiplist. The narrowed threshold encodes the r6 design's expected
-    winner; bench.py's zipf config re-measures it every run on real
-    hardware, so a regression shows up in the graded numbers, not
-    silently in routing."""
+    """The measured winner per regime (table above) — NARROWED as the
+    kernel grows the structure each regime needs, until the router has
+    nothing left to route away (ROADMAP "kill the CPU fallback"):
+
+    * hot_key stays on device with the r6 tiered+dedup kernel (the
+      delta tier's merge rows scale with distinct boundaries and the
+      dedup probe's searches with distinct ranges — the zipf attack);
+    * range_heavy stays on device with the r14 SORTED-ENDPOINT SWEEP
+      (config.range_sweep): wide scans cost one streaming co-sort per
+      group plus O(1) table queries instead of per-read binary searches
+      with a per-covered-block probe window — the regime where the
+      fixed-width kernel lost 0.28x to the skiplist's subtree skipping
+      no longer exists as a kernel shape.
+
+    The narrowed thresholds encode each design's expected winner;
+    bench.py's zipf and ycsb_e configs re-measure them every hardware
+    run, so a regression shows up in the graded numbers, not silently
+    in routing."""
     if profile == "uniform":
         return "tpu"
     if (
@@ -1303,14 +1442,46 @@ def backend_for_profile(profile: str, config=None) -> str:
         and getattr(config, "dedup_reads", 0) > 0
     ):
         return "tpu"
+    if (
+        profile == "range_heavy"
+        and config is not None
+        and getattr(config, "delta_capacity", 0) > 0
+        and getattr(config, "range_sweep", False)
+    ):
+        return "tpu"
     return "cpu"
+
+
+def fallback_free(config) -> bool:
+    """True when this config leaves the router nothing to route away:
+    every contention profile resolves on the device (tiered kernel with
+    the dedup probe for hot_key, the endpoint sweep for range_heavy)
+    and delta pressure spills-and-compacts instead of raising. The
+    "no fallback" predicate README's router section documents.
+
+    Note dedup_reads and range_sweep are per-profile probe choices and
+    mutually exclusive on ONE instance — a deployment covers all
+    profiles by routing per stream (route_stream picks the backend
+    from the leading batches, and the resolver configures the probe
+    for the profile it routed)."""
+    return bool(
+        config is not None
+        and getattr(config, "delta_capacity", 0) > 0
+        and getattr(config, "delta_spill", False)
+        and (
+            getattr(config, "dedup_reads", 0) > 0
+            or getattr(config, "range_sweep", False)
+        )
+    )
 
 
 def route_stream(batches, config, sample_batches: int = 2) -> str:
     """Pick the backend for a stream from its leading batches' profiles
     + the batch-capacity gate (RESOLVER_TPU_MIN_BATCH): TPU for
     large-batch uniform streams — and, with the tiered+dedup kernel
-    configured, hot-key streams too (see backend_for_profile).
+    configured, hot-key streams too; with the tiered+sweep kernel,
+    range-heavy streams too (see backend_for_profile — a fully
+    configured deployment has nothing left to route away).
     Used by the resolver role when resolver_backend="tpu"."""
     from foundationdb_tpu.utils.knobs import SERVER_KNOBS
 

@@ -278,6 +278,7 @@ def _shard_resolve_group_tiered(state, g: dict, lo, hi, *,
                                 fixpoint_unroll: int,
                                 fixpoint_latch: bool,
                                 dedup_reads: int,
+                                range_sweep: bool = False,
                                 axis: str = AXIS):
     """Per-device body: the tiered group scan on the clipped batch plus
     the cross-shard combine. Leading shard axis squeezed on entry."""
@@ -296,6 +297,13 @@ def _shard_resolve_group_tiered(state, g: dict, lo, hi, *,
     from foundationdb_tpu.ops import rangemax as _rm
 
     main_tab = _rm.build(state.main.main_ver, op="max")
+    if range_sweep:
+        # ISSUE 14: the per-group sorted-endpoint sweep runs PER SHARD
+        # against the shard-local main tier, on the CLIPPED ranges —
+        # same ops/delta machinery as the single-device scan, inside
+        # the same shard_map program (no extra collective: ranks are
+        # shard-local inputs to the shard-local probe)
+        local = D.attach_sweep_ranks(state.main, local)
 
     def body(carry, xs):
         return D.batch_body(
@@ -304,6 +312,7 @@ def _shard_resolve_group_tiered(state, g: dict, lo, hi, *,
             fixpoint_unroll=fixpoint_unroll,
             fixpoint_latch=fixpoint_latch,
             dedup_reads=dedup_reads,
+            range_sweep=range_sweep,
         )
 
     (delta_f, trip), outs = jax.lax.scan(
@@ -388,13 +397,14 @@ _COLLECTIVE_PROBE_JITS: dict = {}
 
 def tiered_sharded_jit(mesh: Mesh, short_span_limit: int,
                        fixpoint_unroll: int, fixpoint_latch: bool,
-                       dedup_reads: int, axis: str = AXIS):
+                       dedup_reads: int, range_sweep: bool = False,
+                       axis: str = AXIS):
     """The compiled mesh-sharded tiered group kernel: ONE shard_map
     program per dispatch (clip + scan + pmin/psum combine), compiled
     once per (mesh, static switches) — the scan body is G-independent
     exactly like the single-device tiered kernel."""
     key = (mesh, short_span_limit, fixpoint_unroll, fixpoint_latch,
-           dedup_reads, axis)
+           dedup_reads, range_sweep, axis)
     fn = _TIERED_SHARD_JITS.get(key)
     if fn is None:
         spec_state = _tiered_spec_state(axis)
@@ -404,6 +414,7 @@ def tiered_sharded_jit(mesh: Mesh, short_span_limit: int,
             fixpoint_unroll=fixpoint_unroll,
             fixpoint_latch=fixpoint_latch,
             dedup_reads=dedup_reads,
+            range_sweep=range_sweep,
             axis=axis,
         )
         # no donation: the latch fallback re-dispatches the same input

@@ -289,7 +289,31 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
     from foundationdb_tpu.runtime.flow import AuditedDict, Scheduler
 
     kernel_config = _CC.kernel_config.scaled(window_versions=window)
-    if plan.resolver_backend == "tpu-force" and seed % 2 == 0:
+    if plan.resolver_backend == "tpu-force" and bool(
+        spec.policy.get("kernel_range_sweep")
+    ):
+        # the ISSUE-14 range-heavy ensemble: EVERY tpu-force seed arms
+        # the sorted-endpoint sweep probe + spill-and-compact pressure
+        # handling (range_sweep excludes dedup_reads — they compile the
+        # same probe differently). delta_capacity is sized SMALL on
+        # purpose: the conservative spill bound (2*max_writes per
+        # batch) trips within a couple of batches, so the spill fold
+        # runs INSIDE the fault ensemble (resolver.delta_spill probe),
+        # never a latch+raise. The mesh-sharded alternation below still
+        # applies on seed % 4 == 0.
+        # compact_interval=0: compaction is PURELY pressure-driven here
+        # — a cadence compaction would reset the spill bound before it
+        # ever tripped, and the spec exists to run the spill fold (not
+        # just the sweep) inside the fault mix
+        kernel_config = kernel_config.scaled(
+            delta_capacity=4 * kernel_config.max_writes,
+            range_sweep=True,
+            delta_spill=True,
+            compact_interval=0,
+        )
+        if seed % 4 == 0 and _sharded_mesh_available(2):
+            kernel_config = kernel_config.scaled(n_shards=2)
+    elif plan.resolver_backend == "tpu-force" and seed % 2 == 0:
         # alternate the r6 TIERED kernel (ops/delta.py: delta tier +
         # device-side read dedup + per-group compaction) through the
         # fault ensemble on even tpu-force seeds — decisions are

@@ -224,9 +224,17 @@ class SoakSpec:
                 f"spec {self.name!r}: policy.audit must be a bool (the "
                 f"interleaving-auditor knob), got {audit!r}"
             )
+        sweep = self.policy.get("kernel_range_sweep", False)
+        if not isinstance(sweep, bool):
+            raise SpecError(
+                f"spec {self.name!r}: policy.kernel_range_sweep must be "
+                f"a bool (tpu-force seeds arm the ISSUE-14 sorted-"
+                f"endpoint sweep + spill-and-compact kernel instead of "
+                f"the dedup probe), got {sweep!r}"
+            )
         unknown = set(self.policy) - {
             "randomize_knobs", "small_window", "resolver_backends",
-            "determinism_every", "audit",
+            "determinism_every", "audit", "kernel_range_sweep",
         }
         if unknown:
             raise SpecError(

@@ -502,6 +502,25 @@ def bench_row_to_metrics(row: dict) -> dict:
                       ("fence_ms_per_group", "fence_ms_per_group")):
         if src in abl:
             m[name] = metric(abl[src], "ms", "lower")
+    # ISSUE 14 structural accounting (absent on pre-r14 rows, keeping
+    # the historical --import byte-stable): total decisions over the
+    # seeded stream plus the range-path counters — deterministic on any
+    # host, gated exactly by perfcheck (the YCSB-E acceptance row)
+    st = row.get("structural") or {}
+    for src, name, direction in (
+        ("committed", "decisions_committed", "higher"),
+        ("conflicted", "decisions_conflicted", "lower"),
+        ("too_old", "decisions_too_old", "lower"),
+        ("spills", "spills", "lower"),
+        ("sweep_groups", "sweep_groups", "higher"),
+        ("compactions", "compactions", "lower"),
+    ):
+        if src in st:
+            m[name] = metric(st[src], "count", direction, tier="structural")
+    if "sweep_rows_per_group" in st:
+        m["sweep_rows_per_group"] = metric(
+            st["sweep_rows_per_group"], "rows", "lower", tier="structural"
+        )
     cc = row.get("compile_cache") or {}
     if cc:
         # both counters depend on persistent-cache warmth (JAX fires
@@ -551,6 +570,11 @@ def bench_row_to_record(row: dict, *, imported_from: str = None,
         "dedup_reads": row.get("dedup_reads"),
         "compact_interval": row.get("compact_interval"),
     }
+    # r14 knobs join the fingerprint only when present, so every
+    # pre-r14 row's baseline key is unchanged (import byte-stability)
+    for k in ("range_sweep", "delta_spill"):
+        if row.get(k):
+            knobs[k] = row[k]
     return make_record(
         "bench", bench_row_to_metrics(row), workload=workload, knobs=knobs,
         fingerprint=fingerprint, imported_from=imported_from,

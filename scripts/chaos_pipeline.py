@@ -324,6 +324,15 @@ async def _run_scenario(kill_kind: str, args) -> dict:
             # fully_recovered), for comparison
             "recovery_walk_s": q["last_recovery_s"],
             "recovery_reason": q["last_recovery_reason"],
+            # ISSUE 14: the monitor's push-on-death beat the heartbeat
+            # backstop — detection cost one supervision poll, not
+            # HEARTBEAT_MISSES status polls (only meaningful for
+            # transaction-path kills, which trigger a recovery walk)
+            "push_detected": int(
+                kill_kind in ("proxy", "resolver", "tlog")
+                and str(q["last_recovery_reason"] or "").startswith("push:")
+            ),
+            "death_notifications": q.get("death_notifications", 0),
             "recovered": int(
                 killed.get("recovered_after_s") is not None
                 and q["recovery_state"] == gen.FULLY_RECOVERED
@@ -386,6 +395,18 @@ def _emit_ledger(args, results: list[dict]) -> None:
             "goodput_ratio": perf.metric(
                 round(min(r["goodput_ratio"] for r in results), 3),
                 "ratio", direction="higher", tier="hardware",
+            ),
+            # every transaction-path kill must have been detected by
+            # the monitor's push, not the heartbeat backstop (ISSUE 14
+            # — the detection-latency fix is structural: the push either
+            # wins the race by design or the wiring regressed)
+            "push_detected": perf.metric(
+                int(all(
+                    r["push_detected"]
+                    for r in results
+                    if r["kill"] in ("proxy", "resolver", "tlog")
+                )),
+                "bool", direction="higher", tier="structural",
             ),
         },
         workload={
@@ -457,6 +478,12 @@ def main() -> int:
             )
         if not res["timeline_ok"]:
             failures.append(f"{kind}: recovery timeline not in trace")
+        if kind in ("proxy", "resolver", "tlog") and not res["push_detected"]:
+            failures.append(
+                f"{kind}: recovery was heartbeat-detected "
+                f"(reason {res['recovery_reason']!r}) — the monitor's "
+                "push-on-death should have won"
+            )
         if res["committed"] == 0:
             failures.append(f"{kind}: nothing committed")
         if (res["recovery_time_s"] or args.recovery_bound) \

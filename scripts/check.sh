@@ -51,6 +51,21 @@ rm -f "$shard_row"
 t1=$(date +%s.%N)
 awk -v a="$t0" -v b="$t1" 'BEGIN {printf "shard_smoke wall time: %.1fs\n", b - a}'
 
+echo "== ycsb_e bench (tiny-shape YCSB-E through the sweep+spill kernel: =="
+echo "== range_heavy must classify + route to the device, and the run's  =="
+echo "== structural ledger row — decisions, sweep rows, spills — gates    =="
+echo "== against the committed baseline via perfcheck)                    =="
+t0=$(date +%s.%N)
+ycsb_row=$(mktemp /tmp/ycsbcheck_row.XXXXXX.jsonl)
+JAX_PLATFORMS=cpu BENCH_MODE=ycsb_e BENCH_TXNS=256 BENCH_BATCHES=6 \
+    BENCH_CPU_BATCHES=2 BENCH_REPS=1 BENCH_FUSE=3 BENCH_DELTA_CAP=2048 \
+    BENCH_COMPACT_INTERVAL=0 \
+    python bench.py --perf-ledger "$ycsb_row" > /dev/null
+JAX_PLATFORMS=cpu python scripts/perfcheck.py --check "$ycsb_row" --tier structural
+rm -f "$ycsb_row"
+t1=$(date +%s.%N)
+awk -v a="$t0" -v b="$t1" 'BEGIN {printf "ycsb_e bench wall time: %.1fs\n", b - a}'
+
 echo "== spec + perturbation smoke (1 short seed per spec, then the same =="
 echo "== seed x 3 schedule perturbations, api workload + auditor on)    =="
 # --perturb runs the unperturbed base seed first, so one lane covers both

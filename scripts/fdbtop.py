@@ -80,7 +80,11 @@ REQUIRED_SENSORS = {
                  "occupancy", "kernel", "kernel.shards",
                  "kernel.worst_shard_delta_occupancy",
                  "kernel.worst_shard_main_occupancy",
-                 "kernel.collective_time_share"),
+                 "kernel.collective_time_share",
+                 # r14 range-path counters (sweep groups dispatched,
+                 # pressure spills) — zeros on unconfigured kernels,
+                 # never a missing key
+                 "kernel.spills", "kernel.sweep_groups"),
     "commit_proxy": ("queued_requests", "inflight_batches", "batch_sizer"),
     "grv_proxy": ("queued_requests", "sheds", "budget_stale"),
     "ratekeeper": ("transactions_per_second_limit", "budget_limited_by",

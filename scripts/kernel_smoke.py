@@ -101,19 +101,88 @@ def main() -> int:
                       f"{got.conflicting_key_ranges} != "
                       f"{want[i].conflicting_key_ranges}")
                 failures += 1
+
+    # ---- range-heavy oracle case (ISSUE 14): the sorted-endpoint -----
+    # sweep probe ON and OFF against the oracle on wide-scan shapes,
+    # with spill-and-compact exercised mid-stream (delta sized to trip
+    # the pressure fold). A regression in the sweep ranks, the spill
+    # fold, or the no-fallback contract (exactFallbacks must stay 0)
+    # fails the fast lane before any hardware run.
+    sweep_cfg = KernelConfig(
+        **base_cfg, delta_capacity=64, compact_interval=0,
+        range_sweep=True, delta_spill=True,
+    )
+    probe_cfg = KernelConfig(
+        **base_cfg, delta_capacity=64, compact_interval=2,
+    )
+
+    def scan_txn(lo, hi):
+        b = int(rng.integers(0, 200))
+        span = int(rng.integers(8, 64))
+        wb = int(rng.integers(0, 200))
+        return CommitTransaction(
+            read_conflict_ranges=[(bytes([b // 256, b % 256]),
+                                   bytes([(b + span) // 256,
+                                          (b + span) % 256]))],
+            write_conflict_ranges=[(bytes([wb // 256, wb % 256]),
+                                    bytes([wb // 256, wb % 256, 1]))],
+            read_snapshot=int(rng.integers(lo, hi)),
+            report_conflicting_keys=bool(rng.random() < 0.5),
+        )
+
+    rstream = []
+    for i in range(6):
+        v = base + (i + 1) * step
+        rstream.append(([scan_txn(base - 150, v) for _ in range(6)], v))
+    r_oracle = CpuConflictSet(classic)
+    r_want = [r_oracle.resolve(txns, v) for txns, v in rstream]
+    range_sets = {
+        "sweep+spill": TpuConflictSet(sweep_cfg),
+        "sweep-off": TpuConflictSet(probe_cfg),
+    }
+    for name, cs in range_sets.items():
+        for i, (txns, v) in enumerate(rstream):
+            got = cs.resolve(txns, v)
+            if got.verdicts != r_want[i].verdicts:
+                print(f"FAIL range/{name} batch {i}: verdicts "
+                      f"{got.verdicts} != {r_want[i].verdicts}")
+                failures += 1
+            if got.conflicting_key_ranges != r_want[i].conflicting_key_ranges:
+                print(f"FAIL range/{name} batch {i}: conflicting ranges "
+                      f"{got.conflicting_key_ranges} != "
+                      f"{r_want[i].conflicting_key_ranges}")
+                failures += 1
+    sweep_counters = range_sets["sweep+spill"].metrics.counters
+    if sweep_counters.get("sweepGroups") != len(rstream):
+        print(f"FAIL range/sweep+spill: sweepGroups "
+              f"{sweep_counters.get('sweepGroups')} != {len(rstream)}")
+        failures += 1
+    if sweep_counters.get("spills") == 0:
+        print("FAIL range/sweep+spill: stream was sized to spill but "
+              "spills == 0")
+        failures += 1
+    if sweep_counters.get("exactFallbacks") != 0:
+        print(f"FAIL range/sweep+spill: exactFallbacks "
+              f"{sweep_counters.get('exactFallbacks')} != 0 — the "
+              "no-host-re-dispatch contract")
+        failures += 1
+
     n = len(stream)
     if failures:
         print(f"kernel smoke: {failures} FAILURES")
         return 1
     if args.perf_out:
-        _emit_perf_row(args.perf_out, sets, want, tiered)
+        _emit_perf_row(args.perf_out, sets, want, tiered,
+                       range_sets=range_sets, r_want=r_want)
     print(f"kernel smoke: OK — {len(sets)} kernel paths x {n} batches "
-          f"decision-identical to the oracle "
+          f"+ {len(range_sets)} range-heavy paths x {len(rstream)} "
+          f"batches decision-identical to the oracle "
           f"({time.perf_counter() - t_start:.1f}s)")
     return 0
 
 
-def _emit_perf_row(path: str, sets: dict, want, tiered_cfg) -> None:
+def _emit_perf_row(path: str, sets: dict, want, tiered_cfg, *,
+                   range_sets=None, r_want=None) -> None:
     """The structural ledger row the check.sh perf lane gates on: every
     value is deterministic given the seeded stream and tiny shapes —
     decision counts protect commit/abort parity, the kernel counters
@@ -162,10 +231,39 @@ def _emit_perf_row(path: str, sets: dict, want, tiered_cfg) -> None:
             c.get("latchTrips") + c.get("exactFallbacks"), "count",
             "lower", tier="structural",
         )
+    paths = sorted(sets)
+    if range_sets:
+        # ISSUE 14 range-heavy structural row half: oracle decision
+        # counts for the wide-scan stream plus the sweep/spill/
+        # no-fallback counters — a re-routed probe path or a lost spill
+        # fails the exact compare
+        from foundationdb_tpu.models.types import TransactionResult as _TR
+
+        metrics["range_committed"] = perf.metric(
+            sum(sum(1 for v in r.verdicts if v == _TR.COMMITTED)
+                for r in r_want),
+            "txns", "higher", tier="structural",
+        )
+        metrics["range_conflicted"] = perf.metric(
+            sum(sum(1 for v in r.verdicts if v == _TR.CONFLICT)
+                for r in r_want),
+            "txns", "lower", tier="structural",
+        )
+        c = range_sets["sweep+spill"].metrics.counters
+        metrics["range_sweep_groups"] = perf.metric(
+            c.get("sweepGroups"), "count", "higher", tier="structural"
+        )
+        metrics["range_spills"] = perf.metric(
+            c.get("spills"), "count", "higher", tier="structural"
+        )
+        metrics["range_exact_fallbacks"] = perf.metric(
+            c.get("exactFallbacks"), "count", "lower", tier="structural"
+        )
+        paths = paths + [f"range:{n}" for n in sorted(range_sets)]
     rec = perf.make_record(
         "kernel_smoke", metrics,
         workload={"batches": len(want), "txns_per_batch": 6,
-                  "paths": sorted(sets)},
+                  "paths": paths},
         knobs={"delta_capacity": tiered_cfg.delta_capacity,
                "dedup_reads": tiered_cfg.dedup_reads,
                "compact_interval": tiered_cfg.compact_interval},

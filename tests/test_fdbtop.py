@@ -239,7 +239,10 @@ def test_fdbtop_check_status_gate_both_directions():
                                "shards": 1,
                                "worst_shard_delta_occupancy": 0.0,
                                "worst_shard_main_occupancy": 0.0,
-                               "collective_time_share": 0.0}}},
+                               "collective_time_share": 0.0,
+                               # r14 range-path counters
+                               "spills": 0,
+                               "sweep_groups": 0}}},
                 "proxy0": {"role": "commit_proxy", "qos": {
                     "queued_requests": 0, "inflight_batches": 0,
                     "batch_sizer": {}}},

@@ -366,3 +366,61 @@ def test_ratekeeper_peers_follow_topology(tmp_path):
                 await s.close()
 
     run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# Push-on-death (ISSUE 14): the monitor's WorkerDeath notification must
+# flag recovery immediately — no heartbeat-miss budget spent.
+
+
+def test_worker_death_push_flags_recovery_immediately():
+    ctrl = mp.ClusterControllerRole({"resolvers": 1})
+    ctrl._needs_recovery = False  # steady state after initial recruitment
+    ctrl.assignments = {
+        "resolver0": {"kind": "resolver", "worker_id": "w1",
+                      "address": "/tmp/x1.sock", "epoch": 3},
+        "storage0": {"kind": "storage", "worker_id": "w2",
+                     "address": "/tmp/x2.sock", "epoch": 3},
+    }
+    ctrl.workers = {
+        "w1": {"worker_id": "w1", "address": "/tmp/x1.sock",
+               "last_seen": time.monotonic()},
+        "w2": {"worker_id": "w2", "address": "/tmp/x2.sock",
+               "last_seen": time.monotonic()},
+    }
+
+    reply = run(ctrl.worker_death(mp.WorkerDeath(payload=json.dumps(
+        {"worker_id": "w1", "kind": "worker", "rc": -9}
+    ))))
+    info = json.loads(reply.payload)
+    assert info["roles"] == ["resolver0"]
+    # a transaction-path death flags the recovery walk NOW, with the
+    # push-attributed reason the chaos smoke pins
+    assert ctrl._needs_recovery
+    assert ctrl._recovery_reason == "push:resolver0"
+    assert ctrl.death_notifications == 1
+    # the dead worker can't be re-planned into the next generation
+    assert "w1" not in ctrl.workers
+    # the wake event cut the supervision sleep short
+    assert ctrl._wake.is_set()
+
+
+def test_worker_death_push_singleton_preloads_miss_budget():
+    """A non-transaction-path death (storage/ratekeeper singletons)
+    must NOT bump the generation; it pre-loads the heartbeat miss count
+    so the next failed poll — not the third — re-recruits."""
+    ctrl = mp.ClusterControllerRole({"resolvers": 1})
+    ctrl._needs_recovery = False
+    ctrl.assignments = {
+        "storage0": {"kind": "storage", "worker_id": "w2",
+                     "address": "/tmp/x2.sock", "epoch": 3},
+    }
+    ctrl.workers = {
+        "w2": {"worker_id": "w2", "address": "/tmp/x2.sock",
+               "last_seen": time.monotonic()},
+    }
+    run(ctrl.worker_death(mp.WorkerDeath(payload=json.dumps(
+        {"worker_id": "w2", "kind": "worker", "rc": -9}
+    ))))
+    assert not ctrl._needs_recovery  # singletons re-recruit, no epoch bump
+    assert ctrl._miss_counts["storage0"] >= ctrl.HEARTBEAT_MISSES

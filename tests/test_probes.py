@@ -542,6 +542,40 @@ def test_every_declared_probe_fires():
     assert rep["regressions"] == ["rows"]
     compile_cache._on_event(compile_cache._MISS_EVENT)
 
+    # -- range-path probes (ISSUE 14) -------------------------------------
+    # the sorted-endpoint sweep dispatching and the pressure spill
+    # folding delta into MAIN (delta sized so the conservative bound
+    # trips on the second batch)
+    import dataclasses as _dc
+
+    from foundationdb_tpu.config import KernelConfig
+    from foundationdb_tpu.models.conflict_set import TpuConflictSet
+    from foundationdb_tpu.models.types import CommitTransaction
+
+    sweep_cfg = _dc.replace(
+        KernelConfig(
+            max_key_bytes=8, max_txns=8, max_reads=16, max_writes=16,
+            history_capacity=256, window_versions=1000,
+        ),
+        delta_capacity=48, compact_interval=0,
+        range_sweep=True, delta_spill=True,
+    )
+    cs = TpuConflictSet(sweep_cfg)
+    for i in range(3):
+        cs.resolve(
+            [
+                CommitTransaction(
+                    read_conflict_ranges=[(bytes([0, j]), bytes([0, j + 40]))],
+                    write_conflict_ranges=[
+                        (bytes([1, 8 * i + j]), bytes([1, 8 * i + j, 1]))
+                    ],
+                    read_snapshot=900,
+                )
+                for j in range(4)
+            ],
+            1000 + 100 * i,
+        )
+
     assert probes.missed() == [], (
         f"declared CODE_PROBEs never fired: {probes.missed()}\n"
         f"fired: { {k: v for k, v in probes.snapshot().items() if v} }"

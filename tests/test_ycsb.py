@@ -1,0 +1,118 @@
+"""YCSB letter-suite batch generation (ISSUE 14 workload breadth).
+
+The generator must honor the packing layout contract (valid rows
+contiguous, txn ids nondecreasing, padding ids == B), classify to the
+expected contention profile (E = range_heavy — the profile that now
+stays on device with the sweep configured), and resolve decision-
+identically to the oracle through the sweep kernel.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.config import KernelConfig
+from foundationdb_tpu.models.conflict_set import (
+    backend_for_profile,
+    profile_batch,
+)
+from foundationdb_tpu.testing.benchgen import YCSB_MIXES, ycsb_batch
+
+
+def cfg(cap=1024):
+    return KernelConfig(
+        max_key_bytes=8, max_txns=cap, max_reads=cap, max_writes=cap,
+        history_capacity=12 * cap, window_versions=1_000_000,
+    )
+
+
+def gen(letter, n=1024, **kw):
+    rng = np.random.default_rng(5)
+    return ycsb_batch(
+        rng, cfg(), n, letter, version=200_000, keyspace=1_000_000,
+        snapshot_lag=400_000, insert_frontier=500_000, **kw,
+    )
+
+
+@pytest.mark.parametrize("letter", sorted(YCSB_MIXES))
+def test_layout_contract(letter):
+    b = gen(letter)
+    cap = cfg().max_txns
+    for txn, n, valid in (
+        (b.read_txn, b.n_reads, b.read_valid),
+        (b.write_txn, b.n_writes, b.write_valid),
+    ):
+        assert valid[:n].all() and not valid[n:].any()
+        if n:
+            assert (np.diff(txn[:n]) >= 0).all(), "txn ids nondecreasing"
+            assert (txn[:n] < cap).all()
+        assert (txn[n:] == cap).all(), "padding rows carry txn id == B"
+    # read-only letters carry no write rows at all
+    if YCSB_MIXES[letter][2] == 0.0:
+        assert b.n_writes == 0
+    # begins < ends on every valid row
+    for beg, end, n in ((b.read_begin, b.read_end, b.n_reads),
+                        (b.write_begin, b.write_end, b.n_writes)):
+        for r in range(min(n, 64)):
+            assert tuple(beg[r]) < tuple(end[r])
+
+
+def test_profiles_and_routing():
+    """E classifies range_heavy and stays on device exactly when the
+    sweep is configured; B's zipf updates classify hot_key."""
+    import dataclasses
+
+    assert profile_batch(gen("ycsb_e", zipf=1.1, scan_max=100)) == (
+        "range_heavy"
+    )
+    assert profile_batch(gen("ycsb_b", zipf=1.1)) == "hot_key"
+    sweep = dataclasses.replace(
+        cfg(), delta_capacity=4096, range_sweep=True, delta_spill=True
+    )
+    assert backend_for_profile("range_heavy", sweep) == "tpu"
+    assert backend_for_profile("range_heavy", cfg()) == "cpu"
+
+
+@pytest.mark.kernel
+def test_ycsb_e_sweep_oracle_parity():
+    """A YCSB-E stream through the sweep+spill kernel vs the native
+    skip-list baseline (the bench's decision-parity contract at small
+    shape)."""
+    import dataclasses
+
+    from foundationdb_tpu.models.conflict_set import TpuConflictSet
+    from foundationdb_tpu.native import NativeSkipListConflictSet
+    from foundationdb_tpu.testing.benchgen import flatten_for_native
+
+    config = dataclasses.replace(
+        KernelConfig(
+            max_key_bytes=8, max_txns=64, max_reads=64, max_writes=64,
+            history_capacity=1 << 10, window_versions=500_000,
+        ),
+        delta_capacity=256, compact_interval=0,
+        range_sweep=True, delta_spill=True,
+    )
+    rng = np.random.default_rng(12)
+    batches = [
+        ycsb_batch(
+            rng, config, 48, "ycsb_e", version=(i + 1) * 100_000,
+            keyspace=100_000, zipf=1.1, scan_max=100, snapshot_lag=200_000,
+        )
+        for i in range(6)
+    ]
+    cpu = NativeSkipListConflictSet(window=config.window_versions)
+    cs = TpuConflictSet(config)
+    for b in batches:
+        (rk, ro, rt), (wk, wo, wt) = (
+            flatten_for_native(b, "r"), flatten_for_native(b, "w")
+        )
+        want = cpu.resolve_raw(
+            int(b.version), b.snapshot[:48].astype(np.int64),
+            rk, ro, rt, wk, wo, wt,
+        )
+        got = np.asarray(cs.resolve_packed(b).verdict)[:48]
+        np.testing.assert_array_equal(got, want)
+    assert cs.metrics.counters.get("sweepGroups") == len(batches)
+    assert cs.metrics.counters.get("spills") > 0
+    assert cs.metrics.counters.get("exactFallbacks") == 0
