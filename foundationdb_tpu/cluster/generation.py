@@ -66,6 +66,25 @@ CONSERVATIVE_ABORT_RANGE = (b"", b"\xff\xff")
 #: RemoteError repr across the wire, matched by is_stale_epoch()
 STALE_EPOCH_MARKER = "stale_epoch"
 
+#: recovery-reason prefix for ELASTIC topology changes (ISSUE 15): the
+#: controller recruits one more instance of the role the Ratekeeper's
+#: binding limiter names, via the SAME generation-bumped recovery walk
+#: any configuration change drives (the reference's
+#: configuration-change-causes-recovery discipline). The drill and the
+#: perf ledger pin the prefix the way the chaos smoke pins "push:".
+ELASTIC_REASON_PREFIX = "elastic:"
+
+
+def elastic_reason(kind: str, new_count: int) -> str:
+    """The recovery reason an elastic recruit records, e.g.
+    "elastic:resolver->2" — reconstructable from the controller trace
+    like any other recovery reason."""
+    return f"{ELASTIC_REASON_PREFIX}{kind}->{new_count}"
+
+
+def is_elastic_reason(reason) -> bool:
+    return str(reason or "").startswith(ELASTIC_REASON_PREFIX)
+
 
 def recovery_version_for(*durable_versions: int) -> int:
     """The new generation's recovery version: strictly above anything

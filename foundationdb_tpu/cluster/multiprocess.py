@@ -33,6 +33,7 @@ import sys
 from typing import Any, Optional
 
 from foundationdb_tpu.cluster.grv_proxy import GrvThrottledError  # noqa: F401
+from foundationdb_tpu.utils.probes import code_probe, declare
 from foundationdb_tpu.models.types import (
     CommitTransaction,
     ResolveTransactionBatchReply,
@@ -40,6 +41,8 @@ from foundationdb_tpu.models.types import (
     TransactionResult,
 )
 from foundationdb_tpu.wire import codec, transport
+
+declare("controller.elastic_recruit")
 
 # ---------------------------------------------------------------------------
 # Well-known endpoint tokens (the WellKnownEndpoints.h analog).
@@ -405,6 +408,16 @@ WorkerDeath = _message(0x0262, "WorkerDeath", [("payload", "str")])
 WorkerDeathReply = _message(
     0x0263, "WorkerDeathReply", [("payload", "str")]
 )
+# ratekeeper -> proxy: PUSH-BASED RATE UPDATE (ISSUE 15, the PR-14
+# push-frame shape applied to the budget): when a control cycle moves
+# the budget past the push hysteresis (or flips the binding limiter),
+# the ratekeeper pushes the fresh GetRateInfo payload to every proxy
+# instead of waiting out the proxy's poll cadence — budget staleness
+# during overload ONSET drops from the fetch interval to one control
+# cycle. Polling remains the backstop (a dead pusher degrades to the
+# exact pre-r15 behavior, including the fail-safe decay).
+RateUpdate = _message(0x0264, "RateUpdate", [("payload", "str")])
+RateUpdateReply = _message(0x0265, "RateUpdateReply", [("payload", "str")])
 
 TOKEN_TLOG_VERSION = 0x0203
 TOKEN_STORAGE_VERSION = 0x0304
@@ -418,6 +431,7 @@ TOKEN_REGISTER_WORKER = 0x0601
 TOKEN_INIT_ROLE = 0x0602
 TOKEN_TOPOLOGY = 0x0603
 TOKEN_WORKER_DEATH = 0x0604
+TOKEN_RATE_UPDATE = 0x0605
 # client front door (proxy worker)
 TOKEN_CLIENT_GRV = 0x0701
 TOKEN_CLIENT_COMMIT = 0x0702
@@ -443,6 +457,63 @@ def _fence_epoch(req, role) -> None:
         raise transport.RemoteError(
             stale_epoch_message(req_epoch, role.epoch)
         )
+
+
+def default_resolver_boundaries(n: int) -> list[bytes]:
+    """Even byte-prefix keyspace split for n resolvers: the n-1
+    interior boundary keys. The SAME formula as
+    parallel/sharding.default_boundaries (pinned equal in
+    tests/test_elasticity.py) — duplicated here so the controller's
+    control-plane process never pays the jax import that module
+    carries."""
+    if not 1 <= n <= 256:
+        raise ValueError(f"resolver count must be in [1, 256], got {n}")
+    return [bytes([(256 * (i + 1)) // n]) for i in range(n - 1)]
+
+
+def resolver_key_ranges(boundaries: list[bytes]) -> list[tuple]:
+    """[(lo, hi_or_None)] partitions from n-1 interior split keys (the
+    parallel/sharding.default_boundaries shape): resolver i owns
+    [lo_i, hi_i), the last partition is unbounded above."""
+    lows = [b""] + list(boundaries)
+    highs = list(boundaries) + [None]
+    return list(zip(lows, highs))
+
+
+def clip_transactions(txns, lo: bytes, hi) -> list:
+    """The multi-resolver split (ISSUE 15): each resolver sees only the
+    conflict-range pieces inside its key partition — the reference's
+    ResolutionRequestBuilder (CommitProxyServer.actor.cpp:105-261),
+    exactly the clip `testing/oracle.MultiResolverOracle` models and
+    the mesh-sharded kernel runs on device. Slot alignment is
+    preserved: every transaction appears at its index in every
+    resolver's batch (the verdict min-combine needs aligned slots); a
+    txn with no local READS is a local blind write and votes COMMITTED
+    (its clipped local writes still merge into that resolver's history
+    on a local commit — the reference's phantom-commit semantics,
+    pinned against MultiResolverOracle in tests). Applies to the
+    stripped conflict-metadata hop only — mutations never travel on
+    the resolve hop."""
+
+    def clip(ranges):
+        out = []
+        for b, e in ranges:
+            cb = b if b > lo else lo
+            ce = e if hi is None or e < hi else hi
+            if cb < ce:
+                out.append((cb, ce))
+        return out
+
+    return [
+        CommitTransaction(
+            read_conflict_ranges=clip(t.read_conflict_ranges),
+            write_conflict_ranges=clip(t.write_conflict_ranges),
+            read_snapshot=t.read_snapshot,
+            report_conflicting_keys=t.report_conflicting_keys,
+            debug_id=t.debug_id,
+        )
+        for t in txns
+    ]
 
 
 def _decode_alloc_count(txns) -> int:
@@ -473,9 +544,17 @@ class ResolverRole:
     """
 
     def __init__(self, backend: str = "native", window: int = 5_000_000,
-                 epoch: int = 0):
+                 epoch: int = 0, compute_cost_per_txn: float = 0.0):
         self.version = -1
         self.window = window
+        #: modeled per-transaction compute seconds (the wire twin of the
+        #: sim Resolver.sim_compute_cost_per_txn, PR 8): awaited per
+        #: batch AFTER the real resolve, scaled by the txns that carry
+        #: LOCAL conflict work — under the multi-resolver split each
+        #: resolver pays only for its partition's rows, so the
+        #: elasticity drill's goodput genuinely scales with recruits.
+        #: 0.0 (production default) is a strict no-op.
+        self.compute_cost_per_txn = float(compute_cost_per_txn or 0.0)
         #: generation fencing: a recruited resolver belongs to ONE
         #: recovery generation; batches carrying any other epoch are
         #: rejected retryably (cluster/generation.py). 0 = unfenced
@@ -691,6 +770,16 @@ class ResolverRole:
                 )
             t_compute = _time.perf_counter()
             reply = self._resolve_now(req)
+            if self.compute_cost_per_txn > 0.0:
+                # modeled compute rides the version chain exactly like
+                # real compute (successors wait on the condition), but
+                # as an await so the role process keeps serving status
+                # polls — occupancy and compute_time absorb it below,
+                # which is what makes the Ratekeeper's resolver_busy
+                # attribution (and the elasticity drill's plateau) real
+                await asyncio.sleep(
+                    self.compute_cost_per_txn * self._local_txns(req)
+                )
             dt_compute = _time.perf_counter() - t_compute
             self.compute_time.sample(dt_compute)
             self.occupancy.add_delta(dt_compute)
@@ -704,6 +793,22 @@ class ResolverRole:
             self.version = req.version
             cond.notify_all()
             return reply
+
+    def _local_txns(self, req) -> int:
+        """Transactions in this batch carrying LOCAL conflict work —
+        the modeled-compute multiplier. Under the proxy-side
+        multi-resolver split, foreign-partition txns arrive with zero
+        ranges (slot-aligned local blind writes) and cost nothing."""
+        if isinstance(req, codec.ResolveBatchColumnar):
+            cols = req.cols
+            return sum(
+                1 for i in range(cols.n_txns)
+                if int(cols.read_counts[i]) + int(cols.write_counts[i]) > 0
+            )
+        return sum(
+            1 for t in req.transactions
+            if t.read_conflict_ranges or t.write_conflict_ranges
+        )
 
     def _trace_columnar_decode(self, req) -> None:
         """The Resolver.resolveBatch.ColumnarDecode micro-event: fired
@@ -1743,6 +1848,18 @@ class RatekeeperRole:
         self._controller_conns: dict = {}  # _cached_call cache
         self.peer_refreshes = 0
         self.topology_epoch = 0
+        # -- push-based rate updates (ISSUE 15): when a control cycle
+        # moves the budget past the hysteresis threshold (or flips the
+        # binding limiter / staleness), the fresh GetRateInfo payload
+        # is PUSHED to every proxy in the topology instead of waiting
+        # out the proxies' poll cadence. Threshold semantics mirror the
+        # law's own hysteresis discipline: small drift never floods the
+        # wire, overload onset lands in one control cycle.
+        self.push_threshold = 0.15
+        self.rate_pushes = 0
+        self.rate_push_failures = 0
+        self._proxy_addrs: list[str] = []
+        self._last_pushed: dict | None = None
         #: last cycle's observed GRV admission rate (the law's
         #: actualTps input) — surfaced in status so the wire feedback
         #: path is testable end to end
@@ -1803,6 +1920,13 @@ class RatekeeperRole:
                 entry["address"]
                 for entry in topo.get("roles", {}).values()
                 if entry.get("kind") != "ratekeeper"
+            }
+        )
+        self._proxy_addrs = sorted(
+            {
+                entry["address"]
+                for entry in topo.get("roles", {}).values()
+                if entry.get("kind") == "proxy"
             }
         )
         if peers and peers != self.peers:
@@ -1870,7 +1994,71 @@ class RatekeeperRole:
                 self.law.decay()
             else:
                 self.law.update(slots, current_tps=current_tps)
+            await self._maybe_push_rate()
             await asyncio.sleep(self.interval)
+
+    def _push_due(self) -> bool:
+        """Hysteresis: push only when the budget moved by more than
+        push_threshold relative to the last delivered value, or the
+        binding limiter / staleness flipped — overload ONSET is exactly
+        a limiter flip plus a large budget drop, so it always pushes."""
+        info = self.law.rate_info()
+        last = self._last_pushed
+        if last is None:
+            return True
+        budget = info["transactions_per_second_limit"]
+        moved = abs(budget - last["budget"]) > (
+            self.push_threshold * max(last["budget"], self.law.min_tps)
+        )
+        return (
+            moved
+            or info["budget_limited_by"]["name"] != last["limiter"]
+            or bool(info["budget_stale"]) != last["stale"]
+        )
+
+    async def _maybe_push_rate(self) -> None:
+        import json as _json
+
+        if not self._proxy_addrs or not self._push_due():
+            return
+        info = self.law.rate_info()
+        # fence stamp: the generation this pusher believes is live
+        # (ProxyRole.rate_update rejects a mismatch — a superseded
+        # ratekeeper cannot override the new generation's budget)
+        info["epoch"] = self.topology_epoch
+        payload = _json.dumps(info)
+        # pushes go out CONCURRENTLY, like the sensor polls above: one
+        # dead/hung proxy (2s call timeout) bounds this step at the
+        # slowest single push, not the sum — a serial loop would stall
+        # the control cadence on exactly the overload-onset cycles the
+        # push exists to speed up
+        results = await asyncio.gather(
+            *(
+                _cached_call(
+                    self._conns, addr, TOKEN_RATE_UPDATE,
+                    RateUpdate(payload=payload), timeout=2.0, retries=1,
+                )
+                for addr in self._proxy_addrs
+            ),
+            return_exceptions=True,
+        )
+        delivered = False
+        for res in results:
+            if isinstance(res, asyncio.CancelledError):
+                raise res
+            if isinstance(res, BaseException):
+                # a proxy that can't be pushed still has its poll loop
+                # (the backstop) — count and continue
+                self.rate_push_failures += 1
+            else:
+                self.rate_pushes += 1
+                delivered = True
+        if delivered:
+            self._last_pushed = {
+                "budget": info["transactions_per_second_limit"],
+                "limiter": info["budget_limited_by"]["name"],
+                "stale": bool(info["budget_stale"]),
+            }
 
     async def get_rate_info(
         self, _req: GetRateInfoRequest
@@ -1890,6 +2078,8 @@ class RatekeeperRole:
                 "peer_refreshes": self.peer_refreshes,
                 "topology_epoch": self.topology_epoch,
                 "observed_grv_per_s": self.observed_grv_per_s,
+                "rate_pushes": self.rate_pushes,
+                "rate_push_failures": self.rate_push_failures,
             },
         }
 
@@ -1964,6 +2154,9 @@ class ProxyRole:
         self.recovered = False
         self.pipeline: ProxyPipeline | None = None
         self._conns: list[transport.RpcConnection] = []
+        #: rate pushes rejected by the epoch fence (a superseded
+        #: ratekeeper still pushing) — surfaced in status
+        self.stale_rate_pushes = 0
 
     async def start(self) -> None:
         topo = self.spec["topology"]
@@ -1974,6 +2167,13 @@ class ProxyRole:
         if topo.get("ratekeeper"):
             rk = await connect(topo["ratekeeper"])
         self._conns = [*resolvers, tlog, storage] + ([rk] if rk else [])
+        # resolver partition boundaries (hex-encoded in the topology
+        # JSON; the controller re-derives them on every resolver-count
+        # change — the elastic-recruit path's multi-resolver split)
+        boundaries = [
+            bytes.fromhex(h)
+            for h in topo.get("resolver_boundaries") or []
+        ]
         self.pipeline = ProxyPipeline(
             resolvers,
             tlog,
@@ -1984,6 +2184,7 @@ class ProxyRole:
             epoch=self.epoch,
             ratekeeper=rk,
             trace=bool(self.spec.get("trace", False)),
+            resolver_boundaries=boundaries or None,
         )
         self.pipeline.start()
         if self.spec.get("recover", True):
@@ -2033,12 +2234,43 @@ class ProxyRole:
         v = await self.pipeline.read(req.key, req.version)
         return ClientReadReply(value=v)
 
+    async def rate_update(self, req: "RateUpdate") -> "RateUpdateReply":
+        """Push-based budget delivery (ISSUE 15): the ratekeeper calls
+        this the cycle the budget moves past its push hysteresis; the
+        pipeline applies it exactly like a poll result. The poll loop
+        keeps running as the backstop.
+
+        EPOCH-FENCED like every other control frame: the pusher stamps
+        its topology epoch, and a mismatch is rejected retryably — a
+        superseded-but-alive ratekeeper (re-recruited away after a
+        clog) must not keep overriding the live generation's budget
+        (its pushes would even clear the fail-safe staleness a dead
+        feed is supposed to engage). Epoch 0 == unfenced standalone
+        deployment, matching the resolve/tlog fencing convention."""
+        import json as _json
+
+        info = _json.loads(req.payload)
+        push_epoch = int(info.get("epoch", 0))
+        if push_epoch != self.epoch:
+            from foundationdb_tpu.cluster.generation import (
+                stale_epoch_message,
+            )
+
+            self.stale_rate_pushes += 1
+            raise transport.RemoteError(
+                stale_epoch_message(push_epoch, self.epoch)
+            )
+        self.pipeline.apply_rate_info(info)
+        self.pipeline.rate_pushes_applied += 1
+        return RateUpdateReply(payload=_json.dumps({"ok": True}))
+
     def status(self) -> dict:
         block = _pipeline_status_blocks(self.pipeline)
         payload = block["proxy0"]
         payload["grv_proxy"] = block["grv_proxy0"]
         payload["epoch"] = self.epoch
         payload["recovered"] = self.recovered
+        payload["stale_rate_pushes"] = self.stale_rate_pushes
         return payload
 
 
@@ -2156,7 +2388,10 @@ class WorkerRole:
             if spec.get("resolver_kernel"):
                 os.environ["RESOLVER_KERNEL"] = spec["resolver_kernel"]
             role = ResolverRole(
-                backend=spec.get("backend", "native"), epoch=epoch
+                backend=spec.get("backend", "native"), epoch=epoch,
+                compute_cost_per_txn=float(
+                    spec.get("compute_cost_per_txn") or 0.0
+                ),
             )
             return role, {}
         if kind == "tlog":
@@ -2243,6 +2478,7 @@ class WorkerRole:
         server.register(TOKEN_CLIENT_GRV, route("proxy", "client_grv"))
         server.register(TOKEN_CLIENT_COMMIT, route("proxy", "client_commit"))
         server.register(TOKEN_CLIENT_READ, route("proxy", "client_read"))
+        server.register(TOKEN_RATE_UPDATE, route("proxy", "rate_update"))
 
 
 class ClusterControllerRole:
@@ -2294,6 +2530,37 @@ class ClusterControllerRole:
         self._miss_counts: dict[str, int] = {}
         self._conns: dict[str, transport.RpcConnection] = {}
         self._task: asyncio.Task | None = None
+        # -- elastic topology (ISSUE 15): when the Ratekeeper's binding
+        # limiter names resolver occupancy/queueing for `elastic_streak`
+        # consecutive control intervals (the law's own binding_streak
+        # counter, read off the ratekeeper's heartbeat status), the
+        # controller plans a topology with ONE MORE resolver and drives
+        # the normal generation-bumped recovery walk to recruit it live
+        # — the reference's configuration-change-causes-recovery
+        # discipline, with Ratekeeper turned from a brake into a
+        # scaling signal. Capped at elastic_max_resolvers; OFF by
+        # default (conf "elastic": true arms it).
+        self.elastic_enabled = bool(conf.get("elastic", False))
+        self.elastic_max_resolvers = int(
+            conf.get("elastic_max_resolvers", 2)
+        )
+        self.elastic_streak = int(conf.get("elastic_streak", 4))
+        #: limiter names that mean "another resolver would help"
+        self.ELASTIC_RESOLVER_REASONS = ("resolver_busy", "resolver_queue")
+        self.elastic_recruits = 0
+        self.elastic_last_streak = 0
+        self.elastic_last_limiter = None
+        self._rk_qos: dict = {}
+        #: the streak value a trigger must reach. Normally
+        #: elastic_streak; after a recruit it is raised to
+        #: (streak-at-recruit + elastic_streak) because the surviving
+        #: ratekeeper's law carries its streak ACROSS the recovery — a
+        #: still-binding limiter must hold for elastic_streak FRESH
+        #: post-recruit intervals (proof the previous recruit didn't
+        #: help) before the next one, never chain off the old streak.
+        #: A streak reset observed in between restores the normal gate.
+        self._elastic_gate = self.elastic_streak
+        self._elastic_last_observed = 0
         #: set by worker_death to cut the supervision loop's sleep short
         #: — a pushed death starts the recovery walk on the next loop
         #: iteration, not up to check_interval later
@@ -2419,6 +2686,14 @@ class ClusterControllerRole:
                 "last_recovery_s": self.last_recovery_s,
                 "last_recovery_reason": self.last_recovery_reason,
                 "death_notifications": self.death_notifications,
+                # elastic topology (ISSUE 15) — the fdbtop panel's and
+                # the drill's observability surface
+                "elastic_enabled": self.elastic_enabled,
+                "elastic_recruits": self.elastic_recruits,
+                "elastic_streak_needed": self.elastic_streak,
+                "elastic_last_streak": self.elastic_last_streak,
+                "elastic_last_limiter": self.elastic_last_limiter,
+                "resolvers_planned": int(self.conf.get("resolvers", 1)),
                 "workers_registered": len(self.workers),
                 "workers_live": len(self._live_workers()),
                 "roles_recruited": len(self.assignments),
@@ -2628,6 +2903,7 @@ class ClusterControllerRole:
             await self._init_role(place, {
                 "backend": conf.get("backend", "native"),
                 "resolver_kernel": conf.get("resolver_kernel"),
+                "compute_cost_per_txn": conf.get("resolver_compute_cost"),
             })
             await self._worker_call(
                 place["address"], TOKEN_RESOLVE,
@@ -2640,8 +2916,18 @@ class ClusterControllerRole:
             )
         # 4. Ratekeeper: a singleton, re-recruited only if dead (it
         #    re-resolves peers from our topology each control cycle).
+        #    The resolver-count change (elastic recruit or conf edit)
+        #    RE-DERIVES the keyspace split here: N resolvers get the
+        #    even byte-prefix boundaries (the ResolutionBalancer's
+        #    key-sample feed is the remaining headroom), and the new
+        #    proxy clips every batch to them — so a recruit genuinely
+        #    divides conflict work instead of broadcasting it N times.
         topo_addrs = {
             "resolvers": [p["address"] for p in resolver_places],
+            "resolver_boundaries": [
+                b.hex()
+                for b in default_resolver_boundaries(len(resolver_places))
+            ],
             "tlog": tlog["address"],
             "storage": storage["address"],
         }
@@ -2712,6 +2998,12 @@ class ClusterControllerRole:
                 block = _json.loads(reply.payload)
             except Exception:
                 return name, False
+            if a["kind"] == "ratekeeper":
+                # heartbeats double as sensor reads: the ratekeeper's
+                # qos carries the law's budget + binding_streak — the
+                # elasticity trigger's input (stale entries age out via
+                # the budget_stale flag the law itself sets)
+                self._rk_qos = block.get("qos") or {}
             hosted = block.get("role_epochs") or {}
             return name, hosted.get(a["kind"]) == a["epoch"]
 
@@ -2771,6 +3063,11 @@ class ClusterControllerRole:
                     else:
                         for name in dead:
                             await self._rerecruit_singleton(name)
+                        if not dead:
+                            # only a HEALTHY pass may scale: a dying
+                            # role's missing occupancy feed can read as
+                            # a saturated survivor for a cycle
+                            self._elastic_check()
             except asyncio.CancelledError:
                 raise
             except Exception as e:
@@ -2787,6 +3084,70 @@ class ClusterControllerRole:
             except asyncio.TimeoutError:
                 pass
             self._wake.clear()
+
+    def _elastic_check(self) -> None:
+        """The elasticity trigger (ISSUE 15): read the admission law's
+        binding_streak off the ratekeeper's last heartbeat status; when
+        a resolver-shaped limiter has been binding for elastic_streak
+        consecutive control intervals (and the budget is not running on
+        stale sensors), plan a topology with ONE MORE resolver and flag
+        the generation-bumped recovery walk — the recruit happens
+        through the exact code path any configuration change takes, so
+        epoch fencing, the conservative abort and the boundary
+        re-derivation all apply unchanged."""
+        from foundationdb_tpu.cluster.generation import elastic_reason
+
+        if not self.elastic_enabled or self._needs_recovery:
+            return
+        qos = self._rk_qos or {}
+        streak = qos.get("binding_streak") or {}
+        limiter = streak.get("name")
+        self.elastic_last_limiter = limiter
+        if qos.get("budget_stale") or limiter not in \
+                self.ELASTIC_RESOLVER_REASONS:
+            self.elastic_last_streak = 0
+            self._elastic_last_observed = 0
+            self._elastic_gate = self.elastic_streak
+            return
+        self.elastic_last_streak = int(streak.get("intervals", 0))
+        if self.elastic_last_streak < self._elastic_last_observed:
+            # the law's streak restarted since the last look (the
+            # limiter released and re-engaged): the post-recruit gate
+            # no longer applies — this is a fresh signal
+            self._elastic_gate = self.elastic_streak
+        self._elastic_last_observed = self.elastic_last_streak
+        if self.elastic_last_streak < self._elastic_gate:
+            return
+        current = int(self.conf.get("resolvers", 1))
+        if current >= self.elastic_max_resolvers:
+            return
+        from foundationdb_tpu.utils.trace import SEV_WARN_ALWAYS, TraceEvent
+
+        self.conf["resolvers"] = current + 1
+        self.elastic_recruits += 1
+        # the snapshot that fired this trigger must not fire the next
+        # one: drop it, AND raise the gate past the law's surviving
+        # streak — the ratekeeper outlives the recovery walk with its
+        # counter intact, so the next recruit needs elastic_streak
+        # FRESH intervals on top (or a reset, handled above)
+        self._rk_qos = {}
+        self._elastic_gate = self.elastic_last_streak + self.elastic_streak
+        self._needs_recovery = True
+        self._recovery_reason = elastic_reason("resolver", current + 1)
+        # cut the supervision sleep short, like a pushed worker death:
+        # the recovery walk (loop top) starts next iteration, not up
+        # to check_interval later
+        self._wake.set()
+        code_probe(True, "controller.elastic_recruit")
+        TraceEvent(
+            "ElasticRecruitPlanned", severity=SEV_WARN_ALWAYS
+        ).detail("Kind", "resolver").detail(
+            "From", current
+        ).detail("To", current + 1).detail(
+            "Limiter", limiter
+        ).detail("StreakIntervals", self.elastic_last_streak).detail(
+            "Epoch", self.gen.epoch
+        ).log()
 
     async def _rerecruit_singleton(self, name: str) -> None:
         """Non-transaction-path roles (storage, ratekeeper) re-recruit
@@ -3394,6 +3755,7 @@ class ProxyPipeline:
         max_grv_queue: int = None,
         resolve_columnar: bool = None,
         epoch: int = 0,
+        resolver_boundaries: list = None,
     ):
         from foundationdb_tpu.cluster.batching import AdaptiveBatchSizer
         from foundationdb_tpu.utils.knobs import SERVER_KNOBS as _K
@@ -3401,6 +3763,26 @@ class ProxyPipeline:
         self.resolvers = resolvers
         self.tlog = tlog
         self.storage = storage
+        # -- multi-resolver keyspace split (ISSUE 15): with N > 1
+        # resolvers and boundaries (N-1 interior split keys, re-derived
+        # by the controller on every resolver-count change), each
+        # resolver receives the batch with its conflict ranges CLIPPED
+        # to its partition (clip_transactions — the reference's
+        # ResolutionRequestBuilder), so per-resolver conflict work
+        # scales down with recruits. No boundaries (or a single
+        # resolver) keeps the pre-r15 full-broadcast behavior.
+        if resolver_boundaries and len(resolvers) > 1:
+            if len(resolver_boundaries) != len(resolvers) - 1:
+                raise ValueError(
+                    f"{len(resolvers)} resolver(s) need "
+                    f"{len(resolvers) - 1} boundary key(s), got "
+                    f"{len(resolver_boundaries)}"
+                )
+            self._resolver_ranges = resolver_key_ranges(
+                list(resolver_boundaries)
+            )
+        else:
+            self._resolver_ranges = None
         #: this proxy generation's recovery epoch, stamped on every
         #: resolve frame and tlog push — resolvers/tlogs of another
         #: generation reject them retryably (stale_epoch), so a fenced
@@ -3442,6 +3824,10 @@ class ProxyPipeline:
         self._grv_next_slot = 0.0
         self.grv_sheds = 0
         self.grv_throttle_waits = 0
+        #: push-based rate updates applied (ISSUE 15): the ratekeeper
+        #: pushes GetRateInfo deltas past a hysteresis threshold; the
+        #: poll loop stays as the backstop
+        self.rate_pushes_applied = 0
         self.version_step = version_step
         self.batch_interval = batch_interval
         self.max_batch = max_batch
@@ -3585,19 +3971,7 @@ class ProxyPipeline:
                     TOKEN_GET_RATE_INFO, GetRateInfoRequest(pad=0),
                     timeout=2.0,
                 )
-                info = _json.loads(rep.payload)
-                self._rate_limit = float(
-                    info["transactions_per_second_limit"]
-                )
-                self._rate_floor = float(
-                    info.get("failsafe_tps", self._rate_floor)
-                )
-                self._rate_tau = float(
-                    info.get("failsafe_tau", self._rate_tau)
-                )
-                self._rate_info = info
-                self._rate_failures = 0
-                self._rate_stale = False
+                self.apply_rate_info(_json.loads(rep.payload))
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -3613,6 +3987,20 @@ class ProxyPipeline:
                             * _math.exp(-dt / self._rate_tau),
                         )
             await asyncio.sleep(self._rate_interval)
+
+    def apply_rate_info(self, info: dict) -> None:
+        """Apply one GetRateInfo payload — shared by the poll loop and
+        the ratekeeper's push path (ISSUE 15). A push counts as a fresh
+        feed: it clears the staleness/decay state exactly like a
+        successful poll, so during overload onset the enforced budget
+        tracks the control loop at one control-cycle latency instead of
+        the fetch cadence."""
+        self._rate_limit = float(info["transactions_per_second_limit"])
+        self._rate_floor = float(info.get("failsafe_tps", self._rate_floor))
+        self._rate_tau = float(info.get("failsafe_tau", self._rate_tau))
+        self._rate_info = info
+        self._rate_failures = 0
+        self._rate_stale = False
 
     def _grv_backlog(self) -> int:
         """Requests currently parked in the admission throttle (the
@@ -3712,6 +4100,7 @@ class ProxyPipeline:
             "budget_stale": self._rate_stale,
             "sheds": self.grv_sheds,
             "throttle_waits": self.grv_throttle_waits,
+            "rate_pushes_applied": self.rate_pushes_applied,
             "max_queue": self.max_grv_queue,
         }
 
@@ -3953,48 +4342,71 @@ class ProxyPipeline:
         # flat interval-array layout the resolver kernel consumes —
         # per-txn counts + versions + one joined key blob — instead of
         # per-txn objects the resolver would re-flatten.
+        # the multi-resolver split applies on the stripped
+        # conflict-metadata hop only: with RESOLVE_STRIP=0 (mutations
+        # on the wire for A/B) every resolver still needs the full
+        # transactions, so the split degrades to the broadcast
+        if self._resolver_ranges is not None and _RESOLVE_STRIP:
+            txn_views = [
+                clip_transactions(txns, lo, hi)
+                for lo, hi in self._resolver_ranges
+            ]
+        else:
+            txn_views = None
+        span_tuple = span.context.as_tuple() if span is not None else None
         if self._columnar:
             from foundationdb_tpu.utils import packing as _packing
 
-            req = codec.ResolveBatchColumnar(
-                prev_version=prev_version,
-                version=version,
-                last_received_version=prev_version,
-                epoch=self.epoch,
-                cols=_packing.pack_columnar(txns),
-                debug_id=dbg,
-                span=span.context.as_tuple() if span is not None else None,
-            )
+            def columnar_req(view):
+                return codec.ResolveBatchColumnar(
+                    prev_version=prev_version,
+                    version=version,
+                    last_received_version=prev_version,
+                    epoch=self.epoch,
+                    cols=_packing.pack_columnar(view),
+                    debug_id=dbg,
+                    span=span_tuple,
+                )
+
+            if txn_views is None:
+                reqs = [columnar_req(txns)] * len(self.resolvers)
+            else:
+                reqs = [columnar_req(view) for view in txn_views]
             if dbg is not None:
                 _tr.g_trace_batch.add_event(
                     "CommitDebug", dbg, _cdbg.PROXY_COLUMNAR_PACK
                 )
         else:
-            req = ResolveTransactionBatchRequest(
-                prev_version=prev_version,
-                version=version,
-                last_received_version=prev_version,
-                epoch=self.epoch,
-                transactions=(
-                    [
-                        CommitTransaction(
-                            read_conflict_ranges=t.read_conflict_ranges,
-                            write_conflict_ranges=t.write_conflict_ranges,
-                            read_snapshot=t.read_snapshot,
-                            report_conflicting_keys=t.report_conflicting_keys,
-                            debug_id=t.debug_id,
-                        )
-                        for t in txns
-                    ]
-                    if _RESOLVE_STRIP
-                    else txns
-                ),
-                debug_id=dbg,
-                span=span.context.as_tuple() if span is not None else None,
-            )
+            def object_req(view):
+                return ResolveTransactionBatchRequest(
+                    prev_version=prev_version,
+                    version=version,
+                    last_received_version=prev_version,
+                    epoch=self.epoch,
+                    transactions=view,
+                    debug_id=dbg,
+                    span=span_tuple,
+                )
+
+            if txn_views is not None:
+                reqs = [object_req(view) for view in txn_views]
+            elif _RESOLVE_STRIP:
+                reqs = [object_req([
+                    CommitTransaction(
+                        read_conflict_ranges=t.read_conflict_ranges,
+                        write_conflict_ranges=t.write_conflict_ranges,
+                        read_snapshot=t.read_snapshot,
+                        report_conflicting_keys=t.report_conflicting_keys,
+                        debug_id=t.debug_id,
+                    )
+                    for t in txns
+                ])] * len(self.resolvers)
+            else:
+                reqs = [object_req(txns)] * len(self.resolvers)
         t_resolve = loop.time()
         replies = await asyncio.gather(
-            *(r.call(TOKEN_RESOLVE, req) for r in self.resolvers)
+            *(r.call(TOKEN_RESOLVE, req)
+              for r, req in zip(self.resolvers, reqs))
         )
         resolve_s = loop.time() - t_resolve
         if dbg is not None:
@@ -4173,6 +4585,14 @@ def serve_status(
 
 
 def main() -> None:
+    # autotune trial hook (ISSUE 15): role PROCESSES apply the same
+    # FDBTPU_KNOB_OVERRIDES env points as the bench_pipeline parent —
+    # a server-knob trial consumed inside a spawned role (resolver /
+    # tlog / storage) must actually take effect in that process, not
+    # silently run defaults while the ledger row claims otherwise
+    from foundationdb_tpu.utils.knobs import SERVER_KNOBS
+
+    SERVER_KNOBS.apply_env_overrides()
     ap = argparse.ArgumentParser()
     ap.add_argument("--role", required=True)
     ap.add_argument("--address", required=True)

@@ -135,6 +135,14 @@ class AdmissionController:
             "reason_server_id": "",
             "tps_limit": max_tps,
         }
+        #: consecutive control intervals the SAME limiter has been
+        #: binding — the elasticity trigger's input (ISSUE 15: a
+        #: resolver_busy streak past the controller's threshold recruits
+        #: another resolver; one counter in the law so sim and wire
+        #: consumers read the identical signal). "workload" streaks are
+        #: tracked too (they read as "nothing is binding for N
+        #: intervals" — the scale-down signal a future PR could spend).
+        self.binding_streak = {"name": "workload", "intervals": 0}
         self.stale = False
         self._decay_from = clock()
 
@@ -217,6 +225,7 @@ class AdmissionController:
                 "reason_server_id": "",
                 "tps_limit": self.min_tps,
             }
+            self._note_binding("ratekeeper_failsafe")
             code_probe(True, "ratekeeper.failsafe")
             return self.tps_budget
         self.stale = False
@@ -281,7 +290,19 @@ class AdmissionController:
             "reason_server_id": binding[1],
             "tps_limit": binding[2],
         }
+        self._note_binding(binding[0])
         return self.tps_budget
+
+    def _note_binding(self, name: str) -> None:
+        """Advance the binding-limiter streak: +1 while the same reason
+        stays binding, reset to 1 on a change. Streaks key on the
+        REASON only (not the process): two saturated resolvers trading
+        the worst-occupancy crown are one continuous resolver_busy
+        signal, which is exactly when recruiting another helps."""
+        if self.binding_streak["name"] == name:
+            self.binding_streak["intervals"] += 1
+        else:
+            self.binding_streak = {"name": name, "intervals": 1}
 
     def _decay_locked(self, now: float) -> float:
         dt = max(0.0, now - self._decay_from)
@@ -297,6 +318,9 @@ class AdmissionController:
             "reason_server_id": "",
             "tps_limit": self.tps_budget,
         }
+        # a stale feed interrupts whatever streak was building: the
+        # elasticity trigger must never recruit off dead sensors
+        self._note_binding("ratekeeper_failsafe")
         code_probe(True, "ratekeeper.failsafe")
         return self.tps_budget
 
@@ -311,6 +335,7 @@ class AdmissionController:
         return {
             "transactions_per_second_limit": self.tps_budget,
             "budget_limited_by": dict(self.limited_by),
+            "binding_streak": dict(self.binding_streak),
             "budget_stale": self.stale,
             "failsafe_tps": self.failsafe_tps,
             "failsafe_tau": self.failsafe_tau,

@@ -83,6 +83,11 @@ class KernelStageMetrics:
                 # pressure-triggered subset — the "no raise, no host
                 # re-dispatch" accounting the ISSUE-14 gate pins
                 "spills",
+                # overflow-check syncs where the measured live delta
+                # occupancy tightened the host-side spill bound (ISSUE
+                # 15 — the PR-14 headroom (b) fix: real occupancy, not
+                # the 2*max_writes worst case, drives pressure spills)
+                "spillBoundAnchors",
                 # groups dispatched through the sorted-endpoint sweep
                 # probe (range_sweep) — the range-path structural count
                 "sweepGroups",
@@ -984,6 +989,28 @@ class TpuConflictSet:
             fn = _resolve_group_jit(ssl, unroll, latch)
         return _perf.cost_analysis_of(fn, self.state, stacked_args)
 
+    def _re_anchor_spill_bound(self, d_live: float) -> None:
+        """ISSUE 15 (ROADMAP PR-14 headroom (b)): tighten the delta_spill
+        pressure bound to the REAL delta occupancy, piggybacked on the
+        sync the overflow check already paid — zero extra fences.
+
+        The host bound accrues 2*max_writes per dispatched batch
+        (duplicate keys and merged ranges make the true boundary count
+        far smaller on most streams); at this sync every dispatched
+        batch has completed, so the measured live boundary count IS the
+        exact occupancy the bound conservatively over-estimates.
+        Re-anchoring to min(bound, live) keeps the bound conservative
+        (batches dispatched after the sync keep accruing the worst
+        case) while shedding the accumulated over-estimate — ~2x fewer
+        pressure spills on overlapping-write streams, with DECISIONS
+        UNCHANGED (spill timing only moves compaction points, and
+        decisions are compaction-cadence invariant — pinned in
+        tests/test_range_sweep.py)."""
+        bound = int(d_live)
+        if bound < self._spill_bound_rows:
+            self._spill_bound_rows = bound
+            self.metrics.counters.add("spillBoundAnchors")
+
     def _maybe_check_overflow(self) -> None:
         self._batches_since_check += 1
         if self._batches_since_check >= OVERFLOW_CHECK_INTERVAL:
@@ -1001,8 +1028,10 @@ class TpuConflictSet:
                 bool(np.asarray(self.state.delta.overflow).any())
             )
             m_cnt, d_cnt = _D.boundary_counts_per_shard(self.state)
+            d_live = float(np.asarray(d_cnt).max())
             self.metrics.main_occupancy.sample(float(np.asarray(m_cnt).max()))
-            self.metrics.delta_occupancy.sample(float(np.asarray(d_cnt).max()))
+            self.metrics.delta_occupancy.sample(d_live)
+            self._re_anchor_spill_bound(d_live)
             self._sample_collective()
         elif self.tiered:
             tripped = bool(np.asarray(self.state.main.overflow)) or bool(
@@ -1011,8 +1040,10 @@ class TpuConflictSet:
             # tier-occupancy sampling rides the sync this check already
             # paid — two more scalar pulls, no extra fence
             m_cnt, d_cnt = _D.boundary_counts(self.state)
+            d_live = float(np.asarray(d_cnt))
             self.metrics.main_occupancy.sample(float(np.asarray(m_cnt)))
-            self.metrics.delta_occupancy.sample(float(np.asarray(d_cnt)))
+            self.metrics.delta_occupancy.sample(d_live)
+            self._re_anchor_spill_bound(d_live)
         else:
             tripped = bool(np.asarray(self.state.overflow))
         # device-memory gauges ride the same sync (allocator stats are

@@ -32,6 +32,13 @@ declare(
     "workload.sideband_checked",
     "workload.atomic_sum_checked",
     "workload.backup_restored",
+    # ISSUE 15: the ycsb_d soak twin (ROADMAP PR-14 headroom (d)) — a
+    # read-latest workload whose insert frontier PERSISTS across
+    # batches inside the fault ensemble (bench ycsb_d's frontier resets
+    # per run; here a read landing on a key inserted in an EARLIER
+    # round proves cross-batch persistence through the chaos)
+    "workload.ycsb_d_read_latest_checked",
+    "workload.ycsb_d_frontier_persisted",
 )
 
 
@@ -114,6 +121,11 @@ class SeedPlan:
     #                            alternates backends so the TPU kernel
     #                            runs inside the fault ensemble
     spec_name: str             # which spec derived this plan
+    # ISSUE 15 (append-only, defaulted: pre-r15 call sites and plans
+    # are untouched): the ycsb_d read-latest workload — an insert
+    # frontier advancing over CONSECUTIVE fresh keys that persists
+    # across rounds, with exponentially-recent reads model-checked
+    ycsb_d: bool = False
 
 
 def plan_for_seed(seed: int, spec=None) -> SeedPlan:
@@ -485,6 +497,77 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
                 except retryable:
                     await sched.delay(0.01)
                 if rng.random() < 0.3:
+                    await sched.delay(0.02)
+
+        # ycsb_d soak twin (ISSUE 15, ROADMAP PR-14 headroom (d)): the
+        # read-latest insert-frontier workload under the fault mix.
+        # Single-writer state (one actor owns it), so a plain dict:
+        # frontier = next fresh index; allowed[idx] = the value set a
+        # read may legally observe ({v} definite, {None, v} unknown
+        # fate); round_of[idx] = the round that FIRST reserved idx (a
+        # later-round read hitting it proves the frontier persisted
+        # across batches — the thing bench ycsb_d resets per run).
+        yd_state = {"frontier": 0, "allowed": {}, "round_of": {}}
+
+        async def ycsb_d_flow():
+            rng_d = np.random.default_rng(seed ^ 0xD00D)
+            for i in range(plan.rounds):
+                txn = db.create_transaction()
+                base = yd_state["frontier"]
+                n_ins = int(rng_d.integers(1, 3))
+                idxs = list(range(base, base + n_ins))
+                try:
+                    if base > 0 and rng_d.random() < 0.7:
+                        # read-latest: exponentially-recent index
+                        # behind the frontier (the YCSB-D access law)
+                        off = int(min(base - 1, rng_d.exponential(3.0)))
+                        idx = base - 1 - off
+                        got = await txn.get(b"yd%06d" % idx)
+                        allowed = yd_state["allowed"].get(idx, {None})
+                        assert got in allowed, (
+                            f"seed {seed}: ycsb_d read idx {idx} = "
+                            f"{got!r} not in {allowed}"
+                        )
+                        code_probe(
+                            True, "workload.ycsb_d_read_latest_checked"
+                        )
+                        # the frontier PERSISTED: the read landed on an
+                        # insert from >= 5 rounds ago — state that has
+                        # lived through a meaningful slice of the fault
+                        # ensemble (any read trivially predates its own
+                        # round; a 1-round gap proves nothing)
+                        code_probe(
+                            i - yd_state["round_of"].get(idx, i) >= 5,
+                            "workload.ycsb_d_frontier_persisted",
+                        )
+                    for idx in idxs:
+                        txn.set(b"yd%06d" % idx, b"d%d" % idx)
+                    await txn.commit()
+                    for idx in idxs:
+                        yd_state["allowed"][idx] = {b"d%d" % idx}
+                        yd_state["round_of"].setdefault(idx, i)
+                    # CONSECUTIVE fresh keys: the frontier advances
+                    # over exactly the inserted indices and NEVER
+                    # resets — recoveries, kills and throttles included
+                    # (re-read at write time: single-writer state, and
+                    # the flow.rmw-across-wait discipline holds anyway)
+                    yd_state["frontier"] += n_ins
+                except CommitUnknownResult:
+                    for idx in idxs:
+                        yd_state["allowed"].setdefault(idx, {None}).add(
+                            b"d%d" % idx
+                        )
+                        yd_state["round_of"].setdefault(idx, i)
+                    # fate unknown: the indices are RESERVED (a later
+                    # read must tolerate either outcome), the frontier
+                    # still advances monotonically
+                    yd_state["frontier"] += n_ins
+                    await sched.delay(0.01)
+                except retryable:
+                    # definite abort: nothing written, the same indices
+                    # are retried by the next round at the same values
+                    await sched.delay(0.01)
+                if rng_d.random() < 0.2:
                     await sched.delay(0.02)
 
         backup_state = AuditedDict(
@@ -941,6 +1024,8 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
             )
         if plan.atomic_ops:
             tasks.append(sched.spawn(atomic_ops(), name="soak-atomic").done)
+        if plan.ycsb_d:
+            tasks.append(sched.spawn(ycsb_d_flow(), name="soak-ycsb-d").done)
         if plan.backup_restore:
             tasks.append(sched.spawn(backup_flow(), name="soak-backup").done)
         sched.run_until(all_of(tasks))
@@ -973,6 +1058,22 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
                 f"{atomic_state['unknown']}"
             )
             code_probe(True, "workload.atomic_sum_checked")
+
+        if plan.ycsb_d:
+            # end-of-seed durability: every DEFINITELY-committed
+            # frontier key must have survived the whole fault ensemble
+            # (unknown-fate keys may legally be absent)
+            async def read_frontier():
+                txn = db.create_transaction()
+                return dict(await txn.get_range(b"yd", b"ye"))
+
+            got_yd = sched.run_until(sched.spawn(read_frontier()).done)
+            for idx, allowed in yd_state["allowed"].items():
+                v = got_yd.get(b"yd%06d" % idx)
+                assert v in allowed, (
+                    f"seed {seed}: ycsb_d final idx {idx} = {v!r} "
+                    f"not in {allowed}"
+                )
 
         if plan.backup_restore and backup_state["agent"] is not None:
             agent = backup_state["agent"]

@@ -188,8 +188,18 @@ class SoakSpec:
                     f"spec {self.name!r}: workloads.{f} must be a "
                     f"positive int, got {v!r}"
                 )
+        # OPTIONAL workloads (r15 append-only: drawn AFTER every
+        # pre-existing field, from the tail of the rng stream, so specs
+        # without the key keep byte-identical plans)
+        if "ycsb_d" in self.workloads:
+            p = self.workloads["ycsb_d"]
+            if not isinstance(p, (int, float)) or not 0.0 <= p <= 1.0:
+                raise SpecError(
+                    f"spec {self.name!r}: workloads.ycsb_d must be a "
+                    f"probability in [0, 1], got {p!r}"
+                )
         unknown = set(self.workloads) - set(WORKLOAD_FIELDS) - {
-            "api_actors", "api_rounds"
+            "api_actors", "api_rounds", "ycsb_d"
         }
         if unknown:
             raise SpecError(
@@ -389,4 +399,8 @@ def derive_plan_fields(seed: int, spec: SoakSpec) -> dict:
     fields["api_actors"] = int(spec.workloads["api_actors"])
     fields["api_rounds"] = int(spec.workloads["api_rounds"])
     fields["spec_name"] = spec.name
+    # r15 OPTIONAL draws come LAST (one draw each, unconditionally —
+    # the draw-order discipline): every pre-existing field above reads
+    # the identical rng stream, so old specs' plans are byte-stable
+    fields["ycsb_d"] = bool(r.random() < spec.workloads.get("ycsb_d", 0.0))
     return fields

@@ -70,6 +70,44 @@ class Knobs:
     def as_dict(self) -> dict:
         return dict(self._values)
 
+    def apply_env_overrides(self, env_var: str = None) -> dict:
+        """Apply `NAME=value;NAME=value` overrides from an environment
+        variable (default FDBTPU_KNOB_OVERRIDES) — the hook the
+        autotuner's subprocess harnesses use to drive knob trials
+        (scripts/autotune.py sets it per trial; values are coerced via
+        set()'s type check). Returns {name: value} of what was applied
+        so harnesses can record the knob fingerprint honestly."""
+        import os as _os
+
+        raw = _os.environ.get(env_var or "FDBTPU_KNOB_OVERRIDES", "")
+        applied = {}
+        for part in raw.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, value = part.partition("=")
+            name, value = name.strip(), value.strip()
+            d = self._defs.get(name)
+            if d is not None and d.ktype is bool:
+                # bool('False') is True — env strings need real
+                # parsing, and an unrecognized spelling is a config
+                # error, never a silent True
+                lowered = value.lower()
+                if lowered in ("1", "true", "yes", "on"):
+                    parsed = True
+                elif lowered in ("0", "false", "no", "off"):
+                    parsed = False
+                else:
+                    raise ValueError(
+                        f"knob {name!r}: {value!r} is not a boolean "
+                        "(use true/false/1/0)"
+                    )
+                self.set(name, parsed)
+            else:
+                self.set(name, value)
+            applied[name] = self._values[name]
+        return applied
+
 
 class Buggifier:
     """Deterministic rare-branch activation (BUGGIFY).

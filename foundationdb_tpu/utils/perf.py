@@ -21,6 +21,14 @@ as a view. A ledger row is self-describing:
   mode, spec, seeds, ...).
 * `knobs` — the knob fingerprint (kernel kind, delta capacity, dedup,
   fuse, ...): a knob change is a different experiment, not noise.
+* `experiment` — OPTIONAL: the autotune search this row is a TRIAL of
+  (scripts/autotune.py stamps the search id). Experiment rows are the
+  searcher's resumability cache — fingerprint-keyed, so a re-run skips
+  already-measured configurations — and are EXCLUDED from baseline
+  windows in both directions: a normal candidate never compares against
+  trials, and a trial row can never be accepted as a committed baseline
+  (`perfcheck --accept` refuses it). The search winner is re-emitted
+  WITHOUT the field through `perfcheck --check --accept`.
 * `metrics` — a FLAT name -> {value, unit, direction, tier} map.
   direction is "higher" | "lower" (which way is better); tier is
   "structural" (deterministic on any host: merge-row counts, compile
@@ -151,11 +159,13 @@ _NOW = object()  # sentinel: stamp at build time
 def make_record(source: str, metrics: dict, *, workload: dict = None,
                 knobs: dict = None, fingerprint: dict = None,
                 timestamp=_NOW, git_sha=None,
-                imported_from: str = None, extra: dict = None) -> dict:
+                imported_from: str = None, extra: dict = None,
+                experiment: str = None) -> dict:
     """Assemble one schema-valid ledger row. Imported historical rows
     carry `timestamp: null` / `git_sha: null` (unless given) so the
     migration is byte-stable — re-running --import reproduces
-    identical bytes."""
+    identical bytes. `experiment` marks the row an autotune TRIAL
+    (absent on every non-trial row, keeping pre-r15 bytes stable)."""
     import time as _time
 
     rec = {
@@ -176,6 +186,8 @@ def make_record(source: str, metrics: dict, *, workload: dict = None,
     }
     if imported_from:
         rec["imported_from"] = imported_from
+    if experiment:
+        rec["experiment"] = experiment
     if extra:
         rec["extra"] = extra
     validate_record(rec)
@@ -204,6 +216,10 @@ def validate_record(rec: dict) -> None:
     for key in ("workload", "knobs"):
         if not isinstance(rec.get(key), dict):
             problems.append(f"{key} must be a dict")
+    if "experiment" in rec and not (
+        isinstance(rec["experiment"], str) and rec["experiment"]
+    ):
+        problems.append("experiment must be a non-empty string when present")
     metrics = rec.get("metrics")
     if not isinstance(metrics, dict) or not metrics:
         problems.append("metrics must be a non-empty dict")
@@ -243,11 +259,12 @@ def append(rec: dict, path: str = None) -> str:
 
 
 def emit(source: str, metrics: dict, *, workload: dict = None,
-         knobs: dict = None, ledger: str = None, extra: dict = None) -> dict:
+         knobs: dict = None, ledger: str = None, extra: dict = None,
+         experiment: str = None) -> dict:
     """The one call every perf CLI makes: build a row for THIS host and
     append it to the ledger (or `ledger`/$FDBTPU_PERF_LEDGER)."""
     rec = make_record(source, metrics, workload=workload, knobs=knobs,
-                      extra=extra)
+                      extra=extra, experiment=experiment)
     append(rec, path=ledger)
     return rec
 
@@ -299,11 +316,16 @@ def baseline_window(history: list[dict], candidate: dict, *, tier: str,
                     window: int = 8) -> list[dict]:
     """The most recent `window` ledger rows comparable to `candidate`
     at `tier` (matching fingerprint key, same schema). Rows with a
-    mismatched fingerprint are ignored, never 'close enough'."""
+    mismatched fingerprint are ignored, never 'close enough'.
+    EXPERIMENT rows (autotune trials) are never baselines: a trial runs
+    a deliberately non-default knob point, so comparing a committed
+    configuration against it would gate the tree on a configuration
+    nobody shipped."""
     want = fingerprint_key(candidate, tier)
     matched = [
         r for r in history
         if r.get("schema_version") == candidate.get("schema_version")
+        and not r.get("experiment")
         and fingerprint_key(r, tier) == want
     ]
     return matched[-window:]
@@ -642,6 +664,11 @@ def pipeline_row_to_records(row: dict, *, imported_from: str = None,
             # the baseline fingerprint (absent on pre-r12 rows and
             # cluster-mode rows, so their keys are unchanged)
             knobs["resolve_path"] = row["resolve_path"]
+        if row.get("knob_overrides"):
+            # autotune trials drive server knobs through the env hook;
+            # the applied overrides key each trial apart (absent on
+            # every non-trial row — import byte-stability)
+            knobs.update(row["knob_overrides"])
         recs.append(make_record(
             "bench_pipeline", metrics,
             workload={

@@ -419,6 +419,8 @@ def emit_row(args, results: dict) -> dict:
         "kernel": "classic" if args.classic_kernel else "tiered",
         "backends": results,
     }
+    if getattr(args, "knob_overrides", None):
+        row["knob_overrides"] = args.knob_overrides
     # the resolve-hop frame, as OBSERVED by the resolver role's
     # path_stats (wire mode only) — never re-derived from env/args, so
     # the ledger's fingerprint knob cannot mislabel a run if the
@@ -595,6 +597,13 @@ def main():
                     help="wire mode: keep the cluster alive N seconds "
                          "after the workload (fdbtop polling window)")
     args = ap.parse_args()
+    # autotune trial hook: FDBTPU_KNOB_OVERRIDES drives server-knob
+    # points (adaptive-batch count/bytes/interval targets) through this
+    # harness; what was APPLIED lands in the row's knob fingerprint so
+    # every trial keys apart in the ledger
+    from foundationdb_tpu.utils.knobs import SERVER_KNOBS
+
+    args.knob_overrides = SERVER_KNOBS.apply_env_overrides()
     if args.legacy:
         args.clients = args.legacy[0]
         if len(args.legacy) > 1:

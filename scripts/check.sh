@@ -66,6 +66,29 @@ rm -f "$ycsb_row"
 t1=$(date +%s.%N)
 awk -v a="$t0" -v b="$t1" 'BEGIN {printf "ycsb_e bench wall time: %.1fs\n", b - a}'
 
+echo "== autotune smoke (deterministic structural-objective search over  =="
+echo "== the tiny YCSB-E spill fixture: must converge to the known-best   =="
+echo "== knob, re-run as a 100% fingerprint-cache hit, leave the          =="
+echo "== committed ledger byte-stable, and prove experiment rows never    =="
+echo "== enter a baseline window)                                         =="
+t0=$(date +%s.%N)
+JAX_PLATFORMS=cpu python scripts/autotune.py --smoke
+t1=$(date +%s.%N)
+awk -v a="$t0" -v b="$t1" 'BEGIN {printf "autotune smoke wall time: %.1fs\n", b - a}'
+
+echo "== elasticity smoke (limiter-driven live resolver recruitment, both =="
+echo "== directions: ON must recruit a second resolver off the            =="
+echo "== resolver_busy streak and scale goodput >= 1.5x the plateau with  =="
+echo "== exact consistency; OFF must stay pinned at the plateau, still    =="
+echo "== attributed resolver_busy — structural ledger row perfcheck-gated) =="
+t0=$(date +%s.%N)
+elastic_row=$(mktemp /tmp/elasticcheck_row.XXXXXX.jsonl)
+JAX_PLATFORMS=cpu python scripts/elasticity_drill.py --smoke --perf-ledger "$elastic_row"
+JAX_PLATFORMS=cpu python scripts/perfcheck.py --check "$elastic_row" --tier structural
+rm -f "$elastic_row"
+t1=$(date +%s.%N)
+awk -v a="$t0" -v b="$t1" 'BEGIN {printf "elasticity smoke wall time: %.1fs\n", b - a}'
+
 echo "== spec + perturbation smoke (1 short seed per spec, then the same =="
 echo "== seed x 3 schedule perturbations, api workload + auditor on)    =="
 # --perturb runs the unperturbed base seed first, so one lane covers both

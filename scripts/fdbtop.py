@@ -87,13 +87,16 @@ REQUIRED_SENSORS = {
                  "kernel.spills", "kernel.sweep_groups"),
     "commit_proxy": ("queued_requests", "inflight_batches", "batch_sizer"),
     "grv_proxy": ("queued_requests", "sheds", "budget_stale"),
+    # binding_streak is the r15 elasticity trigger's input — shipped by
+    # the shared law's rate_info(), so sim and wire both pin it
     "ratekeeper": ("transactions_per_second_limit", "budget_limited_by",
-                   "budget_stale"),
+                   "budget_stale", "binding_streak"),
     # wire-cluster lifecycle: the controller's generation + recovery
-    # surface (the chaos drill reads the same fields)
+    # surface (the chaos drill reads the same fields); elastic_recruits
+    # is the r15 elasticity panel's headline counter (0 when disabled)
     "cluster_controller": ("epoch", "recovery_state",
                            "recoveries_completed", "workers_live",
-                           "recovery_timeline"),
+                           "recovery_timeline", "elastic_recruits"),
 }
 
 
@@ -272,19 +275,35 @@ def _row_metrics(role: str, block: dict) -> list[tuple[str, object]]:
         ]
     if role == "ratekeeper":
         limited = q.get("budget_limited_by") or {}
+        streak = q.get("binding_streak") or {}
         return [
             ("by", limited.get("name", "?")),
+            # the elasticity trigger's input: how long the binding
+            # limiter has held (ISSUE 15)
+            ("streak", streak.get("intervals", 0)),
             ("stale", int(bool(q.get("budget_stale")))),
+            ("pushes", q.get("rate_pushes", 0)),
             ("polls", q.get("peer_polls", q.get("control_loops", 0))),
         ]
     if role == "cluster_controller":
-        return [
+        out = [
             ("state", q.get("recovery_state", "?")),
             ("recoveries", q.get("recoveries_completed", 0)),
             ("last s", q.get("last_recovery_s") or 0.0),
             ("workers", f"{q.get('workers_live', 0)}/"
                         f"{q.get('workers_registered', 0)}"),
         ]
+        if q.get("elastic_enabled"):
+            # the elasticity panel (ISSUE 15): planned resolver count,
+            # completed elastic recruits, the live trigger streak
+            out.append((
+                "elastic",
+                f"res={q.get('resolvers_planned', '?')} "
+                f"recruits={q.get('elastic_recruits', 0)} "
+                f"streak={q.get('elastic_last_streak', 0)}/"
+                f"{q.get('elastic_streak_needed', 0)}",
+            ))
+        return out
     if role == "worker":
         return [
             ("hosted", ",".join(q.get("hosted", [])) or "idle"),
@@ -520,6 +539,9 @@ def main() -> int:
                      help="in-process sim cluster + demo workload")
     src.add_argument("--smoke", action="store_true",
                      help="CI: bench_pipeline wire smoke + sensor gate")
+    src.add_argument("--autotune", action="store_true",
+                     help="summarize autotune experiment rows from the "
+                          "perf ledger (searches, trials, best knobs)")
     ap.add_argument("--watch", action="store_true",
                     help="refresh live until interrupted")
     ap.add_argument("--once", action="store_true",
@@ -534,6 +556,8 @@ def main() -> int:
              "(exit nonzero on any missing sensor)",
     )
     args = ap.parse_args()
+    if args.autotune:
+        return _autotune_main()
     if args.smoke:
         return _smoke_main(args)
     if args.sim:
@@ -541,6 +565,49 @@ def main() -> int:
     if not args.socket_dir and not args.conf:
         ap.error("one of --socket-dir / --conf / --sim / --smoke required")
     return asyncio.run(_wire_main(args))
+
+
+def _autotune_main() -> int:
+    """The autotune panel (ISSUE 15): every experiment in the perf
+    ledger as one line — trial count, fingerprint spread, and the best
+    trial per objective-bearing metric — so a resumable search's state
+    is readable without re-running it."""
+    from foundationdb_tpu.utils import perf
+
+    history = perf.load_history()
+    by_exp: dict = {}
+    for rec in history:
+        exp = rec.get("experiment")
+        if exp:
+            by_exp.setdefault(exp, []).append(rec)
+    if not by_exp:
+        print(f"no experiment rows in {perf.history_path()} "
+              "(run scripts/autotune.py)")
+        return 0
+    for exp, rows in sorted(by_exp.items()):
+        kinds = sorted({
+            str((r.get("fingerprint") or {}).get("device_kind"))
+            for r in rows
+        })
+        print(f"== {exp}: {len(rows)} trial(s) on {', '.join(kinds)} ==")
+        metrics = sorted({m for r in rows for m in r.get("metrics", {})})
+        for name in metrics:
+            scored = [
+                (r["metrics"][name], r) for r in rows
+                if name in r.get("metrics", {})
+            ]
+            if not scored:
+                continue
+            direction = scored[0][0].get("direction", "lower")
+            best_m, best_r = (
+                max(scored, key=lambda s: s[0]["value"])
+                if direction == "higher"
+                else min(scored, key=lambda s: s[0]["value"])
+            )
+            print(f"  {name:<28} best {best_m['value']:>12g} "
+                  f"{best_m.get('unit') or '':<8} @ "
+                  f"{json.dumps(best_r.get('knobs', {}), sort_keys=True)}")
+    return 0
 
 
 if __name__ == "__main__":

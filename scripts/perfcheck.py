@@ -331,6 +331,19 @@ def main() -> int:
         tiers = [args.tier]
     rc, _reports = check_rows(candidates, history, tiers, args.window)
     if rc == 0 and args.accept:
+        # experiment rows are autotune TRIALS — the searcher's cache,
+        # never a committed baseline. The winner must be re-emitted
+        # without the field (scripts/autotune.py --promote does) before
+        # it can be accepted.
+        trials = [r for r in candidates if r.get("experiment")]
+        if trials:
+            print(f"perfcheck: refusing --accept: {len(trials)} candidate "
+                  f"row(s) carry an `experiment` marker "
+                  f"({sorted({r['experiment'] for r in trials})}); promote "
+                  "the winner without it (scripts/autotune.py "
+                  "--promote-out)",
+                  file=sys.stderr)
+            return 1
         for rec in candidates:
             perf.append(rec, path=history_path)
         print(f"perfcheck: {len(candidates)} candidate row(s) accepted "

@@ -1,0 +1,452 @@
+"""ISSUE 15: the ledger-driven knob autotuner (utils/autotune.py) and
+the `experiment` row discipline in the perf schema (utils/perf.py).
+
+Pinned here:
+
+* resumability — killing a search mid-sweep and re-running completes
+  ONLY the missing trials (fingerprint-cache hit counts pinned), both
+  in-process and across "sessions" (fresh run_search over the same
+  ledger file);
+* experiment exclusion BOTH directions — a trial row is never selected
+  into a normal candidate's baseline window, and a trial row can never
+  be accepted as a committed baseline (perfcheck --accept exits 1);
+* the promote flow — the winner re-emits without the experiment marker
+  and then IS acceptable;
+* schema byte-stability — records built without `experiment` carry no
+  new key (the committed-ledger re-import contract is untouched).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from foundationdb_tpu.utils import autotune, perf
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def fake_row(knobs: dict, value: float, *, metric="txn_s",
+             direction="higher", source="bench", workload=None) -> dict:
+    return perf.make_record(
+        source,
+        {metric: perf.metric(value, "txn/s", direction,
+                             tier="structural")},
+        workload=workload or {"metric": "m"},
+        knobs=knobs,
+        fingerprint={
+            "backend": "cpu", "device_kind": None, "device_count": 0,
+            "jax_version": None, "jaxlib_version": None,
+            "python_version": None, "machine": None,
+        },
+        git_sha="t", timestamp=0.0,
+    )
+
+
+@pytest.fixture
+def ledger(tmp_path):
+    return str(tmp_path / "search.jsonl")
+
+
+# ---------------------------------------------------------------------------
+# schema: the experiment field
+
+
+def test_experiment_field_roundtrip_and_validation():
+    rec = perf.make_record(
+        "bench", {"m": perf.metric(1, "x", "higher", tier="structural")},
+        fingerprint=fake_row({}, 0)["fingerprint"], git_sha="t",
+        timestamp=0.0, experiment="s1",
+    )
+    assert rec["experiment"] == "s1"
+    perf.validate_record(rec)
+    bad = dict(rec, experiment="")
+    with pytest.raises(ValueError, match="experiment"):
+        perf.validate_record(bad)
+
+
+def test_no_experiment_key_when_absent():
+    """Byte-stability: non-trial rows must not grow a new key (the
+    committed-ledger-matches-reimport pin depends on it)."""
+    rec = fake_row({"fuse": 8}, 1.0)
+    assert "experiment" not in rec
+    assert "experiment" not in json.dumps(rec)
+
+
+def test_baseline_window_excludes_experiment_rows():
+    """Direction 1: trials never gate a normal candidate."""
+    normal = [fake_row({"fuse": 8}, 100.0) for _ in range(3)]
+    trial = dict(fake_row({"fuse": 8}, 5.0), experiment="s1")
+    cand = fake_row({"fuse": 8}, 99.0)
+    window = perf.baseline_window(
+        normal + [trial], cand, tier="structural"
+    )
+    assert trial not in window and len(window) == 3
+    # and through compare(): the trial's awful 5.0 must not drag the
+    # median (structural exact compare would flag 99 vs median 5 as
+    # improvement-or-regression depending on direction — either way a
+    # polluted window changes the report)
+    rep = perf.compare(cand, normal + [trial], tier="structural")
+    rep2 = perf.compare(cand, normal, tier="structural")
+    assert rep["metrics"] == rep2["metrics"]
+
+
+def test_perfcheck_accept_refuses_experiment_rows(tmp_path):
+    """Direction 2: a trial row can never become a committed baseline."""
+    hist = tmp_path / "history.jsonl"
+    cand_path = tmp_path / "cand.jsonl"
+    trial = dict(fake_row({"fuse": 8}, 5.0), experiment="s1")
+    with open(cand_path, "w") as f:
+        f.write(json.dumps(trial, sort_keys=True) + "\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "perfcheck.py"),
+         "--check", str(cand_path), "--accept", "--history", str(hist)],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "experiment" in proc.stderr
+    assert not os.path.exists(hist) or not perf.load_history(str(hist))
+    # the promoted twin (marker stripped) IS acceptable
+    promoted = autotune.promote_record(trial)
+    with open(cand_path, "w") as f:
+        f.write(json.dumps(promoted, sort_keys=True) + "\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "perfcheck.py"),
+         "--check", str(cand_path), "--accept", "--history", str(hist)],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert len(perf.load_history(str(hist))) == 1
+
+
+# ---------------------------------------------------------------------------
+# the search loop
+
+
+def objective_table(table):
+    """run_trial from a {trial_key: value} table, counting invocations."""
+    calls = []
+
+    def run(knobs):
+        calls.append(dict(knobs))
+        return fake_row(knobs, table[autotune.trial_key(knobs)])
+
+    run.calls = calls
+    return run
+
+
+def test_search_space_enumeration_deterministic():
+    space = autotune.SearchSpace({"a": (1, 2), "b": ("x", "y")})
+    assert space.points() == [
+        {"a": 1, "b": "x"}, {"a": 1, "b": "y"},
+        {"a": 2, "b": "x"}, {"a": 2, "b": "y"},
+    ]
+    assert len(space) == 4
+
+
+def test_run_search_lands_experiment_rows_and_picks_winner(ledger):
+    space = autotune.SearchSpace({"fuse": (8, 16, 32)})
+    table = {
+        autotune.trial_key({"fuse": 8}): 10.0,
+        autotune.trial_key({"fuse": 16}): 30.0,
+        autotune.trial_key({"fuse": 32}): 20.0,
+    }
+    run = objective_table(table)
+    rep = autotune.run_search(
+        "s1", space, run, objective_metric="txn_s", ledger=ledger,
+    )
+    assert rep.best.knobs == {"fuse": 16}
+    assert rep.ran == 3 and rep.cache_hits == 0
+    assert rep.stopped == "exhausted"
+    rows = perf.load_history(ledger)
+    assert len(rows) == 3
+    assert all(r["experiment"] == "s1" for r in rows)
+    assert all(r["extra"]["trial_key"] for r in rows)
+
+
+def test_resumability_mid_sweep_kill(ledger):
+    """Kill the search after trial 2 of 4; the re-run completes only
+    the missing trials — cache-hit counts pinned both runs."""
+    space = autotune.SearchSpace({"fuse": (8, 16, 32, 64)})
+    table = {
+        autotune.trial_key({"fuse": f}): float(f) for f in (8, 16, 32, 64)
+    }
+    boom = RuntimeError("killed")
+
+    killed = []
+
+    def dying(knobs):
+        if len(killed) >= 2:
+            raise KeyboardInterrupt  # the mid-sweep kill
+        killed.append(knobs)
+        return fake_row(knobs, table[autotune.trial_key(knobs)])
+
+    with pytest.raises(KeyboardInterrupt):
+        autotune.run_search(
+            "s2", space, dying, objective_metric="txn_s", ledger=ledger,
+        )
+    assert len(perf.load_history(ledger)) == 2  # two trials survived
+
+    run = objective_table(table)
+    rep = autotune.run_search(
+        "s2", space, run, objective_metric="txn_s", ledger=ledger,
+    )
+    assert rep.cache_hits == 2 and rep.ran == 2
+    assert run.calls == [{"fuse": 32}, {"fuse": 64}]  # ONLY the missing
+    assert rep.best.knobs == {"fuse": 64}
+
+    # third run: 100% cache hit
+    run2 = objective_table(table)
+    rep2 = autotune.run_search(
+        "s2", space, run2, objective_metric="txn_s", ledger=ledger,
+    )
+    assert rep2.cache_hits == 4 and rep2.ran == 0 and not run2.calls
+    assert rep2.best.knobs == {"fuse": 64}
+    del boom
+
+
+def test_cache_is_per_experiment(ledger):
+    """Two searches over the same knob point do not share trials."""
+    space = autotune.SearchSpace({"fuse": (8,)})
+    table = {autotune.trial_key({"fuse": 8}): 1.0}
+    autotune.run_search("a", space, objective_table(table),
+                        objective_metric="txn_s", ledger=ledger)
+    run = objective_table(table)
+    rep = autotune.run_search("b", space, run,
+                              objective_metric="txn_s", ledger=ledger)
+    assert rep.ran == 1 and run.calls
+
+
+def test_cache_scope_device_rejects_foreign_fingerprint(ledger):
+    """A hardware-objective search must not resume from another
+    machine's trial rows."""
+    space = autotune.SearchSpace({"fuse": (8,)})
+    foreign = dict(fake_row({"fuse": 8}, 1.0), experiment="s3")
+    foreign["fingerprint"] = dict(
+        foreign["fingerprint"], device_kind="TPU v5e", backend="tpu",
+    )
+    foreign["extra"] = {"trial_key": autotune.trial_key({"fuse": 8})}
+    perf.append(foreign, path=ledger)
+    run = objective_table({autotune.trial_key({"fuse": 8}): 2.0})
+    rep = autotune.run_search(
+        "s3", space, run, objective_metric="txn_s", ledger=ledger,
+        cache_scope="device",
+    )
+    assert rep.ran == 1, "foreign-device trial must not satisfy the cache"
+    # scope "any" DOES resume from it
+    rep2 = autotune.run_search(
+        "s3", space, objective_table({}), objective_metric="txn_s",
+        ledger=ledger, cache_scope="any",
+    )
+    assert rep2.ran == 0 and rep2.cache_hits == 1
+
+
+def test_lower_is_better_objective_negated(ledger):
+    space = autotune.SearchSpace({"cap": (1, 2)})
+
+    def run(knobs):
+        return fake_row(knobs, {1: 5.0, 2: 2.0}[knobs["cap"]],
+                        metric="spills", direction="lower")
+
+    rep = autotune.run_search("s4", space, run,
+                              objective_metric="spills", ledger=ledger)
+    assert rep.best.knobs == {"cap": 2}  # fewer spills wins
+
+
+def test_failed_trial_recorded_not_fatal(ledger):
+    space = autotune.SearchSpace({"fuse": (8, 16)})
+
+    def run(knobs):
+        if knobs["fuse"] == 8:
+            raise RuntimeError("harness exploded")
+        return fake_row(knobs, 1.0)
+
+    rep = autotune.run_search("s5", space, run,
+                              objective_metric="txn_s", ledger=ledger)
+    assert rep.trials[0].error and rep.trials[0].record is None
+    assert rep.best.knobs == {"fuse": 16}
+    assert len(perf.load_history(ledger)) == 1  # no row for the failure
+
+
+def test_no_improve_stop(ledger):
+    space = autotune.SearchSpace({"fuse": (1, 2, 3, 4, 5)})
+    table = {autotune.trial_key({"fuse": f}): 10.0 - f for f in range(1, 6)}
+    rep = autotune.run_search(
+        "s6", space, objective_table(table), objective_metric="txn_s",
+        ledger=ledger, no_improve_limit=2,
+    )
+    assert rep.stopped == "no_improve"
+    assert len(rep.trials) == 3  # best at fuse=1, then 2 non-improving
+
+
+def test_roofline_stop(ledger):
+    """A trial achieving >= roofline_frac of the bytes-bound ceiling
+    stops the search before exhaustion."""
+    space = autotune.SearchSpace({"fuse": (8, 16, 32)})
+
+    def run(knobs):
+        rec = fake_row(knobs, 1000.0)
+        rec["fingerprint"]["device_kind"] = "TPU v5e"
+        rec["extra"] = {"hlo_cost": {"bytes_accessed": 8.19e8}}
+        return rec
+
+    # roofline = 1024 txns / (8.19e8 / 8.19e11 s) = 1.024e6 txn/s;
+    # achieved 1000 of it -> tiny frac; arm a tiny roofline_frac so the
+    # first trial satisfies it
+    rep = autotune.run_search(
+        "s7", space, run, objective_metric="txn_s", ledger=ledger,
+        roofline_txns_per_dispatch=1024, roofline_frac=9e-4,
+    )
+    assert rep.stopped == "roofline"
+    assert len(rep.trials) == 1
+    assert rep.roofline == pytest.approx(1024 / (8.19e8 / 8.19e11))
+
+
+def test_roofline_unavailable_on_unknown_device():
+    assert autotune.roofline_txn_s(
+        {"bytes_accessed": 1e9},
+        {"device_kind": None}, 1024,
+    ) is None
+    assert autotune.roofline_txn_s({}, {"device_kind": "TPU v5e"}, 1024) \
+        is None
+
+
+def test_promote_record_strips_markers():
+    trial = dict(fake_row({"fuse": 8}, 1.0), experiment="s8")
+    trial["extra"] = {"trial_key": "k", "note": "keep"}
+    out = autotune.promote_record(trial)
+    assert "experiment" not in out
+    assert out["extra"] == {"note": "keep"}
+    trial2 = dict(fake_row({"fuse": 8}, 1.0), experiment="s8")
+    trial2["extra"] = {"trial_key": "k"}
+    assert "extra" not in autotune.promote_record(trial2)
+
+
+def test_knob_env_override_hook():
+    """The FDBTPU_KNOB_OVERRIDES hook the pipeline harness trials ride."""
+    from foundationdb_tpu.utils.knobs import make_server_knobs
+
+    k = make_server_knobs()
+    default = k.COMMIT_TRANSACTION_BATCH_COUNT_MAX
+    os.environ["FDBTPU_KNOB_OVERRIDES"] = (
+        "COMMIT_TRANSACTION_BATCH_COUNT_MAX=1234"
+    )
+    try:
+        applied = k.apply_env_overrides()
+    finally:
+        del os.environ["FDBTPU_KNOB_OVERRIDES"]
+    assert applied == {"COMMIT_TRANSACTION_BATCH_COUNT_MAX": 1234}
+    assert k.COMMIT_TRANSACTION_BATCH_COUNT_MAX == 1234 != default
+    with pytest.raises(KeyError):
+        os.environ["FDBTPU_KNOB_OVERRIDES"] = "NO_SUCH_KNOB=1"
+        try:
+            k.apply_env_overrides()
+        finally:
+            del os.environ["FDBTPU_KNOB_OVERRIDES"]
+
+
+def test_knob_env_override_bool_parsing():
+    """bool('False') is True — the env hook must parse boolean knobs
+    for real, and reject unrecognized spellings instead of silently
+    enabling them."""
+    from foundationdb_tpu.utils.knobs import Knobs
+
+    k = Knobs("test")
+    k.define("FLAG", True)
+    for spelling, want in (("false", False), ("0", False), ("off", False),
+                           ("true", True), ("1", True), ("ON", True)):
+        os.environ["FDBTPU_KNOB_OVERRIDES"] = f"FLAG={spelling}"
+        try:
+            applied = k.apply_env_overrides()
+        finally:
+            del os.environ["FDBTPU_KNOB_OVERRIDES"]
+        assert applied == {"FLAG": want}, spelling
+        assert k.FLAG is want
+    os.environ["FDBTPU_KNOB_OVERRIDES"] = "FLAG=maybe"
+    try:
+        with pytest.raises(ValueError, match="boolean"):
+            k.apply_env_overrides()
+    finally:
+        del os.environ["FDBTPU_KNOB_OVERRIDES"]
+
+
+# ---------------------------------------------------------------------------
+# the CLI layer: space-vs-harness validation + batch routing
+
+
+def _load_cli():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "autotune_cli", os.path.join(REPO, "scripts", "autotune.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_validate_space_rejects_unconsumed_knob_family():
+    """A knob the target harness silently ignores would make every
+    trial measure the identical default configuration — the noise
+    'winner' could then be promoted into the committed baseline. The
+    CLI must reject the mismatch up front, both directions."""
+    cli = _load_cli()
+    # bench_pipeline reads no BENCH_* env var
+    with pytest.raises(SystemExit, match="bench_pipeline reads no"):
+        cli.validate_space({"fuse": (8, 64)}, "bench_pipeline")
+    # bench.py consumes neither server knobs nor --batch
+    with pytest.raises(SystemExit, match="consumes neither"):
+        cli.validate_space(
+            {"knob.COMMIT_TRANSACTION_BATCH_COUNT_MAX": (4096,)}, "bench"
+        )
+    with pytest.raises(SystemExit, match="consumes neither"):
+        cli.validate_space({"batch": (64,)}, "bench")
+    with pytest.raises(SystemExit, match="unknown bench knob"):
+        cli.validate_space({"typo": (1,)}, "bench")
+    # the legitimate families pass
+    cli.validate_space(
+        {"fuse": (8, 64), "path": ("range_sweep", "dedup")}, "bench"
+    )
+    cli.validate_space(
+        {"knob.GRV_PROXY_MAX_QUEUE": (64,), "batch": (256, 1024)},
+        "bench_pipeline",
+    )
+
+
+def test_pipeline_runner_routes_batch_to_cli(monkeypatch, tmp_path):
+    """A `batch` grid point rides bench_pipeline's --batch flag, never
+    the env builder (which would reject it as an unknown knob and kill
+    the whole sweep on trial 1)."""
+    cli = _load_cli()
+
+    class _Args:
+        mode = "cluster"
+        clients = 2
+        ops = 3
+        backend = "native"
+        trial_timeout = 5.0
+        verbose = False
+
+    seen = {}
+
+    def fake_run(cmd, **kw):
+        seen["cmd"] = cmd
+        # the runner reads the trial row back from --perf-ledger
+        ledger = cmd[cmd.index("--perf-ledger") + 1]
+        with open(ledger, "w") as f:
+            f.write(json.dumps(fake_row({"b": 1}, 5.0)) + "\n")
+
+    monkeypatch.setattr(cli.subprocess, "run", fake_run)
+    runner = cli.make_pipeline_runner(_Args())
+    knobs = {"batch": 512, "knob.GRV_PROXY_MAX_QUEUE": 64}
+    row = runner(dict(knobs))
+    assert row["metrics"]["txn_s"]["value"] == 5.0
+    cmd = seen["cmd"]
+    assert cmd[cmd.index("--batch") + 1] == "512"
+    # batch stayed off the env surface; the server knob rode it
+    assert knobs == {"batch": 512, "knob.GRV_PROXY_MAX_QUEUE": 64}

@@ -1,0 +1,574 @@
+"""ISSUE 15: limiter-driven elastic resolver recruitment + the
+multi-resolver keyspace split + push-based rate updates.
+
+Layers pinned here:
+
+* the shared law's binding-limiter STREAK (the elasticity trigger's
+  input) accumulates/resets correctly, including the fail-safe reset;
+* `clip_transactions` (the proxy-side ResolutionRequestBuilder) is
+  decision-identical to the pinned MultiResolverOracle semantics —
+  phantom commits included;
+* the controller's `_elastic_check` trigger semantics: fires only on a
+  healthy resolver-shaped streak past the threshold, below the cap,
+  exactly once per snapshot, with the elastic recovery reason;
+* the controller's derived boundaries match the sharded kernel's
+  canonical formula (the jax-free twin cannot drift);
+* a REAL two-resolver wire pipeline with boundaries splits batches,
+  min-combines verdicts, and keeps MVCC conflict semantics across and
+  within partitions;
+* push-based rate updates: hysteresis (`_push_due`) and the proxy-side
+  apply path clearing staleness.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from foundationdb_tpu.cluster import multiprocess as mp
+from foundationdb_tpu.cluster.ratekeeper import AdmissionController
+from foundationdb_tpu.models.types import CommitTransaction
+from foundationdb_tpu.wire.codec import Mutation
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# the law's binding streak
+
+
+def _slots(occ=0.0, queue=0):
+    return {
+        "tlogs": {}, "storages": {},
+        "resolvers": {"resolver0": {"occupancy": occ,
+                                    "queue_depth": queue}},
+        "proxies": {},
+    }
+
+
+def test_binding_streak_accumulates_and_resets():
+    law = AdmissionController(clock=time.monotonic, max_tps=1000.0)
+    for i in range(3):
+        law.update(_slots(occ=2.0), current_tps=500.0)
+    assert law.binding_streak == {"name": "resolver_busy", "intervals": 3}
+    # limiter releases -> budget eventually recovers to max ->
+    # workload becomes binding and the resolver streak RESETS
+    for _ in range(40):
+        law.update(_slots(occ=0.0), current_tps=500.0)
+    assert law.binding_streak["name"] == "workload"
+    law.update(_slots(occ=2.0), current_tps=500.0)
+    assert law.binding_streak == {"name": "resolver_busy", "intervals": 1}
+
+
+def test_binding_streak_failsafe_resets():
+    law = AdmissionController(clock=time.monotonic, max_tps=1000.0)
+    law.update(_slots(occ=2.0), current_tps=500.0)
+    law.decay()  # stale feed
+    assert law.binding_streak["name"] == "ratekeeper_failsafe"
+    info = law.rate_info()
+    assert info["binding_streak"]["name"] == "ratekeeper_failsafe"
+
+
+# ---------------------------------------------------------------------------
+# boundaries + the clip
+
+
+def test_controller_boundaries_match_sharding_formula():
+    from foundationdb_tpu.parallel.sharding import default_boundaries
+
+    for n in range(1, 9):
+        assert mp.default_resolver_boundaries(n) == default_boundaries(n)
+    with pytest.raises(ValueError):
+        mp.default_resolver_boundaries(0)
+
+
+def test_resolver_key_ranges_shape():
+    assert mp.resolver_key_ranges([]) == [(b"", None)]
+    assert mp.resolver_key_ranges([b"\x80"]) == [
+        (b"", b"\x80"), (b"\x80", None),
+    ]
+
+
+def _txn(reads=(), writes=(), snap=0, report=False):
+    return CommitTransaction(
+        read_conflict_ranges=list(reads),
+        write_conflict_ranges=list(writes),
+        read_snapshot=snap,
+        report_conflicting_keys=report,
+    )
+
+
+def test_clip_preserves_slot_alignment_and_clips_ranges():
+    txns = [
+        _txn(reads=[(b"\x10", b"\x20")]),           # low only
+        _txn(writes=[(b"\xf0", b"\xf8")]),          # high only
+        _txn(reads=[(b"\x70", b"\x90")]),           # straddles 0x80
+    ]
+    views = [
+        mp.clip_transactions(txns, lo, hi)
+        for lo, hi in mp.resolver_key_ranges([b"\x80"])
+    ]
+    low, high = views
+    assert len(low) == len(high) == 3  # slots aligned
+    assert low[0].read_conflict_ranges == [(b"\x10", b"\x20")]
+    assert high[0].read_conflict_ranges == []
+    assert low[1].write_conflict_ranges == []
+    assert high[1].write_conflict_ranges == [(b"\xf0", b"\xf8")]
+    assert low[2].read_conflict_ranges == [(b"\x70", b"\x80")]
+    assert high[2].read_conflict_ranges == [(b"\x80", b"\x90")]
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_clip_min_combine_matches_multi_resolver_oracle(seed):
+    """The proxy-side clip + per-partition resolve + min-combine IS the
+    MultiResolverOracle's semantics (phantom commits included): random
+    conflicting streams decide identically."""
+    import numpy as np
+
+    from foundationdb_tpu.testing.oracle import (
+        ConflictOracle,
+        MultiResolverOracle,
+        OracleTxn,
+    )
+
+    rng = np.random.default_rng(seed)
+    boundaries = [b"\x55", b"\xaa"]
+    oracle = MultiResolverOracle(boundaries, window=10_000)
+    shards = [ConflictOracle(10_000) for _ in range(3)]
+    ranges = mp.resolver_key_ranges(boundaries)
+
+    def rand_range():
+        b = bytes([int(rng.integers(0, 250)), int(rng.integers(0, 250))])
+        return (b, b + bytes([int(rng.integers(1, 60))]))
+
+    version = 1000
+    for _batch in range(6):
+        version += 100
+        txns = [
+            _txn(
+                reads=[rand_range() for _ in range(int(rng.integers(0, 3)))],
+                writes=[rand_range() for _ in range(int(rng.integers(1, 3)))],
+                snap=int(rng.integers(version - 300, version)),
+            )
+            for _ in range(8)
+        ]
+        want = oracle.resolve(
+            [
+                OracleTxn(
+                    read_conflict_ranges=t.read_conflict_ranges,
+                    write_conflict_ranges=t.write_conflict_ranges,
+                    read_snapshot=t.read_snapshot,
+                )
+                for t in txns
+            ],
+            version,
+        ).verdicts
+        # the wire path's shape: clip per partition, resolve per
+        # shard, min-combine
+        got = [min(vs) for vs in zip(*(
+            shard.resolve(
+                [
+                    OracleTxn(
+                        read_conflict_ranges=v.read_conflict_ranges,
+                        write_conflict_ranges=v.write_conflict_ranges,
+                        read_snapshot=v.read_snapshot,
+                    )
+                    for v in mp.clip_transactions(txns, lo, hi)
+                ],
+                version,
+            ).verdicts
+            for shard, (lo, hi) in zip(shards, ranges)
+        ))]
+        assert got == want
+
+
+# ---------------------------------------------------------------------------
+# the elasticity trigger
+
+
+def _controller(**conf):
+    base = {"resolvers": 1, "elastic": True, "elastic_streak": 3,
+            "elastic_max_resolvers": 2}
+    base.update(conf)
+    return mp.ClusterControllerRole(base)
+
+
+def _armed(ctrl, *, name="resolver_busy", intervals=5, stale=False):
+    ctrl._needs_recovery = False
+    ctrl._rk_qos = {
+        "binding_streak": {"name": name, "intervals": intervals},
+        "budget_stale": stale,
+    }
+
+
+def test_elastic_trigger_fires_and_re_derives_topology():
+    ctrl = _controller()
+    _armed(ctrl)
+    ctrl._elastic_check()
+    assert ctrl.elastic_recruits == 1
+    assert ctrl.conf["resolvers"] == 2
+    assert ctrl._needs_recovery
+    from foundationdb_tpu.cluster.generation import is_elastic_reason
+
+    assert is_elastic_reason(ctrl._recovery_reason)
+    assert ctrl._recovery_reason == "elastic:resolver->2"
+    # the consumed snapshot cannot double-fire
+    assert ctrl._rk_qos == {}
+    # the supervision sleep is cut short like a pushed worker death —
+    # the recruit starts next loop iteration, not check_interval later
+    assert ctrl._wake.is_set()
+
+
+def test_elastic_trigger_requires_streak():
+    ctrl = _controller()
+    _armed(ctrl, intervals=2)  # below elastic_streak=3
+    ctrl._elastic_check()
+    assert ctrl.elastic_recruits == 0 and not ctrl._needs_recovery
+
+
+def test_elastic_trigger_ignores_stale_feed():
+    ctrl = _controller()
+    _armed(ctrl, stale=True)
+    ctrl._elastic_check()
+    assert ctrl.elastic_recruits == 0
+    assert ctrl.elastic_last_streak == 0
+
+
+def test_elastic_trigger_ignores_non_resolver_limiters():
+    ctrl = _controller()
+    for name in ("workload", "log_server_write_queue",
+                 "ratekeeper_failsafe", "commit_proxy_queue"):
+        _armed(ctrl, name=name)
+        ctrl._elastic_check()
+    assert ctrl.elastic_recruits == 0
+
+
+def test_elastic_trigger_capped_and_disabled():
+    ctrl = _controller(resolvers=2)  # already at the cap
+    _armed(ctrl)
+    ctrl._elastic_check()
+    assert ctrl.elastic_recruits == 0
+    off = _controller(elastic=False)
+    _armed(off)
+    off._elastic_check()
+    assert off.elastic_recruits == 0 and not off._needs_recovery
+
+
+def test_elastic_trigger_skipped_during_recovery():
+    ctrl = _controller()
+    _armed(ctrl)
+    ctrl._needs_recovery = True
+    ctrl._recovery_reason = "proxy0"
+    ctrl._elastic_check()
+    assert ctrl.elastic_recruits == 0
+    assert ctrl._recovery_reason == "proxy0"
+
+
+def test_resolver_queue_limiter_also_triggers():
+    ctrl = _controller()
+    _armed(ctrl, name="resolver_queue")
+    ctrl._elastic_check()
+    assert ctrl.elastic_recruits == 1
+
+
+def test_elastic_surviving_streak_cannot_chain_recruits():
+    """The ratekeeper's law outlives the recovery walk with its streak
+    intact: a still-binding limiter must hold for elastic_streak FRESH
+    intervals before the NEXT recruit — never chain one recruit per
+    heartbeat off the pre-recruit streak."""
+    ctrl = _controller(elastic_max_resolvers=3)
+    _armed(ctrl, intervals=5)
+    ctrl._elastic_check()
+    assert ctrl.elastic_recruits == 1 and ctrl.conf["resolvers"] == 2
+    ctrl._needs_recovery = False  # recovery walk "completed"
+    # the law's streak CONTINUED across the recovery (6, 7 = the very
+    # next healthy heartbeats): below the raised gate (5 + 3 = 8)
+    for intervals in (6, 7):
+        _armed(ctrl, intervals=intervals)
+        ctrl._elastic_check()
+        assert ctrl.elastic_recruits == 1, (
+            f"chained a recruit off the surviving streak at "
+            f"{intervals} intervals"
+        )
+    # elastic_streak fresh intervals on top of the recruit-time streak:
+    # the previous recruit demonstrably didn't help — recruit again
+    _armed(ctrl, intervals=8)
+    ctrl._elastic_check()
+    assert ctrl.elastic_recruits == 2 and ctrl.conf["resolvers"] == 3
+
+
+def test_elastic_streak_reset_restores_normal_gate():
+    """A streak RESET observed after a recruit (limiter released and
+    re-engaged) is a fresh signal: the normal threshold applies, not
+    the raised post-recruit gate."""
+    ctrl = _controller(elastic_max_resolvers=3)
+    _armed(ctrl, intervals=10)
+    ctrl._elastic_check()
+    assert ctrl.elastic_recruits == 1  # gate now 10 + 3 = 13
+    ctrl._needs_recovery = False
+    _armed(ctrl, intervals=1)  # the law restarted its count
+    ctrl._elastic_check()
+    assert ctrl.elastic_recruits == 1
+    _armed(ctrl, intervals=3)  # a fresh streak at the normal threshold
+    ctrl._elastic_check()
+    assert ctrl.elastic_recruits == 2
+
+
+# ---------------------------------------------------------------------------
+# push-based rate updates
+
+
+def _rk(**kw):
+    return mp.RatekeeperRole([], **kw)
+
+
+def test_push_due_hysteresis():
+    rk = _rk()
+    assert rk._push_due()  # nothing delivered yet
+    info = rk.law.rate_info()
+    rk._last_pushed = {
+        "budget": info["transactions_per_second_limit"],
+        "limiter": info["budget_limited_by"]["name"],
+        "stale": bool(info["budget_stale"]),
+    }
+    assert not rk._push_due()  # unchanged: no push
+    # a small drift stays inside the hysteresis band
+    rk.law.tps_budget = rk._last_pushed["budget"] * (
+        1.0 - rk.push_threshold / 2
+    )
+    assert not rk._push_due()
+    # a large move pushes
+    rk.law.tps_budget = rk._last_pushed["budget"] * 0.5
+    assert rk._push_due()
+    # a limiter flip pushes even at the same budget
+    rk.law.tps_budget = rk._last_pushed["budget"]
+    rk.law.limited_by = dict(rk.law.limited_by, name="resolver_busy")
+    assert rk._push_due()
+
+
+def test_proxy_rate_update_applies_and_clears_staleness(tmp_path):
+    """A pushed GetRateInfo payload lands on the pipeline like a fresh
+    poll: limit applied, staleness cleared, push counted."""
+    import json
+
+    class _Conn:  # enough of RpcConnection for construction
+        pass
+
+    pipe = mp.ProxyPipeline([_Conn()], _Conn(), _Conn(),
+                            ratekeeper=_Conn())
+    pipe._rate_stale = True
+    pipe._rate_failures = 2
+    role = mp.ProxyRole.__new__(mp.ProxyRole)
+    role.pipeline = pipe
+    role.epoch = 0
+    role.stale_rate_pushes = 0
+    law = AdmissionController(clock=time.monotonic, max_tps=5000.0)
+    law.tps_budget = 123.0
+    reply = run(role.rate_update(
+        mp.RateUpdate(payload=json.dumps(law.rate_info()))
+    ))
+    assert json.loads(reply.payload)["ok"]
+    assert pipe._rate_limit == 123.0
+    assert not pipe._rate_stale and pipe._rate_failures == 0
+    assert pipe.rate_pushes_applied == 1
+
+
+def test_rate_push_epoch_fenced():
+    """A superseded-but-alive ratekeeper's pushes are fenced BY EPOCH
+    like every other control frame: a mismatched stamp is rejected
+    retryably and the live budget (and fail-safe staleness state) is
+    untouched."""
+    import json
+
+    from foundationdb_tpu.cluster.generation import is_stale_epoch
+    from foundationdb_tpu.wire import transport
+
+    class _Conn:
+        pass
+
+    pipe = mp.ProxyPipeline([_Conn()], _Conn(), _Conn(),
+                            ratekeeper=_Conn(), epoch=3)
+    pipe._rate_stale = True
+    role = mp.ProxyRole.__new__(mp.ProxyRole)
+    role.pipeline = pipe
+    role.epoch = 3
+    role.stale_rate_pushes = 0
+    law = AdmissionController(clock=time.monotonic, max_tps=5000.0)
+    law.tps_budget = 42.0
+    stale = {**law.rate_info(), "epoch": 2}  # the OLD generation
+    with pytest.raises(transport.RemoteError) as ei:
+        run(role.rate_update(mp.RateUpdate(payload=json.dumps(stale))))
+    assert is_stale_epoch(ei.value)
+    assert role.stale_rate_pushes == 1
+    assert pipe._rate_limit == float("inf")  # budget untouched
+    assert pipe._rate_stale                  # staleness NOT cleared
+    # the matching generation applies
+    fresh = {**law.rate_info(), "epoch": 3}
+    run(role.rate_update(mp.RateUpdate(payload=json.dumps(fresh))))
+    assert pipe._rate_limit == 42.0 and not pipe._rate_stale
+
+
+def test_rate_push_over_real_wire(tmp_path):
+    """End to end over a UDS: a worker hosting a ProxyRole receives
+    TOKEN_RATE_UPDATE and the hosted pipeline's budget moves — the
+    exact frame the ratekeeper's _maybe_push_rate sends."""
+    import json
+
+    procs = [
+        mp.spawn_role("resolver", str(tmp_path)),
+        mp.spawn_role("tlog", str(tmp_path)),
+        mp.spawn_role("storage", str(tmp_path)),
+        mp.spawn_role("worker", str(tmp_path), worker_id="wpush"),
+    ]
+    try:
+        async def scenario():
+            worker = await mp.connect(procs[3].address)
+            init = await worker.call(
+                mp.TOKEN_INIT_ROLE,
+                mp.InitializeRole(payload=json.dumps({
+                    "kind": "proxy", "epoch": 0, "recover": False,
+                    "topology": {
+                        "resolvers": [procs[0].address],
+                        "tlog": procs[1].address,
+                        "storage": procs[2].address,
+                    },
+                })),
+            )
+            assert json.loads(init.payload)["ok"]
+            law = AdmissionController(
+                clock=time.monotonic, max_tps=5000.0
+            )
+            law.tps_budget = 77.0
+            rep = await worker.call(
+                mp.TOKEN_RATE_UPDATE,
+                mp.RateUpdate(payload=json.dumps(law.rate_info())),
+            )
+            assert json.loads(rep.payload)["ok"]
+            status = json.loads((await worker.call(
+                mp.TOKEN_STATUS, mp.StatusRequest(pad=0)
+            )).payload)
+            grv = status["grv_proxy"]["qos"]
+            assert grv["transactions_per_second_limit"] == 77.0
+            assert grv["rate_pushes_applied"] == 1
+            await worker.close()
+
+        run(scenario())
+    finally:
+        for p in procs:
+            p.stop()
+
+
+# ---------------------------------------------------------------------------
+# the split over a real two-resolver wire pipeline
+
+
+def test_two_resolver_split_pipeline(tmp_path):
+    """Boundaries split the batch: conflicts are detected inside each
+    partition AND across the boundary (a straddling read clips into
+    both), blind writes commit, and MVCC versioning holds."""
+    procs = [
+        mp.spawn_role("resolver", str(tmp_path), index=0),
+        mp.spawn_role("resolver", str(tmp_path), index=1),
+        mp.spawn_role("tlog", str(tmp_path)),
+        mp.spawn_role("storage", str(tmp_path)),
+    ]
+    try:
+        async def scenario():
+            r0 = await mp.connect(procs[0].address)
+            r1 = await mp.connect(procs[1].address)
+            tlog = await mp.connect(procs[2].address)
+            storage = await mp.connect(procs[3].address)
+            pipe = mp.ProxyPipeline(
+                [r0, r1], tlog, storage,
+                resolver_boundaries=[b"\x80"],
+            )
+            pipe.start()
+            lo_key, hi_key = b"\x10lo", b"\xf0hi"
+            v1 = await pipe.commit(CommitTransaction(
+                write_conflict_ranges=[(lo_key, lo_key + b"\x00")],
+                mutations=[Mutation(0, lo_key, b"1")],
+            ))
+            v2 = await pipe.commit(CommitTransaction(
+                write_conflict_ranges=[(hi_key, hi_key + b"\x00")],
+                mutations=[Mutation(0, hi_key, b"2")],
+            ))
+            # stale reader in the LOW partition conflicts (only
+            # resolver0 holds that history)
+            with pytest.raises(mp.NotCommittedError):
+                await pipe.commit(CommitTransaction(
+                    read_conflict_ranges=[(lo_key, lo_key + b"\x00")],
+                    read_snapshot=0,
+                ))
+            # stale reader in the HIGH partition conflicts too
+            with pytest.raises(mp.NotCommittedError):
+                await pipe.commit(CommitTransaction(
+                    read_conflict_ranges=[(hi_key, hi_key + b"\x00")],
+                    read_snapshot=0,
+                ))
+            # a stale read STRADDLING the boundary conflicts (either
+            # side's clipped piece suffices)
+            with pytest.raises(mp.NotCommittedError):
+                await pipe.commit(CommitTransaction(
+                    read_conflict_ranges=[(b"\x10", b"\xf1")],
+                    read_snapshot=0,
+                ))
+            # fresh snapshots commit
+            rv = await pipe.get_read_version()
+            v3 = await pipe.commit(CommitTransaction(
+                read_conflict_ranges=[(b"\x10", b"\xf1")],
+                write_conflict_ranges=[(lo_key, lo_key + b"\x00")],
+                read_snapshot=rv,
+                mutations=[Mutation(0, lo_key, b"3")],
+            ))
+            assert v3 > v2 > v1
+            assert await pipe.read(lo_key, v3) == b"3"
+            assert await pipe.read(lo_key, v1) == b"1"
+            assert await pipe.read(hi_key, v3) == b"2"
+            await pipe.stop()
+            for c in (r0, r1, tlog, storage):
+                await c.close()
+
+        run(scenario())
+    finally:
+        for p in procs:
+            p.stop()
+
+
+def test_boundary_count_validated():
+    class _Conn:
+        pass
+
+    with pytest.raises(ValueError, match="boundary"):
+        mp.ProxyPipeline([_Conn(), _Conn()], _Conn(), _Conn(),
+                         resolver_boundaries=[b"\x40", b"\x80"])
+
+
+# ---------------------------------------------------------------------------
+# modeled compute locality
+
+
+def test_local_txns_counts_partition_work():
+    role = mp.ResolverRole.__new__(mp.ResolverRole)
+    req = mp.ResolveTransactionBatchRequest(
+        prev_version=-1, version=100, last_received_version=-1,
+        transactions=[
+            _txn(reads=[(b"a", b"b")]),
+            _txn(),                       # clipped-out foreign slot
+            _txn(writes=[(b"c", b"d")]),
+        ],
+    )
+    assert role._local_txns(req) == 2
+    from foundationdb_tpu.utils import packing
+    from foundationdb_tpu.wire import codec
+
+    creq = codec.ResolveBatchColumnar(
+        prev_version=-1, version=100, last_received_version=-1,
+        cols=packing.pack_columnar(req.transactions),
+    )
+    assert role._local_txns(creq) == 2

@@ -252,6 +252,10 @@ def test_fdbtop_check_status_gate_both_directions():
                 "ratekeeper0": {"role": "ratekeeper", "qos": {
                     "transactions_per_second_limit": 1e7,
                     "budget_limited_by": {"name": "workload"},
+                    # r15: the law's binding-limiter streak (the
+                    # elasticity trigger input) ships in rate_info
+                    "binding_streak": {"name": "workload",
+                                       "intervals": 1},
                     "budget_stale": False}},
             },
         }

@@ -576,6 +576,67 @@ def test_every_declared_probe_fires():
             1000 + 100 * i,
         )
 
+    # -- ycsb_d soak twin (ISSUE 15) --------------------------------------
+    # the read-latest check fires on most rounds; the frontier-persisted
+    # probe needs a read landing >= 5 rounds behind the frontier, which
+    # the exponential access law makes common per seed
+    run_seed(1, spec="ycsb_d")
+
+    # -- elasticity trigger (ISSUE 15) ------------------------------------
+    # a resolver_busy binding streak past the threshold, on a healthy
+    # (non-stale) feed, flags the elastic recruit walk
+    from foundationdb_tpu.cluster.multiprocess import ClusterControllerRole
+
+    ctrl = ClusterControllerRole(
+        {"resolvers": 1, "elastic": True, "elastic_streak": 2}
+    )
+    ctrl._needs_recovery = False
+    ctrl._rk_qos = {
+        "binding_streak": {"name": "resolver_busy", "intervals": 5},
+        "budget_stale": False,
+    }
+    ctrl._elastic_check()
+    assert ctrl.elastic_recruits == 1
+
+    # -- autotune probes (ISSUE 15) ---------------------------------------
+    # cache_hit: the second sweep over the same ledger resumes every
+    # trial; roofline_stop: a trial achieving the (tiny) target frac of
+    # the bytes-bound ceiling stops the search early
+    import tempfile as _tf
+
+    from foundationdb_tpu.utils import autotune
+
+    def _trial(knobs):
+        rec = perf.make_record(
+            "probe_drive",
+            {"txn_s": perf.metric(1000.0, "txn/s", "higher",
+                                  tier="structural")},
+            knobs=knobs,
+            fingerprint={
+                "backend": "tpu", "device_kind": "TPU v5e",
+                "device_count": 1, "jax_version": None,
+                "jaxlib_version": None, "python_version": None,
+                "machine": None,
+            },
+            git_sha="t", timestamp=0.0,
+        )
+        rec["extra"] = {"hlo_cost": {"bytes_accessed": 8.19e8}}
+        return rec
+
+    with _tf.TemporaryDirectory() as td:
+        ledger = f"{td}/search.jsonl"
+        space = autotune.SearchSpace({"fuse": (8, 16)})
+        autotune.run_search("probe", space, _trial,
+                            objective_metric="txn_s", ledger=ledger)
+        autotune.run_search("probe", space, _trial,
+                            objective_metric="txn_s", ledger=ledger)
+        rep = autotune.run_search(
+            "probe-roofline", space, _trial, objective_metric="txn_s",
+            ledger=ledger, roofline_txns_per_dispatch=1024,
+            roofline_frac=9e-4,
+        )
+        assert rep.stopped == "roofline"
+
     assert probes.missed() == [], (
         f"declared CODE_PROBEs never fired: {probes.missed()}\n"
         f"fired: { {k: v for k, v in probes.snapshot().items() if v} }"

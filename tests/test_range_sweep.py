@@ -312,6 +312,82 @@ def test_spill_stream_completes_with_zero_exact_fallbacks():
         off.check_overflow()
 
 
+def dup_txn(rng, *, snap_lo, snap_hi, **_kw):
+    """Overlapping-write shape: every batch writes the SAME 8 keys, so
+    the delta tier's REAL live boundary count stays ~constant while the
+    conservative 2*max_writes-per-batch bound grows linearly."""
+    k = int(rng.integers(0, 8)) * 16
+    return CommitTransaction(
+        read_conflict_ranges=[(ikey(k), ikey(k + 2))],
+        write_conflict_ranges=[(ikey(k), ikey(k + 2)),
+                               (ikey(k + 4), ikey(k + 6))],
+        read_snapshot=int(rng.integers(snap_lo, snap_hi)),
+    )
+
+
+def test_spill_bound_anchors_to_live_occupancy(monkeypatch):
+    """ISSUE 15 (ROADMAP PR-14 headroom (b)): the overflow-check sync's
+    live boundary count re-anchors the host-side spill bound, so an
+    overlapping-write stream spills strictly FEWER times than the
+    conservative 2*max_writes accounting would — with decisions
+    unchanged vs a never-spilling reference. The old accounting is
+    replayed arithmetically here (that's all it was: host arithmetic)
+    as the pinned worse-case."""
+    from foundationdb_tpu.models import conflict_set as cs_mod
+
+    monkeypatch.setattr(cs_mod, "OVERFLOW_CHECK_INTERVAL", 4)
+    rng = np.random.default_rng(7)
+    # capacity holds the REAL occupancy (~32 live rows) plus one
+    # anchor interval's conservative accrual (4 * 2*max_writes = 256),
+    # but NOT the unanchored linear accrual — exactly the regime the
+    # measured count fixes
+    cfg = spill_config(delta_capacity=320)
+    n_batches = 16
+    stream = gen_stream(rng, n_batches, dup_txn)
+    cs = TpuConflictSet(cfg)
+    res = run_resolve(cs, stream)
+    c = cs.metrics.counters
+    spills = c.get("spills")
+    assert c.get("spillBoundAnchors") > 0, (
+        "the overflow-check sync never tightened the bound"
+    )
+    # the conservative accounting this PR replaces, replayed exactly:
+    # += 2*max_writes per batch, spill-and-reset when the next batch
+    # could overflow
+    bound = conservative_spills = 0
+    for _ in range(n_batches):
+        add = 2 * cfg.max_writes
+        if bound + add > cfg.delta_capacity:
+            conservative_spills += 1
+            bound = 0
+        bound += add
+    assert conservative_spills >= 2 * max(1, spills), (
+        f"tightened bound should spill ~2x less: measured {spills}, "
+        f"conservative {conservative_spills}"
+    )
+    assert c.get("exactFallbacks") == 0
+    assert c.get("overflowRaised") == 0
+
+    ref = TpuConflictSet(
+        dataclasses.replace(cfg, delta_capacity=4096, delta_spill=False)
+    )
+    assert_results_match(res, run_resolve(ref, stream),
+                         "anchored spill vs big delta")
+
+
+def test_spill_bound_anchor_never_loosens():
+    """The re-anchor is min(bound, live): a live count ABOVE the
+    accrued bound (impossible by construction, but the invariant is
+    what keeps spill decisions conservative) must never raise it."""
+    cfg = spill_config()
+    cs = TpuConflictSet(cfg)
+    cs._spill_bound_rows = 10
+    cs._re_anchor_spill_bound(50.0)
+    assert cs._spill_bound_rows == 10
+    cs._re_anchor_spill_bound(3.0)
+    assert cs._spill_bound_rows == 3
+
+
 @pytest.mark.parametrize("interval", [0, 1, 4])
 def test_spill_decisions_invariant_vs_compact_interval(interval):
     """Pressure spills interleave with (or replace) cadence compaction;
