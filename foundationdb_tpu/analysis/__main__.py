@@ -14,9 +14,14 @@ from pathlib import Path
 from foundationdb_tpu.analysis import baseline as baseline_mod
 from foundationdb_tpu.analysis import manifest as manifest_mod
 from foundationdb_tpu.analysis import registry
-from foundationdb_tpu.analysis.report import render, run_analysis
+from foundationdb_tpu.analysis.report import (
+    render,
+    render_timings,
+    run_analysis,
+)
 from foundationdb_tpu.analysis.rules_probes import tree_manifest
 from foundationdb_tpu.analysis.rules_trace import tree_trace_manifest
+from foundationdb_tpu.analysis.rules_wire import tree_wire_manifest
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -52,6 +57,14 @@ def main(argv: list[str] | None = None) -> int:
         help="regenerate analysis/trace_manifest.json from the tree",
     )
     ap.add_argument(
+        "--write-wire-manifest", action="store_true",
+        help="regenerate analysis/wire_manifest.json from the tree",
+    )
+    ap.add_argument(
+        "--timings", action="store_true",
+        help="print the per-rule-family wall-time breakdown",
+    )
+    ap.add_argument(
         "--rules", action="store_true", help="print the rule catalog",
     )
     args = ap.parse_args(argv)
@@ -81,6 +94,14 @@ def main(argv: list[str] | None = None) -> int:
         result = run_analysis(
             root=args.root, use_baseline=not args.no_baseline
         )
+    if args.write_wire_manifest:
+        manifest_mod.save_wire_manifest(
+            tree_wire_manifest(result.contexts)
+        )
+        print(f"wrote {manifest_mod.wire_manifest_path()}")
+        result = run_analysis(
+            root=args.root, use_baseline=not args.no_baseline
+        )
     if args.write_baseline:
         baseline_mod.save_baseline(result.findings)
         print(
@@ -90,6 +111,8 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     render(result, show_all=args.all)
+    if args.timings:
+        render_timings(result)
     return 0 if result.ok else 1
 
 

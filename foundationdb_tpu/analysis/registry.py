@@ -66,4 +66,5 @@ def load_rules() -> None:
         rules_jax,
         rules_probes,
         rules_trace,
+        rules_wire,
     )

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import sys
+import time
 from collections import Counter
 from pathlib import Path
 
@@ -32,6 +33,9 @@ class AnalysisResult:
     baselined: list[Finding]
     stale: Counter               # baseline entries nothing matched (fixed)
     suppressed: int              # findings absorbed by ignore[] comments
+    #: rule family -> wall seconds (plus "parse"), for --timings: cost
+    #: regressions in the static pass stay visible, not discovered by feel
+    timings: dict = dataclasses.field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -58,7 +62,14 @@ def run_analysis(
     root = (root or Path(__file__).resolve().parents[2])
     ctxs: list[FileContext] = []
     findings: list[Finding] = []
+    timings: dict[str, float] = {}
+
+    def _family(fn) -> str:
+        leaf = fn.__module__.rsplit(".", 1)[-1]
+        return leaf[len("rules_"):] if leaf.startswith("rules_") else leaf
+
     for path in walker.discover(root):
+        t0 = time.perf_counter()
         try:
             ctx = walker.parse_file(root, path)
         except SyntaxError as e:
@@ -70,12 +81,24 @@ def run_analysis(
                 message=str(e.msg),
             ))
             continue
+        finally:
+            timings["parse"] = (
+                timings.get("parse", 0.0) + time.perf_counter() - t0
+            )
         ctxs.append(ctx)
         for check in registry.FILE_CHECKS:
+            t0 = time.perf_counter()
             check(ctx)
+            fam = _family(check)
+            timings[fam] = (
+                timings.get(fam, 0.0) + time.perf_counter() - t0
+            )
         findings.extend(ctx.findings)
     for tree_rule in registry.TREE_CHECKS:
+        t0 = time.perf_counter()
         findings.extend(tree_rule(ctxs, manifest_path=manifest_path))
+        fam = _family(tree_rule)
+        timings[fam] = timings.get(fam, 0.0) + time.perf_counter() - t0
 
     # the stale-suppression audit: after EVERY rule has run, an
     # ignore[] pattern that absorbed no finding is dead weight — the
@@ -122,6 +145,7 @@ def run_analysis(
         baselined=baselined,
         stale=stale,
         suppressed=sum(len(c.suppressed) for c in ctxs),
+        timings=timings,
     )
 
 
@@ -148,3 +172,17 @@ def render(result: AnalysisResult, *, show_all: bool = False,
             "baseline",
             file=out,
         )
+
+
+def render_timings(result: AnalysisResult, out=None) -> None:
+    """Per-family wall-time breakdown (--timings): slowest first, so a
+    rule family that regresses the gate's cost names itself."""
+    out = out or sys.stdout
+    total = sum(result.timings.values())
+    for fam, secs in sorted(
+        result.timings.items(), key=lambda kv: -kv[1]
+    ):
+        print(f"flowcheck timing: {fam:12s} {secs * 1000:7.1f}ms",
+              file=out)
+    print(f"flowcheck timing: {'total':12s} {total * 1000:7.1f}ms",
+          file=out)

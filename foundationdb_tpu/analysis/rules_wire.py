@@ -1,0 +1,340 @@
+"""The wire-protocol contract pass: `wire.*`.
+
+PR 13 made three properties load-bearing for the wire cluster — epoch
+fencing on txn-path handlers, token-dispatched RPC, and
+CodecError-never-crash decoding — but only by convention. The reference
+gets the equivalent guarantees from its build system: FlowTransport
+endpoints are typed, FileIdentifiers are unique by a compile step, and
+`serializer(ar, ...)` makes encode/decode one declaration
+(fdbrpc/fdbrpc.h, flow/flat_buffers.h). These tree rules re-create that
+hostility to silent protocol drift over the hand-rolled Python wire
+layer, driven by the AST-extracted registry in `wire_registry.py` (the
+same registry `scripts/wire_fuzz.py` mutates at runtime):
+
+* wire.token-collision — two frames registered on one type id, or two
+  TOKEN_* endpoints on one value: dispatch becomes ambiguous the day it
+  happens, loudly here instead.
+* wire.codec-field-drift — a hand-written encode/decode pair whose
+  primitive op streams diverge, or whose field sets differ (encoder
+  writes a field the decoder never reconstructs): the classic
+  silent-corruption bug `serializer(...)` makes impossible.
+* wire.epoch-unfenced-handler — a registered handler for an
+  epoch-carrying frame that awaits or mutates role state before the
+  stale_epoch fence: a stale-generation message could act on a
+  recovered role.
+* wire.call-without-timeout — an RPC call site with no explicit bound:
+  one dead peer wedges the caller forever.
+* wire.unclassified-error — an RPC call site whose failures no
+  enclosing except clause classifies retryable-or-not; an escaping raw
+  transport error skips the caller's retry/fail-safe policy. Sites
+  whose classification boundary is a caller one frame up carry a
+  justified `# flowcheck: ignore[wire.unclassified-error]` naming it.
+* wire.manifest-drift — `analysis/wire_manifest.json` out of date with
+  the tree; changing the message set without bumping PROTOCOL_VERSION
+  is called out specifically (mixed-version peers would disagree about
+  frame layouts while handshaking identically).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from foundationdb_tpu.analysis import manifest as manifest_mod
+from foundationdb_tpu.analysis import wire_registry as wr
+from foundationdb_tpu.analysis.registry import rule, tree_check
+from foundationdb_tpu.analysis.walker import FileContext, Finding
+
+R_COLLISION = rule(
+    "wire.token-collision",
+    "two frames share a type id, or two TOKEN_* endpoints share a value",
+)
+R_DRIFT_CODEC = rule(
+    "wire.codec-field-drift",
+    "hand-written encode/decode pair out of sync (op stream or field set)",
+)
+R_UNFENCED = rule(
+    "wire.epoch-unfenced-handler",
+    "handler for an epoch-carrying frame awaits/mutates state before "
+    "the stale_epoch fence",
+)
+R_NO_TIMEOUT = rule(
+    "wire.call-without-timeout",
+    "RPC call site without an explicit timeout bound",
+)
+R_UNCLASSIFIED = rule(
+    "wire.unclassified-error",
+    "RPC call site whose errors no enclosing except classifies",
+)
+R_DRIFT_MANIFEST = rule(
+    "wire.manifest-drift",
+    "wire_manifest.json does not match the tree (--write-wire-manifest; "
+    "message-set changes must bump PROTOCOL_VERSION)",
+)
+
+#: mutating container/dict methods: `self.x.append(...)` before the
+#: fence is role-state mutation even though no attribute is assigned
+MUTATOR_METHODS = {
+    "append", "add", "update", "extend", "insert", "remove", "discard",
+    "clear", "pop", "popleft", "setdefault", "appendleft",
+}
+
+
+def wire_contexts(ctxs: list[FileContext]) -> list[FileContext]:
+    """THE exclusion policy for the wire pass, shared by the tree check,
+    --write-wire-manifest, and (via the same discovery rule in
+    wire_registry.load_repo_registry) the fuzzer: skip this package —
+    rule docs and the extractor itself mention the scanned callables."""
+    return [c for c in ctxs if not c.rel.startswith("analysis/")]
+
+
+# ---------------------------------------------------------------------------
+# wire.epoch-unfenced-handler: the fence-precedes-effects path scan.
+
+
+def _is_fence(stmt: ast.stmt) -> bool:
+    """The two fence idioms: a `_fence_epoch(req, role)` call statement,
+    or the inline `if req.epoch < self.epoch: ... raise` compare."""
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        leaf = wr._leaf(stmt.value.func)
+        if leaf and leaf.endswith("fence_epoch"):
+            return True
+    if isinstance(stmt, ast.If):
+        tests_epoch = any(
+            isinstance(n, ast.Attribute) and n.attr == "epoch"
+            for n in ast.walk(stmt.test)
+        )
+        raises = any(
+            isinstance(n, ast.Raise)
+            for s in stmt.body for n in ast.walk(s)
+        )
+        return tests_epoch and raises
+    return False
+
+
+def _stmt_effect(stmt: ast.stmt) -> ast.AST | None:
+    """First await or self-state mutation anywhere inside `stmt` (the
+    full compound statement — a fence nested past an effect can't save
+    it), or None. Local work (assigns to locals, pure calls, trace
+    emits) passes through."""
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Await):
+            return n
+        if isinstance(n, (ast.Assign, ast.AugAssign)):
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    return n
+                if isinstance(t, ast.Subscript):
+                    base = t.value
+                    while isinstance(base, ast.Subscript):
+                        base = base.value
+                    if isinstance(base, ast.Attribute) \
+                            and isinstance(base.value, ast.Name) \
+                            and base.value.id == "self":
+                        return n
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in MUTATOR_METHODS:
+            base = n.func.value
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id == "self":
+                return n
+    return None
+
+
+def unfenced_effect(handler: ast.AsyncFunctionDef) -> ast.AST | None:
+    """The first await/state-mutation a stale-epoch message would reach,
+    if it comes before any fence; None when the handler fences first."""
+    for stmt in handler.body:
+        if _is_fence(stmt):
+            return None
+        effect = _stmt_effect(stmt)
+        if effect is not None:
+            return effect
+    return None
+
+
+# ---------------------------------------------------------------------------
+# wire.manifest-drift: diff rendering.
+
+
+def _manifest_diff(stored: dict, cur: dict) -> str:
+    parts = []
+    for key in ("frames", "tokens"):
+        s, c = stored.get(key, {}), cur.get(key, {})
+        added = sorted(set(c) - set(s))
+        removed = sorted(set(s) - set(c))
+        changed = sorted(k for k in set(c) & set(s) if c[k] != s[k])
+        if added:
+            parts.append(f"new {key}: {added[:4]}")
+        if removed:
+            parts.append(f"removed {key}: {removed[:4]}")
+        if changed:
+            parts.append(f"changed {key}: {changed[:4]}")
+    if stored.get("protocol_version") != cur.get("protocol_version"):
+        parts.append(
+            f"protocol_version {stored.get('protocol_version')} -> "
+            f"{cur.get('protocol_version')}"
+        )
+    return "; ".join(parts) or "layout detail changed"
+
+
+@tree_check
+def check_wire(ctxs: list[FileContext],
+               manifest_path: Path | None = None) -> list[Finding]:
+    findings: list[Finding] = []
+    by_path = {c.path: c for c in ctxs}
+
+    def report(path: str, node: ast.AST, rule_id: str,
+               message: str) -> None:
+        ctx = by_path.get(path)
+        if ctx is None:
+            return
+        before = len(ctx.findings)
+        ctx.report(node, rule_id, message)
+        # move from the per-file list into the tree result, so line
+        # ignore-comment suppressions apply normally
+        if len(ctx.findings) > before:
+            findings.append(ctx.findings.pop())
+
+    reg = wr.aggregate([wr.facts_of(c) for c in wire_contexts(ctxs)])
+
+    # -- wire.token-collision: one namespace at a time. Frame ids and
+    # endpoint tokens are DIFFERENT namespaces (TOKEN_RESOLVE == 0x0101
+    # == the CommitTransaction frame id is fine; two frames on 0x0101
+    # is not).
+    by_id: dict[int, list] = {}
+    for f in reg.frames:
+        by_id.setdefault(f.type_id, []).append(f)
+    for type_id, decls in sorted(by_id.items()):
+        if len(decls) > 1:
+            names = ", ".join(d.name for d in decls)
+            for d in decls[1:]:
+                report(
+                    d.path, d.node, R_COLLISION,
+                    f"frame id 0x{type_id:04x} registered twice: {names}",
+                )
+    by_val: dict[int, list] = {}
+    for t in reg.tokens:
+        by_val.setdefault(t.value, []).append(t)
+    for value, decls in sorted(by_val.items()):
+        if len(decls) > 1:
+            names = ", ".join(d.name for d in decls)
+            for d in decls[1:]:
+                report(
+                    d.path, d.node, R_COLLISION,
+                    f"endpoint token 0x{value:04x} bound twice: {names}",
+                )
+
+    # -- wire.codec-field-drift: hand-written pairs only. `_message`
+    # frames generate encode and decode from ONE kinds list — drift is
+    # impossible by construction, which is exactly the serializer(...)
+    # property this rule enforces on the pairs written by hand.
+    for f in reg.frames:
+        if f.style != "handwritten":
+            continue
+        enc = reg.codec_funcs.get(f.encoder or "")
+        dec = reg.codec_funcs.get(f.decoder or "")
+        if enc is None or dec is None:
+            continue  # registered from a module the pass can't see
+        funcs = {name: fn for name, (_p, fn) in reg.codec_funcs.items()}
+        w_ops = wr.expand_ops(wr.encoder_ops(enc[1]), funcs, "w")
+        r_ops = wr.expand_ops(wr.decoder_ops(dec[1]), funcs, "r")
+        if w_ops != r_ops:
+            report(
+                f.path, f.node, R_DRIFT_CODEC,
+                f"{f.name}: encoder op stream "
+                f"[{wr.ops_signature(w_ops)}] != decoder "
+                f"[{wr.ops_signature(r_ops)}]",
+            )
+            continue
+        wf = wr.encoder_fields(enc[1])
+        rf = wr.decoder_fields(dec[1])
+        # `span` unpacks via an attribute read either way; only flag
+        # fields one side has and the other lacks entirely
+        only_w = sorted(wf - rf - {"span"})
+        only_r = sorted(rf - wf)
+        if only_w or only_r:
+            detail = []
+            if only_w:
+                detail.append(f"encoded but never decoded: {only_w}")
+            if only_r:
+                detail.append(f"decoded but never encoded: {only_r}")
+            report(
+                f.path, f.node, R_DRIFT_CODEC,
+                f"{f.name}: {'; '.join(detail)}",
+            )
+
+    # -- wire.epoch-unfenced-handler: only REGISTERED handlers (helpers
+    # like _resolve_ordered run behind an already-fenced entry point,
+    # and the in-process Resolver shares method names but is never
+    # token-dispatched). Registration scope is per-file: the module
+    # that registers a token names the handler it dispatches to.
+    epoch_frames = reg.epoch_frames()
+    registered = {
+        (r.path, r.handler) for r in reg.handler_regs if r.handler
+    }
+    for hd in reg.handler_defs:
+        if (hd.path, hd.method) not in registered \
+                or hd.frame not in epoch_frames:
+            continue
+        effect = unfenced_effect(hd.node)
+        if effect is not None:
+            where = f"{hd.cls}.{hd.method}" if hd.cls else hd.method
+            report(
+                hd.path, effect, R_UNFENCED,
+                f"{where}({hd.frame}) reaches an await/state mutation "
+                "before the stale_epoch fence",
+            )
+
+    # -- wire.call-without-timeout / wire.unclassified-error
+    for site in reg.call_sites:
+        if not site.has_timeout:
+            report(
+                site.path, site.node, R_NO_TIMEOUT,
+                f"conn.call({site.token}, ...) has no explicit timeout=",
+            )
+        if not site.classified:
+            report(
+                site.path, site.node, R_UNCLASSIFIED,
+                f"conn.call({site.token}, ...) errors escape "
+                "unclassified (no enclosing transport-aware except)",
+            )
+
+    # -- wire.manifest-drift
+    cur = reg.manifest()
+    stored = manifest_mod.load_wire_manifest(manifest_path)
+    empty_tree = not cur["frames"] and not cur["tokens"]
+    if stored != cur and not (not stored and empty_tree):
+        detail = _manifest_diff(stored, cur)
+        set_changed = (
+            stored.get("frames") != cur["frames"]
+            or stored.get("tokens") != cur["tokens"]
+        )
+        if stored and set_changed and (
+            stored.get("protocol_version") == cur["protocol_version"]
+        ):
+            message = (
+                f"wire message set changed without a PROTOCOL_VERSION "
+                f"bump ({detail}); bump wire/codec.py PROTOCOL_VERSION "
+                "and run --write-wire-manifest"
+            )
+        else:
+            message = f"{detail} (run --write-wire-manifest)"
+        findings.append(Finding(
+            path=("foundationdb_tpu/analysis/"
+                  + manifest_mod.WIRE_MANIFEST_NAME),
+            line=1,
+            rule=R_DRIFT_MANIFEST,
+            message=message,
+        ))
+    return findings
+
+
+def tree_wire_manifest(ctxs: list[FileContext]) -> dict:
+    """The manifest payload for --write-wire-manifest."""
+    reg = wr.aggregate([wr.facts_of(c) for c in wire_contexts(ctxs)])
+    return reg.manifest()

@@ -215,7 +215,10 @@ class Monitor:
             conn = mp.transport.RpcConnection(spec.controller)
             await conn.connect(retries=1, delay=0.05)
             try:
-                await conn.call(
+                # classification boundary is _notify_death's outer
+                # `except Exception` around asyncio.run(_send()):
+                # death-push failure is logged, never fatal
+                await conn.call(  # flowcheck: ignore[wire.unclassified-error]
                     mp.TOKEN_WORKER_DEATH,
                     mp.WorkerDeath(payload=json.dumps({
                         "worker_id": spec.name,

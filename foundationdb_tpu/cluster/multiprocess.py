@@ -1507,12 +1507,21 @@ class StorageRole:
         await conn.connect()
         try:
             while True:
-                rep = await conn.call(
-                    TOKEN_TLOG_PEEK_BATCH,
-                    TLogPeekBatchReq(
-                        after_version=self.version, max_entries=256
-                    ),
-                )
+                try:
+                    rep = await conn.call(
+                        TOKEN_TLOG_PEEK_BATCH,
+                        TLogPeekBatchReq(
+                            after_version=self.version, max_entries=256
+                        ),
+                        timeout=30.0,
+                    )
+                except (transport.TransportError, ConnectionError,
+                        asyncio.TimeoutError) as e:
+                    # classify for the recovery caller: catch-up is
+                    # retryable against a fresh tlog address
+                    raise transport.RemoteError(
+                        f"tlog catch-up from {tlog_address} failed: {e!r}"
+                    ) from e
                 if not rep.versions:
                     break
                 reqs = [
@@ -1890,7 +1899,10 @@ class RatekeeperRole:
             conn = transport.RpcConnection(path, tls=_tls_from_env())
             await conn.connect(retries=1)
             self._conns[path] = conn
-        reply = await conn.call(
+        # classification boundary is _poll_loop's gather with
+        # return_exceptions=True: a failed poll counts poll_failures
+        # and invalidates the cached connection there
+        reply = await conn.call(  # flowcheck: ignore[wire.unclassified-error]
             TOKEN_STATUS, StatusRequest(pad=0), timeout=2.0
         )
         return _json.loads(reply.payload)
@@ -4146,6 +4158,7 @@ class ProxyPipeline:
                     versions=[v for _k, v, _f in pending],
                     keys=[k for k, _v, _f in pending],
                 ),
+                timeout=30.0,
             )
             for (_k, _v, fut), val in zip(pending, rep.values):
                 if not fut.done():
@@ -4173,6 +4186,7 @@ class ProxyPipeline:
                             versions=[v for v, _m in q],
                             groups=[m for _v, m in q],
                         ),
+                        timeout=30.0,
                     )
                 except Exception as e:
                     if self.failed is None:
@@ -4404,8 +4418,11 @@ class ProxyPipeline:
             else:
                 reqs = [object_req(txns)] * len(self.resolvers)
         t_resolve = loop.time()
+        # classification boundary is _commit_batch: any pipeline
+        # exception marks self.failed and fans RemoteError("commit
+        # pipeline: ...") out to every queued client future
         replies = await asyncio.gather(
-            *(r.call(TOKEN_RESOLVE, req)
+            *(r.call(TOKEN_RESOLVE, req, timeout=30.0)  # flowcheck: ignore[wire.unclassified-error]
               for r, req in zip(self.resolvers, reqs))
         )
         resolve_s = loop.time() - t_resolve
@@ -4433,7 +4450,9 @@ class ProxyPipeline:
         if self.failed is not None:
             raise PipelineFailedError(repr(self.failed))
         t_log = loop.time()
-        await self.tlog.call(
+        # classification boundary is _commit_batch (same fan-out as the
+        # resolve gather above)
+        await self.tlog.call(  # flowcheck: ignore[wire.unclassified-error]
             TOKEN_TLOG_PUSH,
             TLogPush(
                 version=version,
@@ -4441,6 +4460,7 @@ class ProxyPipeline:
                 mutations=mutations,
                 epoch=self.epoch,
             ),
+            timeout=30.0,
         )
         log_s = loop.time() - t_log
         if dbg is not None:
@@ -4551,7 +4571,17 @@ async def wire_cluster_status(
 
     procs: dict[str, dict] = {}
     for name, conn in roles.items():
-        reply = await conn.call(TOKEN_STATUS, StatusRequest(pad=0))
+        try:
+            reply = await conn.call(
+                TOKEN_STATUS, StatusRequest(pad=0), timeout=30.0
+            )
+        except (transport.TransportError, ConnectionError,
+                asyncio.TimeoutError) as e:
+            # classify: a status poll of one dead role names the role
+            # instead of surfacing a raw socket error to the CLI
+            raise transport.RemoteError(
+                f"status poll of role {name!r} failed: {e!r}"
+            ) from e
         procs[name] = _json.loads(reply.payload)
     if pipeline is not None:
         procs.update(_pipeline_status_blocks(pipeline))

@@ -200,7 +200,15 @@ def r_bytes(buf: memoryview, off: int) -> tuple[bytes, int]:
 
 def r_str(buf: memoryview, off: int) -> tuple[str | None, int]:
     b, off = r_bytes(buf, off)
-    return (b.decode("utf-8") if b else None), off
+    if not b:
+        return None, off
+    try:
+        return b.decode("utf-8"), off
+    except UnicodeDecodeError as e:
+        # wire_fuzz found this escaping as UnicodeDecodeError — any
+        # malformed payload must reject as CodecError, never crash the
+        # transport's decode path
+        raise CodecError(f"invalid utf-8 in str field: {e}") from None
 
 
 def r_bool(buf: memoryview, off: int) -> tuple[bool, int]:
@@ -404,7 +412,14 @@ def r_resolve_reply(
     committed = []
     for _ in range(n):
         v, off = r_u8(buf, off)
-        committed.append(TransactionResult(v))
+        try:
+            committed.append(TransactionResult(v))
+        except ValueError:
+            # wire_fuzz found the enum's ValueError escaping on a
+            # verdict byte outside the TransactionResult members
+            raise CodecError(
+                f"invalid TransactionResult verdict {v}"
+            ) from None
     n, off = r_u32(buf, off)
     ckr = {}
     for _ in range(n):
@@ -676,8 +691,13 @@ def decode(data: bytes | memoryview) -> Any:
         raise CodecError(f"unknown wire type id {tid:#06x}")
     try:
         msg, off = entry[1](buf, 2)
-    except struct.error as e:
-        raise CodecError(f"truncated message: {e}") from None
+    except CodecError:
+        raise
+    except (struct.error, ValueError, IndexError, OverflowError) as e:
+        # defense in depth for the decoder contract (CodecError or a
+        # clean decode, nothing else): struct truncations and any
+        # malformed-value error a field decoder lets slip both reject
+        raise CodecError(f"malformed message: {e}") from None
     if off != len(buf):
         raise CodecError(f"{len(buf) - off} trailing bytes after message")
     return msg

@@ -19,9 +19,18 @@ cd "$(dirname "$0")/.."
 
 echo "== flowcheck (python -m foundationdb_tpu.analysis) =="
 t0=$(date +%s.%N)
-JAX_PLATFORMS=cpu python -m foundationdb_tpu.analysis
+JAX_PLATFORMS=cpu python -m foundationdb_tpu.analysis --timings
 t1=$(date +%s.%N)
 awk -v a="$t0" -v b="$t1" 'BEGIN {printf "flowcheck wall time: %.1fs\n", b - a}'
+
+echo "== wire-fuzz smoke (corpus replay + ~1k seeded mutations over    =="
+echo "== every registered frame: decode must reject with CodecError,   =="
+echo "== never crash/hang/partial-decode — exit-code enforced; the     =="
+echo "== wire-manifest drift gate itself runs inside flowcheck above)  =="
+t0=$(date +%s.%N)
+JAX_PLATFORMS=cpu python scripts/wire_fuzz.py --smoke
+t1=$(date +%s.%N)
+awk -v a="$t0" -v b="$t1" 'BEGIN {printf "wire-fuzz smoke wall time: %.1fs\n", b - a}'
 
 echo "== kernel-parity smoke (tiny shapes: classic + tiered + dedup    =="
 echo "== fallback vs the Python oracle — seconds, compile-bound)       =="

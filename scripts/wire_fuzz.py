@@ -1,0 +1,347 @@
+#!/usr/bin/env python
+"""Structure-aware wire-codec fuzzer: the runtime twin of `wire.*`.
+
+The static pass (`analysis/rules_wire.py`) proves the frame inventory is
+coherent; this script proves the DECODERS honor the one contract the
+transport relies on: any byte sequence either decodes or raises
+CodecError — never a crash (a raw ValueError/UnicodeDecodeError would
+escape `transport`'s framing as a connection-killing internal error),
+never a hang, never a silent partial decode (trailing bytes reject).
+The reference trusts its simulator's BUGGIFYd network for the same
+property; here a seeded mutator stands in.
+
+Driven from the SAME AST-extracted registry the flowcheck family
+checks (`analysis/wire_registry.py`): for every registered frame a
+valid sample message is encoded, then deterministically mutated —
+truncations at every boundary, magic byte stamps (0xff/0x80/0x01 at
+every offset: length-prefix and enum bytes live there), 4-byte
+little-endian count/length patches, trailing junk — and every mutant
+is fed to `codec.decode`. Verdicts: ok (mutant is some other valid
+frame), reject (CodecError), FAIL (anything else — the bug class this
+exists to catch).
+
+Deterministic per seed: one `random.Random(f"{seed}:{frame}")` per
+frame, and the run digest (sha256 over every case descriptor+verdict)
+is printed so two runs with one seed are byte-comparable.
+
+The rejecting corpus in tests/fixtures/wire_fuzz_corpus.json is
+committed for regression replay (every entry must still reject) and
+includes the targeted cases that demonstrated real decoder bugs:
+invalid UTF-8 inside a str field and an out-of-range TransactionResult
+verdict byte, both of which once escaped as non-CodecError exceptions.
+
+  scripts/wire_fuzz.py --smoke          # ~1k mutations, CI lane
+  scripts/wire_fuzz.py                  # full sweep
+  scripts/wire_fuzz.py --write-corpus   # regenerate the replay corpus
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import random
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from foundationdb_tpu.analysis import wire_registry as wr  # noqa: E402
+from foundationdb_tpu.cluster import multiprocess as mp  # noqa: E402
+from foundationdb_tpu.models.types import (  # noqa: E402
+    CommitTransaction,
+    ResolveTransactionBatchReply,
+    ResolveTransactionBatchRequest,
+    TransactionResult,
+)
+from foundationdb_tpu.utils import packing  # noqa: E402
+from foundationdb_tpu.wire import codec  # noqa: E402
+
+CORPUS_PATH = REPO / "tests" / "fixtures" / "wire_fuzz_corpus.json"
+DEFAULT_SEED = 20160
+
+
+def _sample_txn(tag: bytes = b"") -> CommitTransaction:
+    return CommitTransaction(
+        read_conflict_ranges=[(b"a" + tag, b"b"), (b"k\x00", b"k\xff")],
+        write_conflict_ranges=[(b"w" + tag, b"x")],
+        read_snapshot=41,
+        report_conflicting_keys=True,
+        mutations=[
+            codec.Mutation(0, b"key" + tag, b"value"),
+            codec.Mutation(1, b"d", b""),
+        ],
+        lock_aware=True,
+        debug_id="txn-0",
+        span=(7, 9),
+    )
+
+
+#: one representative runtime value per declarative field kind; a new
+#: kind in _WRITERS with no sample here fails the fuzzer loudly, which
+#: is the point — every kind must be fuzzable
+_KIND_SAMPLES = {
+    "u8": 3,
+    "u16": 9,
+    "u32": 70_000,
+    "i64": -12_345,
+    "u64": (1 << 40) + 5,
+    "bool": True,
+    "bytes": b"payload\x00\xff",
+    "str": "status-json",
+    "optbytes": b"opt-value",
+    "mutlist": [codec.Mutation(0, b"k1", b"v1"),
+                codec.Mutation(2, b"r0", b"r9")],
+    "kvlist": [(b"k", b"v"), (b"k2", b"v2")],
+    "i64list": [1, 5, 7],
+    "mutgroups": [[codec.Mutation(0, b"a", b"1")],
+                  [codec.Mutation(0, b"b", b"2"),
+                   codec.Mutation(1, b"c", b"")]],
+    "byteslist": [b"aa", b"bb"],
+    "optbyteslist": [b"aa", None],
+    "txn": _sample_txn(),
+}
+
+
+def _handwritten_samples() -> dict[str, object]:
+    txns = [_sample_txn(), _sample_txn(b"2")]
+    return {
+        "CommitTransaction": _sample_txn(),
+        "ResolveTransactionBatchRequest": ResolveTransactionBatchRequest(
+            prev_version=-1, version=100, last_received_version=90,
+            transactions=txns, txn_state_transactions=[1],
+            proxy_id="proxy0", debug_id="batch-1", epoch=3, span=(1, 2),
+        ),
+        "ResolveTransactionBatchReply": ResolveTransactionBatchReply(
+            committed=[TransactionResult.COMMITTED,
+                       TransactionResult.CONFLICT,
+                       TransactionResult.TOO_OLD],
+            conflicting_key_range_map={1: [0, 2]},
+            state_mutations=[(100, [codec.Mutation(0, b"s", b"m")])],
+            private_mutations={0: [codec.Mutation(1, b"p", b"")]},
+            debug_id="batch-1",
+        ),
+        "ResolveBatchColumnar": codec.ResolveBatchColumnar(
+            prev_version=-1, version=100, last_received_version=90,
+            cols=packing.pack_columnar(txns),
+            proxy_id="proxy0", debug_id="batch-1", span=(3, 4), epoch=2,
+        ),
+    }
+
+
+def build_samples(registry: wr.WireRegistry) -> dict[str, bytes]:
+    """frame name -> one valid encoded blob, for EVERY frame the static
+    registry knows. Also the registry<->runtime cross-check: a frame
+    extracted statically must be registered at runtime and vice versa."""
+    static_ids = {f.type_id for f in registry.frames}
+    runtime_ids = set(codec._REGISTRY)
+    if static_ids != runtime_ids:
+        only_s = sorted(hex(i) for i in static_ids - runtime_ids)
+        only_r = sorted(hex(i) for i in runtime_ids - static_ids)
+        raise SystemExit(
+            f"wire_fuzz: static registry != runtime registry "
+            f"(static-only {only_s}, runtime-only {only_r})"
+        )
+    handwritten = _handwritten_samples()
+    samples: dict[str, bytes] = {}
+    for frame in sorted(registry.frames, key=lambda f: f.type_id):
+        if frame.style == "message":
+            kwargs = {}
+            for field, kind in frame.fields or ():
+                if kind not in _KIND_SAMPLES:
+                    raise SystemExit(
+                        f"wire_fuzz: no sample for field kind {kind!r} "
+                        f"({frame.name}.{field}) — add one"
+                    )
+                kwargs[field] = _KIND_SAMPLES[kind]
+            msg = getattr(mp, frame.name)(**kwargs)
+        else:
+            if frame.name not in handwritten:
+                raise SystemExit(
+                    f"wire_fuzz: no hand-built sample for {frame.name}"
+                )
+            msg = handwritten[frame.name]
+        samples[frame.name] = codec.encode(msg)
+    return samples
+
+
+def targeted_cases(samples: dict[str, bytes]) -> list[tuple]:
+    """Known-dangerous structured mutations, always run regardless of
+    seed/limit — the regression pins for bugs this fuzzer found:
+
+    * invalid UTF-8 inside a str field (r_str once let
+      UnicodeDecodeError escape),
+    * an out-of-range TransactionResult verdict byte (r_resolve_reply
+      once let the enum's ValueError escape)."""
+    cases: list[tuple] = []
+    status = codec.encode(mp.StatusReply(payload="abcd"))
+    cases.append(
+        ("StatusReply", "str-invalid-utf8", status[:-2] + b"\xff\xfe")
+    )
+    reply = samples["ResolveTransactionBatchReply"]
+    # layout: u16 type id, u32 count, then one verdict byte per txn —
+    # offset 6 is the first verdict; 0x2a names no TransactionResult
+    cases.append(
+        ("ResolveTransactionBatchReply", "verdict-out-of-range",
+         reply[:6] + b"\x2a" + reply[7:]),
+    )
+    return cases
+
+
+def mutations_for(name: str, data: bytes, seed: int,
+                  limit: int | None) -> list[tuple[str, bytes]]:
+    """The deterministic mutation stream for one frame."""
+    rng = random.Random(f"{seed}:{name}")
+    n = len(data)
+    cases: list[tuple[str, bytes]] = []
+    for cut in range(0, n):
+        cases.append((f"trunc@{cut}", data[:cut]))
+    for off in range(2, n):
+        for val in (0xFF, 0x80, 0x01):
+            if data[off] != val:
+                cases.append((
+                    f"stamp{val:02x}@{off}",
+                    data[:off] + bytes([val]) + data[off + 1:],
+                ))
+    for _ in range(12):
+        off = rng.randrange(2, max(3, n - 4)) if n > 7 else 2
+        val = rng.choice(
+            [0xFFFF_FFFF, 0x7FFF_FFFF, n, n * 17, 1 << 31]
+        )
+        cases.append((
+            f"patch{val:08x}@{off}",
+            data[:off] + val.to_bytes(4, "little") + data[off + 4:],
+        ))
+    for k in (1, 7):
+        junk = bytes(rng.randrange(256) for _ in range(k))
+        cases.append((f"junk+{k}", data + junk))
+    if limit is not None and len(cases) > limit:
+        keep = sorted(rng.sample(range(len(cases)), limit))
+        cases = [cases[i] for i in keep]
+    return cases
+
+
+def run_case(blob: bytes) -> tuple[str, str]:
+    """(verdict, detail): ok | reject | FAIL. The contract is exactly
+    'never anything but a clean decode or CodecError'."""
+    try:
+        codec.decode(blob)
+        return "ok", ""
+    except codec.CodecError as e:
+        return "reject", str(e)
+    except Exception as e:  # the bug class: anything non-CodecError
+        return "FAIL", f"{type(e).__name__}: {e}"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=DEFAULT_SEED)
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="~1k mutations across all frames (the check.sh lane)",
+    )
+    ap.add_argument(
+        "--limit", type=int, default=None,
+        help="per-frame mutation cap (overrides --smoke's)",
+    )
+    ap.add_argument(
+        "--write-corpus", action="store_true",
+        help=f"regenerate {CORPUS_PATH.relative_to(REPO)}",
+    )
+    ap.add_argument("--corpus", type=Path, default=CORPUS_PATH)
+    args = ap.parse_args(argv)
+
+    registry = wr.load_repo_registry(REPO)
+    samples = build_samples(registry)
+    limit = args.limit
+    if limit is None and args.smoke:
+        limit = max(4, 1000 // max(1, len(samples)))
+
+    digest = hashlib.sha256()
+    counts = {"ok": 0, "reject": 0, "FAIL": 0}
+    failures: list[str] = []
+    rejecting: dict[str, list[tuple[str, bytes]]] = {}
+
+    def run_one(frame: str, desc: str, blob: bytes) -> None:
+        verdict, detail = run_case(blob)
+        counts[verdict] += 1
+        digest.update(f"{frame}|{desc}|{verdict}\n".encode())
+        if verdict == "FAIL":
+            failures.append(
+                f"  {frame} [{desc}] -> {detail} (hex {blob.hex()})"
+            )
+        elif verdict == "reject":
+            rejecting.setdefault(frame, []).append((desc, blob))
+
+    # 1. committed corpus replay: every entry must still reject
+    replayed = 0
+    if args.corpus.exists() and not args.write_corpus:
+        corpus = json.loads(args.corpus.read_text(encoding="utf-8"))
+        for entry in corpus["cases"]:
+            blob = bytes.fromhex(entry["hex"])
+            verdict, detail = run_case(blob)
+            replayed += 1
+            digest.update(
+                f"corpus|{entry['frame']}|{entry['desc']}|{verdict}\n"
+                .encode()
+            )
+            if verdict != entry["expect"]:
+                counts["FAIL"] += 1
+                failures.append(
+                    f"  corpus {entry['frame']} [{entry['desc']}] "
+                    f"expected {entry['expect']}, got {verdict} {detail}"
+                )
+
+    # 2. the targeted structured cases, then 3. the seeded sweep
+    for frame, desc, blob in targeted_cases(samples):
+        run_one(frame, desc, blob)
+    for frame, data in samples.items():
+        for desc, blob in mutations_for(frame, data, args.seed, limit):
+            run_one(frame, desc, blob)
+
+    if args.write_corpus:
+        cases = [
+            {"frame": f, "desc": d, "hex": b.hex(), "expect": "reject"}
+            for f, d, b in targeted_cases(samples)
+        ]
+        for frame in sorted(rejecting):
+            picks = rejecting[frame][:4]
+            cases.extend(
+                {"frame": frame, "desc": desc, "hex": blob.hex(),
+                 "expect": "reject"}
+                for desc, blob in picks
+            )
+        args.corpus.parent.mkdir(parents=True, exist_ok=True)
+        args.corpus.write_text(json.dumps({
+            "comment": (
+                "Generated by `scripts/wire_fuzz.py --write-corpus` "
+                f"(seed {args.seed}). Every case must decode to a "
+                "CodecError reject — replayed at the start of each "
+                "fuzz run."
+            ),
+            "seed": args.seed,
+            "cases": cases,
+        }, indent=2) + "\n", encoding="utf-8")
+        print(f"wire_fuzz: wrote {args.corpus} ({len(cases)} cases)")
+
+    total = sum(counts.values())
+    print(
+        f"wire_fuzz: {len(samples)} frames, {total} cases "
+        f"({replayed} corpus) — {counts['ok']} ok, "
+        f"{counts['reject']} reject, {counts['FAIL']} FAIL "
+        f"[seed {args.seed}]"
+    )
+    print(f"wire_fuzz: digest {digest.hexdigest()}")
+    if failures:
+        print("wire_fuzz: decoder contract violations:")
+        for line in failures[:20]:
+            print(line)
+        if len(failures) > 20:
+            print(f"  ... and {len(failures) - 20} more")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,6 +5,8 @@ permission_denied; verified tokens are cached by signature."""
 
 import pytest
 
+pytest.importorskip("cryptography")
+
 from foundationdb_tpu.cluster import tenant as T
 from foundationdb_tpu.cluster.database import ClusterConfig, open_cluster
 from foundationdb_tpu.crypto.token_sign import (

@@ -9,6 +9,8 @@ SimKmsConnector / RESTKmsConnector).
 
 import pytest
 
+pytest.importorskip("cryptography")
+
 from foundationdb_tpu.cluster.encrypt_key_proxy import EncryptKeyProxy
 from foundationdb_tpu.cluster.kms import (
     KmsError,

@@ -13,6 +13,8 @@ import os
 
 import pytest
 
+pytest.importorskip("cryptography")
+
 from foundationdb_tpu.cluster import multiprocess as mp
 from foundationdb_tpu.cluster.encrypt_key_proxy import EncryptKeyProxy
 from foundationdb_tpu.cluster.kms import SimKmsConnector

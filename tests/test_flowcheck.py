@@ -506,8 +506,8 @@ def test_rule_catalog_is_populated():
 
     registry.load_rules()
     families = {r.family for r in registry.RULES.values()}
-    assert {"determinism", "actor", "jax", "probe"} <= families
-    assert len(registry.RULES) >= 13
+    assert {"determinism", "actor", "jax", "probe", "wire"} <= families
+    assert len(registry.RULES) >= 19
 
 
 def test_cli_entrypoint_exits_zero():
@@ -987,3 +987,265 @@ def test_stale_ignores_cannot_be_baselined(tmp_path):
     again = run_analysis(root=tmp_path, baseline_path=bl, manifest_path=man)
     assert [f.rule for f in again.new] == ["flowcheck.stale-ignore"]
     assert not again.stale  # and it left no phantom baseline entry
+
+
+# -- wire family (protocol contract; analysis/wire_registry.py) -------------
+
+
+def _wire_rules(ctxs, tmp_path):
+    from foundationdb_tpu.analysis.rules_wire import check_wire
+
+    man = tmp_path / "wire.json"
+    return [f.rule for f in check_wire(ctxs, manifest_path=man)]
+
+
+def test_wire_frame_id_collision_flagged(tmp_path):
+    ctxs = ctxs_from(
+        'A = _message(0x0901, "A", [("v", "i64")])\n'
+        'B = _message(0x0901, "B", [("v", "i64")])\n'
+    )
+    got = _wire_rules(ctxs, tmp_path)
+    assert "wire.token-collision" in got
+    # fix shape: distinct ids
+    ctxs2 = ctxs_from(
+        'A = _message(0x0901, "A", [("v", "i64")])\n'
+        'B = _message(0x0902, "B", [("v", "i64")])\n'
+    )
+    assert "wire.token-collision" not in _wire_rules(ctxs2, tmp_path)
+
+
+def test_wire_token_collision_flagged_but_not_across_namespaces(tmp_path):
+    ctxs = ctxs_from(
+        "TOKEN_A = 0x0111\nTOKEN_B = 0x0111\n"
+    )
+    assert "wire.token-collision" in _wire_rules(ctxs, tmp_path)
+    # frame ids and endpoint tokens are DIFFERENT namespaces: the live
+    # tree's TOKEN_RESOLVE (0x0101) numerically equals the
+    # CommitTransaction frame id, and that is fine
+    ctxs2 = ctxs_from(
+        "TOKEN_A = 0x0901\n"
+        'A = _message(0x0901, "A", [("v", "i64")])\n'
+    )
+    assert "wire.token-collision" not in _wire_rules(ctxs2, tmp_path)
+
+
+_WIRE_PAIR = """\
+def w_thing(out, t):
+    w_u32(out, t.a)
+    w_i64(out, t.b)
+
+
+def r_thing(buf, off):
+    a, off = r_u32(buf, off)
+{dec_b}    return (Thing({kwargs}), off)
+
+
+register(0x0901, Thing, w_thing, r_thing)
+"""
+
+
+def test_wire_codec_field_drift_flagged_and_paired_clean(tmp_path):
+    # decoder skips the i64 the encoder wrote: op streams diverge
+    short = _WIRE_PAIR.format(dec_b="", kwargs="a=a")
+    assert "wire.codec-field-drift" in _wire_rules(
+        ctxs_from(short), tmp_path
+    )
+    # decoder reads it but drops the field on the floor: field-set drift
+    dropped = _WIRE_PAIR.format(
+        dec_b="    b, off = r_i64(buf, off)\n", kwargs="a=a"
+    )
+    assert "wire.codec-field-drift" in _wire_rules(
+        ctxs_from(dropped), tmp_path
+    )
+    # the fix shape: read AND reconstruct every encoded field
+    paired = _WIRE_PAIR.format(
+        dec_b="    b, off = r_i64(buf, off)\n", kwargs="a=a, b=b"
+    )
+    assert "wire.codec-field-drift" not in _wire_rules(
+        ctxs_from(paired), tmp_path
+    )
+
+
+_WIRE_HANDLER = """\
+TOKEN_PUSH = 0x0911
+Push = _message(0x0910, "Push", [("version", "i64"), ("epoch", "i64")])
+
+
+class Role:
+    async def push(self, req: Push):
+{body}
+
+def setup(server, role):
+    server.register(TOKEN_PUSH, role.push)
+"""
+
+
+def test_wire_epoch_unfenced_handler_fixture(tmp_path):
+    tripped = _WIRE_HANDLER.format(
+        body=(
+            "        self.version = req.version\n"
+            "        _fence_epoch(req, self)\n"
+        )
+    )
+    assert "wire.epoch-unfenced-handler" in _wire_rules(
+        ctxs_from(tripped), tmp_path
+    )
+    # the fix shape is exactly the silencing edit: fence first
+    fenced = _WIRE_HANDLER.format(
+        body=(
+            "        _fence_epoch(req, self)\n"
+            "        self.version = req.version\n"
+        )
+    )
+    assert "wire.epoch-unfenced-handler" not in _wire_rules(
+        ctxs_from(fenced), tmp_path
+    )
+    # the inline if-raise fence idiom (TLogRole.lock) also counts
+    if_fenced = _WIRE_HANDLER.format(
+        body=(
+            "        if req.epoch < self.epoch:\n"
+            "            raise RemoteError('stale')\n"
+            "        self.version = req.version\n"
+        )
+    )
+    assert "wire.epoch-unfenced-handler" not in _wire_rules(
+        ctxs_from(if_fenced), tmp_path
+    )
+
+
+def test_wire_epoch_revert_acceptance_pin(tmp_path):
+    """THE acceptance pin: surgically reverting ResolverRole's
+    stale_epoch fence in the REAL multiprocess.py must trip
+    wire.epoch-unfenced-handler; the shipped source must not."""
+    mp_path = REPO / "foundationdb_tpu" / "cluster" / "multiprocess.py"
+    codec_path = REPO / "foundationdb_tpu" / "wire" / "codec.py"
+    src = mp_path.read_text(encoding="utf-8")
+    fence = "        _fence_epoch(req, self)\n"
+    assert fence in src
+    reverted = src.replace(fence, "", 1)
+
+    def run(mp_src):
+        from foundationdb_tpu.analysis.rules_wire import check_wire
+
+        ctxs = [
+            FileContext(
+                "foundationdb_tpu/cluster/multiprocess.py", mp_src
+            ),
+            FileContext(
+                "foundationdb_tpu/wire/codec.py",
+                codec_path.read_text(encoding="utf-8"),
+            ),
+        ]
+        return [
+            f for f in check_wire(ctxs, manifest_path=tmp_path / "w.json")
+            if f.rule == "wire.epoch-unfenced-handler"
+        ]
+
+    assert run(src) == []
+    tripped = run(reverted)
+    assert tripped, "reverting the resolver fence must trip the rule"
+    assert "ResolverRole.resolve" in tripped[0].message
+
+
+def test_wire_call_timeout_and_classification(tmp_path):
+    bare = (
+        "async def f(conn, msg):\n"
+        "    return await conn.call(TOKEN_PING, msg)\n"
+    )
+    got = _wire_rules(ctxs_from(bare), tmp_path)
+    assert "wire.call-without-timeout" in got
+    assert "wire.unclassified-error" in got
+    # the fix shape: bounded call inside a classifying except
+    fixed = (
+        "async def f(conn, msg):\n"
+        "    try:\n"
+        "        return await conn.call(TOKEN_PING, msg, timeout=5.0)\n"
+        "    except transport.TransportError as e:\n"
+        "        raise transport.RemoteError(f'ping: {e!r}')\n"
+    )
+    got2 = _wire_rules(ctxs_from(fixed), tmp_path)
+    assert "wire.call-without-timeout" not in got2
+    assert "wire.unclassified-error" not in got2
+
+
+def test_wire_manifest_drift_and_version_bump_message(tmp_path):
+    from foundationdb_tpu.analysis.manifest import save_wire_manifest
+    from foundationdb_tpu.analysis.rules_wire import (
+        check_wire,
+        tree_wire_manifest,
+    )
+
+    man = tmp_path / "wire.json"
+    base = (
+        "PROTOCOL_VERSION = 0x0001\n"
+        'A = _message(0x0901, "A", [("v", "i64")])\n'
+    )
+    ctxs = ctxs_from(base)
+    # no manifest yet: plain drift pointing at the writer workflow
+    drift = [
+        f for f in check_wire(ctxs, manifest_path=man)
+        if f.rule == "wire.manifest-drift"
+    ]
+    assert drift and "--write-wire-manifest" in drift[0].message
+    # write it: clean
+    save_wire_manifest(tree_wire_manifest(ctxs), man)
+    assert "wire.manifest-drift" not in [
+        f.rule for f in check_wire(ctxs, manifest_path=man)
+    ]
+    # grow the message set WITHOUT bumping PROTOCOL_VERSION: the drift
+    # finding must demand the bump
+    ctxs2 = ctxs_from(
+        base + 'B = _message(0x0902, "B", [("v", "i64")])\n'
+    )
+    drift2 = [
+        f for f in check_wire(ctxs2, manifest_path=man)
+        if f.rule == "wire.manifest-drift"
+    ]
+    assert drift2 and "PROTOCOL_VERSION bump" in drift2[0].message
+
+
+def test_wire_ignore_comment_suppresses(tmp_path):
+    src = (
+        "async def f(conn, msg):\n"
+        "    return await conn.call(  # flowcheck: ignore[wire.unclassified-error]\n"
+        "        TOKEN_PING, msg, timeout=5.0\n"
+        "    )\n"
+    )
+    got = _wire_rules(ctxs_from(src), tmp_path)
+    assert "wire.unclassified-error" not in got
+
+
+def test_wire_registry_matches_runtime_tables():
+    """The extraction the gate and the fuzzer share agrees with the
+    IMPORTED modules: every TOKEN_* constant and every registered
+    frame id."""
+    from foundationdb_tpu.analysis import wire_registry as wr
+    from foundationdb_tpu.cluster import multiprocess as mp
+    from foundationdb_tpu.wire import codec
+
+    reg = wr.load_repo_registry(REPO)
+    static_tokens = {t.name: t.value for t in reg.tokens}
+    runtime_tokens = {
+        name: getattr(mp, name)
+        for name in dir(mp) if name.startswith("TOKEN_")
+    }
+    assert static_tokens == runtime_tokens
+    assert {f.type_id for f in reg.frames} == set(codec._REGISTRY)
+    # the fencing contract covers exactly the epoch-carrying frames
+    # (TLogLockReply carries the epoch BACK; replies have no handler,
+    # so only the request frames feed the unfenced-handler rule)
+    assert reg.epoch_frames() == {
+        "TLogPush", "TLogPop", "TLogLock", "TLogLockReply",
+        "ResolveTransactionBatchRequest", "ResolveBatchColumnar",
+    }
+
+
+def test_live_tree_wire_manifest_is_current():
+    from foundationdb_tpu.analysis.manifest import load_wire_manifest
+    from foundationdb_tpu.analysis.rules_wire import tree_wire_manifest
+
+    result = run_analysis(root=REPO)
+    assert tree_wire_manifest(result.contexts) == load_wire_manifest(), (
+        "wire_manifest.json is stale: run `python -m "
+        "foundationdb_tpu.analysis --write-wire-manifest`"
+    )

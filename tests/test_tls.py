@@ -10,6 +10,8 @@ import asyncio
 
 import pytest
 
+pytest.importorskip("cryptography")
+
 from foundationdb_tpu.crypto.tls import TLSConfig, make_test_tls
 from foundationdb_tpu.cluster.multiprocess import Ping, Pong
 from foundationdb_tpu.wire import transport
