@@ -72,8 +72,36 @@ USE = "use"          # ("use", name, in_test, node, deref) — deref: the
 #                      of a stale snapshotted value
 GUARD = "guard"      # ("guard", kind, frozenset[keys], node)
 CHECK = "check"      # ("check", calldump, node)
-RETURN = "return"    # ("return",)
-RAISE = "raise"      # ("raise",)
+RETURN = "return"    # ("return", node)
+RAISE = "raise"      # ("raise", node)
+NARROW = "narrow"    # ("narrow", name, "none"|"notnone", node) — branch
+#                      fact from an `if x is (not) None` / `if (not) x`
+#                      test on a plain local name: the first event of
+#                      each branch block, so path walks can kill
+#                      branches infeasible for what they track
+
+
+def _narrow_of(test: ast.expr) -> Optional[tuple[str, str, str]]:
+    """(name, true-branch fact, false-branch fact) for branch tests a
+    path walk can narrow on; None for anything richer. Truthiness tests
+    on a plain name narrow None-ness too — a held resource object is
+    truthy (none of the tracked handle types define __bool__)."""
+    if isinstance(test, ast.Name):
+        return (test.id, "notnone", "none")
+    if isinstance(test, ast.UnaryOp) and isinstance(
+        test.op, ast.Not
+    ) and isinstance(test.operand, ast.Name):
+        return (test.operand.id, "none", "notnone")
+    if isinstance(test, ast.Compare) and isinstance(
+        test.left, ast.Name
+    ) and len(test.ops) == 1 and isinstance(
+        test.comparators[0], ast.Constant
+    ) and test.comparators[0].value is None:
+        if isinstance(test.ops[0], ast.Is):
+            return (test.left.id, "none", "notnone")
+        if isinstance(test.ops[0], ast.IsNot):
+            return (test.left.id, "notnone", "none")
+    return None
 
 
 def keys_conflict(a: tuple, b: tuple) -> bool:
@@ -141,9 +169,23 @@ def iter_async_functions(tree: ast.Module) -> Iterator[FuncInfo]:
     inside another function (the soak-workload shape), behind a
     decorator, or inside a class inside a function is still walked.
     """
+    yield from iter_functions(tree, sync=False)
+
+
+def iter_functions(tree: ast.Module, *, sync: bool = True
+                   ) -> Iterator[FuncInfo]:
+    """Every function def in the module, sync and async alike (the
+    resource-ownership pass tracks `open()`/Popen acquires in plain
+    defs too). `_Builder` only touches `fn.args`/`fn.body`, so the CFG
+    lowering applies unchanged to sync functions — AWAIT events simply
+    never occur in them."""
+    kinds = (
+        (ast.FunctionDef, ast.AsyncFunctionDef) if sync
+        else ast.AsyncFunctionDef
+    )
     annotate_parents(tree)
     for node in ast.walk(tree):
-        if not isinstance(node, ast.AsyncFunctionDef):
+        if not isinstance(node, kinds):
             continue
         chain = _enclosing_chain(node)
         enclosing = [
@@ -682,13 +724,13 @@ class _Builder:
             ve: list[tuple] = []
             self.expr(stmt.value, ve)
             ev.extend(ve)
-            ev.append((RETURN,))
+            ev.append((RETURN, stmt))
             cur.terminated = True
             return None
         if isinstance(stmt, ast.Raise):
             self.expr(stmt.exc, ev)
             self.expr(stmt.cause, ev)
-            ev.append((RAISE,))
+            ev.append((RAISE, stmt))
             cur.terminated = True
             return None
         if isinstance(stmt, ast.If):
@@ -699,13 +741,27 @@ class _Builder:
                 ge = self._guard_event(stmt.test, "if", stmt)
                 if ge is not None:
                     ev.append(ge)
+            nar = _narrow_of(stmt.test)
             body_b = self.new_block()
+            if nar is not None:
+                body_b.events.append((NARROW, nar[0], nar[1], stmt.test))
             cur.add_succ(body_b)
             body_out = self.stmts(stmt.body, body_b, loops)
             if stmt.orelse:
                 else_b = self.new_block()
+                if nar is not None:
+                    else_b.events.append(
+                        (NARROW, nar[0], nar[2], stmt.test)
+                    )
                 cur.add_succ(else_b)
                 else_out = self.stmts(stmt.orelse, else_b, loops)
+            elif nar is not None:
+                # the fall-through IS the false branch: give it its own
+                # block so the narrowing fact rides the right edge
+                else_b = self.new_block()
+                else_b.events.append((NARROW, nar[0], nar[2], stmt.test))
+                cur.add_succ(else_b)
+                else_out = else_b
             else:
                 else_out = cur
             join = self.new_block()
@@ -760,9 +816,16 @@ class _Builder:
                 cur.add_succ(loops[-1][0])
             return None
         if isinstance(stmt, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+            # the try body gets a FRESH block: statements lowered into
+            # `cur` before the try are outside the protected region and
+            # must not grow exception edges into the handlers (a stale
+            # pre-try state escaping into a handler manufactures paths
+            # that cannot execute — see rules_res' loop-carried case)
             before = len(self.blocks)
-            body_out = self.stmts(stmt.body, cur, loops)
-            body_blocks = [cur] + self.blocks[before:]
+            body_b = self.new_block()
+            cur.add_succ(body_b)
+            body_out = self.stmts(stmt.body, body_b, loops)
+            body_blocks = self.blocks[before:]
             join = self.new_block()
             if stmt.handlers:
                 for h in stmt.handlers:

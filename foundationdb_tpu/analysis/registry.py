@@ -65,6 +65,7 @@ def load_rules() -> None:
         rules_flow,
         rules_jax,
         rules_probes,
+        rules_res,
         rules_trace,
         rules_wire,
     )

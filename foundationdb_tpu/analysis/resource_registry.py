@@ -1,0 +1,349 @@
+"""Resource acquire/release classification: the ownership model the
+`res.*` flowcheck family (rules_res.py) path-walks over.
+
+The wire cluster has already needed four review-found connection-close
+fixes on error paths, each caught by hand; this module promotes the
+bug class to machine-checked structure the way `analysis/cfg.py` did
+for stale-reads. It answers three questions, all statically, stdlib
+`ast` only:
+
+* **What acquires a resource?** Constructor leaves (`RpcConnection`,
+  `RpcServer`, `DiskQueue`, `Popen`, executors), resolved call targets
+  (`asyncio.create_task`/`ensure_future`, bare `open()`, socket/
+  server factories), `Scheduler.spawn` on a sched-named receiver, and
+  — the compositional step — same-file helper functions that RETURN a
+  freshly acquired resource (`connect()` in multiprocess.py), so a
+  call to the helper is itself an acquire site at the caller.
+* **When is it live?** Kinds with an *activation* method
+  (`RpcConnection.connect`, `RpcServer.start`) hold no OS resource
+  until the activation succeeds — the transport cleans up internally
+  on a failed connect — so construction yields a `pending` handle and
+  only a successful activation makes it `live`.
+* **What releases or transfers it?** Per-kind release methods
+  (`.close()`/`.stop()`/`.cancel()`/`close_disk()`...), hand-off to a
+  release-stem helper (`_close_all(conns)`), ownership transfer by
+  `return`, by call-argument hand-off, by storing into a container or
+  onto an object, and — for `self.X = <acquire>` — a release of that
+  attribute reachable anywhere in the class (the store-on-self idiom:
+  `stop()`/`close()` owns shutdown).
+
+Deliberate precision limits (documented, tests pin the live ones):
+collections of resources built by comprehensions are not tracked
+element-wise (the scalar acquires around them carry the rules), helper
+recognition is same-file only, and `with`-managed acquires are owned
+by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator, Optional
+
+#: release-method leaves per resource kind
+RELEASE_METHODS: dict[str, set[str]] = {
+    "connection": {"close", "aclose"},
+    "server": {"close", "stop"},
+    "task": {"cancel"},
+    "file": {"close"},
+    "diskqueue": {"close_disk", "aclose_disk", "close"},
+    "process": {"stop", "terminate", "kill"},
+    "executor": {"shutdown"},
+    "socket": {"close", "shutdown", "wait_closed", "stop"},
+}
+RELEASE_METHODS_ANY: set[str] = set().union(*RELEASE_METHODS.values())
+
+#: a call whose func leaf carries one of these stems releases every
+#: tracked resource passed to it (`_close_all(conns)`, `stop_roles(x)`)
+RELEASE_HELPER_STEMS = (
+    "close", "stop", "shutdown", "cancel", "release", "teardown",
+)
+
+#: constructor leaf -> (kind, activation method or None). Leaf-exact on
+#: purpose: `SimDiskQueue` (the sim twin, no real fd) does not match.
+CONSTRUCTORS: dict[str, tuple[str, Optional[str]]] = {
+    "RpcConnection": ("connection", "connect"),
+    "RpcServer": ("server", "start"),
+    "DiskQueue": ("diskqueue", None),
+    "Popen": ("process", None),
+    "ThreadPoolExecutor": ("executor", None),
+    "ProcessPoolExecutor": ("executor", None),
+}
+
+#: import-resolved dotted call -> kind; live at construction
+RESOLVED_ACQUIRES: dict[str, str] = {
+    "asyncio.create_task": "task",
+    "asyncio.ensure_future": "task",
+    "open": "file",
+    "io.open": "file",
+    "socket.socket": "socket",
+    "socket.create_connection": "socket",
+    "asyncio.start_server": "server",
+    "asyncio.start_unix_server": "server",
+}
+
+#: dotted receivers whose `.spawn(...)` is a Scheduler task spawn. The
+#: DISCARDED-spawn case belongs to `actor.fire-and-forget` (rules_actor
+#: has owned it since PR 1) — rules_res must not double-report it.
+SPAWN_RECEIVERS = {
+    "sched", "scheduler", "_sched",
+    "self.sched", "self._sched", "self.scheduler",
+}
+
+
+@dataclasses.dataclass
+class Acquire:
+    """One acquire site inside one function."""
+
+    kind: str                  # RELEASE_METHODS key
+    call: ast.Call             # the acquiring call expression
+    #: how the acquired value is bound at the site
+    binding: str               # local|self|discard|with|return|arg|other
+    name: Optional[str] = None     # local name when binding == "local"
+    attr: Optional[str] = None     # self attribute when binding == "self"
+    activation: Optional[str] = None  # method that makes it live
+    spawned: bool = False      # Scheduler.spawn site (see SPAWN_RECEIVERS)
+
+
+def _leaf(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def has_release_stem(leaf: Optional[str]) -> bool:
+    if not leaf:
+        return False
+    low = leaf.lower()
+    return any(stem in low for stem in RELEASE_HELPER_STEMS)
+
+
+def walk_scope(fn) -> Iterator[ast.AST]:
+    """ast.walk over one function's own scope: nested function/class
+    bodies (separate execution scopes, walked separately) excluded."""
+    stack: list[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def acquire_kind(ctx, call: ast.Call,
+                 helpers: dict[str, str]) -> Optional[Acquire]:
+    """Classify one Call as an acquire site (binding left unset)."""
+    leaf = _leaf(call.func)
+    if leaf in CONSTRUCTORS:
+        kind, activation = CONSTRUCTORS[leaf]
+        return Acquire(kind=kind, call=call, binding="",
+                       activation=activation)
+    resolved = ctx.resolved(call.func)
+    if resolved in RESOLVED_ACQUIRES:
+        return Acquire(kind=RESOLVED_ACQUIRES[resolved], call=call,
+                       binding="")
+    if leaf == "spawn":
+        recv = ctx.dotted(call.func)
+        if recv is not None and recv.rsplit(".", 1)[0] in SPAWN_RECEIVERS:
+            return Acquire(kind="task", call=call, binding="",
+                           spawned=True)
+    if leaf == "create_task" and isinstance(call.func, ast.Attribute):
+        recv = _leaf(call.func.value) if isinstance(
+            call.func.value, (ast.Name, ast.Attribute)
+        ) else None
+        if recv is not None and "loop" in recv:
+            return Acquire(kind="task", call=call, binding="")
+    if leaf in helpers and isinstance(call.func, ast.Name):
+        # same-file helper that returns a fresh resource: the returned
+        # handle is LIVE (the helper performed any activation itself).
+        # Plain-name calls only — `conn.connect()` is an activation
+        # method on a handle, not the module helper.
+        return Acquire(kind=helpers[leaf], call=call, binding="")
+    return None
+
+
+def _classify_binding(call: ast.Call) -> tuple[str, Optional[str],
+                                               Optional[str]]:
+    """(binding, local name, self attr) from the acquire's AST parents.
+
+    Climbs through Await/IfExp wrappers (`self._fh = open(p) if p else
+    None`) to the binding construct."""
+    node: ast.AST = call
+    parent = getattr(node, "_fc_parent", None)
+    while isinstance(parent, (ast.Await, ast.IfExp, ast.BoolOp)):
+        node, parent = parent, getattr(parent, "_fc_parent", None)
+    if isinstance(parent, ast.withitem):
+        return "with", None, None
+    if isinstance(parent, ast.Expr):
+        return "discard", None, None
+    if isinstance(parent, ast.Return):
+        return "return", None, None
+    if isinstance(parent, (ast.Assign, ast.AnnAssign)):
+        targets = (
+            parent.targets if isinstance(parent, ast.Assign)
+            else [parent.target]
+        )
+        t = targets[0]
+        if isinstance(t, ast.Name):
+            return "local", t.id, None
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name):
+            if t.value.id == "self":
+                return "self", None, t.attr
+            return "other", None, None
+        if isinstance(t, ast.Subscript):
+            base = t.value
+            if isinstance(base, ast.Attribute) and isinstance(
+                base.value, ast.Name
+            ) and base.value.id == "self":
+                return "self", None, base.attr
+            return "other", None, None
+        return "other", None, None
+    if isinstance(parent, ast.Call) and node is not parent.func:
+        return "arg", None, None
+    if isinstance(parent, ast.keyword):
+        return "arg", None, None
+    return "other", None, None
+
+
+def extract_acquires(ctx, fn, helpers: dict[str, str]) -> list[Acquire]:
+    """Every acquire site in one function's own scope, classified."""
+    out: list[Acquire] = []
+    for node in walk_scope(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        acq = acquire_kind(ctx, node, helpers)
+        if acq is None:
+            continue
+        acq.binding, acq.name, acq.attr = _classify_binding(node)
+        out.append(acq)
+    return out
+
+
+def module_helpers(ctx, funcs) -> dict[str, str]:
+    """Same-file functions that RETURN a freshly acquired resource:
+    simple name -> kind. A call to one of these IS an acquire at the
+    caller (ownership transfer by return — multiprocess.py's
+    `connect()` shape)."""
+    helpers: dict[str, str] = {}
+    for info in funcs:
+        if "." in info.qualname:
+            continue
+        fn = info.node
+        direct: dict[str, str] = {}
+        returned: Optional[str] = None
+        # two passes: walk_scope order is arbitrary, and the Return may
+        # be visited before the Assign that makes its name an acquire
+        nodes = list(walk_scope(fn))
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                acq = acquire_kind(ctx, node, {})
+                if acq is None:
+                    continue
+                binding, name, _attr = _classify_binding(node)
+                if binding == "local" and name:
+                    direct[name] = acq.kind
+                elif binding == "return":
+                    returned = acq.kind
+        for node in nodes:
+            if isinstance(node, ast.Return) and isinstance(
+                node.value, ast.Name
+            ) and node.value.id in direct:
+                returned = direct[node.value.id]
+        if returned is not None:
+            helpers[fn.name] = returned
+    return helpers
+
+
+def class_released_attrs(cls: ast.ClassDef) -> set[str]:
+    """Self attributes some method of the class releases: the
+    store-on-self ownership idiom (`self._task = ensure_future(...)`
+    is owned iff a `stop()`-reachable release of `self._task` exists).
+
+    Release shapes recognized anywhere in the class body:
+    * `self.X.close()` / `.stop()` / `.cancel()` / `close_disk()` ...
+      (subscripted receivers like `self._conns[k].close()` included)
+    * `self.X` (or a deref of it) passed to a release-stem helper —
+      `_close_all(self._conns)`
+    * `for c in self.X...: c.close()` — iterate-and-release
+    * `del self.X`
+    * the null-then-release alias idiom: `t = self.X; self.X = None;
+      t.cancel()` (how `_drop_proxy`/`stop` avoid re-entry races)
+    """
+    out: set[str] = set()
+
+    # per-method alias map: local name -> self attribute it snapshots
+    aliases: dict[str, str] = {}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and (
+            isinstance(node.targets[0], ast.Name)
+        ) and isinstance(node.value, ast.Attribute) and isinstance(
+            node.value.value, ast.Name
+        ) and node.value.value.id == "self":
+            aliases[node.targets[0].id] = node.value.attr
+
+    def self_attr_of(node: ast.AST) -> Optional[str]:
+        # self.X, self.X[k], self.X.values(), self.X[k].close -> "X":
+        # descend to the root, returning the attribute directly on self
+        while True:
+            if isinstance(node, ast.Attribute):
+                if isinstance(node.value, ast.Name) and (
+                    node.value.id == "self"
+                ):
+                    return node.attr
+                node = node.value
+            elif isinstance(node, ast.Subscript):
+                node = node.value
+            elif isinstance(node, ast.Call):
+                node = node.func
+            else:
+                return None
+
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) and (
+                node.func.attr in RELEASE_METHODS_ANY
+            ):
+                attr = self_attr_of(node.func)
+                if attr is not None:
+                    out.add(attr)
+                elif isinstance(node.func.value, ast.Name) and (
+                    node.func.value.id in aliases
+                ):
+                    out.add(aliases[node.func.value.id])
+            if has_release_stem(_leaf(node.func)):
+                for arg in node.args:
+                    attr = self_attr_of(arg)
+                    if attr is not None:
+                        out.add(attr)
+                    elif isinstance(arg, ast.Name) and arg.id in aliases:
+                        out.add(aliases[arg.id])
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and isinstance(
+                    t.value, ast.Name
+                ) and t.value.id == "self":
+                    out.add(t.attr)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            attr = None
+            for sub in ast.walk(node.iter):
+                if isinstance(sub, ast.Attribute) and isinstance(
+                    sub.value, ast.Name
+                ) and sub.value.id == "self":
+                    attr = sub.attr
+                    break
+            if attr is None or not isinstance(node.target, ast.Name):
+                continue
+            tgt = node.target.id
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and isinstance(
+                    sub.func, ast.Attribute
+                ) and sub.func.attr in RELEASE_METHODS_ANY and isinstance(
+                    sub.func.value, ast.Name
+                ) and sub.func.value.id == tgt:
+                    out.add(attr)
+                    break
+    return out

@@ -1,0 +1,410 @@
+"""res.* — resource-ownership leak pass over the CFG substrate.
+
+The compositional ownership analysis (the RacerD/Infer discipline
+scaled to this codebase): every function that acquires a resource
+(`analysis/resource_registry.py` decides what acquires, releases,
+activates, and transfers) gets its CFG path-walked — exception and
+cancellation edges included, the PR-3 lowering — and any path on which
+a live resource reaches an exit unreleased and unowned is a finding.
+
+State machine per tracked local handle, walked over `cfg.build_cfg`
+blocks (sync functions included — `open()`/Popen live in plain defs):
+
+    DEF of an acquire        -> pending (activation kinds) or live
+    successful activation    -> live     (`await conn.connect()`)
+    release method / helper  -> released (`.close()`, `_close_all(c)`)
+    return / arg / store     -> transferred (ownership moved; untracked)
+    rebind while live        -> res.leak-on-error-path
+    exit (return/raise/fall-off) while live -> res.leak-on-error-path
+    unprotected await while live            -> res.leak-on-error-path
+    release while released (same block)     -> res.double-close
+    deref while released                    -> res.transfer-then-use
+
+Exceptions at an *activation* await propagate the PRE state (pending —
+the transport cleans up its own half-open sockets on a failed connect),
+so `conn = RpcConnection(...); await conn.connect()` with no try is NOT
+a finding; an exception at any other await while live escapes with the
+handle live, which is exactly the bug class the wire cluster needed
+four hand-caught review fixes for.
+
+The CFG lowers `finally` bodies after the join only (cfg.py's
+documented conservative edge), so try/finally protection is checked
+syntactically: an enclosing `try` whose finalbody releases the handle
+protects its awaits/returns/raises; an enclosing `try` with handlers
+defers to the exception-edge path walk instead.
+
+Deliberate conservative choices (README "resource ownership"):
+* tasks use a syntactic ever-owned check, not the path walk — a task
+  handle is owned the moment anything derefs, awaits, cancels, stores,
+  or hands it off (`w.done` into an all_of list is ownership);
+  `Scheduler.spawn` discards stay with `actor.fire-and-forget`.
+* cancellation-tight (BaseException) handlers are not required: any
+  handler or releasing finalbody counts as protection.
+* resource collections built by comprehensions are not tracked
+  element-wise; same-file helper returns are the interprocedural step.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from foundationdb_tpu.analysis import cfg
+from foundationdb_tpu.analysis import resource_registry as rr
+from foundationdb_tpu.analysis.registry import file_check, rule
+
+RULE_LEAK = rule(
+    "res.leak-on-error-path",
+    "an acquired resource (connection/server/file/process/queue) "
+    "reaches a function exit — return, raise, fall-off, rebind, or an "
+    "exception at an unprotected await — unreleased and unowned",
+)
+RULE_TASK = rule(
+    "res.task-unowned",
+    "a spawned task nothing owns: discarded create_task/ensure_future, "
+    "or a bound task handle never awaited/cancelled/stored/handed off",
+)
+RULE_DOUBLE = rule(
+    "res.double-close",
+    "one handle released twice on the same straight-line path with no "
+    "re-acquire between",
+)
+RULE_USE = rule(
+    "res.transfer-then-use",
+    "a handle dereferenced after its release on the same path "
+    "(use-after-close)",
+)
+
+#: path-walk state-space bound per function (visited (block, state)
+#: pairs) — far above any real function; a backstop, not a budget
+_WALK_BUDGET = 60_000
+
+
+def _display(info: cfg.FuncInfo) -> str:
+    cls = info.class_node.name + "." if info.class_node is not None else ""
+    return f"{cls}{info.qualname}"
+
+
+def _acquire_for(acqs: list[rr.Acquire],
+                 value_node: ast.AST) -> Optional[rr.Acquire]:
+    """The acquire whose call the DEF's value expression contains."""
+    for a in acqs:
+        if value_node is a.call:
+            return a
+        for sub in ast.walk(value_node):
+            if sub is a.call:
+                return a
+    return None
+
+
+def _classify_use(node: ast.Name, kind: str, activation: Optional[str]
+                  ) -> tuple[str, Optional[ast.Await]]:
+    """(action, enclosing-await) for one Load use of a tracked handle:
+    release | activate | deref | transfer | none."""
+    parent = getattr(node, "_fc_parent", None)
+    if isinstance(parent, ast.Attribute) and parent.value is node:
+        gp = getattr(parent, "_fc_parent", None)
+        if isinstance(gp, ast.Call) and gp.func is parent:
+            ggp = getattr(gp, "_fc_parent", None)
+            awaited = ggp if isinstance(ggp, ast.Await) else None
+            leaf = parent.attr
+            if leaf in rr.RELEASE_METHODS.get(kind, set()):
+                return "release", awaited
+            if activation is not None and leaf == activation:
+                return "activate", awaited
+            return "deref", awaited
+        return "deref", None
+    if isinstance(parent, ast.Call) and node is not parent.func:
+        if rr.has_release_stem(rr._leaf(parent.func)):
+            gp = getattr(parent, "_fc_parent", None)
+            return "release", gp if isinstance(gp, ast.Await) else None
+        return "transfer", None
+    if isinstance(parent, ast.keyword):
+        return "transfer", None
+    if isinstance(parent, ast.Await) and parent.value is node:
+        return "transfer", None  # awaiting the handle consumes it
+    if isinstance(parent, ast.Return):
+        return "transfer", None
+    if isinstance(parent, (ast.Assign, ast.AnnAssign)):
+        return "transfer", None  # aliased / stored somewhere persistent
+    if isinstance(parent, (ast.Dict, ast.List, ast.Tuple, ast.Set)):
+        return "transfer", None  # placed into a container literal
+    if isinstance(parent, ast.Subscript) and parent.value is node:
+        return "deref", None
+    return "none", None
+
+
+def _releases_name(stmts: list[ast.stmt], name: str) -> bool:
+    for s in stmts:
+        for node in ast.walk(s):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and isinstance(
+                f.value, ast.Name
+            ) and f.value.id == name and f.attr in rr.RELEASE_METHODS_ANY:
+                return True
+            if rr.has_release_stem(rr._leaf(f)) and any(
+                isinstance(a, ast.Name) and a.id == name
+                for a in node.args
+            ):
+                return True
+    return False
+
+
+def _protected(node: ast.AST, name: str, info: cfg.FuncInfo,
+               exception: bool) -> bool:
+    """An enclosing try protects this exit for `name`: a finalbody that
+    releases it always does; for exception exits, any handler does too
+    (the exception-edge path walk owns that continuation)."""
+    prev: ast.AST = node
+    cur = getattr(node, "_fc_parent", None)
+    while cur is not None and prev is not info.node:
+        if isinstance(cur, ast.Try):
+            in_body = any(prev is s for s in cur.body) or any(
+                prev is s for s in cur.orelse
+            )
+            if in_body:
+                if exception and cur.handlers:
+                    return True
+                if _releases_name(cur.finalbody, name):
+                    return True
+        prev, cur = cur, getattr(cur, "_fc_parent", None)
+    return False
+
+
+def _ever_owned(fn, name: str, binding_call: ast.Call) -> bool:
+    """Syntactic task-ownership: any Load use of the handle besides its
+    own binding (deref, await, cancel, hand-off, container add)."""
+    for node in rr.walk_scope(fn):
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, ast.Load
+        ) and node.id == name:
+            return True
+    return False
+
+
+def _walk_function(ctx, info: cfg.FuncInfo, acqs: list[rr.Acquire]
+                   ) -> None:
+    tracked: dict[str, list[rr.Acquire]] = {}
+    for a in acqs:
+        if a.binding == "local" and a.name and a.kind != "task":
+            tracked.setdefault(a.name, []).append(a)
+    if not tracked:
+        return
+    entry, _shared = cfg.build_cfg(info, ctx.tree)
+    findings: dict[tuple[str, str], tuple[ast.AST, str]] = {}
+    benign_awaits: set[int] = set()
+    disp = _display(info)
+
+    def flag(rule_id: str, name: str, node: ast.AST, msg: str) -> None:
+        findings.setdefault((rule_id, name), (node, msg))
+
+    # state[name] = (status, kind, activation, released-in-block-id)
+    stack: list[tuple[cfg.Block, frozenset]] = [(entry, frozenset())]
+    visited: set[tuple[int, frozenset]] = set()
+    budget = _WALK_BUDGET
+    while stack and budget > 0:
+        budget -= 1
+        block, fstate = stack.pop()
+        key = (id(block), fstate)
+        if key in visited:
+            continue
+        visited.add(key)
+        state = dict(fstate)
+        escapes: list[dict] = [dict(state)] if fstate else []
+        infeasible = False
+        for ev in block.events:
+            k = ev[0]
+            if k == cfg.NARROW:
+                # every tracked status (pending/live/released) means the
+                # name holds a real object — the `x is None` branch of
+                # this path cannot execute; kill it
+                if ev[2] == "none" and ev[1] in state:
+                    infeasible = True
+                    break
+            elif k == cfg.DEF:
+                name, node = ev[1], ev[3]
+                if name not in tracked:
+                    continue
+                acq = _acquire_for(tracked[name], node)
+                cur = state.get(name)
+                if cur is not None and cur[0] == "live":
+                    flag(
+                        RULE_LEAK, name, node,
+                        f"{disp}: rebinds `{name}` while the previous "
+                        f"{cur[1]} is still live and unreleased",
+                    )
+                if acq is not None:
+                    state[name] = (
+                        "pending" if acq.activation else "live",
+                        acq.kind, acq.activation, 0,
+                    )
+                else:
+                    state.pop(name, None)
+            elif k == cfg.USE:
+                name, node = ev[1], ev[3]
+                cur = state.get(name)
+                if cur is None or not isinstance(node, ast.Name):
+                    continue
+                status, kind, activation, relb = cur
+                action, awaited = _classify_use(node, kind, activation)
+                if action == "release":
+                    if status == "released" and relb == id(block):
+                        flag(
+                            RULE_DOUBLE, name, node,
+                            f"{disp}: `{name}` ({kind}) released twice "
+                            "on the same path with no re-acquire "
+                            "between",
+                        )
+                    state[name] = ("released", kind, activation,
+                                   id(block))
+                    # best-effort close: a release attempt releases even
+                    # on its own exception edge (`try: await c.close()
+                    # except: pass` must be clean) — rewrite the live
+                    # snapshots this block already captured
+                    for e in escapes:
+                        ec = e.get(name)
+                        if ec is not None and ec[0] == "live":
+                            e[name] = state[name]
+                    if awaited is not None:
+                        benign_awaits.add(id(awaited))
+                elif action == "activate":
+                    if status == "pending":
+                        # an exception AT the activation escapes the
+                        # PRE state: nothing live yet
+                        escapes.append(dict(state))
+                        state[name] = ("live", kind, activation, 0)
+                    if awaited is not None:
+                        benign_awaits.add(id(awaited))
+                elif action == "transfer":
+                    state.pop(name, None)
+                elif action == "deref":
+                    if status == "released":
+                        flag(
+                            RULE_USE, name, node,
+                            f"{disp}: `{name}` ({kind}) used after "
+                            "being closed/released on this path",
+                        )
+            elif k == cfg.AWAIT:
+                node = ev[1]
+                if id(node) in benign_awaits or not state:
+                    continue
+                escapes.append(dict(state))
+                if not block.exc_succs:
+                    for name, cur in state.items():
+                        if cur[0] != "live":
+                            continue
+                        if _protected(node, name, info, exception=True):
+                            continue
+                        flag(
+                            RULE_LEAK, name, node,
+                            f"{disp}: `{name}` ({cur[1]}) is live "
+                            "across `await` with no enclosing "
+                            "try/finally releasing it — an exception "
+                            "here leaks it",
+                        )
+            elif k in (cfg.RETURN, cfg.RAISE):
+                node = ev[1] if len(ev) > 1 else info.node
+                is_raise = k == cfg.RAISE
+                if is_raise and block.exc_succs:
+                    continue  # the handler path walk owns it
+                for name, cur in state.items():
+                    if cur[0] != "live":
+                        continue
+                    if _protected(node, name, info, exception=is_raise):
+                        continue
+                    flag(
+                        RULE_LEAK, name, node,
+                        f"{disp}: `{name}` ({cur[1]}) still unreleased "
+                        + ("when raising" if is_raise else "at return"),
+                    )
+        if infeasible:
+            continue  # `x is None` branch while x holds the resource
+        fr = frozenset(state.items())
+        for s in block.succs:
+            stack.append((s, fr))
+        if block.exc_succs:
+            escapes.append(dict(state))
+            for es in escapes:
+                for h in block.exc_succs:
+                    stack.append((h, frozenset(es.items())))
+        if not block.succs and not block.terminated:
+            # fall-off function exit (finalbody events, if any, were
+            # already lowered into this path by the CFG)
+            for name, cur in state.items():
+                if cur[0] != "live":
+                    continue
+                acq = tracked[name][0]
+                flag(
+                    RULE_LEAK, name, acq.call,
+                    f"{disp}: `{name}` ({cur[1]}) may reach the end of "
+                    "the function unreleased",
+                )
+    for (rule_id, _name), (node, msg) in findings.items():
+        ctx.report(node, rule_id, msg)
+
+
+@file_check
+def check_resource_ownership(ctx) -> None:
+    if ctx.rel.startswith("analysis/"):
+        return  # the analyzer's own fixtures/docs mention acquire idioms
+    funcs = list(cfg.iter_functions(ctx.tree))
+    if not funcs:
+        return
+    helpers = rr.module_helpers(ctx, funcs)
+    released_attr_cache: dict[int, set[str]] = {}
+    for info in funcs:
+        acqs = rr.extract_acquires(ctx, info.node, helpers)
+        if not acqs:
+            continue
+        disp = _display(info)
+        for a in acqs:
+            if a.kind == "task":
+                if a.binding == "discard" and not a.spawned:
+                    ctx.report(
+                        a.call, RULE_TASK,
+                        f"{disp}: task discarded at spawn — nothing "
+                        "can await, cancel, or observe its error",
+                    )
+                elif a.binding == "self" and info.class_node is not None:
+                    rel = released_attr_cache.setdefault(
+                        id(info.class_node),
+                        rr.class_released_attrs(info.class_node),
+                    )
+                    if a.attr not in rel:
+                        ctx.report(
+                            a.call, RULE_TASK,
+                            f"{disp}: `self.{a.attr}` task stored on "
+                            "self but no method of "
+                            f"{info.class_node.name} ever cancels or "
+                            "awaits it (no release reachable from "
+                            "stop()/close())",
+                        )
+                elif a.binding == "local" and a.name:
+                    if not _ever_owned(info.node, a.name, a.call):
+                        ctx.report(
+                            a.call, RULE_TASK,
+                            f"{disp}: `{a.name}` task bound but never "
+                            "awaited, cancelled, or handed off",
+                        )
+            elif a.binding == "self" and info.class_node is not None:
+                rel = released_attr_cache.setdefault(
+                    id(info.class_node),
+                    rr.class_released_attrs(info.class_node),
+                )
+                if a.attr not in rel:
+                    ctx.report(
+                        a.call, RULE_LEAK,
+                        f"{disp}: `self.{a.attr}` ({a.kind}) stored on "
+                        "self but no method of "
+                        f"{info.class_node.name} ever releases it (no "
+                        "close/stop reachable from shutdown)",
+                    )
+            elif a.binding == "discard" and a.activation is None:
+                ctx.report(
+                    a.call, RULE_LEAK,
+                    f"{disp}: {a.kind} acquired and immediately "
+                    "discarded — nothing can ever release it",
+                )
+        _walk_function(ctx, info, acqs)

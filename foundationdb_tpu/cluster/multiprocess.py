@@ -2172,13 +2172,32 @@ class ProxyRole:
 
     async def start(self) -> None:
         topo = self.spec["topology"]
-        resolvers = [await connect(a) for a in topo["resolvers"]]
-        tlog = await connect(topo["tlog"])
-        storage = await connect(topo["storage"])
-        rk = None
-        if topo.get("ratekeeper"):
-            rk = await connect(topo["ratekeeper"])
-        self._conns = [*resolvers, tlog, storage] + ([rk] if rk else [])
+        # partial-recruit cleanup: a failed later connect must not leak
+        # the connections already opened (a recruit raced a kill here
+        # leaks one socket per retry otherwise)
+        opened: list[transport.RpcConnection] = []
+        try:
+            resolvers = []
+            for a in topo["resolvers"]:
+                c = await connect(a)
+                opened.append(c)
+                resolvers.append(c)
+            tlog = await connect(topo["tlog"])
+            opened.append(tlog)
+            storage = await connect(topo["storage"])
+            opened.append(storage)
+            rk = None
+            if topo.get("ratekeeper"):
+                rk = await connect(topo["ratekeeper"])
+                opened.append(rk)
+        except BaseException:
+            for c in opened:
+                try:
+                    await c.close()
+                except Exception:
+                    pass
+            raise
+        self._conns = opened
         # resolver partition boundaries (hex-encoded in the topology
         # JSON; the controller re-derives them on every resolver-count
         # change — the elastic-recruit path's multi-resolver split)
@@ -2314,6 +2333,33 @@ class WorkerRole:
     async def start(self) -> None:
         if self.controller:
             self._reg_task = asyncio.ensure_future(self._register_loop())
+
+    async def stop(self) -> None:
+        """Release everything the worker owns: the registration beacon
+        task, its controller connection, and every hosted role — the
+        ownership hook the res.* pass (and the per-process census)
+        require of any store-on-self acquire."""
+        task = self._reg_task
+        self._reg_task = None
+        if task is not None:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+        conn = self._reg_conn
+        self._reg_conn = None
+        if conn is not None:
+            try:
+                await conn.close()
+            except Exception:
+                pass
+        for kind in list(self.roles):
+            old = self.roles.pop(kind)
+            self.role_epochs.pop(kind, None)
+            if isinstance(old, (ProxyRole, RatekeeperRole)):
+                await old.stop()
+            elif isinstance(old, StorageRole):
+                await old.aclose_disk()
+            elif hasattr(old, "close_disk"):
+                old.close_disk()
 
     async def _register_loop(self) -> None:
         import json as _json
@@ -3239,11 +3285,18 @@ class ClusterClient:
         #: strong refs to detached close() tasks (the loop only keeps
         #: weak task refs — without this a close could be GC'd unrun)
         self._closing: set = set()
+        #: serializes _refresh: N coroutines losing the generation at
+        #: once must produce ONE probe connection, not N (the census
+        #: gate caught the stampede leaking every non-winner's conn)
+        self._refresh_lock = asyncio.Lock()
         self.epoch = 0
         self.proxy_address: str | None = None
         self.refreshes = 0
 
     async def connect(self) -> None:
+        # drop any current proxy first: connect() means "re-resolve the
+        # generation", never "reuse whatever is cached"
+        self._drop_proxy()
         await self._refresh()
 
     async def close(self) -> None:
@@ -3293,62 +3346,63 @@ class ClusterClient:
         from foundationdb_tpu.cluster import generation as gen
 
         deadline = _time.monotonic() + self.recovery_timeout
-        old = self._proxy
-        self._proxy = None
-        if old is not None:
-            try:
-                await old.close()
-            except Exception:
-                pass
-        while True:
-            topo = None
-            try:
-                topo = await self.topology()
-            except Exception:
-                pass
-            if topo and topo.get("state") == gen.FULLY_RECOVERED:
-                proxy = next(
-                    (e for e in topo.get("roles", {}).values()
-                     if e["kind"] == "proxy"),
-                    None,
-                )
-                if proxy is not None:
-                    conn = None
-                    try:
-                        conn = transport.RpcConnection(
-                            proxy["address"], tls=_tls_from_env()
-                        )
-                        await conn.connect(retries=2, delay=0.05)
-                        # liveness probe: the socket may be a corpse the
-                        # controller hasn't noticed yet
-                        await conn.call(
-                            TOKEN_CLIENT_GRV, ClientGrvRequest(pad=0),
-                            timeout=5.0,
-                        )
-                        alive = True
-                    except transport.RemoteError as e:
-                        # a throttled front door IS alive
-                        alive = "grv_throttled" in str(e)
-                    except Exception:
-                        alive = False
-                    if alive:
-                        self._proxy = conn
-                        self.proxy_address = proxy["address"]
-                        self.epoch = int(topo["epoch"])
-                        self.refreshes += 1
-                        return topo
-                    if conn is not None:
+        async with self._refresh_lock:
+            if self._proxy is not None:
+                # a concurrent refresher won while we waited on the
+                # lock: its liveness probe just passed, so reuse its
+                # connection — N callers must not stampede N probes
+                return {"state": gen.FULLY_RECOVERED,
+                        "epoch": self.epoch}
+            while True:
+                topo = None
+                try:
+                    topo = await self.topology()
+                except Exception:
+                    pass
+                if topo and topo.get("state") == gen.FULLY_RECOVERED:
+                    proxy = next(
+                        (e for e in topo.get("roles", {}).values()
+                         if e["kind"] == "proxy"),
+                        None,
+                    )
+                    if proxy is not None:
+                        conn = None
                         try:
-                            await conn.close()
+                            conn = transport.RpcConnection(
+                                proxy["address"], tls=_tls_from_env()
+                            )
+                            await conn.connect(retries=2, delay=0.05)
+                            # liveness probe: the socket may be a
+                            # corpse the controller hasn't noticed yet
+                            await conn.call(
+                                TOKEN_CLIENT_GRV,
+                                ClientGrvRequest(pad=0),
+                                timeout=5.0,
+                            )
+                            alive = True
+                        except transport.RemoteError as e:
+                            # a throttled front door IS alive
+                            alive = "grv_throttled" in str(e)
                         except Exception:
-                            pass
-            if _time.monotonic() > deadline:
-                raise ClusterRecoveringError(
-                    f"no recovered generation within "
-                    f"{self.recovery_timeout}s (topology: "
-                    f"{topo and topo.get('state')})"
-                )
-            await asyncio.sleep(0.1)
+                            alive = False
+                        if alive:
+                            self._proxy = conn
+                            self.proxy_address = proxy["address"]
+                            self.epoch = int(topo["epoch"])
+                            self.refreshes += 1
+                            return topo
+                        if conn is not None:
+                            try:
+                                await conn.close()
+                            except Exception:
+                                pass
+                if _time.monotonic() > deadline:
+                    raise ClusterRecoveringError(
+                        f"no recovered generation within "
+                        f"{self.recovery_timeout}s (topology: "
+                        f"{topo and topo.get('state')})"
+                    )
+                await asyncio.sleep(0.1)
 
     async def _retryable_call(self, token: int, msg, *,
                               timeout: float = 30.0):
@@ -3555,13 +3609,29 @@ async def _serve_role(
     # with its status block (fdbtop / wire_cluster_status poll this)
     import json as _json
 
+    from foundationdb_tpu.runtime import census as _census
+
     async def status(_req: StatusRequest) -> StatusReply:
-        return StatusReply(payload=_json.dumps(role.status()))
+        blk = role.status()
+        # per-process resource census: this role process's own live
+        # fds/connections/servers plus its asyncio task count — the
+        # leak gate's gauges, per role, for fdbtop's columns
+        blk["census"] = {
+            **_census.snapshot(),
+            "tasks": len(asyncio.all_tasks()),
+        }
+        return StatusReply(payload=_json.dumps(blk))
 
     server.register(TOKEN_STATUS, status)
     await server.start()
-    # run until killed
-    await asyncio.Event().wait()
+    try:
+        # run until killed
+        await asyncio.Event().wait()
+    finally:
+        # normally unreachable except by cancellation (SIGTERM tears
+        # the whole process down) — but a clean close here means the
+        # in-process drills' census sees the listener go away
+        await server.close()
 
 
 # ---------------------------------------------------------------------------
@@ -4542,11 +4612,20 @@ async def connect(address, **kw) -> transport.RpcConnection:
 def _pipeline_status_blocks(pipeline: "ProxyPipeline") -> dict[str, dict]:
     """The parent process's own process blocks: it plays both proxies
     in wire mode (commit batching + the GRV front door)."""
+    from foundationdb_tpu.runtime import census as _census
+
+    try:
+        tasks = len(asyncio.all_tasks())
+    except RuntimeError:  # no running loop (sync status dump)
+        tasks = 0
     return {
         "proxy0": {
             "role": "commit_proxy",
             "committed_version": pipeline.committed_version,
             "qos": pipeline.saturation(),
+            # the parent process's own resource census (the role
+            # processes each report theirs via _serve_role's handler)
+            "census": {**_census.snapshot(), "tasks": tasks},
         },
         "grv_proxy0": {
             "role": "grv_proxy",

@@ -276,6 +276,12 @@ def _compile_cache_section() -> dict[str, Any]:
     return compile_cache.stats()
 
 
+def _census_snapshot(sched=None) -> dict[str, int]:
+    from foundationdb_tpu.runtime import census
+
+    return census.snapshot(sched)
+
+
 def _kernel_section(resolver) -> dict[str, Any]:
     cs = resolver.conflict_set
     metrics = getattr(cs, "metrics", None)
@@ -340,6 +346,11 @@ def cluster_status(cluster) -> dict[str, Any]:
             # design: it measures how busy this OS process's loop is;
             # status readers surface it, traced output never does)
             "run_loop": cluster.sched.run_loop_stats(),
+            # live resource census (runtime/census.py): fds straight
+            # off /proc, transport gauges, the Scheduler's live-task
+            # count — the leak gate's gauges, surfaced for operators.
+            # Status-only, like run_loop: never lands in traces.
+            "census": _census_snapshot(sched=cluster.sched),
             "workload": {
                 "transactions": {
                     "committed": sum(

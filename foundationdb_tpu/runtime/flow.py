@@ -494,7 +494,7 @@ class Task:
     """A spawned actor: drives a coroutine over Futures."""
 
     __slots__ = ("_coro", "_sched", "_priority", "done", "_cancelled",
-                 "_name", "_waiting")
+                 "_name", "_waiting", "_retired")
 
     def __init__(self, coro, sched: "Scheduler", priority: int, name: str = ""):
         self._coro = coro
@@ -509,6 +509,16 @@ class Task:
         #: batch actor awaiting it is not an "escaped" error
         self._waiting: Optional[Future] = None
         self.done = Future()
+        #: live-task census: retired exactly once, at the terminal
+        #: done._set/_set_error — NOT via add_done_callback, which would
+        #: defeat the `not done._callbacks` fire-and-forget crash print
+        self._retired = False
+        sched._tasks_live += 1
+
+    def _retire(self) -> None:
+        if not self._retired:
+            self._retired = True
+            self._sched._tasks_live -= 1
 
     def cancel(self) -> None:
         """Cancel the actor (reference: dropping the last Future reference)."""
@@ -541,12 +551,15 @@ class Task:
             self._coro.throw(ActorCancelled())
         except (StopIteration, ActorCancelled):
             self.done._set_error(ActorCancelled())
+            self._retire()
             return
         except BaseException as e:  # actor swallowed the cancel and raised
             self.done._set_error(e)
+            self._retire()
             return
         # Actor caught the cancellation and kept awaiting: treat as done.
         self.done._set_error(ActorCancelled())
+        self._retire()
 
     def _step(self, fut: Optional[Future]) -> None:
         if self.done.is_ready or self._cancelled:
@@ -586,9 +599,11 @@ class Task:
                 waited = self._coro.send(None)
         except StopIteration as stop:
             self.done._set(stop.value)
+            self._retire()
             return
         except ActorCancelled:
             self.done._set_error(ActorCancelled())
+            self._retire()
             return
         except BaseException as e:
             if not self.done._callbacks:
@@ -622,6 +637,7 @@ class Task:
                     del ledger[:512]
             ledger.append((self._name, e, self.done))
             self.done._set_error(e)
+            self._retire()
             return
         if not isinstance(waited, Future):
             raise TypeError(f"actor awaited non-Future {waited!r}")
@@ -695,6 +711,11 @@ class Scheduler:
         self._busy_wall = 0.0
         self._steps = 0
         self._slow_task_total = 0
+        #: live-task census (incremented at Task construction, retired
+        #: at its terminal done-set): the scheduler half of the
+        #: resource census gate — a drained run returns this to its
+        #: pre-run baseline or the census gate fails the seed
+        self._tasks_live = 0
         self._wall_anchor = _time.perf_counter()  # flowcheck: ignore[determinism]
 
     def run_loop_stats(self) -> dict:
@@ -711,6 +732,7 @@ class Scheduler:
             "busy_seconds": self._busy_wall,
             "wall_seconds": wall,
             "steps": self._steps,
+            "tasks_live": self._tasks_live,
             "slow_tasks": self._slow_task_total,
             "slow_tasks_by_actor": dict(
                 sorted(slow_by_actor.items(), key=lambda kv: -kv[1])[:10]

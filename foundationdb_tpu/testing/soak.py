@@ -187,7 +187,7 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
              _inject_fault=None, _corrupt_api: bool = False,
              perturb: int = 0, _inject_race: bool = False,
              trace: bool = False, _corrupt_trace: bool = False,
-             status_probe: bool = False):
+             status_probe: bool = False, census: bool = False):
     """Run one ensemble seed under a named spec; returns the
     deterministic signature (and, with collect_probes, the CODE_PROBE
     hit snapshot for ensemble coverage accounting — the Joshua side of
@@ -230,6 +230,17 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
     (seed, perturb). `_corrupt_trace` is the gate's divergence
     self-test: it deletes one pipeline stage's events before the check,
     which must then fail the seed.
+
+    `census=True` arms the resource-census gate (runtime/census.py):
+    a snapshot before the cluster is built vs one after it is stopped
+    and drained — growth in live scheduler tasks or transport gauges
+    fails the seed. fd counts are excluded HERE on purpose: sim seeds
+    share one process with lazily-initialized JAX/NumPy internals, so
+    /proc/self/fd growth is not attributable to the run — the wire
+    drills (bench/chaos/elasticity, each owning its process) gate fds.
+    Census reads stay out of the signature and the trace digest, so
+    an armed gate leaves signatures bit-identical per (seed, perturb)
+    (pinned by tests/test_census.py).
 
     `status_probe=True` arms the saturation-sensor determinism guard:
     a background actor samples the full `cluster_status()` document
@@ -372,6 +383,13 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
                 else (seed * 1_000_003 + perturb) & ((1 << 63) - 1)
             ),
         )
+        census_pre = None
+        if census:
+            from foundationdb_tpu.runtime import census as _census
+
+            # BEFORE the cluster exists: everything it spawns or opens
+            # must be gone again by the post-drain snapshot
+            census_pre = _census.snapshot(sched)
         _s, cluster, db = open_cluster(
             ClusterConfig(
                 n_commit_proxies=plan.n_commit_proxies,
@@ -1215,6 +1233,20 @@ def run_seed(seed: int, spec=None, collect_probes: bool = False,
         ) + trace_extra
         if not stopped:
             cluster.stop()
+        if census_pre is not None:
+            from foundationdb_tpu.runtime import census as _census
+
+            # pump the loop so stop()'s cancels are DELIVERED (a
+            # cancelled-but-not-yet-stepped task is still live), then
+            # require every gauge back at its pre-run baseline. The
+            # signature is already built: an armed census cannot
+            # perturb it (the determinism sweep pins this).
+            sched.run_for(0.1)
+            _census.check_drained(
+                census_pre, _census.snapshot(sched),
+                label=f"seed {seed} perturb {perturb}",
+                ignore={"fds"},
+            )
         if collect_probes:
             from foundationdb_tpu.utils import probes
 

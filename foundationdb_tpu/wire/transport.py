@@ -38,6 +38,7 @@ import sys
 import zlib
 from typing import Any, Callable
 
+from foundationdb_tpu.runtime import census
 from foundationdb_tpu.wire import codec
 
 MAGIC = b"FDBTPUv1"
@@ -158,6 +159,7 @@ class RpcServer:
         self._handlers: dict[int, Callable] = {}
         self._server: asyncio.AbstractServer | None = None
         self._conns: set = set()  # live connection writers
+        self._census_live = False  # tracked in census.SERVERS
 
     def register(self, token: int, handler: Callable) -> None:
         """handler: async (msg) -> reply msg (codec-registered types)."""
@@ -215,8 +217,14 @@ class RpcServer:
             self._server = await asyncio.start_server(
                 self._serve_conn, host=host, port=port, ssl=ssl_ctx
             )
+        if not self._census_live:
+            self._census_live = True
+            census.SERVERS.inc()
 
     async def close(self) -> None:
+        if self._census_live:
+            self._census_live = False
+            census.SERVERS.dec()
         if self._server is not None:
             self._server.close()
             # drop live connections too: wait_closed() (3.12) waits for
@@ -299,6 +307,7 @@ class RpcConnection:
         self._reader_task: asyncio.Task | None = None
         self._fb = _FrameBuffer(zero_copy=tls is None)
         self._loop: asyncio.AbstractEventLoop | None = None
+        self._census_live = False  # tracked in census.CONNECTIONS
 
     async def connect(self, *, retries: int = 50, delay: float = 0.1) -> None:
         last = None
@@ -351,8 +360,14 @@ class RpcConnection:
                 f"(peer closed: {e!r})"
             )
         self._reader_task = asyncio.ensure_future(self._read_loop())
+        if not self._census_live:
+            self._census_live = True
+            census.CONNECTIONS.inc()
 
     async def close(self) -> None:
+        if self._census_live:
+            self._census_live = False
+            census.CONNECTIONS.dec()
         if self._reader_task:
             self._reader_task.cancel()
             try:

@@ -173,6 +173,14 @@ async def _run_wire(backend: str, args) -> dict:
         )
     import contextlib
 
+    from foundationdb_tpu.runtime import census
+
+    # resource-census gate: the drill owns this whole process, so the
+    # gate is strict (fds included) — snapshot AFTER the trace sink is
+    # installed (its file stays open past the run by design) and check
+    # after teardown; any growth is a leak and fails the run
+    census_pre = census.snapshot()
+
     # --socket-dir pins the role sockets to a caller-owned dir so an
     # EXTERNAL fdbtop can poll StatusRequest on them mid-run (the
     # check.sh fdbtop lane); default stays a self-cleaning tempdir
@@ -351,12 +359,21 @@ async def _run_wire(backend: str, args) -> dict:
             await pipe.stop()
             if status_server is not None:
                 await status_server.close()
-            for c in (resolver, tlog, storage):
-                await c.close()
+            # rk_conn included: leaving the ratekeeper connection open
+            # was exactly the leak class the census gate exists to
+            # catch (res.leak-on-error-path's dynamic twin)
+            for c in (resolver, tlog, storage, rk_conn):
+                if c is not None:
+                    await c.close()
         finally:
             for p in procs:
                 p.stop()
             os.environ.pop("RESOLVER_KERNEL", None)
+    # post-drain census: one loop-tick sleep lets asyncio finish the
+    # writer/transport closes queued by the teardown above
+    await asyncio.sleep(0.1)
+    census.check_drained(census_pre, census.snapshot(),
+                         label="bench_pipeline wire")
     if trace_dir:
         # merge this process's trace with the resolver process's and
         # reconstruct: committed wire transactions must chain across the

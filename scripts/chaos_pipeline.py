@@ -361,6 +361,24 @@ async def _run_scenario(kill_kind: str, args) -> dict:
             shutil.rmtree(d, ignore_errors=True)
 
 
+async def _run_scenario_gated(kill_kind: str, args) -> dict:
+    """Run one scenario under the resource-census gate: every fd,
+    connection and server the scenario opens in THIS process must be
+    gone once the monitor is down. A leak fails the scenario (and so
+    the drill's exit code), same contract as run_seed(census=True)."""
+    from foundationdb_tpu.runtime import census
+
+    pre = census.snapshot()
+    res = await _run_scenario(kill_kind, args)
+    # asyncio tears transports down a tick after close(); let the loop
+    # drain before reading the post census.
+    await asyncio.sleep(0.1)
+    census.check_drained(
+        pre, census.snapshot(), label=f"chaos_pipeline {kill_kind}"
+    )
+    return res
+
+
 def _emit_ledger(args, results: list[dict]) -> None:
     """One perf-ledger row for the run: scenario recoveries + the
     consistency bit are STRUCTURAL (deterministic on any host — the
@@ -462,7 +480,7 @@ def main() -> int:
     failures = []
     for kind in scenarios:
         print(f"== chaos scenario: kill -9 {kind} ==", flush=True)
-        res = asyncio.run(_run_scenario(kind, args))
+        res = asyncio.run(_run_scenario_gated(kind, args))
         results.append(res)
         print(json.dumps(
             {k: v for k, v in res.items() if k != "timeline"}

@@ -295,6 +295,23 @@ async def _run_direction(elastic: bool, args) -> dict:
             shutil.rmtree(d, ignore_errors=True)
 
 
+async def _run_direction_gated(elastic: bool, args) -> dict:
+    """One direction under the resource-census gate (see
+    runtime/census.py): fds/connections/servers opened by this process
+    must all be gone once the monitor is down, or the drill fails."""
+    from foundationdb_tpu.runtime import census
+
+    pre = census.snapshot()
+    res = await _run_direction(elastic, args)
+    # let the loop drain transport teardown before the post census
+    await asyncio.sleep(0.1)
+    census.check_drained(
+        pre, census.snapshot(),
+        label=f"elasticity_drill {'on' if elastic else 'off'}",
+    )
+    return res
+
+
 def _emit_ledger(args, on: dict, off: dict) -> None:
     from foundationdb_tpu.utils import perf
 
@@ -388,7 +405,7 @@ def main() -> int:
     if args.direction in ("both", "on"):
         print("== elasticity ON: saturate one resolver, expect a live "
               "recruit ==", flush=True)
-        on = asyncio.run(_run_direction(True, args))
+        on = asyncio.run(_run_direction_gated(True, args))
         print(json.dumps(on), flush=True)
         if not on["recruited"]:
             failures.append("ON: no second resolver was recruited")
@@ -419,7 +436,7 @@ def main() -> int:
     if args.direction in ("both", "off"):
         print("== elasticity OFF: same load must stay pinned at the "
               "plateau ==", flush=True)
-        off = asyncio.run(_run_direction(False, args))
+        off = asyncio.run(_run_direction_gated(False, args))
         print(json.dumps(off), flush=True)
         if off["recruited"] or off.get("elastic_recruits"):
             failures.append("OFF: a resolver was recruited with "

@@ -99,6 +99,14 @@ REQUIRED_SENSORS = {
                            "recovery_timeline", "elastic_recruits"),
 }
 
+#: per-process resource-census keys (runtime/census.py) every wire role
+#: process must report NEXT TO its qos block — the leak gate's gauges
+#: as operator columns. Enforced by --smoke only: the sim surfaces one
+#: cluster-level census (the whole sim is one process), and grv_proxy
+#: rides the proxy0 socket so its census IS proxy0's.
+CENSUS_SENSORS = ("census.fds", "census.connections", "census.servers",
+                  "census.tasks")
+
 
 # ---------------------------------------------------------------------------
 # Wire-mode polling.
@@ -311,6 +319,21 @@ def _row_metrics(role: str, block: dict) -> list[tuple[str, object]]:
     return [("version", block.get("version", 0))]
 
 
+def _census_cols(block: dict) -> list[tuple[str, object]]:
+    """The resource-census columns riding every wire process row:
+    live connections / asyncio tasks / open fds in that role's OS
+    process (runtime/census.py gauges). Absent block (sim rows, grv
+    sharing proxy0's process) renders no columns."""
+    c = block.get("census")
+    if not c:
+        return []
+    return [
+        ("conns", c.get("connections", 0)),
+        ("tasks", c.get("tasks", 0)),
+        ("fds", c.get("fds", -1)),
+    ]
+
+
 def render(status: dict, histories: dict[str, MetricHistory],
            t: float) -> str:
     cl = status.get("cluster", {})
@@ -354,7 +377,8 @@ def render(status: dict, histories: dict[str, MetricHistory],
         hist = histories.setdefault(name, MetricHistory(120))
         hist.append(t, float(val))
         detail = "  ".join(
-            f"{k}={_fmt(v).strip()}" for k, v in _row_metrics(role, block)
+            f"{k}={_fmt(v).strip()}"
+            for k, v in _row_metrics(role, block) + _census_cols(block)
         )
         lines.append(
             f"{name:<14} {role:<13} {label:<8} {_fmt(val)}  "
@@ -367,9 +391,12 @@ def render(status: dict, histories: dict[str, MetricHistory],
 # Modes.
 
 
-def check_status(status: dict, require: list[str]) -> list[str]:
+def check_status(status: dict, require: list[str], *,
+                 census: bool = False) -> list[str]:
     """The smoke gate: every required role present, every process's qos
-    non-empty, every role-required sensor key populated. Returns the
+    non-empty, every role-required sensor key populated. With
+    census=True (the --smoke lane: wire processes only), every role
+    process must also carry its CENSUS_SENSORS block. Returns the
     list of problems (empty == healthy)."""
     problems = []
     procs = status.get("cluster", {}).get("processes", {})
@@ -382,9 +409,13 @@ def check_status(status: dict, require: list[str]) -> list[str]:
         if not qos:
             problems.append(f"{name}: empty qos block")
             continue
-        for key in REQUIRED_SENSORS.get(block.get("role", ""), ()):
-            # dotted keys descend into nested blocks (kernel.shards)
-            node = qos
+        keys = REQUIRED_SENSORS.get(block.get("role", ""), ())
+        if census and block.get("role") != "grv_proxy":
+            keys = (*keys, *CENSUS_SENSORS)
+        for key in keys:
+            # dotted keys descend into nested blocks (kernel.shards);
+            # census.* keys live NEXT TO qos in the process block
+            node = block if key.startswith("census.") else qos
             missing = False
             for part in key.split("."):
                 if not isinstance(node, dict) or part not in node:
@@ -392,7 +423,7 @@ def check_status(status: dict, require: list[str]) -> list[str]:
                     break
                 node = node[part]
             if missing:
-                problems.append(f"{name}: qos missing sensor {key!r}")
+                problems.append(f"{name}: missing sensor {key!r}")
     if "performance_limited_by" not in status.get("cluster", {}).get(
         "qos", {}
     ):
@@ -506,7 +537,7 @@ def _smoke_main(args) -> int:
                     await _close_conns(conns)
 
             status = asyncio.run(one_poll())
-            last_problems = check_status(status, require)
+            last_problems = check_status(status, require, census=True)
             if not last_problems:
                 print(json.dumps(status, sort_keys=True))
                 print(

@@ -1,0 +1,176 @@
+"""Live resource census (runtime/census.py): the dynamic half of the
+resource-ownership gate.
+
+The `res.*` flowcheck family (tests/test_flowcheck.py) proves no code
+PATH leaks a resource; this file pins that no RUN does — and, just as
+load-bearing, that ARMING the gate perturbs nothing: soak signatures
+(trace digest included) must stay bit-identical with the census on,
+because census reads never participate in scheduling or tracing."""
+
+import asyncio
+
+import pytest
+
+from foundationdb_tpu.runtime import census
+
+
+# ---------------------------------------------------------------------------
+# Gauges + snapshot mechanics.
+
+
+def test_gauge_and_snapshot_shape():
+    g = census.Gauge("x")
+    g.inc()
+    g.inc()
+    g.dec()
+    assert g.value == 1
+    snap = census.snapshot()
+    assert set(snap) == {"fds", "connections", "servers", "tasks"}
+    # /proc/self/fd exists on the CI hosts; elsewhere live_fds() must
+    # degrade to the "not measurable" sentinel, never throw
+    assert snap["fds"] >= -1
+    assert snap["tasks"] == 0  # no Scheduler passed
+
+
+def test_growth_and_check_drained_semantics():
+    pre = {"fds": 8, "connections": 2, "servers": 1, "tasks": 3}
+    post = {"fds": 9, "connections": 2, "servers": 0, "tasks": 5}
+    leaks = census.growth(pre, post)
+    assert leaks == ["fds grew 8 -> 9", "tasks grew 3 -> 5"]
+    # ignore set, unmeasurable (-1), and missing keys are all skipped;
+    # equality and shrinkage are clean
+    assert census.growth(pre, post, ignore={"fds", "tasks"}) == []
+    assert census.growth({"fds": -1}, {"fds": 100}) == []
+    assert census.growth({"a": 1}, {"b": 2}) == []
+    assert census.growth(pre, dict(pre)) == []
+    census.check_drained(pre, dict(pre))  # no raise
+    with pytest.raises(RuntimeError, match="tasks grew 3 -> 5"):
+        census.check_drained(pre, post, ignore={"fds"}, label="unit")
+
+
+# ---------------------------------------------------------------------------
+# Transport gauges: the wire layer's own accounting.
+
+
+def test_transport_gauges_track_connect_and_close(tmp_path):
+    from foundationdb_tpu.cluster.multiprocess import TOKEN_PING, Ping, Pong
+    from foundationdb_tpu.wire import transport
+
+    sock = str(tmp_path / "role.sock")
+
+    async def scenario():
+        c0 = census.CONNECTIONS.value
+        s0 = census.SERVERS.value
+        server = transport.RpcServer(sock)
+
+        async def ping(msg):
+            return Pong(payload=msg.payload)
+
+        server.register(TOKEN_PING, ping)
+        await server.start()
+        assert census.SERVERS.value == s0 + 1
+        conn = transport.RpcConnection(sock)
+        assert census.CONNECTIONS.value == c0  # constructed != activated
+        await conn.connect()
+        assert census.CONNECTIONS.value == c0 + 1
+        await conn.call(TOKEN_PING, Ping(payload=b"x"))
+        await conn.close()
+        await conn.close()  # idempotent: the gauge must not go double-dec
+        assert census.CONNECTIONS.value == c0
+        await server.close()
+        await server.close()
+        assert census.SERVERS.value == s0
+
+    asyncio.new_event_loop().run_until_complete(scenario())
+
+
+# ---------------------------------------------------------------------------
+# Scheduler task accounting: tasks_live retires exactly once.
+
+
+def test_tasks_live_retires_on_every_terminal_path():
+    from foundationdb_tpu.runtime.flow import ActorCancelled, Scheduler
+
+    sched = Scheduler(sim=True)
+    assert sched.run_loop_stats()["tasks_live"] == 0
+
+    async def ok():
+        await sched.delay(0.01)
+
+    async def boom():
+        await sched.delay(0.01)
+        raise ValueError("x")
+
+    async def forever():
+        await sched.delay(10**6)
+
+    t_ok = sched.spawn(ok())
+    t_boom = sched.spawn(boom())
+    t_fore = sched.spawn(forever())
+    assert sched.run_loop_stats()["tasks_live"] == 3
+    sched.run_for(0.1)
+    # ok completed, boom errored — both retired; forever is still live
+    assert sched.run_loop_stats()["tasks_live"] == 1
+    t_fore.cancel()
+    sched.run_for(0.1)
+    assert sched.run_loop_stats()["tasks_live"] == 0
+    # consume the futures so the error ledger stays clean
+    async def drain():
+        await t_ok
+        with pytest.raises(ValueError):
+            await t_boom
+        with pytest.raises(ActorCancelled):
+            await t_fore
+
+    sched.run_until(sched.spawn(drain()).done)
+
+
+# ---------------------------------------------------------------------------
+# The armed gate: catches a leak, perturbs nothing.
+
+
+def test_census_gate_fails_a_seed_with_a_lingering_task():
+    """A fire-and-forget actor still live after drain is a TASK LEAK:
+    the armed census gate must fail the seed, naming the gauge."""
+    from foundationdb_tpu.testing.soak import run_seed
+
+    async def linger(sched, cluster, db):
+        await sched.delay(10**6)
+
+    with pytest.raises(RuntimeError, match="tasks grew"):
+        run_seed(3, spec="smoke", census=True, _inject_fault=linger)
+    # and the same seed WITHOUT the lingering task passes armed
+    assert run_seed(3, spec="smoke", census=True)
+
+
+def test_census_armed_seed_is_bit_identical():
+    """Fast shape of the determinism pin: arming the census gate leaves
+    the signature (trace digest included) bit-identical, FIFO and
+    perturbed. The 20-seed sweep lives in the slow lane below."""
+    from foundationdb_tpu.testing.soak import run_seed
+
+    for perturb in (0, 1):
+        armed = run_seed(7, spec="smoke", trace=True, census=True,
+                         perturb=perturb)
+        plain = run_seed(7, spec="smoke", trace=True, perturb=perturb)
+        assert armed == plain, f"census perturbed seed 7/{perturb}"
+
+
+@pytest.mark.slow
+def test_census_determinism_sweep_20_seeds():
+    """The round-18 acceptance sweep: 20 seeds x 2 perturbations with
+    the census gate ARMED — every (seed, perturb) passes the gate (no
+    resource growth across the whole ensemble) and stays bit-identical
+    with the unarmed run."""
+    from foundationdb_tpu.testing.soak import run_seed
+
+    for seed in range(20):
+        for perturb in (0, 1):
+            armed = run_seed(seed, spec="smoke", trace=True, census=True,
+                             perturb=perturb)
+            plain = run_seed(seed, spec="smoke", trace=True,
+                             perturb=perturb)
+            assert armed == plain, (
+                f"seed {seed} perturb {perturb}: census-armed signature "
+                "diverged from the unarmed run"
+            )

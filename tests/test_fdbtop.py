@@ -450,3 +450,52 @@ def test_fdbtop_sim_once_json_smoke():
     assert out.returncode == 0, out.stderr
     doc = json.loads(out.stdout)
     assert "performance_limited_by" in doc["cluster"]["qos"]
+
+
+def test_fdbtop_census_gate_and_columns():
+    """r18: with census=True, every wire role process must report its
+    resource-census block (fds/connections/servers/tasks) NEXT TO qos;
+    grv_proxy is exempt (it rides proxy0's process). The render path
+    turns the block into conns/tasks/fds columns."""
+    import json
+
+    import fdbtop
+
+    census = {"fds": 11, "connections": 2, "servers": 1, "tasks": 5}
+    good = {
+        "cluster": {
+            "qos": {"performance_limited_by": {"name": "workload"}},
+            "processes": {
+                "storage0": {"role": "storage", "census": dict(census),
+                             "qos": {"version_lag_versions": 0,
+                                     "input_bytes_per_s": 0.0}},
+                "grv_proxy0": {"role": "grv_proxy",
+                               "qos": {"queued_requests": 0, "sheds": 0,
+                                       "budget_stale": False}},
+            },
+        }
+    }
+    require = ["storage", "grv_proxy"]
+    assert fdbtop.check_status(good, require, census=True) == []
+    # census off: the block is optional (sim rows don't carry one)
+    bare = json.loads(json.dumps(good))
+    del bare["cluster"]["processes"]["storage0"]["census"]
+    assert fdbtop.check_status(bare, require) == []
+    # census on: a missing gauge names the process and the dotted key
+    partial = json.loads(json.dumps(good))
+    del partial["cluster"]["processes"]["storage0"]["census"]["fds"]
+    problems = fdbtop.check_status(partial, require, census=True)
+    assert any("storage0" in p and "census.fds" in p for p in problems)
+    # the render columns
+    cols = dict(fdbtop._census_cols(good["cluster"]["processes"]
+                                    ["storage0"]))
+    assert cols == {"conns": 2, "tasks": 5, "fds": 11}
+    assert fdbtop._census_cols({"role": "grv_proxy"}) == []
+
+
+def test_sim_cluster_status_has_census(sim_status):
+    """r18: the sim surfaces ONE cluster-level census (the whole sim is
+    a single OS process) with the Scheduler's live-task gauge."""
+    c = sim_status["cluster"]["census"]
+    assert set(c) == {"fds", "connections", "servers", "tasks"}
+    assert c["tasks"] >= 0 and c["fds"] >= -1

@@ -1249,3 +1249,257 @@ def test_live_tree_wire_manifest_is_current():
         "wire_manifest.json is stale: run `python -m "
         "foundationdb_tpu.analysis --write-wire-manifest`"
     )
+
+
+# -- res family: resource-ownership leaks ----------------------------------
+
+
+def test_res_leak_on_unprotected_await_and_protected_clean():
+    """The wire cluster's four hand-caught review fixes, as a rule: a
+    live connection across an unprotected await leaks on the exception
+    edge; an except-BaseException cleanup (ProxyRole.start's fixed
+    shape) protects it."""
+    leaky = (
+        "from foundationdb_tpu.wire import transport\n\n"
+        "class ProxyRole:\n"
+        "    async def start(self, addr, msg):\n"
+        "        conn = transport.RpcConnection(addr)\n"
+        "        await conn.connect()\n"
+        "        reply = await conn.call(1, msg)\n"
+        "        self._conn = conn\n"
+    )
+    assert rules_of(analyze_source(leaky, SIM)) == [
+        "res.leak-on-error-path"
+    ]
+    fixed = (
+        "from foundationdb_tpu.wire import transport\n\n"
+        "class ProxyRole:\n"
+        "    async def start(self, addr, msg):\n"
+        "        conn = transport.RpcConnection(addr)\n"
+        "        await conn.connect()\n"
+        "        try:\n"
+        "            reply = await conn.call(1, msg)\n"
+        "        except BaseException:\n"
+        "            await conn.close()\n"
+        "            raise\n"
+        "        self._conn = conn\n"
+    )
+    assert analyze_source(fixed, SIM) == []
+
+
+def test_res_bare_activation_is_not_a_finding():
+    """`conn = RpcConnection(...); await conn.connect()` with no try is
+    clean: an exception AT the activation escapes the PRE state (the
+    transport cleans up its own half-open socket)."""
+    src = (
+        "from foundationdb_tpu.wire import transport\n\n"
+        "async def dial(addr):\n"
+        "    conn = transport.RpcConnection(addr)\n"
+        "    await conn.connect()\n"
+        "    return conn\n"
+    )
+    assert analyze_source(src, SIM) == []
+
+
+def test_res_server_leak_and_try_finally_clean():
+    """_serve_role's fixed shape: a started RpcServer awaited-on
+    forever must close in a finally; without it the cancellation edge
+    leaks the listener."""
+    leaky = (
+        "import asyncio\n\n"
+        "from foundationdb_tpu.wire import transport\n\n"
+        "async def serve(addr):\n"
+        "    server = transport.RpcServer(addr)\n"
+        "    await server.start()\n"
+        "    await asyncio.Event().wait()\n"
+    )
+    # OUT scope: wire/ is the asyncio side (determinism.asyncio would
+    # also fire in sim scope, correctly — different family's business)
+    assert rules_of(analyze_source(leaky, OUT)) == [
+        "res.leak-on-error-path"
+    ]
+    fixed = (
+        "import asyncio\n\n"
+        "from foundationdb_tpu.wire import transport\n\n"
+        "async def serve(addr):\n"
+        "    server = transport.RpcServer(addr)\n"
+        "    await server.start()\n"
+        "    try:\n"
+        "        await asyncio.Event().wait()\n"
+        "    finally:\n"
+        "        await server.close()\n"
+    )
+    assert analyze_source(fixed, OUT) == []
+
+
+def test_res_task_stored_on_self_needs_reachable_release():
+    """WorkerRole's fixed shape: a task stored on self must be
+    cancellable from some method — and the null-then-release ALIAS
+    idiom (`task = self._t; self._t = None; task.cancel()`) counts."""
+    leaky = (
+        "import asyncio\n\n"
+        "class WorkerRole:\n"
+        "    async def start(self):\n"
+        "        self._reg_task = asyncio.ensure_future(self._loop())\n"
+    )
+    assert rules_of(analyze_source(leaky, OUT)) == ["res.task-unowned"]
+    fixed = leaky + (
+        "\n"
+        "    async def stop(self):\n"
+        "        task = self._reg_task\n"
+        "        self._reg_task = None\n"
+        "        if task is not None:\n"
+        "            task.cancel()\n"
+    )
+    assert analyze_source(fixed, OUT) == []
+
+
+def test_res_task_discard_and_unowned_local():
+    discard = (
+        "import asyncio\n\n"
+        "async def f(coro):\n"
+        "    asyncio.create_task(coro)\n"
+    )
+    assert rules_of(analyze_source(discard, OUT)) == ["res.task-unowned"]
+    unowned = (
+        "import asyncio\n\n"
+        "async def f(coro):\n"
+        "    t = asyncio.create_task(coro)\n"
+    )
+    assert rules_of(analyze_source(unowned, OUT)) == ["res.task-unowned"]
+    owned = (
+        "import asyncio\n\n"
+        "async def f(coro):\n"
+        "    t = asyncio.create_task(coro)\n"
+        "    await t\n"
+    )
+    assert analyze_source(owned, OUT) == []
+
+
+def test_res_double_close_and_use_after_close():
+    double = (
+        "from foundationdb_tpu.wire import transport\n\n"
+        "async def f(addr):\n"
+        "    conn = transport.RpcConnection(addr)\n"
+        "    await conn.connect()\n"
+        "    await conn.close()\n"
+        "    await conn.close()\n"
+    )
+    assert rules_of(analyze_source(double, SIM)) == ["res.double-close"]
+    use = (
+        "from foundationdb_tpu.wire import transport\n\n"
+        "async def f(addr, msg):\n"
+        "    conn = transport.RpcConnection(addr)\n"
+        "    await conn.connect()\n"
+        "    await conn.close()\n"
+        "    return await conn.call(1, msg)\n"
+    )
+    assert rules_of(analyze_source(use, SIM)) == ["res.transfer-then-use"]
+    # close-then-reacquire-then-close is NOT a double close
+    ok = (
+        "from foundationdb_tpu.wire import transport\n\n"
+        "async def f(addr):\n"
+        "    conn = transport.RpcConnection(addr)\n"
+        "    await conn.connect()\n"
+        "    await conn.close()\n"
+        "    conn = transport.RpcConnection(addr)\n"
+        "    await conn.connect()\n"
+        "    await conn.close()\n"
+    )
+    assert analyze_source(ok, SIM) == []
+
+
+def test_res_none_narrowing_kills_infeasible_paths():
+    """`if conn is not None: await conn.close()` after a tracked
+    acquire must NOT leak through the infeasible None branch — but a
+    close behind an UNRELATED condition still can."""
+    src = (
+        "from foundationdb_tpu.wire import transport\n\n"
+        "async def f(addr):\n"
+        "    conn = transport.RpcConnection(addr)\n"
+        "    await conn.connect()\n"
+        "    if conn is not None:\n"
+        "        await conn.close()\n"
+    )
+    assert analyze_source(src, SIM) == []
+    leaky = (
+        "from foundationdb_tpu.wire import transport\n\n"
+        "async def f(addr, flag):\n"
+        "    conn = transport.RpcConnection(addr)\n"
+        "    await conn.connect()\n"
+        "    if flag:\n"
+        "        await conn.close()\n"
+    )
+    assert rules_of(analyze_source(leaky, SIM)) == [
+        "res.leak-on-error-path"
+    ]
+
+
+def test_res_helper_acquire_is_interprocedural():
+    """A module helper that returns its acquire (mp.connect's shape)
+    makes the CALLER the owner: discarding its result is a leak."""
+    src = (
+        "from foundationdb_tpu.wire import transport\n\n"
+        "async def connect(addr):\n"
+        "    conn = transport.RpcConnection(addr)\n"
+        "    await conn.connect()\n"
+        "    return conn\n\n"
+        "async def f(addr, msg):\n"
+        "    c = await connect(addr)\n"
+        "    await c.call(1, msg)\n"
+        "    await c.close()\n"
+    )
+    assert rules_of(analyze_source(src, SIM)) == ["res.leak-on-error-path"]
+    fixed = (
+        "from foundationdb_tpu.wire import transport\n\n"
+        "async def connect(addr):\n"
+        "    conn = transport.RpcConnection(addr)\n"
+        "    await conn.connect()\n"
+        "    return conn\n\n"
+        "async def f(addr, msg):\n"
+        "    c = await connect(addr)\n"
+        "    try:\n"
+        "        await c.call(1, msg)\n"
+        "    finally:\n"
+        "        await c.close()\n"
+    )
+    assert analyze_source(fixed, SIM) == []
+
+
+def test_res_revert_acceptance_pin():
+    """THE res acceptance pin: surgically reverting ClusterClient.
+    _refresh's failed-probe connection close (a PR-13-era leak fix) in
+    the REAL multiprocess.py must trip res.leak-on-error-path naming
+    _refresh; the shipped source must analyze clean."""
+    mp_path = REPO / "foundationdb_tpu" / "cluster" / "multiprocess.py"
+    src = mp_path.read_text(encoding="utf-8")
+    close_fix = (
+        "                        if conn is not None:\n"
+        "                            try:\n"
+        "                                await conn.close()\n"
+        "                            except Exception:\n"
+        "                                pass\n"
+    )
+    assert close_fix in src, "the _refresh failed-probe close moved"
+    reverted = src.replace(close_fix, "", 1)
+
+    rel = "foundationdb_tpu/cluster/multiprocess.py"
+    assert [
+        f for f in analyze_source(src, rel)
+        if f.rule.startswith("res.")
+    ] == []
+    tripped = [
+        f for f in analyze_source(reverted, rel)
+        if f.rule == "res.leak-on-error-path"
+    ]
+    assert tripped, "reverting the probe-close must trip the leak rule"
+    assert any("_refresh" in f.message for f in tripped)
+
+
+def test_res_family_in_catalog():
+    from foundationdb_tpu.analysis.registry import RULES, load_rules
+
+    load_rules()
+    for rid in ("res.leak-on-error-path", "res.task-unowned",
+                "res.double-close", "res.transfer-then-use"):
+        assert rid in RULES and RULES[rid].doc
