@@ -300,6 +300,12 @@ class CommitProxy:
             "batches_started": self._batch_num,
             "batches_logged": self.latest_batch_logging.get(),
             "batch_sizer": self.batch_sizer.as_dict(),
+            # r19 scale-out sensors, shared schema with the wire proxy:
+            # grants = GetCommitVersion round-trips to the sequencer;
+            # the sim proxy pushes through ONE log-system front (tag
+            # fan-out happens inside it), so partitioned stays False
+            "version_grants": self._request_num,
+            "tag_partitioned": False,
             "failed": self.failed is not None,
         }
 

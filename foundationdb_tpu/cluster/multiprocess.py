@@ -43,6 +43,7 @@ from foundationdb_tpu.models.types import (
 from foundationdb_tpu.wire import codec, transport
 
 declare("controller.elastic_recruit")
+declare("controller.elastic_scale_down")
 
 # ---------------------------------------------------------------------------
 # Well-known endpoint tokens (the WellKnownEndpoints.h analog).
@@ -132,14 +133,21 @@ _READERS["kvlist"] = _r_kvlist
 def _message(type_id: int, name: str, fields: list[tuple]):
     # a field is (name, kind) or (name, kind, default); wire layout is
     # the field order either way (defaults are a constructor nicety for
-    # fields appended to an existing message, e.g. TLogPush.epoch)
-    cls = dataclasses.make_dataclass(
-        name,
-        [
-            f[0] if len(f) == 2 else (f[0], "object", f[2])
-            for f in fields
-        ],
-    )
+    # fields appended to an existing message, e.g. TLogPush.epoch).
+    # Sequence defaults are spelled as tuples (dataclasses reject
+    # mutable defaults) but materialize as LISTS so a default-constructed
+    # message compares equal to its decode roundtrip — every list-kind
+    # reader returns a list.
+    def _spec(f):
+        if len(f) == 2:
+            return f[0]
+        default = f[2]
+        if isinstance(default, (tuple, list)):
+            return (f[0], "object",
+                    dataclasses.field(default_factory=lambda d=default: list(d)))
+        return (f[0], "object", default)
+
+    cls = dataclasses.make_dataclass(name, [_spec(f) for f in fields])
     kinds = [(f[0], f[1]) for f in fields]
 
     def enc(out, m, _fields=kinds):
@@ -274,10 +282,27 @@ def _r_optbyteslist(buf, off):
     return vs, off
 
 
+def _w_strlist(out, vs):
+    codec.w_u32(out, len(vs))
+    for v in vs:
+        codec.w_str(out, v)
+
+
+def _r_strlist(buf, off):
+    n, off = codec.r_u32(buf, off)
+    vs = []
+    for _ in range(n):
+        v, off = codec.r_str(buf, off)
+        vs.append(v)
+    return vs, off
+
+
 _WRITERS["byteslist"] = _w_byteslist
 _READERS["byteslist"] = _r_byteslist
 _WRITERS["optbyteslist"] = _w_optbyteslist
 _READERS["optbyteslist"] = _r_optbyteslist
+_WRITERS["strlist"] = _w_strlist
+_READERS["strlist"] = _r_strlist
 
 # Batched storage reads: every read the proxy process coalesces in one
 # event-loop turn rides ONE wire roundtrip (keys[i] is served at
@@ -296,7 +321,15 @@ StorageGetBatchReply = _message(
 # reads don't stall on a one-RPC-per-version apply chain.
 StorageApplyBatch = _message(
     0x0228, "StorageApplyBatch",
-    [("versions", "i64list"), ("groups", "mutgroups")],
+    # prev_versions (optional, same length as versions): the global
+    # version chain under N commit proxies — the apply for versions[i]
+    # waits until the store has applied prev_versions[i], so interleaved
+    # per-proxy appliers reconstruct sequencer grant order server-side.
+    # Empty = legacy single-proxy mode (queue order IS version order).
+    # This frame is wire-only (the storage WAL persists StorageApply
+    # records), so growing it does not touch on-disk compatibility.
+    [("versions", "i64list"), ("groups", "mutgroups"),
+     ("prev_versions", "i64list", ())],
 )
 TOKEN_STORAGE_GET_BATCH = 0x0305
 TOKEN_STORAGE_APPLY_BATCH = 0x0306
@@ -353,7 +386,17 @@ TopologyReply = _message(0x0255, "TopologyReply", [("payload", "str")])
 # controller -> tlog: lock the log at a new epoch (recovery step 1) —
 # returns the durable version the recovery version derives from; all
 # later pushes at an older epoch are fenced
-TLogLock = _message(0x0256, "TLogLock", [("epoch", "i64")])
+# recovery_version (default -1 = phase one): the recovery walk's
+# two-phase lock. Phase one (no recovery_version) bumps the epoch and
+# reports the durable version; phase two re-locks at the same epoch
+# with the computed recovery version, advancing the tlog's version
+# floor past the old generation so parked per-tag chain waiters drain
+# as duplicates instead of wedging. Never persisted — safe to extend.
+TLogLock = _message(
+    0x0256, "TLogLock",
+    [("epoch", "i64"), ("recovery_version", "i64", -1),
+     ("partitioned", "u32", 0)],
+)
 TLogLockReply = _message(
     0x0257, "TLogLockReply",
     [("epoch", "i64"), ("durable_version", "i64")],
@@ -381,8 +424,15 @@ ClientReadReply = _message(
 # generation apply would otherwise jump storage.version past the
 # missing tail forever (found by the first chaos run: 375 committed
 # keys missing post-recovery).
+# tlog_addresses (optional): extra tlogs beyond tlog_address for the
+# tag-partitioned log system — catch-up k-way merges the peek streams
+# by version. recovery_version (default -1): after replay, advance the
+# store's version floor to the new generation's recovery version so the
+# first chained apply (prev = recovery_version) finds its predecessor.
 StorageCatchUp = _message(
-    0x025E, "StorageCatchUp", [("tlog_address", "str")]
+    0x025E, "StorageCatchUp",
+    [("tlog_address", "str"), ("tlog_addresses", "strlist", ()),
+     ("recovery_version", "i64", -1)],
 )
 StorageCatchUpReply = _message(
     0x025F, "StorageCatchUpReply", [("version", "i64")]
@@ -418,6 +468,40 @@ WorkerDeathReply = _message(
 # exact pre-r15 behavior, including the fail-safe decay).
 RateUpdate = _message(0x0264, "RateUpdate", [("payload", "str")])
 RateUpdateReply = _message(0x0265, "RateUpdateReply", [("payload", "str")])
+# proxy -> sequencer (ISSUE 19, the MasterInterface shape): version-
+# batch allotment moves behind an RPC so N commit proxies share one
+# global version chain. Each grant carries (prev_version, version) —
+# the proxy hands prev_version to every resolver, which orders
+# interleaved proxy batches exactly as a single proxy would. `tags`
+# declares which tag-partitioned tlogs this batch will push to;
+# `tag_prevs` returns the per-tag previous version for each declared
+# tag so the per-tlog chains stay gapless even though a tlog only sees
+# the versions that own its tags. Proxies number requests from 1;
+# the sequencer replays cached grants for duplicate request_nums and
+# grants in request_num order per proxy (the reference's
+# GetCommitVersionRequest discipline).
+GetCommitVersionRequest = _message(
+    0x0266, "GetCommitVersionRequest",
+    [("proxy_id", "str"), ("request_num", "u32"),
+     ("most_recent_processed", "u32"), ("epoch", "i64"),
+     ("tags", "i64list", ())],
+)
+GetCommitVersionReply = _message(
+    0x0267, "GetCommitVersionReply",
+    [("version", "i64"), ("prev_version", "i64"), ("request_num", "u32"),
+     ("tag_prevs", "i64list", ())],
+)
+# proxy -> sequencer: report a committed version BEFORE acking the
+# client, so any later GRV (from any proxy) observes it. version=-1 is
+# a pure read — the GRV path fetches the live committed version from
+# the sequencer instead of trusting one proxy's local view.
+ReportRawCommittedVersionRequest = _message(
+    0x0268, "ReportRawCommittedVersionRequest",
+    [("version", "i64"), ("epoch", "i64")],
+)
+ReportRawCommittedVersionReply = _message(
+    0x0269, "ReportRawCommittedVersionReply", [("live_version", "i64")]
+)
 
 TOKEN_TLOG_VERSION = 0x0203
 TOKEN_STORAGE_VERSION = 0x0304
@@ -437,6 +521,10 @@ TOKEN_CLIENT_GRV = 0x0701
 TOKEN_CLIENT_COMMIT = 0x0702
 TOKEN_CLIENT_READ = 0x0703
 TOKEN_STORAGE_CATCHUP = 0x0307
+# sequencer role (version-batch allotment)
+TOKEN_GET_COMMIT_VERSION = 0x0801
+TOKEN_REPORT_COMMITTED = 0x0802
+TOKEN_SEQUENCER_VERSION = 0x0803
 
 
 # ---------------------------------------------------------------------------
@@ -1008,10 +1096,19 @@ class TLogRole:
     """
 
     def __init__(self, data_dir: str | None = None, encryption=None,
-                 epoch: int = 0):
+                 epoch: int = 0, partitioned: bool = False):
         self.entries: list[tuple[int, list]] = []  # (version, mutations)
         self.version = -1
         self._dq = None
+        #: tag-partitioned mode (ISSUE 19): this tlog owns a key-range
+        #: tag and sees only the versions that touch it, pushed by N
+        #: proxies concurrently — a push whose per-tag prev_version is
+        #: ahead of us PARKS on the chain condition until its
+        #: predecessor lands (or recovery advances the floor), instead
+        #: of relying on the single-proxy serialized-push invariant.
+        self.partitioned = partitioned
+        self._chain_cond: asyncio.Condition | None = None
+        self._chain_waiters = 0
         #: generation fencing (the reference's tlog epoch lock): after
         #: lock(E), pushes at an older epoch are rejected retryably —
         #: no old in-flight batch can slip in a commit post-recovery.
@@ -1080,15 +1177,69 @@ class TLogRole:
                 stale_epoch_message(req.epoch, self.epoch)
             )
         self.epoch = req.epoch
-        return TLogLockReply(epoch=self.epoch, durable_version=self.version)
+        if req.partitioned:
+            # scale-out recovery onto a SURVIVING tlog: the lock turns
+            # the per-tag chain wait on (the role instance outlives the
+            # topology change that made pushes arrive out of order)
+            self.partitioned = True
+        durable = self.version
+        if req.recovery_version >= 0:
+            # phase two of the two-phase recovery lock: advance the
+            # version floor past the old generation so the new
+            # generation's first push (prev = a per-tag version the old
+            # generation owned) finds its predecessor, and wake parked
+            # chain waiters — they re-check the epoch and drain as
+            # stale rather than wedging across the generation bump.
+            self.version = max(self.version, req.recovery_version)
+        await self._chain_wake()
+        return TLogLockReply(epoch=self.epoch, durable_version=durable)
+
+    def _chain(self) -> asyncio.Condition:
+        if self._chain_cond is None:
+            self._chain_cond = asyncio.Condition()
+        return self._chain_cond
+
+    async def _chain_wake(self) -> None:
+        if self._chain_cond is not None:
+            async with self._chain_cond:
+                self._chain_cond.notify_all()
 
     async def push(self, req: TLogPush) -> TLogPushReply:
         # generation fence: a locked log rejects the old generation's
         # pushes (and a not-yet-locked log rejects a future
         # generation's — the recovery always locks first)
         _fence_epoch(req, self)
+        if self.partitioned and req.prev_version > self.version:
+            # tag-partitioned chain wait: the predecessor version for
+            # this tlog's tag hasn't landed yet (another proxy owns
+            # it). Park until it does, or until a recovery bumps the
+            # epoch / advances the floor — bounded so a dead
+            # predecessor proxy surfaces as a retryable stall instead
+            # of a silent wedge.
+            cond = self._chain()
+            epoch0 = self.epoch
+            self._chain_waiters += 1
+            try:
+                async with cond:
+                    await asyncio.wait_for(
+                        cond.wait_for(
+                            lambda: self.version >= req.prev_version
+                            or self.epoch != epoch0
+                        ),
+                        timeout=10.0,
+                    )
+            except asyncio.TimeoutError:
+                raise transport.RemoteError(
+                    "tlog chain stall: prev_version "
+                    f"{req.prev_version} never arrived (retryable)"
+                )
+            finally:
+                self._chain_waiters -= 1
+            _fence_epoch(req, self)
         if req.version <= self.version:
-            # duplicate push: idempotent ack (proxy retry after lost reply)
+            # duplicate push: idempotent ack (proxy retry after lost
+            # reply; in partitioned mode also a pre-recovery push
+            # overtaken by the recovery-version floor)
             return TLogPushReply(durable_version=self.version)
         # Forward version skips are legal: the proxy serializes pushes and
         # versions are consumed by failed batches and by recovery (a batch
@@ -1114,6 +1265,8 @@ class TLogRole:
         self._queue_bytes += nb
         self.smoothed_input_bytes.add_delta(nb)
         self.smoothed_queue_bytes.set_total(self._queue_bytes)
+        if self.partitioned:
+            await self._chain_wake()
         return TLogPushReply(durable_version=self.version)
 
     def status(self) -> dict:
@@ -1137,6 +1290,8 @@ class TLogRole:
                 ),
                 "entries": len(self.entries),
                 "stale_epoch_rejects": self.stale_epoch_rejects,
+                "partitioned": self.partitioned,
+                "chain_waiters": self._chain_waiters,
             },
         }
 
@@ -1245,6 +1400,118 @@ class TLogRole:
 
     async def get_version(self, req: RoleVersionReq) -> RoleVersionReply:
         return RoleVersionReply(version=self.version)
+
+
+class SequencerRole:
+    """Wire-served sequencer (the reference's master/MasterInterface):
+    version-batch allotment behind an RPC so N commit proxies share one
+    global version chain. Wraps the sim Sequencer state machine
+    (cluster/sequencer.py — in-order per-proxy grants, duplicate-replay
+    cache, live-committed notification) over a wall-clock scheduler.
+
+    On top of the shared machine it tracks the PER-TAG previous
+    version: each grant declares which tag-partitioned tlogs the batch
+    will push to, and the reply carries that tag's previous granted
+    version so every tlog sees a gapless chain even though it only
+    receives the versions owning its tag."""
+
+    def __init__(self, *, epoch: int = 0, recovery_version: int = 0,
+                 n_tags: int = 1):
+        import time as _time
+
+        from foundationdb_tpu.cluster.sequencer import Sequencer
+        from foundationdb_tpu.utils.metrics import TimerSmoother
+
+        class _WallClock:
+            def now(self):
+                return _time.monotonic()
+
+            async def delay(self, seconds):
+                await asyncio.sleep(seconds)
+
+        self.epoch = epoch
+        self.stale_epoch_rejects = 0
+        self.recovery_version = recovery_version
+        self.n_tags = n_tags
+        self._seq = Sequencer(_WallClock(), recovery_version=recovery_version)
+        #: tag -> last granted version touching it (missing = the
+        #: recovery version: the two-phase lock advanced every tlog's
+        #: floor there, so the first push per tag chains off it)
+        self._tag_prev: dict[int, int] = {}
+        #: version -> tag_prevs granted with it (duplicate grants must
+        #: replay the SAME per-tag prevs); bounded FIFO
+        self._grant_cache: dict[int, list[int]] = {}
+        self.grants = 0
+        self.smoothed_grants = TimerSmoother(1.0)
+
+    async def get_commit_version(
+        self, req: "GetCommitVersionRequest"
+    ) -> "GetCommitVersionReply":
+        _fence_epoch(req, self)
+        rep = await self._seq.get_commit_version(
+            req.proxy_id, req.request_num, req.most_recent_processed
+        )
+        if rep is None:
+            raise transport.RemoteError(
+                "sequencer: request_num below most_recent_processed"
+            )
+        tags = list(req.tags or ())
+        if rep.version in self._grant_cache:
+            tag_prevs = self._grant_cache[rep.version]
+        else:
+            # a fresh grant: snapshot each declared tag's prev and
+            # advance it to this version — synchronously (no await
+            # between the sequencer's grant and this bookkeeping), so
+            # concurrent grants see prevs in grant order
+            tag_prevs = [
+                self._tag_prev.get(t, self.recovery_version) for t in tags
+            ]
+            for t in tags:
+                self._tag_prev[t] = rep.version
+            self._grant_cache[rep.version] = tag_prevs
+            while len(self._grant_cache) > 4096:
+                self._grant_cache.pop(next(iter(self._grant_cache)))
+            self.grants += 1
+            self.smoothed_grants.add_delta(1)
+        return GetCommitVersionReply(
+            version=rep.version,
+            prev_version=rep.prev_version,
+            request_num=rep.request_num,
+            tag_prevs=tag_prevs,
+        )
+
+    async def report_committed(
+        self, req: "ReportRawCommittedVersionRequest"
+    ) -> "ReportRawCommittedVersionReply":
+        _fence_epoch(req, self)
+        if req.version >= 0:
+            self._seq.report_live_committed_version(req.version)
+        return ReportRawCommittedVersionReply(
+            live_version=self._seq.get_live_committed_version()
+        )
+
+    async def get_version(self, req: RoleVersionReq) -> RoleVersionReply:
+        """The allocated head — recovery derives the new generation's
+        recovery version from it so granted-but-never-pushed versions
+        can never be re-granted (the sim recovery does the same)."""
+        return RoleVersionReply(version=self._seq.version)
+
+    def status(self) -> dict:
+        return {
+            "role": "sequencer",
+            "version": self._seq.version,
+            "epoch": self.epoch,
+            "qos": {
+                "grants": self.grants,
+                "grants_per_s": self.smoothed_grants.smooth_rate(),
+                "live_committed_version": (
+                    self._seq.get_live_committed_version()
+                ),
+                "tags": self.n_tags,
+                "proxies_seen": len(self._seq._proxies),
+                "stale_epoch_rejects": self.stale_epoch_rejects,
+            },
+        }
 
 
 class StorageRole:
@@ -1575,12 +1842,24 @@ class StorageRole:
         """Version-ordered group apply (the pipeline applier's drain):
         one sealing pass, ONE write-ahead group fsync (when persistent)
         and one ordered in-memory apply sweep for the whole chunk —
-        the storage-side twin of the tlog's group commit."""
+        the storage-side twin of the tlog's group commit.
+
+        With `prev_versions` (N commit proxies), each contiguous run of
+        the chunk first waits for its predecessor version to land: the
+        global sequencer chain is reconstructed server-side, so
+        interleaved per-proxy appliers can never apply out of order
+        (the WAL stays version-ascending, which replay depends on)."""
+        prevs = list(req.prev_versions or ())
+        if prevs and len(prevs) == len(req.versions):
+            return await self._apply_batch_chained(req, prevs)
         reqs = [
             StorageApply(version=v, mutations=m)
             for v, m in zip(req.versions, req.groups)
             if v > self.version
         ]
+        return await self._apply_run(reqs)
+
+    async def _apply_run(self, reqs: list) -> StorageApplyReply:
         if reqs and self._enc is not None:
             loop = asyncio.get_event_loop()
             reqs = await loop.run_in_executor(
@@ -1591,6 +1870,41 @@ class StorageRole:
         rep = None
         for r in reqs:
             rep = await self._apply_logged(r)
+        return rep if rep is not None else StorageApplyReply(
+            durable_version=self.version,
+            durable=1 if self._dq is not None else 0,
+        )
+
+    async def _apply_batch_chained(self, req, prevs) -> StorageApplyReply:
+        rep = None
+        cond = self._cond_lazy()
+        i, n = 0, len(req.versions)
+        while i < n:
+            # a contiguous run: each item's prev is the previous item
+            j = i
+            while j + 1 < n and prevs[j + 1] == req.versions[j]:
+                j += 1
+            run_prev = prevs[i]
+            try:
+                async with cond:
+                    await asyncio.wait_for(
+                        cond.wait_for(lambda: self.version >= run_prev),
+                        timeout=10.0,
+                    )
+            except asyncio.TimeoutError:
+                # the predecessor's proxy died mid-window: surface a
+                # retryable stall — recovery's catch-up advances the
+                # floor past the gap and re-drives us from the tlogs
+                raise transport.RemoteError(
+                    f"storage chain stall: prev_version {run_prev} "
+                    "never applied (retryable)"
+                )
+            rep = await self._apply_run([
+                StorageApply(version=v, mutations=m)
+                for v, m in zip(req.versions[i:j + 1], req.groups[i:j + 1])
+                if v > self.version
+            ]) or rep
+            i = j + 1
         return rep if rep is not None else StorageApplyReply(
             durable_version=self.version,
             durable=1 if self._dq is not None else 0,
@@ -1671,12 +1985,97 @@ class StorageRole:
 
     async def catch_up(self, req: "StorageCatchUp") -> "StorageCatchUpReply":
         """Recovery catch-up (controller-driven): replay the locked
-        tlog's tail above our durable version NOW, before the new
-        generation's first apply can advance our version past it. The
+        tlogs' tails above our durable version NOW, before the new
+        generation's first apply can advance our version past them. The
         pull is idempotent per version, so a straggler apply from the
-        dying generation racing this is harmless."""
-        await self.catch_up_from_tlog(req.tlog_address)
+        dying generation racing this is harmless (chained applies
+        self-order through the prev wait)."""
+        addrs = [req.tlog_address] + list(req.tlog_addresses or ())
+        if len(addrs) > 1:
+            await self.catch_up_from_tlogs(addrs)
+        else:
+            await self.catch_up_from_tlog(req.tlog_address)
+        if req.recovery_version >= 0:
+            await self.advance_floor(req.recovery_version)
         return StorageCatchUpReply(version=self.version)
+
+    async def advance_floor(self, recovery_version: int) -> None:
+        """Advance the version floor to the new generation's recovery
+        version and wake read/chain waiters: versions between the old
+        generation's tail and the recovery version can never carry data
+        (the sequencer grants above the gap), and the first chained
+        apply of the new generation waits on prev == recovery_version."""
+        cond = self._cond_lazy()
+        async with cond:
+            if recovery_version > self.version:
+                self.version = recovery_version
+                cond.notify_all()
+
+    async def catch_up_from_tlogs(self, addresses: list) -> None:
+        """Tag-partitioned catch-up: each tlog holds only the versions
+        owning its tag, so the union of the tails IS the commit history
+        above our durable version — k-way merge the peek streams by
+        version and apply in merged order (the WAL must stay
+        version-ascending)."""
+        conns = []
+        try:
+            for a in addresses:
+                c = transport.RpcConnection(a, tls=_tls_from_env())
+                await c.connect()
+                conns.append((a, c))
+            n = len(conns)
+            cursors = [self.version] * n
+            buffers: list[list] = [[] for _ in conns]
+            done = [False] * n
+            while True:
+                for i, (a, c) in enumerate(conns):
+                    if done[i] or buffers[i]:
+                        continue
+                    try:
+                        rep = await c.call(
+                            TOKEN_TLOG_PEEK_BATCH,
+                            TLogPeekBatchReq(
+                                after_version=cursors[i], max_entries=256
+                            ),
+                            timeout=30.0,
+                        )
+                    except (transport.TransportError, ConnectionError,
+                            asyncio.TimeoutError) as e:
+                        raise transport.RemoteError(
+                            f"tlog catch-up from {a} failed: {e!r}"
+                        ) from e
+                    if not rep.versions:
+                        done[i] = True
+                        continue
+                    cursors[i] = rep.versions[-1]
+                    buffers[i] = list(zip(rep.versions, rep.groups))
+                if not any(buffers):
+                    break
+                # Merge by version until a stream needs a refill. A
+                # version spanning several tags appears in EVERY owning
+                # tlog (with that tag's mutations) — same-version heads
+                # are combined into one apply, never dropped.
+                chunk = []
+                while len(chunk) < 256:
+                    if any(not done[i] and not buffers[i] for i in range(n)):
+                        break
+                    live = [i for i in range(n) if buffers[i]]
+                    if not live:
+                        break
+                    vmin = min(buffers[i][0][0] for i in live)
+                    muts = []
+                    for i in live:
+                        if buffers[i][0][0] == vmin:
+                            muts.extend(buffers[i].pop(0)[1])
+                    chunk.append((vmin, muts))
+                await self._apply_run([
+                    StorageApply(version=v, mutations=muts)
+                    for v, muts in chunk
+                    if v > self.version
+                ])
+        finally:
+            for _a, c in conns:
+                await c.close()
 
     def status(self) -> dict:
         """StatusRequest payload: apply bandwidth, batch-size
@@ -2182,10 +2581,19 @@ class ProxyRole:
                 c = await connect(a)
                 opened.append(c)
                 resolvers.append(c)
-            tlog = await connect(topo["tlog"])
-            opened.append(tlog)
+            # tag-partitioned log system (ISSUE 19): "tlogs" lists every
+            # tlog address; "tlog" stays as the first for back-compat
+            tlogs = []
+            for a in topo.get("tlogs") or [topo["tlog"]]:
+                c = await connect(a)
+                opened.append(c)
+                tlogs.append(c)
             storage = await connect(topo["storage"])
             opened.append(storage)
+            sequencer = None
+            if topo.get("sequencer"):
+                sequencer = await connect(topo["sequencer"])
+                opened.append(sequencer)
             rk = None
             if topo.get("ratekeeper"):
                 rk = await connect(topo["ratekeeper"])
@@ -2205,9 +2613,13 @@ class ProxyRole:
             bytes.fromhex(h)
             for h in topo.get("resolver_boundaries") or []
         ]
+        tlog_boundaries = [
+            bytes.fromhex(h)
+            for h in topo.get("tlog_boundaries") or []
+        ]
         self.pipeline = ProxyPipeline(
             resolvers,
-            tlog,
+            tlogs[0],
             storage,
             batch_interval=float(self.spec.get("batch_interval", 0.002)),
             max_batch=int(self.spec.get("max_batch", 512)),
@@ -2216,6 +2628,10 @@ class ProxyRole:
             ratekeeper=rk,
             trace=bool(self.spec.get("trace", False)),
             resolver_boundaries=boundaries or None,
+            sequencer=sequencer,
+            proxy_id=str(self.spec.get("proxy_id", "proxy0")),
+            tlogs=tlogs,
+            tlog_boundaries=tlog_boundaries or None,
         )
         self.pipeline.start()
         if self.spec.get("recover", True):
@@ -2302,6 +2718,7 @@ class ProxyRole:
         payload["epoch"] = self.epoch
         payload["recovered"] = self.recovered
         payload["stale_rate_pushes"] = self.stale_rate_pushes
+        payload["proxy_id"] = str(self.spec.get("proxy_id", "proxy0"))
         return payload
 
 
@@ -2453,15 +2870,34 @@ class WorkerRole:
             )
             return role, {}
         if kind == "tlog":
-            role = TLogRole(data_dir=spec.get("data_dir"), epoch=epoch)
+            role = TLogRole(
+                data_dir=spec.get("data_dir"), epoch=epoch,
+                partitioned=bool(spec.get("partitioned", False)),
+            )
             return role, {"durable_version": role.version}
+        if kind == "sequencer":
+            role = SequencerRole(
+                epoch=epoch,
+                recovery_version=int(spec.get("recovery_version", 0)),
+                n_tags=int(spec.get("n_tags", 1)),
+            )
+            return role, {"version": role._seq.version}
         if kind == "storage":
             role = StorageRole(
                 data_dir=spec.get("data_dir"),
                 engine=spec.get("storage_engine", "memory"),
             )
             if spec.get("tlog_address"):
-                await role.catch_up_from_tlog(spec["tlog_address"])
+                addrs = [spec["tlog_address"]] + list(
+                    spec.get("tlog_addresses") or ()
+                )
+                if len(addrs) > 1:
+                    await role.catch_up_from_tlogs(addrs)
+                else:
+                    await role.catch_up_from_tlog(spec["tlog_address"])
+            rv = int(spec.get("recovery_version", -1))
+            if rv >= 0:
+                await role.advance_floor(rv)
             return role, {"durable_version": role.version}
         if kind == "ratekeeper":
             role = RatekeeperRole(
@@ -2537,6 +2973,15 @@ class WorkerRole:
         server.register(TOKEN_CLIENT_COMMIT, route("proxy", "client_commit"))
         server.register(TOKEN_CLIENT_READ, route("proxy", "client_read"))
         server.register(TOKEN_RATE_UPDATE, route("proxy", "rate_update"))
+        server.register(
+            TOKEN_GET_COMMIT_VERSION, route("sequencer", "get_commit_version")
+        )
+        server.register(
+            TOKEN_REPORT_COMMITTED, route("sequencer", "report_committed")
+        )
+        server.register(
+            TOKEN_SEQUENCER_VERSION, route("sequencer", "get_version")
+        )
 
 
 class ClusterControllerRole:
@@ -2602,12 +3047,50 @@ class ClusterControllerRole:
         self.elastic_max_resolvers = int(
             conf.get("elastic_max_resolvers", 2)
         )
+        #: commit-path scale-out (ISSUE 19): the SAME trigger machinery
+        #: drives proxy recruitment off the proxy-queue limiter — the
+        #: _plan + clip machinery generalizes verbatim
+        self.elastic_max_proxies = int(conf.get("elastic_max_proxies", 2))
         self.elastic_streak = int(conf.get("elastic_streak", 4))
         #: limiter names that mean "another resolver would help"
         self.ELASTIC_RESOLVER_REASONS = ("resolver_busy", "resolver_queue")
+        #: limiter names that mean "another commit proxy would help"
+        self.ELASTIC_PROXY_REASONS = ("commit_proxy_queue", "proxy_queue")
         self.elastic_recruits = 0
         self.elastic_last_streak = 0
         self.elastic_last_limiter = None
+        # -- elastic scale-down (ISSUE 19 satellite): when the binding
+        # limiter has been "workload" (= nothing structural binds; the
+        # offered load itself is the ceiling) for elastic_scale_down_
+        # streak consecutive control intervals, ONE above-baseline
+        # elastic role is retired through the same recovery walk. The
+        # baseline is the conf as DECLARED (captured before any
+        # persisted elastic override), so scale-down never cuts below
+        # what the operator asked for.
+        self.elastic_scale_down_streak = int(
+            conf.get("elastic_scale_down_streak",
+                     max(4, 2 * self.elastic_streak))
+        )
+        self._elastic_baseline = {
+            "resolvers": int(conf.get("resolvers", 1)),
+            "proxies": int(conf.get("proxies", 1)),
+        }
+        self.elastic_scale_downs = 0
+        self._workload_streak_observed = 0
+        self._workload_gate = self.elastic_scale_down_streak
+        # -- persisted elastic topology (ISSUE 19 satellite): a
+        # controller kill -9 must not forget fleet size — the planned
+        # counts ride the state file next to the epoch and are re-
+        # applied over the conf here, before the first _plan()
+        for kind_key, count in (self._load_state().get(
+                "topology") or {}).items():
+            if kind_key in ("resolvers", "proxies", "tlogs"):
+                try:
+                    self.conf[kind_key] = max(
+                        int(self.conf.get(kind_key, 1)), int(count)
+                    )
+                except (TypeError, ValueError):
+                    pass
         self._rk_qos: dict = {}
         #: the streak value a trigger must reach. Normally
         #: elastic_streak; after a recruit it is raised to
@@ -2626,16 +3109,23 @@ class ClusterControllerRole:
 
     # -- epoch persistence (the coordinated-state analog) ---------------
 
-    def _load_epoch(self) -> int:
+    def _load_state(self) -> dict:
         import json as _json
 
         if self.state_file and os.path.exists(self.state_file):
             try:
                 with open(self.state_file) as f:
-                    return int(_json.load(f).get("epoch", 0))
+                    doc = _json.load(f)
+                    return doc if isinstance(doc, dict) else {}
             except Exception:
-                return 0
-        return 0
+                return {}
+        return {}
+
+    def _load_epoch(self) -> int:
+        try:
+            return int(self._load_state().get("epoch", 0))
+        except (TypeError, ValueError):
+            return 0
 
     def _persist_epoch(self, epoch: int) -> None:
         import json as _json
@@ -2644,7 +3134,18 @@ class ClusterControllerRole:
             return
         tmp = self.state_file + ".tmp"
         with open(tmp, "w") as f:
-            _json.dump({"epoch": epoch}, f)
+            # the planned elastic topology persists NEXT TO the epoch
+            # (ISSUE 19 satellite): a restarted controller re-applies
+            # these counts over its conf, so a kill -9 between an
+            # elastic recruit and the next one never forgets fleet size
+            _json.dump({
+                "epoch": epoch,
+                "topology": {
+                    "resolvers": int(self.conf.get("resolvers", 1)),
+                    "proxies": int(self.conf.get("proxies", 1)),
+                    "tlogs": int(self.conf.get("tlogs", 1)),
+                },
+            }, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.state_file)
@@ -2687,7 +3188,8 @@ class ClusterControllerRole:
         )
         txn_dead = [
             n for n in dead
-            if self.assignments[n]["kind"] in ("proxy", "resolver", "tlog")
+            if self.assignments[n]["kind"]
+            in ("proxy", "resolver", "tlog", "sequencer")
         ]
         TraceEvent(
             "WorkerDeathPushed", severity=SEV_WARN_ALWAYS
@@ -2751,7 +3253,14 @@ class ClusterControllerRole:
                 "elastic_streak_needed": self.elastic_streak,
                 "elastic_last_streak": self.elastic_last_streak,
                 "elastic_last_limiter": self.elastic_last_limiter,
+                "elastic_scale_downs": self.elastic_scale_downs,
                 "resolvers_planned": int(self.conf.get("resolvers", 1)),
+                "proxies_planned": int(self.conf.get("proxies", 1)),
+                "tlogs_planned": int(self.conf.get("tlogs", 1)),
+                "partitioned": self._partitioned(),
+                # the last recovery's phase-one lock width: a one-of-N
+                # tlog kill shows survivors < total (per-tag quorum)
+                "last_tlog_lock": getattr(self, "last_tlog_lock", None),
                 "workers_registered": len(self.workers),
                 "workers_live": len(self._live_workers()),
                 "roles_recruited": len(self.assignments),
@@ -2769,17 +3278,38 @@ class ClusterControllerRole:
 
     # -- recruitment planning --------------------------------------------
 
+    def _partitioned(self) -> bool:
+        """True when the commit path runs in scale-out mode (ISSUE 19):
+        a sequencer role owns version allotment, pushes carry the
+        chained prev_versions, and the tlogs run their per-tag chain
+        wait. Any of N>1 proxies, N>1 tlogs, or an explicit conf
+        "sequencer": true turns it on; the default single-proxy
+        topology keeps the legacy local-allocation path byte-
+        identical."""
+        return (
+            int(self.conf.get("proxies", 1)) > 1
+            or int(self.conf.get("tlogs", 1)) > 1
+            or bool(self.conf.get("sequencer", False))
+        )
+
     def _role_names(self) -> list[tuple[str, str]]:
         """(role name, kind) pairs of the declarative topology, in
-        recruitment order: durable log first (the recovery version
-        source), then storage, resolvers, ratekeeper, proxy last (its
-        init commits the recovery transaction)."""
-        names = [("tlog0", "tlog"), ("storage0", "storage")]
+        recruitment order: durable logs first (the recovery version
+        source), then storage, the sequencer (scale-out mode), the
+        resolvers, ratekeeper, proxies last (proxy0's init commits the
+        recovery transaction)."""
+        names: list[tuple[str, str]] = []
+        for i in range(int(self.conf.get("tlogs", 1))):
+            names.append((f"tlog{i}", "tlog"))
+        names.append(("storage0", "storage"))
+        if self._partitioned():
+            names.append(("sequencer0", "sequencer"))
         for i in range(int(self.conf.get("resolvers", 1))):
             names.append((f"resolver{i}", "resolver"))
         if self.conf.get("ratekeeper", True):
             names.append(("ratekeeper0", "ratekeeper"))
-        names.append(("proxy0", "proxy"))
+        for i in range(int(self.conf.get("proxies", 1))):
+            names.append((f"proxy{i}", "proxy"))
         return names
 
     def _live_workers(self) -> dict[str, dict]:
@@ -2904,30 +3434,107 @@ class ClusterControllerRole:
         conf = self.conf
         self.gen.transition(gen.LOCKING_OLD_TRANSACTION_SERVERS,
                             Reason=reason)
-        # 1. The durable log: keep it where it lives (or re-host it
-        #    from its data dir), then LOCK it at the new epoch — old-
-        #    generation pushes are fenced from here on, and the lock
-        #    reply carries the durable version recovery derives from.
-        tlog = plan["tlog0"]
-        if self._worker_hosts(tlog["worker_id"], "tlog"):
-            # survivor (current assignment OR a restarted controller's
-            # beacon re-adoption): keep the epoch it was INITIALIZED at
-            # — the worker's role_epochs is what heartbeats compare,
-            # and the fencing epoch advances via the lock below (a
-            # re-stamped assignment here made every later heartbeat a
-            # mismatch and cascaded spurious recoveries)
-            tlog["epoch"] = self._hosted_epoch(tlog["worker_id"], "tlog")
-        else:
-            await self._init_role(tlog, {
-                "data_dir": conf.get("tlog_data_dir"),
+        # 1. The durable logs: keep each where it lives (or re-host it
+        #    from its per-index data dir), then LOCK at the new epoch —
+        #    old-generation pushes are fenced from here on, and the
+        #    lock replies carry the durable versions recovery derives
+        #    from. Scale-out mode (ISSUE 19) runs the TWO-PHASE per-tag
+        #    quorum walk: phase one locks the LIVE tlogs immediately
+        #    (killing one of N stalls only its tags for the re-host
+        #    window — the survivors' lock is the quorum), phase two
+        #    re-locks everything with the computed recovery version so
+        #    every per-tag version floor advances past the old
+        #    generation as a unit.
+        n_tlogs = int(conf.get("tlogs", 1))
+        partitioned = self._partitioned()
+        tlog_places = [plan[f"tlog{i}"] for i in range(n_tlogs)]
+        base_tlog_dir = conf.get("tlog_data_dir")
+
+        def _tlog_dir(i: int):
+            if not base_tlog_dir:
+                return None
+            return base_tlog_dir if i == 0 else f"{base_tlog_dir}-{i}"
+
+        part_flag = 1 if partitioned else 0
+        survivor_idx: set[int] = set()
+        for i, place in enumerate(tlog_places):
+            if self._worker_hosts(place["worker_id"], "tlog"):
+                # survivor (current assignment OR a restarted
+                # controller's beacon re-adoption): keep the epoch it
+                # was INITIALIZED at — the worker's role_epochs is what
+                # heartbeats compare, and the fencing epoch advances
+                # via the lock below (a re-stamped assignment here made
+                # every later heartbeat a mismatch and cascaded
+                # spurious recoveries)
+                place["epoch"] = self._hosted_epoch(
+                    place["worker_id"], "tlog"
+                )
+                survivor_idx.add(i)
+        # phase one: fence the survivors NOW (concurrently)
+        locks = await asyncio.gather(*(
+            self._worker_call(
+                tlog_places[i]["address"], TOKEN_TLOG_LOCK,
+                TLogLock(epoch=epoch, partitioned=part_flag),
+            )
+            for i in sorted(survivor_idx)
+        ))
+        durables = [lk.durable_version for lk in locks]
+        # the quorum surface (chaos drill pin): how many tlogs the
+        # phase-one lock needed vs the topology width — a one-of-N
+        # kill must show survivors < total with recovery proceeding
+        self.last_tlog_lock = {
+            "survivors": len(survivor_idx), "total": n_tlogs,
+        }
+        if partitioned:
+            # the OLD sequencer's head (best effort): versions it
+            # GRANTED but no tlog ever saw must stay below the new
+            # floor, or the fresh sequencer could re-issue them
+            old_seq = self.assignments.get("sequencer0")
+            if old_seq is not None and self._worker_hosts(
+                    old_seq["worker_id"], "sequencer"):
+                try:
+                    r = await self._worker_call(
+                        old_seq["address"], TOKEN_SEQUENCER_VERSION,
+                        RoleVersionReq(pad=0), timeout=2.0,
+                    )
+                    durables.append(r.version)
+                except Exception:
+                    pass
+        # re-host dead tlogs from their data dirs (the WAL replay
+        # restores each tag's durable state) and lock them on arrival
+        for i, place in enumerate(tlog_places):
+            if i in survivor_idx:
+                continue
+            await self._init_role(place, {
+                "data_dir": _tlog_dir(i),
+                "partitioned": partitioned,
             })
-        lock = await self._worker_call(
-            tlog["address"], TOKEN_TLOG_LOCK, TLogLock(epoch=epoch)
-        )
-        recovery_version = gen.recovery_version_for(lock.durable_version)
+            lk = await self._worker_call(
+                place["address"], TOKEN_TLOG_LOCK,
+                TLogLock(epoch=epoch, partitioned=part_flag),
+            )
+            durables.append(lk.durable_version)
+        recovery_version = gen.recovery_version_for(*durables)
         self.gen.recovery_version = recovery_version
         self.gen.transition(gen.RECRUITING_TRANSACTION_SERVERS,
                             RecoveryVersion=recovery_version)
+        if partitioned:
+            # phase two: advance every per-tag version floor to the
+            # recovery version — the new generation's first push per
+            # tag (prev = recovery version) finds its predecessor, and
+            # parked chain waiters drain as stale instead of wedging
+            # across the generation bump
+            await asyncio.gather(*(
+                self._worker_call(
+                    p["address"], TOKEN_TLOG_LOCK,
+                    TLogLock(epoch=epoch,
+                             recovery_version=recovery_version,
+                             partitioned=part_flag),
+                )
+                for p in tlog_places
+            ))
+        tlog = tlog_places[0]
+        tlog_addresses = [p["address"] for p in tlog_places]
         # 2. Storage's durable state survives recovery, but its APPLY
         #    FEED died with the old proxy: it must replay the locked
         #    tlog's tail BEFORE the new generation's first apply can
@@ -2935,19 +3542,30 @@ class ClusterControllerRole:
         #    re-hosted from its durable dir (the init catch-up does the
         #    same replay).
         storage = plan["storage0"]
+        # scale-out mode also hands storage the recovery version: its
+        # apply chain's floor must advance past the old generation so
+        # the first new-generation chained apply (prev = a version the
+        # old generation owned) finds its predecessor
+        storage_rv = recovery_version if partitioned else -1
         if self._worker_hosts(storage["worker_id"], "storage"):
             storage["epoch"] = self._hosted_epoch(
                 storage["worker_id"], "storage"
             )
             await self._worker_call(
                 storage["address"], TOKEN_STORAGE_CATCHUP,
-                StorageCatchUp(tlog_address=tlog["address"]),
+                StorageCatchUp(
+                    tlog_address=tlog_addresses[0],
+                    tlog_addresses=tlog_addresses[1:],
+                    recovery_version=storage_rv,
+                ),
             )
         else:
             await self._init_role(storage, {
                 "data_dir": conf.get("storage_data_dir"),
                 "storage_engine": conf.get("storage_engine", "memory"),
-                "tlog_address": tlog["address"],
+                "tlog_address": tlog_addresses[0],
+                "tlog_addresses": tlog_addresses[1:],
+                "recovery_version": storage_rv,
             })
         # 3. NEW resolvers, EMPTY conflict state — always rebuilt, even
         #    on surviving workers (resolvers are stateless across
@@ -2980,6 +3598,18 @@ class ClusterControllerRole:
         #    key-sample feed is the remaining headroom), and the new
         #    proxy clips every batch to them — so a recruit genuinely
         #    divides conflict work instead of broadcasting it N times.
+        # 3b. The sequencer (scale-out mode): ALWAYS rebuilt fresh at
+        #     the recovery version — a surviving old instance carries
+        #     the fenced generation's grant state, and the per-tag
+        #     chains must restart at the new floor. n_tags = the tlog
+        #     count (the tag partition IS the tlog partition).
+        seq_place = None
+        if partitioned:
+            seq_place = plan["sequencer0"]
+            await self._init_role(seq_place, {
+                "recovery_version": recovery_version,
+                "n_tags": n_tlogs,
+            })
         topo_addrs = {
             "resolvers": [p["address"] for p in resolver_places],
             "resolver_boundaries": [
@@ -2989,6 +3619,12 @@ class ClusterControllerRole:
             "tlog": tlog["address"],
             "storage": storage["address"],
         }
+        if partitioned:
+            topo_addrs["tlogs"] = tlog_addresses
+            topo_addrs["tlog_boundaries"] = [
+                b.hex() for b in default_resolver_boundaries(n_tlogs)
+            ]
+            topo_addrs["sequencer"] = seq_place["address"]
         if "ratekeeper0" in plan:
             rk = plan["ratekeeper0"]
             if self._worker_hosts(rk["worker_id"], "ratekeeper"):
@@ -2998,24 +3634,41 @@ class ClusterControllerRole:
                 )
             else:
                 await self._init_role(rk, {
-                    "peers": [tlog["address"], storage["address"],
+                    "peers": [*tlog_addresses, storage["address"],
                               *topo_addrs["resolvers"]],
                 })
             topo_addrs["ratekeeper"] = rk["address"]
-        # 5. The new proxy generation: its start() commits the
-        #    conservative recovery transaction as the FIRST batch.
+        # 5. The new proxy generation: proxy0's start() commits the
+        #    conservative recovery transaction as the FIRST batch (the
+        #    sequencer grants it the first version of the generation),
+        #    then the remaining proxies join the shared version chain
+        #    concurrently — they never recover, only commit.
         self.gen.transition(gen.RECOVERY_TRANSACTION)
-        proxy = plan["proxy0"]
-        info = await self._init_role(proxy, {
-            "topology": topo_addrs,
-            "start_version": recovery_version,
-            "recover": True,
-            "batch_interval": conf.get("batch_interval", 0.002),
-            "max_batch": conf.get("max_batch", 512),
-            "trace": bool(conf.get("trace", False)),
-        })
+        proxy_places = [
+            (n, p) for n, p in sorted(plan.items())
+            if p["kind"] == "proxy"
+        ]
+
+        def _proxy_spec(name: str, recover: bool) -> dict:
+            return {
+                "topology": topo_addrs,
+                "start_version": recovery_version,
+                "recover": recover,
+                "proxy_id": name,
+                "batch_interval": conf.get("batch_interval", 0.002),
+                "max_batch": conf.get("max_batch", 512),
+                "trace": bool(conf.get("trace", False)),
+            }
+
+        name0, proxy = proxy_places[0]
+        info = await self._init_role(proxy, _proxy_spec(name0, True))
         if not info.get("recovered"):
             raise RuntimeError(f"proxy recruitment did not recover: {info}")
+        if len(proxy_places) > 1:
+            await asyncio.gather(*(
+                self._init_role(p, _proxy_spec(n, False))
+                for n, p in proxy_places[1:]
+            ))
         self.gen.transition(gen.ACCEPTING_COMMITS)
         self.assignments = plan
         self._miss_counts.clear()
@@ -3094,7 +3747,7 @@ class ClusterControllerRole:
                     txn_dead = [
                         n for n in dead
                         if self.assignments[n]["kind"]
-                        in ("proxy", "resolver", "tlog")
+                        in ("proxy", "resolver", "tlog", "sequencer")
                     ]
                     for name in dead:
                         TraceEvent(
@@ -3161,8 +3814,33 @@ class ClusterControllerRole:
         streak = qos.get("binding_streak") or {}
         limiter = streak.get("name")
         self.elastic_last_limiter = limiter
-        if qos.get("budget_stale") or limiter not in \
-                self.ELASTIC_RESOLVER_REASONS:
+        # the limiter name routes the SAME trigger machinery to the
+        # role kind that would relieve it (ISSUE 19: the proxy-queue
+        # limiter recruits commit proxies exactly like resolvers)
+        if limiter in self.ELASTIC_RESOLVER_REASONS:
+            kind, conf_key, cap = (
+                "resolver", "resolvers", self.elastic_max_resolvers
+            )
+        elif limiter in self.ELASTIC_PROXY_REASONS:
+            kind, conf_key, cap = (
+                "proxy", "proxies", self.elastic_max_proxies
+            )
+        else:
+            if limiter == "workload" and not qos.get("budget_stale"):
+                # nothing structural binds: the workload itself is the
+                # ceiling — feed the scale-down streak (ISSUE 19
+                # satellite) while the recruit gate resets below
+                self._scale_down_check(streak)
+            else:
+                self._workload_streak_observed = 0
+                self._workload_gate = self.elastic_scale_down_streak
+            self.elastic_last_streak = 0
+            self._elastic_last_observed = 0
+            self._elastic_gate = self.elastic_streak
+            return
+        self._workload_streak_observed = 0
+        self._workload_gate = self.elastic_scale_down_streak
+        if qos.get("budget_stale"):
             self.elastic_last_streak = 0
             self._elastic_last_observed = 0
             self._elastic_gate = self.elastic_streak
@@ -3176,12 +3854,12 @@ class ClusterControllerRole:
         self._elastic_last_observed = self.elastic_last_streak
         if self.elastic_last_streak < self._elastic_gate:
             return
-        current = int(self.conf.get("resolvers", 1))
-        if current >= self.elastic_max_resolvers:
+        current = int(self.conf.get(conf_key, 1))
+        if current >= cap:
             return
         from foundationdb_tpu.utils.trace import SEV_WARN_ALWAYS, TraceEvent
 
-        self.conf["resolvers"] = current + 1
+        self.conf[conf_key] = current + 1
         self.elastic_recruits += 1
         # the snapshot that fired this trigger must not fire the next
         # one: drop it, AND raise the gate past the law's surviving
@@ -3191,7 +3869,7 @@ class ClusterControllerRole:
         self._rk_qos = {}
         self._elastic_gate = self.elastic_last_streak + self.elastic_streak
         self._needs_recovery = True
-        self._recovery_reason = elastic_reason("resolver", current + 1)
+        self._recovery_reason = elastic_reason(kind, current + 1)
         # cut the supervision sleep short, like a pushed worker death:
         # the recovery walk (loop top) starts next iteration, not up
         # to check_interval later
@@ -3199,13 +3877,60 @@ class ClusterControllerRole:
         code_probe(True, "controller.elastic_recruit")
         TraceEvent(
             "ElasticRecruitPlanned", severity=SEV_WARN_ALWAYS
-        ).detail("Kind", "resolver").detail(
+        ).detail("Kind", kind).detail(
             "From", current
         ).detail("To", current + 1).detail(
             "Limiter", limiter
         ).detail("StreakIntervals", self.elastic_last_streak).detail(
             "Epoch", self.gen.epoch
         ).log()
+
+    def _scale_down_check(self, streak: dict) -> None:
+        """The OFF direction of elasticity (ISSUE 19 satellite): when
+        the admission law reports "workload" as the binding limiter —
+        the offered load is the ceiling, nothing structural binds —
+        for elastic_scale_down_streak consecutive control intervals,
+        retire ONE above-baseline elastic role through the same
+        generation-bumped recovery walk the recruit took. The baseline
+        is the conf as declared by the operator (captured before the
+        persisted elastic override), so scale-down never cuts below
+        the configured topology; a gate mirrors the recruit gate so a
+        ratekeeper streak surviving the walk cannot chain-retire the
+        whole fleet in consecutive passes."""
+        from foundationdb_tpu.cluster.generation import elastic_reason
+        from foundationdb_tpu.utils.trace import SEV_WARN_ALWAYS, TraceEvent
+
+        intervals = int(streak.get("intervals", 0))
+        if intervals < self._workload_streak_observed:
+            # the cold streak restarted: fresh signal, normal gate
+            self._workload_gate = self.elastic_scale_down_streak
+        self._workload_streak_observed = intervals
+        if intervals < self._workload_gate:
+            return
+        for kind, conf_key in (
+            ("proxy", "proxies"), ("resolver", "resolvers")
+        ):
+            current = int(self.conf.get(conf_key, 1))
+            if current <= self._elastic_baseline[conf_key]:
+                continue
+            self.conf[conf_key] = current - 1
+            self.elastic_scale_downs += 1
+            self._rk_qos = {}
+            self._workload_gate = (
+                intervals + self.elastic_scale_down_streak
+            )
+            self._needs_recovery = True
+            self._recovery_reason = elastic_reason(kind, current - 1)
+            self._wake.set()
+            code_probe(True, "controller.elastic_scale_down")
+            TraceEvent(
+                "ElasticScaleDownPlanned", severity=SEV_WARN_ALWAYS
+            ).detail("Kind", kind).detail(
+                "From", current
+            ).detail("To", current - 1).detail(
+                "StreakIntervals", intervals
+            ).detail("Epoch", self.gen.epoch).log()
+            return
 
     async def _rerecruit_singleton(self, name: str) -> None:
         """Non-transaction-path roles (storage, ratekeeper) re-recruit
@@ -3276,10 +4001,17 @@ class ClusterClient:
     CommitUnknownError (the reference's commit_unknown_result) because
     the batch may have logged before the crash."""
 
+    #: process-wide client counter: successive clients start their
+    #: front-door rotation at successive proxies, so a fleet of
+    #: clients spreads across an N-proxy generation (ISSUE 19)
+    _rr_seq = 0
+
     def __init__(self, controller_address: str, *,
                  recovery_timeout: float = 60.0):
         self.controller_address = controller_address
         self.recovery_timeout = recovery_timeout
+        self._rr = ClusterClient._rr_seq
+        ClusterClient._rr_seq += 1
         self._ctrl_conns: dict = {}  # _cached_call cache (controller)
         self._proxy: transport.RpcConnection | None = None
         #: strong refs to detached close() tasks (the loop only keeps
@@ -3360,10 +4092,15 @@ class ClusterClient:
                 except Exception:
                     pass
                 if topo and topo.get("state") == gen.FULLY_RECOVERED:
-                    proxy = next(
-                        (e for e in topo.get("roles", {}).values()
-                         if e["kind"] == "proxy"),
-                        None,
+                    proxies = [
+                        e for _n, e in sorted(
+                            (topo.get("roles") or {}).items()
+                        )
+                        if e["kind"] == "proxy"
+                    ]
+                    proxy = (
+                        proxies[self._rr % len(proxies)]
+                        if proxies else None
                     )
                     if proxy is not None:
                         conn = None
@@ -3391,6 +4128,9 @@ class ClusterClient:
                             self.epoch = int(topo["epoch"])
                             self.refreshes += 1
                             return topo
+                        # rotate: the next attempt probes a different
+                        # proxy of the generation, not the same corpse
+                        self._rr += 1
                         if conn is not None:
                             try:
                                 await conn.close()
@@ -3578,6 +4318,11 @@ async def _serve_role(
         server.register(TOKEN_STORAGE_SNAPSHOT, role.snapshot)
         server.register(TOKEN_STORAGE_VERSION, role.get_version)
         server.register(TOKEN_STORAGE_CATCHUP, role.catch_up)
+    elif role_name == "sequencer":
+        role = SequencerRole()
+        server.register(TOKEN_GET_COMMIT_VERSION, role.get_commit_version)
+        server.register(TOKEN_REPORT_COMMITTED, role.report_committed)
+        server.register(TOKEN_SEQUENCER_VERSION, role.get_version)
     elif role_name == "ratekeeper":
         role = RatekeeperRole(peers or [], controller=controller)
         server.register(TOKEN_GET_RATE_INFO, role.get_rate_info)
@@ -3838,12 +4583,47 @@ class ProxyPipeline:
         resolve_columnar: bool = None,
         epoch: int = 0,
         resolver_boundaries: list = None,
+        sequencer: transport.RpcConnection = None,
+        proxy_id: str = "proxy0",
+        tlogs: list = None,
+        tlog_boundaries: list = None,
     ):
         from foundationdb_tpu.cluster.batching import AdaptiveBatchSizer
         from foundationdb_tpu.utils.knobs import SERVER_KNOBS as _K
 
         self.resolvers = resolvers
-        self.tlog = tlog
+        # -- commit-path scale-out (ISSUE 19): with a sequencer
+        # connection, version allotment moves behind GetCommitVersion —
+        # N proxy processes share the global chain, each handing the
+        # grant's (prev_version, version) to the resolvers. With
+        # `tlogs` + boundaries, pushes are TAG-PARTITIONED: each batch
+        # pushes only to the tlogs owning its mutations' key ranges,
+        # chained per tag by the grant's tag_prevs. Without a
+        # sequencer, the legacy single-proxy local allocation runs
+        # byte-identically.
+        self.sequencer = sequencer
+        self.proxy_id = proxy_id
+        self._tlogs = list(tlogs) if tlogs else [tlog]
+        self.tlog = self._tlogs[0]
+        if tlog_boundaries and len(self._tlogs) > 1:
+            if len(tlog_boundaries) != len(self._tlogs) - 1:
+                raise ValueError(
+                    f"{len(self._tlogs)} tlog(s) need "
+                    f"{len(self._tlogs) - 1} boundary key(s), got "
+                    f"{len(tlog_boundaries)}"
+                )
+            self._tlog_ranges = resolver_key_ranges(list(tlog_boundaries))
+        else:
+            self._tlog_ranges = None
+        self._seq_request_num = 0
+        self._seq_processed = 0
+        self.version_grants = 0
+        # GRV live-committed coalescer (sequencer mode): waiters that
+        # arrive while a fetch is in flight ride the NEXT round, so a
+        # GRV issued after a commit ack can never observe an older
+        # snapshot of the sequencer's live committed version
+        self._grv_waiters: list = []
+        self._grv_fetching = False
         self.storage = storage
         # -- multi-resolver keyspace split (ISSUE 15): with N > 1
         # resolvers and boundaries (N-1 interior split keys, re-derived
@@ -3962,12 +4742,12 @@ class ProxyPipeline:
         )
         self.failed: Optional[BaseException] = None
         self._loop: asyncio.AbstractEventLoop | None = None
-        # ordered apply queue: (version, mutations) appended in commit
-        # order at reply time, drained by ONE applier task in batched
-        # StorageApplyBatch RPCs — replies never wait on storage, and
+        # ordered apply queue: (version, mutations, prev_version)
+        # appended in commit order at reply time, drained by ONE
+        # applier task in batched StorageApplyBatch RPCs — replies never wait on storage, and
         # the storage version trails the committed version by at most
         # one drain roundtrip (the reference's bounded storage lag)
-        self._apply_queue: list[tuple[int, list]] = []
+        self._apply_queue: list[tuple[int, list, int]] = []
         self._apply_event: asyncio.Event | None = None
         self._applier_task: asyncio.Task | None = None
         self.applied_version = start_version
@@ -4132,7 +4912,62 @@ class ProxyPipeline:
             await self._grv_admit()
         self.grvs_served += 1
         self.smoothed_grv_rate.add_delta(1.0)
+        if self.sequencer is not None:
+            # N proxies: this proxy's local committed head misses the
+            # other proxies' commits — serve the sequencer's live
+            # committed version (coalesced: one in-flight fetch serves
+            # every waiter of its round)
+            return max(
+                await self._live_committed(), self.committed_version
+            )
         return self.committed_version
+
+    async def _live_committed(self) -> int:
+        loop = self._loop or asyncio.get_event_loop()
+        fut = loop.create_future()
+        self._grv_waiters.append(fut)
+        if not self._grv_fetching:
+            self._grv_fetching = True
+            t = asyncio.ensure_future(self._live_committed_rounds())
+            self._inflight.add(t)
+            t.add_done_callback(self._inflight.discard)
+        return await fut
+
+    async def _live_committed_rounds(self) -> None:
+        """Serve queued GRV waiters in rounds: a waiter only rides a
+        fetch that STARTS after it queued, so commit-then-GRV ordering
+        holds across proxies (the commit was reported to the sequencer
+        before its client ack)."""
+        try:
+            while self._grv_waiters:
+                waiters, self._grv_waiters = self._grv_waiters, []
+                try:
+                    rep = await self.sequencer.call(
+                        TOKEN_REPORT_COMMITTED,
+                        ReportRawCommittedVersionRequest(
+                            version=-1, epoch=self.epoch
+                        ),
+                        timeout=5.0,
+                    )
+                    for f in waiters:
+                        if not f.done():
+                            f.set_result(rep.live_version)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    for f in waiters:
+                        if not f.done():
+                            f.set_exception(transport.RemoteError(
+                                f"grv live-committed fetch: {e!r}"
+                            ))
+        finally:
+            self._grv_fetching = False
+            for f in self._grv_waiters:
+                if not f.done():
+                    f.set_exception(transport.RemoteError(
+                        "grv live-committed fetch cancelled"
+                    ))
+            self._grv_waiters = []
 
     # -- saturation sensors ------------------------------------------------
 
@@ -4156,6 +4991,8 @@ class ProxyPipeline:
             "read_backlog_keys": len(self._read_pending),
             "batch_sizer": self.batch_sizer.as_dict(),
             "failed": self.failed is not None,
+            "version_grants": self.version_grants,
+            "tag_partitioned": self._tlog_ranges is not None,
         }
 
     def grv_saturation(self) -> dict:
@@ -4253,8 +5090,17 @@ class ProxyPipeline:
                     apply_rep = await self.storage.call(
                         TOKEN_STORAGE_APPLY_BATCH,
                         StorageApplyBatch(
-                            versions=[v for v, _m in q],
-                            groups=[m for _v, m in q],
+                            versions=[v for v, _m, _p in q],
+                            groups=[m for _v, m, _p in q],
+                            # sequencer mode: ship the global grant
+                            # chain so storage orders interleaved
+                            # per-proxy appliers; legacy mode sends no
+                            # prevs (queue order IS version order and
+                            # failed batches legally hole the chain)
+                            prev_versions=(
+                                [p for _v, _m, p in q]
+                                if self.sequencer is not None else ()
+                            ),
                         ),
                         timeout=30.0,
                     )
@@ -4267,7 +5113,7 @@ class ProxyPipeline:
                     from foundationdb_tpu.utils import commit_debug as _cdbg
                     from foundationdb_tpu.utils import trace as _tr
 
-                    for v, m in q:
+                    for v, m, _p in q:
                         if m:
                             _tr.g_trace_batch.add_event(
                                 "CommitDebug", _cdbg.version_id(v),
@@ -4285,19 +5131,20 @@ class ProxyPipeline:
                 # batch's trace events above.
                 if not getattr(apply_rep, "durable", 0):
                     continue
-                try:
-                    await self.tlog.call(
-                        TOKEN_TLOG_POP,
-                        TLogPop(
-                            version=self.applied_version,
-                            epoch=self.epoch,
-                        ),
-                        timeout=5.0,
-                    )
-                except asyncio.CancelledError:
-                    raise
-                except Exception:
-                    pass
+                for tl in self._tlogs:
+                    try:
+                        await tl.call(
+                            TOKEN_TLOG_POP,
+                            TLogPop(
+                                version=self.applied_version,
+                                epoch=self.epoch,
+                            ),
+                            timeout=5.0,
+                        )
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        pass
 
     async def _batcher(self) -> None:
         from foundationdb_tpu.cluster.batching import commit_txn_bytes
@@ -4326,19 +5173,46 @@ class ProxyPipeline:
             await self._depth.acquire()
             self._batch_seq += 1
             num = self._batch_seq
-            # phase 1, synchronous at spawn: version allocation
-            # (monotonic across failed attempts — a dead batch consumed
-            # its version; the reference master never re-hands one) and
-            # the prev_version chain hand-off, in batch order.
-            version = (
-                max(self.committed_version, self._last_allocated)
-                + self.version_step
-            )
-            self._last_allocated = version
-            prev_version, self._chain_prev = self._chain_prev, version
+            # phase 1, at spawn: version allocation. Sequencer mode
+            # awaits a GetCommitVersion grant — the batcher is the sole
+            # caller, so request_nums are issued in order and the
+            # resolve/push stages of successive batches still overlap
+            # (only the allotment RPC is serial, as in the reference).
+            # Legacy mode allocates locally, synchronously (monotonic
+            # across failed attempts — a dead batch consumed its
+            # version; the reference master never re-hands one).
+            tag_info = None
+            if self.sequencer is not None:
+                tags = self._batch_tags([t for t, _f in batch])
+                try:
+                    grant = await self._get_commit_version(tags)
+                except Exception as e:
+                    # an unreachable sequencer breaks the chain for
+                    # this proxy generation: fail fast and retryably
+                    if self.failed is None:
+                        self.failed = e
+                    for _txn, fut in batch:
+                        if not fut.done():
+                            fut.set_exception(transport.RemoteError(
+                                f"commit pipeline: {e!r}"
+                            ))
+                    self._depth.release()
+                    self._batch_seq -= 1
+                    return
+                version, prev_version = grant.version, grant.prev_version
+                self._last_allocated = version
+                self._chain_prev = version
+                tag_info = (tags, dict(zip(tags, grant.tag_prevs)))
+            else:
+                version = (
+                    max(self.committed_version, self._last_allocated)
+                    + self.version_step
+                )
+                self._last_allocated = version
+                prev_version, self._chain_prev = self._chain_prev, version
             t = asyncio.ensure_future(
                 self._commit_batch(batch, num, prev_version, version,
-                                   was_full)
+                                   was_full, tag_info)
             )
             self._inflight.add(t)
             self._batches_inflight += 1
@@ -4352,11 +5226,11 @@ class ProxyPipeline:
             t.add_done_callback(_done)
 
     async def _commit_batch(
-        self, batch, num, prev_version, version, was_full
+        self, batch, num, prev_version, version, was_full, tag_info=None
     ) -> None:
         try:
             await self._commit_batch_traced(
-                batch, num, prev_version, version, was_full
+                batch, num, prev_version, version, was_full, tag_info
             )
         except Exception as e:
             # A hole in the version chain breaks this proxy generation:
@@ -4374,11 +5248,12 @@ class ProxyPipeline:
                 self._latest_batch_logging.set(num)
 
     async def _commit_batch_traced(
-        self, batch, num, prev_version, version, was_full
+        self, batch, num, prev_version, version, was_full, tag_info=None
     ) -> None:
         if not self.trace:
             await self._commit_batch_impl(
-                batch, num, prev_version, version, was_full, None, None
+                batch, num, prev_version, version, was_full, None, None,
+                tag_info,
             )
             return
         from foundationdb_tpu.utils import commit_debug as _cdbg
@@ -4395,11 +5270,102 @@ class ProxyPipeline:
         with Span("ProxyPipeline.commitBatch") as span:
             span.attribute("Txns", len(batch))
             await self._commit_batch_impl(
-                batch, num, prev_version, version, was_full, dbg, span
+                batch, num, prev_version, version, was_full, dbg, span,
+                tag_info,
             )
 
+    # -- tag partitioning (ISSUE 19) -----------------------------------
+
+    def _tag_of_key(self, key: bytes) -> int:
+        """The tlog index owning `key` — the same even byte-prefix
+        partition formula as the resolver split (the ranges come from
+        default_resolver_boundaries over the tlog count)."""
+        for i, (lo, hi) in enumerate(self._tlog_ranges):
+            if key >= lo and (hi is None or key < hi):
+                return i
+        return len(self._tlog_ranges) - 1
+
+    def _mutation_tags(self, m) -> list:
+        """Owning tlog indices for one mutation: a SET has one owner; a
+        CLEAR_RANGE touches every partition it intersects."""
+        if m.op == StorageRole.MUT_CLEAR_RANGE:
+            out = []
+            for i, (lo, hi) in enumerate(self._tlog_ranges):
+                if m.param1 < (hi if hi is not None else m.param1 + b"\x00") \
+                        and (m.param2 > lo):
+                    out.append(i)
+            return out
+        return [self._tag_of_key(m.param1)]
+
+    def _batch_tags(self, txns) -> list:
+        """Declared tags for a batch = owners of every txn's mutations,
+        computed BEFORE resolution (an aborted txn's declared tag still
+        gets its empty push — the per-tag chain must stay gapless
+        whether or not the data survives the conflict check)."""
+        if self._tlog_ranges is None:
+            return [0] if len(self._tlogs) == 1 else list(
+                range(len(self._tlogs))
+            )
+        tags = set()
+        for t in txns:
+            for m in t.mutations:
+                tags.update(self._mutation_tags(m))
+        if not tags:
+            tags.add(0)  # empty batches keep tag 0's chain warm
+        return sorted(tags)
+
+    def _split_mutations(self, mutations, tags) -> dict:
+        """Partition a batch's committed mutations by owning tlog.
+        CLEAR_RANGEs are CLIPPED to each owner's range so recovery's
+        multi-tlog merge concatenates disjoint pieces."""
+        groups = {t: [] for t in tags}
+        if self._tlog_ranges is None:
+            for t in tags:
+                groups[t] = list(mutations)
+            return groups
+        for m in mutations:
+            if m.op == StorageRole.MUT_CLEAR_RANGE:
+                for i in self._mutation_tags(m):
+                    if i not in groups:
+                        continue
+                    lo, hi = self._tlog_ranges[i]
+                    cb = m.param1 if m.param1 > lo else lo
+                    ce = (
+                        m.param2 if hi is None or m.param2 < hi else hi
+                    )
+                    if cb < ce:
+                        groups[i].append(
+                            codec.Mutation(m.op, cb, ce)
+                        )
+            else:
+                i = self._tag_of_key(m.param1)
+                if i in groups:
+                    groups[i].append(m)
+        return groups
+
+    async def _get_commit_version(self, tags):
+        self._seq_request_num += 1
+        rn = self._seq_request_num
+        # classification boundary is the batcher's grant try/except:
+        # a failed grant fails the batch's clients retryably
+        rep = await self.sequencer.call(  # flowcheck: ignore[wire.unclassified-error]
+            TOKEN_GET_COMMIT_VERSION,
+            GetCommitVersionRequest(
+                proxy_id=self.proxy_id,
+                request_num=rn,
+                most_recent_processed=self._seq_processed,
+                epoch=self.epoch,
+                tags=tags,
+            ),
+            timeout=30.0,
+        )
+        self._seq_processed = rn
+        self.version_grants += 1
+        return rep
+
     async def _commit_batch_impl(
-        self, batch, num, prev_version, version, was_full, dbg, span
+        self, batch, num, prev_version, version, was_full, dbg, span,
+        tag_info=None,
     ) -> None:
         if self.failed is not None:
             raise PipelineFailedError(repr(self.failed))
@@ -4522,16 +5488,50 @@ class ProxyPipeline:
         t_log = loop.time()
         # classification boundary is _commit_batch (same fan-out as the
         # resolve gather above)
-        await self.tlog.call(  # flowcheck: ignore[wire.unclassified-error]
-            TOKEN_TLOG_PUSH,
-            TLogPush(
-                version=version,
-                prev_version=prev_version,
-                mutations=mutations,
-                epoch=self.epoch,
-            ),
-            timeout=30.0,
-        )
+        if tag_info is not None:
+            # tag-partitioned push: each declared tlog gets ONLY its
+            # tag's mutations, chained by the grant's per-tag prev.
+            # Declared-but-empty tags (mutations died in the conflict
+            # check or clipped empty) still get their empty push — the
+            # per-tag chain must advance for every granted version that
+            # declared the tag, or a later push would wedge on the gap.
+            tags, tag_prevs = tag_info
+            groups = self._split_mutations(mutations, tags)
+            await asyncio.gather(*(
+                self._tlogs[tg].call(  # flowcheck: ignore[wire.unclassified-error]
+                    TOKEN_TLOG_PUSH,
+                    TLogPush(
+                        version=version,
+                        prev_version=tag_prevs[tg],
+                        mutations=groups[tg],
+                        epoch=self.epoch,
+                    ),
+                    timeout=30.0,
+                )
+                for tg in tags
+            ))
+        else:
+            await self.tlog.call(  # flowcheck: ignore[wire.unclassified-error]
+                TOKEN_TLOG_PUSH,
+                TLogPush(
+                    version=version,
+                    prev_version=prev_version,
+                    mutations=mutations,
+                    epoch=self.epoch,
+                ),
+                timeout=30.0,
+            )
+        if self.sequencer is not None:
+            # report BEFORE the client replies: any later GRV — from
+            # ANY proxy — must observe this version (the reference's
+            # ReportRawCommittedVersion ordering)
+            await self.sequencer.call(  # flowcheck: ignore[wire.unclassified-error]
+                TOKEN_REPORT_COMMITTED,
+                ReportRawCommittedVersionRequest(
+                    version=version, epoch=self.epoch
+                ),
+                timeout=30.0,
+            )
         log_s = loop.time() - t_log
         if dbg is not None:
             _tr.g_trace_batch.add_event(
@@ -4571,7 +5571,7 @@ class ProxyPipeline:
         # version they need, so a lagging apply costs read latency,
         # never correctness). Appended with no await since the logging
         # set above — queue order IS commit order.
-        self._apply_queue.append((version, mutations))
+        self._apply_queue.append((version, mutations, prev_version))
         self._last_enqueued_apply = version
         self._apply_event.set()
 

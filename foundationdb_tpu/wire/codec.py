@@ -32,7 +32,7 @@ from foundationdb_tpu.models.types import (
 from foundationdb_tpu.utils.packing import COLUMNAR_LAYOUT, ColumnarBatch
 
 #: Bumped whenever any wire layout changes; checked at connect time.
-PROTOCOL_VERSION = 0x0FDB_7E50_0008  # 0005: lock_aware txn flag; 0006: per-txn debug_id + span; 0007: columnar resolve frame; 0008: generation epoch on resolve/push frames
+PROTOCOL_VERSION = 0x0FDB_7E50_0009  # 0005: lock_aware txn flag; 0006: per-txn debug_id + span; 0007: columnar resolve frame; 0008: generation epoch on resolve/push frames; 0009: sequencer GetCommitVersion/ReportRawCommittedVersion + per-tag tlog chain fields
 
 
 class CodecError(ValueError):

@@ -201,6 +201,12 @@ async def _run_wire(backend: str, args) -> dict:
             mp.spawn_role("tlog", sock_dir),
             mp.spawn_role("storage", sock_dir),
         ]
+        seq_proc = None
+        if getattr(args, "sequencer", False):
+            # the scale-out version allotment role: grants ride
+            # GetCommitVersion, GRV rides ReportRawCommittedVersion
+            seq_proc = mp.spawn_role("sequencer", sock_dir)
+            procs.append(seq_proc)
         if getattr(args, "ratekeeper", False):
             # the admission-control role: polls every role's
             # StatusRequest sensors (plus the parent's proxy0.sock when
@@ -215,9 +221,22 @@ async def _run_wire(backend: str, args) -> dict:
             resolver = await mp.connect(procs[0].address)
             tlog = await mp.connect(procs[1].address)
             storage = await mp.connect(procs[2].address)
+            seq_conn = None
+            if seq_proc is not None:
+                seq_conn = await mp.connect(seq_proc.address)
+                # boot the resolver's version chain at the sequencer's
+                # recovery version (what the controller's recovery walk
+                # does) so the first grant's prev_version resolves
+                await resolver.call(
+                    mp.TOKEN_RESOLVE,
+                    mp.ResolveTransactionBatchRequest(
+                        prev_version=-1, version=0,
+                        last_received_version=-1, epoch=0,
+                    ),
+                )
             rk_conn = None
             if getattr(args, "ratekeeper", False):
-                rk_conn = await mp.connect(procs[3].address)
+                rk_conn = await mp.connect(procs[-1].address)
             # resolve-hop frame A/B (r12): --resolve-path pins the
             # columnar vs object frame per run; None = RESOLVE_COLUMNAR
             # env default (columnar)
@@ -228,6 +247,7 @@ async def _run_wire(backend: str, args) -> dict:
                 trace=bool(trace_dir),
                 ratekeeper=rk_conn,
                 resolve_columnar=(None if rp is None else rp == "columnar"),
+                sequencer=seq_conn,
             )
             pipe.start()
             status_server = None
@@ -362,7 +382,7 @@ async def _run_wire(backend: str, args) -> dict:
             # rk_conn included: leaving the ratekeeper connection open
             # was exactly the leak class the census gate exists to
             # catch (res.leak-on-error-path's dynamic twin)
-            for c in (resolver, tlog, storage, rk_conn):
+            for c in (resolver, tlog, storage, rk_conn, seq_conn):
                 if c is not None:
                     await c.close()
         finally:
@@ -610,6 +630,11 @@ def main():
                          "every role's StatusRequest sensors, serves the "
                          "budget over GetRateInfo) and enforce it at the "
                          "pipeline's GRV front door")
+    ap.add_argument("--sequencer", action="store_true",
+                    help="wire mode: spawn the sequencer role and route "
+                         "the pipeline's version allotment through its "
+                         "GetCommitVersion grants (the scale-out commit "
+                         "path, opt-in so legacy baselines stay keyed)")
     ap.add_argument("--hold", type=float, default=0.0,
                     help="wire mode: keep the cluster alive N seconds "
                          "after the workload (fdbtop polling window)")

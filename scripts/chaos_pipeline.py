@@ -7,24 +7,35 @@ babysitter); a YCSB-flavored workload runs through the ClusterClient
 front door while a random (or chosen) role's WORKER PROCESS is killed
 with SIGKILL mid-run. The gate: the controller detects the death,
 recovers the transaction system into a new generation (the
-cluster/generation.py walk: lock the durable tlog, recruit EMPTY
-resolvers, conservative whole-keyspace blind write), the monitor
-restarts the corpse, the workload keeps flowing, and the post-run
-exact-count consistency check passes — with the recovery epoch
-timeline reconstructable from the controller's trace file.
+cluster/generation.py walk: per-tag lock of the SURVIVING tlogs,
+recruit EMPTY resolvers, conservative whole-keyspace blind write),
+the monitor restarts the corpse, the workload keeps flowing, and the
+post-run exact-count consistency check passes — with the recovery
+epoch timeline reconstructable from the controller's trace file.
+
+ISSUE 19: every scenario runs the SCALE-OUT commit path — one
+sequencer, TWO commit proxies, TWO tag-partitioned tlogs — and the
+two proxies come from a pre-seeded persisted topology (the conf
+declares 1), so each recovery also regresses elastic-topology
+persistence: a generation that forgets the widened fleet fails the
+drill. Keys land on both sides of the tag boundary, so exact-count
+consistency covers both tlog partitions; a tlog kill must recover off
+the survivor quorum (phase-one lock strictly smaller than the fleet).
 
 Modes:
   python scripts/chaos_pipeline.py --smoke          # check.sh lane:
-      tiny cluster, kill one resolver mid-run, gate recovery +
+      scale-out cluster, kill one resolver mid-run, gate recovery +
       consistency, land the recovery ledger row (perfcheck-gated)
   python scripts/chaos_pipeline.py --kill tlog      # one scenario
   python scripts/chaos_pipeline.py --drill          # the acceptance
-      drill: proxy, resolver, tlog, ratekeeper each killed mid-load
-      on a fresh cluster, SLO gated (admitted-txn p99 <= 0.5s,
-      post-kill goodput >= 70% of the pre-kill peak)
+      drill: proxy, resolver, one-of-two tlogs, sequencer, ratekeeper,
+      controller each killed mid-load on a fresh cluster, SLO gated
+      (admitted-txn p99 <= 0.5s, post-kill goodput >= 70% of the
+      pre-kill peak)
   python scripts/chaos_pipeline.py --kill controller  # the controller
-      itself: monitor restarts it; persisted epoch guarantees it
-      recovers into a strictly newer generation
+      itself: monitor restarts it; persisted epoch + topology
+      guarantee it recovers into a strictly newer generation that
+      still plans the widened fleet
 
 Consistency under chaos: every client write targets a UNIQUE key, so a
 commit whose fate is unknown (connection lost mid-flight — the
@@ -46,7 +57,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-KILLABLE = ("proxy", "resolver", "tlog", "ratekeeper", "controller")
+KILLABLE = ("proxy", "resolver", "tlog", "sequencer", "ratekeeper",
+            "controller")
 
 
 def _pctl(samples, q):
@@ -62,6 +74,16 @@ def _write_confs(d: str, args) -> tuple[str, str]:
     worker's replacement until the monitor restarts the corpse)."""
     cluster_conf = {
         "resolvers": args.resolvers,
+        # ISSUE 19: the drill runs the SCALE-OUT commit path — a
+        # sequencer, two tag-partitioned tlogs, and (via the persisted
+        # topology below) two commit proxies. Declared proxies stays 1
+        # on purpose: the pre-seeded state file says an elastic recruit
+        # already widened the fleet to 2, so every scenario doubles as
+        # the persistence regression — the recovered (or restarted)
+        # controller must plan proxies=2, never fall back to the conf.
+        "proxies": 1,
+        "tlogs": 2,
+        "sequencer": True,
         "backend": "native",
         "tlog_data_dir": os.path.join(d, "tlog-data"),
         "storage_data_dir": os.path.join(d, "storage-data"),
@@ -71,7 +93,10 @@ def _write_confs(d: str, args) -> tuple[str, str]:
     cpath = os.path.join(d, "cluster.json")
     with open(cpath, "w") as f:
         json.dump(cluster_conf, f)
-    n_roles = args.resolvers + 4  # tlog, storage, ratekeeper, proxy
+    with open(os.path.join(d, "epoch.json"), "w") as f:
+        json.dump({"epoch": 0, "topology": {"proxies": 2}}, f)
+    # 2 tlogs + storage + sequencer + ratekeeper + 2 proxies
+    n_roles = args.resolvers + 6
     n_workers = n_roles + 1
     ctrl_addr = os.path.join(d, "controller0.sock")
     lines = [
@@ -176,9 +201,12 @@ async def _run_scenario(kill_kind: str, args) -> dict:
 
         async def one_client(cid: int):
             seq = 0
+            # unique keys on BOTH sides of the 0x80 tag boundary, so
+            # exact-count consistency exercises both tlog partitions
+            prefix = b"chaos" if cid % 2 else b"\xf0chaos"
             while time.monotonic() < stop:
                 seq += 1
-                key = b"chaos-%d-%d" % (cid, seq)
+                key = b"%s-%d-%d" % (prefix, cid, seq)
                 t0 = time.monotonic()
                 try:
                     rv = await client.get_read_version()
@@ -329,10 +357,19 @@ async def _run_scenario(kill_kind: str, args) -> dict:
             # HEARTBEAT_MISSES status polls (only meaningful for
             # transaction-path kills, which trigger a recovery walk)
             "push_detected": int(
-                kill_kind in ("proxy", "resolver", "tlog")
+                kill_kind in ("proxy", "resolver", "tlog", "sequencer")
                 and str(q["last_recovery_reason"] or "").startswith("push:")
             ),
             "death_notifications": q.get("death_notifications", 0),
+            # ISSUE 19 scale-out pins: the recovered generation must
+            # still plan the WIDENED fleet (the pre-seeded persisted
+            # topology says 2 proxies; the conf declares 1), and the
+            # phase-one lock report shows how many tlogs the walk
+            # actually locked vs the topology width — a one-of-N tlog
+            # kill recovers off the SURVIVOR quorum, not all N.
+            "proxies_planned": q.get("proxies_planned"),
+            "partitioned": int(bool(q.get("partitioned"))),
+            "tlog_lock": q.get("last_tlog_lock"),
             "recovered": int(
                 killed.get("recovered_after_s") is not None
                 and q["recovery_state"] == gen.FULLY_RECOVERED
@@ -422,7 +459,17 @@ def _emit_ledger(args, results: list[dict]) -> None:
                 int(all(
                     r["push_detected"]
                     for r in results
-                    if r["kill"] in ("proxy", "resolver", "tlog")
+                    if r["kill"] in ("proxy", "resolver", "tlog",
+                                     "sequencer")
+                )),
+                "bool", direction="higher", tier="structural",
+            ),
+            # ISSUE 19: every recovered generation kept the persisted
+            # 2-proxy fleet (conf declares 1) and stayed partitioned
+            "topology_persisted": perf.metric(
+                int(all(
+                    r["proxies_planned"] == 2 and r["partitioned"]
+                    for r in results
                 )),
                 "bool", direction="higher", tier="structural",
             ),
@@ -432,6 +479,7 @@ def _emit_ledger(args, results: list[dict]) -> None:
             "clients": args.clients,
             "duration_s": args.duration,
             "resolvers": args.resolvers,
+            "topology": "scaleout-2proxy-2tlog-seq",
         },
         knobs={"mode": "drill" if n > 1 else "single"},
         ledger=args.perf_ledger,
@@ -472,7 +520,8 @@ def main() -> int:
         args.clients = min(args.clients, 12)
         args.duration = min(args.duration, 8.0)
     elif args.drill:
-        scenarios = ["proxy", "resolver", "tlog", "ratekeeper"]
+        scenarios = ["proxy", "resolver", "tlog", "sequencer",
+                     "ratekeeper", "controller"]
     else:
         scenarios = [args.kill]
 
@@ -496,12 +545,43 @@ def main() -> int:
             )
         if not res["timeline_ok"]:
             failures.append(f"{kind}: recovery timeline not in trace")
-        if kind in ("proxy", "resolver", "tlog") and not res["push_detected"]:
+        if kind in ("proxy", "resolver", "tlog", "sequencer") \
+                and not res["push_detected"]:
             failures.append(
                 f"{kind}: recovery was heartbeat-detected "
                 f"(reason {res['recovery_reason']!r}) — the monitor's "
                 "push-on-death should have won"
             )
+        # the persisted-topology regression: every recovered generation
+        # (including a fresh controller process) must keep the widened
+        # fleet from the state file, not the declared conf
+        if res["proxies_planned"] != 2:
+            failures.append(
+                f"{kind}: recovered with proxies_planned="
+                f"{res['proxies_planned']} — persisted elastic topology "
+                "lost (expected 2)"
+            )
+        if not res["partitioned"]:
+            failures.append(f"{kind}: cluster not in partitioned mode")
+        lock = res["tlog_lock"] or {}
+        if kind == "tlog":
+            # per-tag quorum: the walk locked the SURVIVORS and
+            # recovered anyway — never waited on the corpse
+            if not (lock.get("survivors", 0) < lock.get("total", 0)):
+                failures.append(
+                    f"{kind}: phase-one lock saw {lock} — expected a "
+                    "survivor quorum strictly smaller than the fleet"
+                )
+        elif kind in ("proxy", "resolver", "sequencer", "controller"):
+            # these kills force a fresh walk with every tlog alive: the
+            # lock must be full-width. (A ratekeeper kill is a singleton
+            # re-recruit with NO walk — status still shows the
+            # bootstrap lock, which had no old generation to lock.)
+            if lock.get("survivors") != lock.get("total"):
+                failures.append(
+                    f"{kind}: phase-one lock lost a tlog it shouldn't "
+                    f"have: {lock}"
+                )
         if res["committed"] == 0:
             failures.append(f"{kind}: nothing committed")
         if (res["recovery_time_s"] or args.recovery_bound) \
@@ -509,7 +589,14 @@ def main() -> int:
             failures.append(
                 f"{kind}: recovery took {res['recovery_time_s']}s"
             )
-        if args.drill:
+        # The SLO pair gates the REDUNDANT data-plane kills: one of N
+        # dies and the survivors keep the pipeline flowing. Sequencer
+        # and controller are singletons — their death stalls EVERY
+        # commit until the recovery walk replaces them (the reference's
+        # master-failure shape), so those scenarios gate on the
+        # recovery bound + consistency + topology persistence instead
+        # of tail latency.
+        if args.drill and kind not in ("sequencer", "controller"):
             if res["commit_p99_ms"] > args.slo_p99_s * 1e3:
                 failures.append(
                     f"{kind}: p99 {res['commit_p99_ms']}ms > SLO"

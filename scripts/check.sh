@@ -144,6 +144,18 @@ rm -f "$chaos_row"
 t1=$(date +%s.%N)
 awk -v a="$t0" -v b="$t1" 'BEGIN {printf "chaos smoke wall time: %.1fs\n", b - a}'
 
+echo "== proxy-scaling smoke (commit-path scale-out: 1 vs 2 wire commit =="
+echo "== proxies on one sequencer + tag-partitioned tlogs — exact-count  =="
+echo "== consistency through BOTH front doors, census gate armed per     =="
+echo "== width, structural ledger row gated by perfcheck)                =="
+t0=$(date +%s.%N)
+scaling_row=$(mktemp /tmp/scalingcheck_row.XXXXXX.jsonl)
+JAX_PLATFORMS=cpu python scripts/proxy_scaling.py --smoke --perf-ledger "$scaling_row"
+JAX_PLATFORMS=cpu python scripts/perfcheck.py --check "$scaling_row" --tier structural
+rm -f "$scaling_row"
+t1=$(date +%s.%N)
+awk -v a="$t0" -v b="$t1" 'BEGIN {printf "proxy-scaling smoke wall time: %.1fs\n", b - a}'
+
 echo "== saturation smoke (short overload ramp via the saturation spec: =="
 echo "== admission ON must hold the p99/goodput SLO, OFF must violate)  =="
 t0=$(date +%s.%N)

@@ -56,6 +56,9 @@ HEADLINE = {
     "commit_proxy": ("queued_requests", "queued"),
     "grv_proxy": ("queued_requests", "queued"),
     "master": ("version", "version"),
+    # the scale-out sequencer: version-batch allotment rate — the
+    # whole commit path's grant heartbeat as a sparkline
+    "sequencer": ("grants_per_s", "grants/s"),
     # the admission budget as a live sparkline: watching the limit dip
     # and recover IS watching the control loop work
     "ratekeeper": ("transactions_per_second_limit", "tps lim"),
@@ -85,7 +88,14 @@ REQUIRED_SENSORS = {
                  # pressure spills) — zeros on unconfigured kernels,
                  # never a missing key
                  "kernel.spills", "kernel.sweep_groups"),
-    "commit_proxy": ("queued_requests", "inflight_batches", "batch_sizer"),
+    "commit_proxy": ("queued_requests", "inflight_batches", "batch_sizer",
+                     # r19 scale-out: grants consumed + whether this
+                     # proxy pushes tag-partitioned (0/False legacy)
+                     "version_grants", "tag_partitioned"),
+    # r19: the sequencer role's allotment surface — grant count/rate,
+    # the GRV notification floor, and the tag/proxy fan-out widths
+    "sequencer": ("grants", "grants_per_s", "live_committed_version",
+                  "tags", "proxies_seen"),
     "grv_proxy": ("queued_requests", "sheds", "budget_stale"),
     # binding_streak is the r15 elasticity trigger's input — shipped by
     # the shared law's rate_info(), so sim and wire both pin it
@@ -270,8 +280,20 @@ def _row_metrics(role: str, block: dict) -> list[tuple[str, object]]:
         bs = q.get("batch_sizer", {})
         return [
             ("inflight", q.get("inflight_batches", 0)),
+            ("queued", q.get("queued_requests", 0)),
+            # r19 scale-out: per-proxyN grant consumption makes an idle
+            # recruit visible at a glance
+            ("grants", q.get("version_grants", 0)),
             ("interval", bs.get("interval", 0.0)),
             ("count", bs.get("target_count", 0)),
+        ]
+    if role == "sequencer":
+        return [
+            ("grants", q.get("grants", 0)),
+            ("live v", q.get("live_committed_version", 0)),
+            ("tags", q.get("tags", 1)),
+            ("proxies", q.get("proxies_seen", 0)),
+            ("stale rej", q.get("stale_epoch_rejects", 0)),
         ]
     if role == "grv_proxy":
         bs = q.get("batch_sizer", {})
@@ -514,12 +536,12 @@ def _smoke_main(args) -> int:
             sys.executable,
             os.path.join(repo, "scripts", "bench_pipeline.py"),
             "--smoke", "--socket-dir", sock_dir, "--serve-status",
-            "--ratekeeper", "--hold", "20",
+            "--ratekeeper", "--sequencer", "--hold", "20",
         ],
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
     require = ["log", "storage", "resolver", "commit_proxy", "grv_proxy",
-               "ratekeeper"]
+               "ratekeeper", "sequencer"]
     try:
         deadline = time.monotonic() + 120
         last_problems = ["no status yet"]

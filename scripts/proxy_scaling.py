@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Commit-path scale-out harness (ISSUE 19): aggregate goodput over N
+wire commit proxies sharing one sequencer.
+
+Each width spawns the scale-out topology as real role processes —
+resolver, two tag-partitioned tlogs, storage, sequencer — then runs N
+in-process ProxyPipelines against it (the controller's recruit shape:
+shared sequencer connection, per-tag tlog fan-out) under a saturating
+blind-write ramp. The per-proxy front door is deliberately paced
+(batch_interval + max_batch cap one proxy's admission rate) so the
+measured curve isolates the PROXY count: the downstream roles have
+headroom at every width, and goodput grows only if the grant RPC
+genuinely lets proxies batch/resolve/push concurrently.
+
+Modes:
+  --smoke   1 vs 2 proxies, exact-count consistency on BOTH widths,
+            census gate armed, one structural ledger row (the check.sh
+            lane; exit code enforces every pin)
+  --full    1/2/4 proxies; asserts the aggregate goodput is monotone
+            and >= 1.5x at 4 vs 1; per-width txn_s rows land in the
+            ledger keyed by workload n_shards so `perfcheck --scaling`
+            reads the curve straight off perf/history.jsonl
+"""
+
+import argparse
+import asyncio
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from foundationdb_tpu.cluster import multiprocess as mp  # noqa: E402
+from foundationdb_tpu.models.types import CommitTransaction  # noqa: E402
+from foundationdb_tpu.wire.codec import Mutation  # noqa: E402
+
+#: the front-door pacing that makes the curve about proxy COUNT: one
+#: proxy admits at most MAX_BATCH txns per BATCH_INTERVAL tick
+BATCH_INTERVAL = 0.004
+MAX_BATCH = 16
+
+
+async def _run_width(n_proxies: int, *, clients_per_proxy: int,
+                     ops: int, sock_dir: str) -> dict:
+    procs = [
+        mp.spawn_role("resolver", sock_dir),
+        mp.spawn_role("tlog", sock_dir, index=0),
+        mp.spawn_role("tlog", sock_dir, index=1),
+        mp.spawn_role("storage", sock_dir),
+        mp.spawn_role("sequencer", sock_dir),
+    ]
+    r_addr, t0_addr, t1_addr, st_addr, seq_addr = [p.address for p in procs]
+    pipes, conns = [], []
+    try:
+        # the controller recovery walk's boot sequence: two-phase lock
+        # arms the per-tag chain wait, the priming batch boots the
+        # resolver's version chain at the recovery version
+        for addr in (t0_addr, t1_addr):
+            c = await mp.connect(addr)
+            await c.call(mp.TOKEN_TLOG_LOCK, mp.TLogLock(
+                epoch=0, recovery_version=0, partitioned=1))
+            await c.close()
+        c = await mp.connect(r_addr)
+        await c.call(mp.TOKEN_RESOLVE, mp.ResolveTransactionBatchRequest(
+            prev_version=-1, version=0, last_received_version=-1, epoch=0))
+        await c.close()
+        for i in range(n_proxies):
+            cs = [await mp.connect(a)
+                  for a in (r_addr, t0_addr, t1_addr, st_addr, seq_addr)]
+            resolver, tl0, tl1, storage, seq = cs
+            pipe = mp.ProxyPipeline(
+                [resolver], tl0, storage,
+                sequencer=seq, proxy_id=f"proxy{i}",
+                tlogs=[tl0, tl1], tlog_boundaries=[b"\x80"],
+                batch_interval=BATCH_INTERVAL, max_batch=MAX_BATCH,
+            )
+            pipe.start()
+            pipes.append(pipe)
+            conns.extend(cs)
+
+        n_clients = n_proxies * clients_per_proxy
+        committed = [0]
+        lastv: dict[bytes, int] = {}
+
+        async def client(cid: int):
+            pipe = pipes[cid % n_proxies]
+            # keys on BOTH sides of the 0x80 tag boundary, disjoint per
+            # client: blind writes never conflict, so every commit must
+            # land and the final read-back is exact
+            key = (b"w%03d" if cid % 2 else b"\xf0w%03d") % cid
+            for op in range(ops):
+                await pipe.commit(CommitTransaction(
+                    read_conflict_ranges=[],
+                    write_conflict_ranges=[],
+                    read_snapshot=0,
+                    mutations=[Mutation(0, key, op.to_bytes(8, "little"))],
+                ))
+                committed[0] += 1
+                lastv[key] = op
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(client(c) for c in range(n_clients)))
+        wall = time.perf_counter() - t0
+
+        # exact-count consistency through EVERY front door: all blind
+        # writes committed, and each key reads back its last write
+        assert committed[0] == n_clients * ops, (
+            f"lost commits: {committed[0]} != {n_clients * ops}"
+        )
+        for pipe in pipes:
+            rv = await pipe.get_read_version()
+            for key, op in lastv.items():
+                got = await pipe.read(key, rv)
+                assert got == op.to_bytes(8, "little"), (
+                    f"{key!r}: {got!r} != last write {op}"
+                )
+        grants = [p.version_grants for p in pipes]
+        assert all(g > 0 for g in grants), f"idle proxy: grants={grants}"
+        for pipe in pipes:
+            await pipe.stop()
+        for c in conns:
+            await c.close()
+        return {
+            "n_proxies": n_proxies,
+            "committed": committed[0],
+            "wall_s": round(wall, 3),
+            "txn_s": round(committed[0] / wall, 1),
+            "version_grants": grants,
+            "consistency_ok": True,
+        }
+    finally:
+        for p in procs:
+            p.stop()
+
+
+def _run_census_gated(n_proxies: int, *, clients_per_proxy: int,
+                      ops: int) -> dict:
+    """One width = one topology lifetime: the census gate pins that
+    every fd/connection/task this process opened for it is gone."""
+    import tempfile
+
+    from foundationdb_tpu.runtime import census
+
+    pre = census.snapshot()
+
+    async def scenario():
+        with tempfile.TemporaryDirectory() as d:
+            res = await _run_width(
+                n_proxies, clients_per_proxy=clients_per_proxy,
+                ops=ops, sock_dir=d,
+            )
+        await asyncio.sleep(0.1)
+        return res
+
+    loop = asyncio.new_event_loop()
+    try:
+        res = loop.run_until_complete(scenario())
+    finally:
+        loop.close()
+    census.check_drained(pre, census.snapshot(),
+                         label=f"proxy_scaling n={n_proxies}")
+    return res
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--smoke", action="store_true")
+    mode.add_argument("--full", action="store_true")
+    ap.add_argument("--clients-per-proxy", type=int, default=None)
+    ap.add_argument("--ops", type=int, default=None)
+    ap.add_argument("--perf-ledger", default=None,
+                    help="ledger path (default perf/history.jsonl)")
+    ap.add_argument("--no-perf", action="store_true")
+    args = ap.parse_args()
+
+    from foundationdb_tpu.utils import perf
+
+    widths = [1, 2] if args.smoke else [1, 2, 4]
+    cpp = args.clients_per_proxy or (8 if args.smoke else 24)
+    ops = args.ops or (20 if args.smoke else 60)
+
+    results = []
+    for n in widths:
+        res = _run_census_gated(n, clients_per_proxy=cpp, ops=ops)
+        print(f"[proxy_scaling] n={n}: {res['txn_s']} txn/s "
+              f"({res['committed']} committed in {res['wall_s']}s, "
+              f"grants={res['version_grants']})", flush=True)
+        results.append(res)
+
+    by_n = {r["n_proxies"]: r["txn_s"] for r in results}
+    if args.full:
+        curve = [by_n[n] for n in widths]
+        assert curve == sorted(curve), f"goodput not monotone: {by_n}"
+        scale = by_n[4] / by_n[1]
+        print(f"[proxy_scaling] 4-proxy scale: {scale:.2f}x", flush=True)
+        assert scale >= 1.5, f"4 vs 1 scale {scale:.2f}x < 1.5x"
+        if not args.no_perf:
+            for r in results:
+                perf.emit(
+                    "proxy_scaling",
+                    {"txn_s": perf.metric(r["txn_s"], "txn/s",
+                                          direction="higher")},
+                    workload={"n_shards": r["n_proxies"],
+                              "clients_per_proxy": cpp, "ops": ops,
+                              "pattern": "blind_write_saturation"},
+                    knobs={"batch_interval": BATCH_INTERVAL,
+                           "max_batch": MAX_BATCH},
+                    ledger=args.perf_ledger,
+                )
+    elif not args.no_perf:
+        perf.emit(
+            "proxy_scaling_smoke",
+            {
+                "consistency_ok": perf.metric(
+                    int(all(r["consistency_ok"] for r in results)),
+                    "bool", direction="higher", tier="structural"),
+                "proxies_exercised": perf.metric(
+                    max(len(r["version_grants"]) for r in results),
+                    "count", direction="higher", tier="structural"),
+                "two_proxy_speedup": perf.metric(
+                    round(by_n[2] / by_n[1], 3), "ratio",
+                    direction="higher"),
+            },
+            workload={"widths": widths, "clients_per_proxy": cpp,
+                      "ops": ops},
+            ledger=args.perf_ledger,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -99,6 +99,7 @@ _KIND_SAMPLES = {
                   [codec.Mutation(0, b"b", b"2"),
                    codec.Mutation(1, b"c", b"")]],
     "byteslist": [b"aa", b"bb"],
+    "strlist": ["tlog0.sock", "tlog1.sock"],
     "optbyteslist": [b"aa", None],
     "txn": _sample_txn(),
 }

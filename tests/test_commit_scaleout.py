@@ -1,0 +1,461 @@
+"""Commit-path scale-out (ISSUE 19): sequencer role, N commit proxies,
+tag-partitioned tlog quorum.
+
+Layer by layer: the SequencerRole's grant semantics (global + per-tag
+version chains, duplicate replay, epoch fencing), the partitioned
+TLogRole's chain wait and two-phase lock, the StorageRole's chained
+applies and multi-tlog merged catch-up — then the acceptance pin: two
+wire ProxyPipelines sharing one sequencer over real role processes,
+with commit/abort decisions replayed against the CPU ConflictOracle in
+granted-version order and exact-count consistency on both front doors.
+"""
+
+import asyncio
+
+import pytest
+
+from foundationdb_tpu.cluster import multiprocess as mp
+from foundationdb_tpu.models.types import CommitTransaction
+from foundationdb_tpu.testing.oracle import (
+    COMMITTED,
+    ConflictOracle,
+    OracleTxn,
+)
+from foundationdb_tpu.wire import transport
+from foundationdb_tpu.wire.codec import Mutation
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# SequencerRole: version-batch allotment semantics
+
+
+def test_sequencer_grants_chain_globally_and_per_tag():
+    async def scenario():
+        seq = mp.SequencerRole(recovery_version=100, n_tags=2)
+        g1 = await seq.get_commit_version(mp.GetCommitVersionRequest(
+            proxy_id="proxy0", request_num=1, most_recent_processed=0,
+            epoch=0, tags=[0],
+        ))
+        # the first grant chains off the recovery version on both the
+        # global chain and its declared tag's chain
+        assert g1.prev_version == 100
+        assert g1.version > g1.prev_version
+        assert list(g1.tag_prevs) == [100]
+        g2 = await seq.get_commit_version(mp.GetCommitVersionRequest(
+            proxy_id="proxy1", request_num=1, most_recent_processed=0,
+            epoch=0, tags=[0, 1],
+        ))
+        # global chain: proxy1's grant chains off proxy0's version
+        assert g2.prev_version == g1.version
+        # tag 0 last saw g1; tag 1 has never been granted
+        assert list(g2.tag_prevs) == [g1.version, 100]
+        g3 = await seq.get_commit_version(mp.GetCommitVersionRequest(
+            proxy_id="proxy0", request_num=2, most_recent_processed=1,
+            epoch=0, tags=[1],
+        ))
+        assert g3.prev_version == g2.version
+        assert list(g3.tag_prevs) == [g2.version]
+        # duplicate request: the SAME grant replays, including tag_prevs
+        dup = await seq.get_commit_version(mp.GetCommitVersionRequest(
+            proxy_id="proxy0", request_num=2, most_recent_processed=1,
+            epoch=0, tags=[1],
+        ))
+        assert (dup.version, dup.prev_version, list(dup.tag_prevs)) == (
+            g3.version, g3.prev_version, list(g3.tag_prevs)
+        )
+        assert seq.grants == 3  # the replay is not a fresh grant
+
+    run(scenario())
+
+
+def test_sequencer_fences_stale_epochs():
+    async def scenario():
+        seq = mp.SequencerRole(epoch=5)
+        with pytest.raises(transport.RemoteError):
+            await seq.get_commit_version(mp.GetCommitVersionRequest(
+                proxy_id="proxy0", request_num=1, most_recent_processed=0,
+                epoch=4, tags=[0],
+            ))
+        with pytest.raises(transport.RemoteError):
+            await seq.report_committed(
+                mp.ReportRawCommittedVersionRequest(version=7, epoch=4)
+            )
+
+    run(scenario())
+
+
+def test_sequencer_live_committed_feeds_grv():
+    async def scenario():
+        seq = mp.SequencerRole(recovery_version=50)
+        rep = await seq.report_committed(
+            mp.ReportRawCommittedVersionRequest(version=-1, epoch=0)
+        )
+        assert rep.live_version == 50  # starts at the recovery version
+        await seq.report_committed(
+            mp.ReportRawCommittedVersionRequest(version=90, epoch=0)
+        )
+        rep = await seq.report_committed(
+            mp.ReportRawCommittedVersionRequest(version=-1, epoch=0)
+        )
+        assert rep.live_version == 90
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# TLogRole: the per-tag chain wait + two-phase recovery lock
+
+
+def test_partitioned_tlog_parks_until_predecessor_lands():
+    async def scenario():
+        tlog = mp.TLogRole(partitioned=True)
+        await tlog.lock(mp.TLogLock(epoch=0, recovery_version=0,
+                                    partitioned=1))
+        order = []
+
+        async def late_push():
+            rep = await tlog.push(mp.TLogPush(
+                version=10, prev_version=5,
+                mutations=[Mutation(0, b"b", b"2")], epoch=0,
+            ))
+            order.append(("late", rep.durable_version))
+
+        task = asyncio.ensure_future(late_push())
+        await asyncio.sleep(0.05)
+        assert not task.done()  # parked: version 5 hasn't landed
+        assert tlog._chain_waiters == 1
+        rep = await tlog.push(mp.TLogPush(
+            version=5, prev_version=0,
+            mutations=[Mutation(0, b"a", b"1")], epoch=0,
+        ))
+        order.append(("early", rep.durable_version))
+        await task
+        assert order == [("early", 5), ("late", 10)]
+        assert [v for v, _m in tlog.entries] == [5, 10]
+
+    run(scenario())
+
+
+def test_partitioned_tlog_lock_drains_parked_waiters_as_stale():
+    async def scenario():
+        tlog = mp.TLogRole(partitioned=True)
+        await tlog.lock(mp.TLogLock(epoch=1, recovery_version=0,
+                                    partitioned=1))
+
+        async def doomed_push():
+            await tlog.push(mp.TLogPush(
+                version=100, prev_version=99,
+                mutations=[], epoch=1,
+            ))
+
+        task = asyncio.ensure_future(doomed_push())
+        await asyncio.sleep(0.05)
+        assert not task.done()
+        # phase-two lock of the NEXT generation: the floor advances and
+        # the parked waiter drains as a stale-epoch reject, not a wedge
+        await tlog.lock(mp.TLogLock(epoch=2, recovery_version=120,
+                                    partitioned=1))
+        with pytest.raises(transport.RemoteError):
+            await task
+        assert tlog.version == 120
+        # the lock turned the chain-wait flag on for survivors too
+        surv = mp.TLogRole()
+        assert not surv.partitioned
+        await surv.lock(mp.TLogLock(epoch=1, recovery_version=0,
+                                    partitioned=1))
+        assert surv.partitioned
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# StorageRole: chained applies + merged multi-tlog catch-up
+
+
+def test_storage_chained_applies_order_interleaved_appliers():
+    async def scenario():
+        st = mp.StorageRole()
+        done = []
+
+        async def late_apply():
+            await st.apply_batch(mp.StorageApplyBatch(
+                versions=[20], groups=[[Mutation(0, b"k", b"late")]],
+                prev_versions=[10],
+            ))
+            done.append("late")
+
+        task = asyncio.ensure_future(late_apply())
+        await asyncio.sleep(0.05)
+        assert not task.done()  # parked on prev 10
+        await st.apply_batch(mp.StorageApplyBatch(
+            versions=[10], groups=[[Mutation(0, b"k", b"early")]],
+            prev_versions=[0],
+        ))
+        done.append("early")
+        await task
+        assert done == ["early", "late"]
+        assert st.version == 20
+        assert st.history[b"k"] == [(10, b"early"), (20, b"late")]
+        # contiguous runs inside one batch wait once, then sweep
+        await st.apply_batch(mp.StorageApplyBatch(
+            versions=[30, 40], groups=[[], [Mutation(0, b"k", b"v40")]],
+            prev_versions=[20, 30],
+        ))
+        assert st.version == 40
+
+    run(scenario())
+
+
+def test_storage_advance_floor_unblocks_post_recovery_chain():
+    async def scenario():
+        st = mp.StorageRole()
+
+        async def first_new_gen_apply():
+            await st.apply_batch(mp.StorageApplyBatch(
+                versions=[60], groups=[[Mutation(0, b"k", b"new")]],
+                prev_versions=[50],
+            ))
+
+        task = asyncio.ensure_future(first_new_gen_apply())
+        await asyncio.sleep(0.05)
+        assert not task.done()
+        await st.advance_floor(50)  # what recovery's catch-up does
+        await task
+        assert st.version == 60
+
+    run(scenario())
+
+
+def test_storage_merged_catchup_combines_cross_tag_versions(tmp_path):
+    """A version spanning tags appears in EVERY owning tlog (with that
+    tag's clipped mutations): the k-way merged catch-up must COMBINE
+    same-version heads into one apply, never drop one."""
+    t0 = mp.spawn_role("tlog", str(tmp_path), index=0)
+    t1 = mp.spawn_role("tlog", str(tmp_path), index=1)
+    try:
+        async def scenario():
+            c0 = await mp.connect(t0.address)
+            c1 = await mp.connect(t1.address)
+            # tag 0 alone at v10, BOTH tags at v20, tag 1 alone at v30
+            await c0.call(mp.TOKEN_TLOG_PUSH, mp.TLogPush(
+                version=10, prev_version=0,
+                mutations=[Mutation(0, b"a", b"1")], epoch=0))
+            await c0.call(mp.TOKEN_TLOG_PUSH, mp.TLogPush(
+                version=20, prev_version=10,
+                mutations=[Mutation(0, b"a", b"2")], epoch=0))
+            await c1.call(mp.TOKEN_TLOG_PUSH, mp.TLogPush(
+                version=20, prev_version=0,
+                mutations=[Mutation(0, b"\xf0z", b"9")], epoch=0))
+            await c1.call(mp.TOKEN_TLOG_PUSH, mp.TLogPush(
+                version=30, prev_version=20,
+                mutations=[Mutation(0, b"\xf0z", b"10")], epoch=0))
+            st = mp.StorageRole()
+            await st.catch_up_from_tlogs([t0.address, t1.address])
+            assert st.version == 30
+            assert st.history[b"a"] == [(10, b"1"), (20, b"2")]
+            assert st.history[b"\xf0z"] == [(20, b"9"), (30, b"10")]
+            await c0.close()
+            await c1.close()
+
+        run(scenario())
+    finally:
+        t0.stop()
+        t1.stop()
+
+
+# ---------------------------------------------------------------------------
+# The acceptance pin: two proxies, one sequencer, tag-partitioned tlogs
+
+
+@pytest.fixture
+def scaleout_procs(tmp_path):
+    procs = {
+        "resolver": mp.spawn_role("resolver", str(tmp_path)),
+        "tlog0": mp.spawn_role("tlog", str(tmp_path), index=0),
+        "tlog1": mp.spawn_role("tlog", str(tmp_path), index=1),
+        "storage": mp.spawn_role("storage", str(tmp_path)),
+        "sequencer": mp.spawn_role("sequencer", str(tmp_path)),
+    }
+    yield procs
+    for p in procs.values():
+        p.stop()
+
+
+async def _scaleout_pipeline(procs, proxy_id):
+    """One in-process ProxyPipeline wired like the controller recruits
+    a scale-out proxy: shared sequencer, tag-partitioned tlogs."""
+    conns = [
+        await mp.connect(procs["resolver"].address),
+        await mp.connect(procs["tlog0"].address),
+        await mp.connect(procs["tlog1"].address),
+        await mp.connect(procs["storage"].address),
+        await mp.connect(procs["sequencer"].address),
+    ]
+    resolver, tl0, tl1, storage, seq = conns
+    pipe = mp.ProxyPipeline(
+        [resolver], tl0, storage,
+        sequencer=seq, proxy_id=proxy_id,
+        tlogs=[tl0, tl1], tlog_boundaries=[b"\x80"],
+        batch_interval=0.001,
+    )
+    pipe.start()
+    return pipe, conns
+
+
+def test_two_proxies_share_the_version_chain_with_oracle_parity(
+    scaleout_procs,
+):
+    n_clients, n_ops, n_keys = 6, 10, 4
+    # counter keys on BOTH sides of the 0x80 tag boundary
+    keys = [b"ctr%d" % i for i in range(n_keys // 2)] + [
+        b"\xf0ctr%d" % i for i in range(n_keys - n_keys // 2)
+    ]
+
+    async def scenario():
+        # the two-phase lock the controller's recovery walk runs: arm
+        # the chain wait and set the per-tag floor before any push
+        for name in ("tlog0", "tlog1"):
+            c = await mp.connect(scaleout_procs[name].address)
+            await c.call(mp.TOKEN_TLOG_LOCK, mp.TLogLock(
+                epoch=0, recovery_version=0, partitioned=1))
+            await c.close()
+        # ... and the resolver priming batch: boots the version chain
+        # at the recovery version so the first grant's prev resolves
+        c = await mp.connect(scaleout_procs["resolver"].address)
+        await c.call(mp.TOKEN_RESOLVE, mp.ResolveTransactionBatchRequest(
+            prev_version=-1, version=0, last_received_version=-1, epoch=0))
+        await c.close()
+        pipe_a, conns_a = await _scaleout_pipeline(scaleout_procs, "proxy0")
+        pipe_b, conns_b = await _scaleout_pipeline(scaleout_procs, "proxy1")
+        pipes = [pipe_a, pipe_b]
+        committed = {k: 0 for k in keys}
+        records = []  # (key, snapshot, outcome_version | None)
+
+        async def client(cid):
+            pipe = pipes[cid % 2]
+            for i in range(n_ops):
+                key = keys[(cid + i) % n_keys]
+                kr = (key, key + b"\x00")
+                rv = await pipe.get_read_version()
+                cur = await pipe.read(key, rv)
+                n = int.from_bytes(cur or b"\0" * 8, "little")
+                try:
+                    v = await pipe.commit(CommitTransaction(
+                        read_conflict_ranges=[kr],
+                        write_conflict_ranges=[kr],
+                        read_snapshot=rv,
+                        mutations=[Mutation(
+                            0, key, (n + 1).to_bytes(8, "little")
+                        )],
+                    ))
+                except mp.NotCommittedError:
+                    records.append((key, rv, None))
+                    continue
+                committed[key] += 1
+                records.append((key, rv, v))
+                # cross-proxy visibility: a GRV issued on the OTHER
+                # proxy after this ack must observe the commit
+                other = pipes[(cid + 1) % 2]
+                assert await other.get_read_version() >= v
+
+        await asyncio.gather(*(client(c) for c in range(n_clients)))
+        assert sum(committed.values()) > 0
+        # both proxies really ran on the shared chain
+        assert pipe_a.version_grants > 0 and pipe_b.version_grants > 0
+        assert pipe_a.saturation()["tag_partitioned"]
+
+        # -- exact-count consistency through BOTH front doors ---------
+        for pipe in pipes:
+            rv = await pipe.get_read_version()
+            for key in keys:
+                cur = await pipe.read(key, rv)
+                n = int.from_bytes(cur or b"\0" * 8, "little")
+                assert n == committed[key], (
+                    f"{key!r}: {n} != {committed[key]} committed"
+                )
+
+        # -- decision parity vs the CPU oracle in granted order -------
+        # Replay every COMMITTED txn in commit-version order (the
+        # global chain is the single-proxy order): the oracle must
+        # agree each one commits — interleaved proxy batches resolved
+        # exactly as the serial order would.
+        oracle = ConflictOracle()
+        commits = sorted(
+            (v, key, rv) for key, rv, v in records if v is not None
+        )
+        by_version: dict[int, list] = {}
+        for v, key, rv in commits:
+            by_version.setdefault(v, []).append((key, rv))
+        for v in sorted(by_version):
+            # txns batched by one proxy share a commit version: replay
+            # the whole batch in one oracle step, like the resolver saw
+            txns = [OracleTxn(
+                read_conflict_ranges=[(key, key + b"\x00")],
+                write_conflict_ranges=[(key, key + b"\x00")],
+                read_snapshot=rv,
+            ) for key, rv in by_version[v]]
+            res = oracle.resolve(txns, v)
+            assert res.verdicts == [COMMITTED] * len(txns), (
+                f"oracle aborts committed txn at v={v}: {res.verdicts}"
+            )
+        # every abort was a REAL conflict: a committed write on the
+        # same key landed after the aborted txn's snapshot
+        for key, rv, v in records:
+            if v is not None:
+                continue
+            assert any(
+                cv > rv and ck == key for cv, ck, _r in commits
+            ), f"spurious abort: key={key!r} snapshot={rv}"
+
+        # -- tag partitioning: each tlog holds ONLY its tag's keys ----
+        for name, lo, hi in (("tlog0", b"", b"\x80"),
+                             ("tlog1", b"\x80", None)):
+            c = await mp.connect(scaleout_procs[name].address)
+            rep = await c.call(mp.TOKEN_TLOG_PEEK_BATCH,
+                               mp.TLogPeekBatchReq(after_version=0,
+                                                   max_entries=10000))
+            assert rep.versions, f"{name} saw no pushes"
+            for muts in rep.groups:
+                for m in muts:
+                    assert m.param1 >= lo
+                    if hi is not None:
+                        assert m.param1 < hi
+            await c.close()
+
+        for pipe, conns in ((pipe_a, conns_a), (pipe_b, conns_b)):
+            await pipe.stop()
+            for c in conns:
+                await c.close()
+
+    run(scenario())
+
+
+def test_scaleout_worker_hosts_sequencer_and_partitioned_tlog(tmp_path):
+    """The controller's recruitment path: a WorkerRole builds the
+    sequencer and a partitioned tlog from InitializeRole specs."""
+    import json
+
+    async def scenario():
+        worker = mp.WorkerRole("w0", str(tmp_path / "w0.sock"))
+        rep = await worker.init_role(mp.InitializeRole(payload=json.dumps({
+            "kind": "sequencer", "epoch": 3, "recovery_version": 500,
+            "n_tags": 2,
+        })))
+        info = json.loads(rep.payload)
+        assert info["version"] == 500
+        seq = worker.roles["sequencer"]
+        assert seq.epoch == 3 and seq.n_tags == 2
+        rep = await worker.init_role(mp.InitializeRole(payload=json.dumps({
+            "kind": "tlog", "epoch": 3, "partitioned": True,
+        })))
+        assert worker.roles["tlog"].partitioned
+
+    run(scenario())

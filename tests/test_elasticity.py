@@ -240,13 +240,107 @@ def test_elastic_trigger_ignores_stale_feed():
     assert ctrl.elastic_last_streak == 0
 
 
-def test_elastic_trigger_ignores_non_resolver_limiters():
+def test_elastic_trigger_ignores_unrelated_limiters():
     ctrl = _controller()
     for name in ("workload", "log_server_write_queue",
-                 "ratekeeper_failsafe", "commit_proxy_queue"):
+                 "ratekeeper_failsafe"):
         _armed(ctrl, name=name)
         ctrl._elastic_check()
     assert ctrl.elastic_recruits == 0
+
+
+def test_proxy_queue_limiter_recruits_a_proxy():
+    """ISSUE 19: the SAME trigger machinery, routed by limiter name —
+    a commit_proxy_queue streak recruits one more commit proxy, never
+    a resolver."""
+    ctrl = _controller()
+    _armed(ctrl, name="commit_proxy_queue")
+    ctrl._elastic_check()
+    assert ctrl.elastic_recruits == 1
+    assert ctrl.conf["proxies"] == 2
+    assert ctrl.conf["resolvers"] == 1
+    assert ctrl._recovery_reason == "elastic:proxy->2"
+    # two proxies means scale-out mode: sequencer + partitioned chain
+    assert ctrl._partitioned()
+    # capped exactly like resolvers
+    ctrl._needs_recovery = False
+    _armed(ctrl, name="commit_proxy_queue", intervals=50)
+    ctrl._elastic_check()
+    assert ctrl.conf["proxies"] == 2
+
+
+def test_workload_streak_scales_down_elastic_role():
+    """ISSUE 19 satellite: a cold fleet — the law binding on "workload"
+    for elastic_scale_down_streak intervals — retires ONE above-
+    baseline elastic role through the same recovery walk."""
+    ctrl = _controller(elastic_scale_down_streak=3)
+    _armed(ctrl, name="commit_proxy_queue")
+    ctrl._elastic_check()
+    assert ctrl.conf["proxies"] == 2
+    ctrl._needs_recovery = False
+    _armed(ctrl, name="workload", intervals=2)  # below the streak
+    ctrl._elastic_check()
+    assert ctrl.elastic_scale_downs == 0 and not ctrl._needs_recovery
+    _armed(ctrl, name="workload", intervals=3)
+    ctrl._elastic_check()
+    assert ctrl.elastic_scale_downs == 1
+    assert ctrl.conf["proxies"] == 1
+    assert ctrl._recovery_reason == "elastic:proxy->1"
+    assert ctrl._needs_recovery and ctrl._wake.is_set()
+
+
+def test_scale_down_never_cuts_below_declared_baseline():
+    ctrl = _controller(resolvers=2, proxies=2,
+                       elastic_scale_down_streak=2)
+    _armed(ctrl, name="workload", intervals=10)
+    ctrl._elastic_check()
+    assert ctrl.elastic_scale_downs == 0
+    assert ctrl.conf["resolvers"] == 2 and ctrl.conf["proxies"] == 2
+
+
+def test_scale_down_gate_cannot_chain_retires():
+    """The workload streak survives the retire's recovery walk like
+    the recruit streak does: one retire per FRESH
+    elastic_scale_down_streak intervals, never one per heartbeat."""
+    ctrl = _controller(elastic_max_resolvers=3,
+                       elastic_scale_down_streak=2)
+    _armed(ctrl, intervals=3)
+    ctrl._elastic_check()
+    ctrl._needs_recovery = False
+    _armed(ctrl, intervals=6)  # past the raised recruit gate (3+3)
+    ctrl._elastic_check()
+    assert ctrl.conf["resolvers"] == 3
+    ctrl._needs_recovery = False
+    _armed(ctrl, name="workload", intervals=2)
+    ctrl._elastic_check()
+    assert ctrl.conf["resolvers"] == 2
+    ctrl._needs_recovery = False
+    _armed(ctrl, name="workload", intervals=3)  # below gate (2+2)
+    ctrl._elastic_check()
+    assert ctrl.conf["resolvers"] == 2
+    _armed(ctrl, name="workload", intervals=4)
+    ctrl._elastic_check()
+    assert ctrl.conf["resolvers"] == 1  # back to baseline, stops there
+
+
+def test_persisted_topology_survives_controller_restart(tmp_path):
+    """ISSUE 19 satellite: the planned elastic topology rides the
+    state file next to the epoch — a kill -9'd controller restarts
+    with the DECLARED conf and re-applies the persisted counts (but
+    the scale-down baseline stays the declared one)."""
+    sf = str(tmp_path / "controller_state.json")
+    ctrl = mp.ClusterControllerRole(
+        {"resolvers": 1, "elastic": True, "elastic_streak": 3,
+         "elastic_max_resolvers": 2}, state_file=sf)
+    _armed(ctrl, name="commit_proxy_queue")
+    ctrl._elastic_check()
+    assert ctrl.conf["proxies"] == 2
+    ctrl._persist_epoch(7)  # what the recovery walk does first
+    ctrl2 = mp.ClusterControllerRole(
+        {"resolvers": 1, "elastic": True}, state_file=sf)
+    assert ctrl2.conf["proxies"] == 2
+    assert ctrl2._elastic_baseline["proxies"] == 1
+    assert ctrl2.gen.epoch >= 7
 
 
 def test_elastic_trigger_capped_and_disabled():

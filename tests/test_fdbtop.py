@@ -245,7 +245,15 @@ def test_fdbtop_check_status_gate_both_directions():
                                "sweep_groups": 0}}},
                 "proxy0": {"role": "commit_proxy", "qos": {
                     "queued_requests": 0, "inflight_batches": 0,
-                    "batch_sizer": {}}},
+                    "batch_sizer": {},
+                    # r19 scale-out: grants consumed + partition mode
+                    # (0/False on the legacy single-proxy path, but the
+                    # KEYS are always present)
+                    "version_grants": 0, "tag_partitioned": False}},
+                "sequencer0": {"role": "sequencer", "qos": {
+                    "grants": 0, "grants_per_s": 0.0,
+                    "live_committed_version": 0, "tags": 2,
+                    "proxies_seen": 2}},
                 "grv_proxy0": {"role": "grv_proxy",
                                "qos": {"queued_requests": 0, "sheds": 0,
                                        "budget_stale": False}},
@@ -261,7 +269,7 @@ def test_fdbtop_check_status_gate_both_directions():
         }
     }
     require = ["log", "storage", "resolver", "commit_proxy", "grv_proxy",
-               "ratekeeper"]
+               "ratekeeper", "sequencer"]
     assert fdbtop.check_status(good, require) == []
     # a missing role fails
     partial = json.loads(json.dumps(good))
@@ -277,6 +285,16 @@ def test_fdbtop_check_status_gate_both_directions():
     del missing["cluster"]["processes"]["proxy0"]["qos"]["batch_sizer"]
     assert any("batch_sizer" in p for p in
                fdbtop.check_status(missing, require))
+    # r19: a proxy that stopped reporting its grant counter fails, and
+    # so does a sequencer missing its allotment surface
+    nogrant = json.loads(json.dumps(good))
+    del nogrant["cluster"]["processes"]["proxy0"]["qos"]["version_grants"]
+    assert any("version_grants" in p for p in
+               fdbtop.check_status(nogrant, require))
+    noseq = json.loads(json.dumps(good))
+    del noseq["cluster"]["processes"]["sequencer0"]["qos"]["proxies_seen"]
+    assert any("proxies_seen" in p for p in
+               fdbtop.check_status(noseq, require))
     # a missing DOTTED sensor (the r11 per-shard kernel columns) fails:
     # the gate descends into nested blocks
     noshard = json.loads(json.dumps(good))

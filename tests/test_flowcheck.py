@@ -1237,6 +1237,9 @@ def test_wire_registry_matches_runtime_tables():
     assert reg.epoch_frames() == {
         "TLogPush", "TLogPop", "TLogLock", "TLogLockReply",
         "ResolveTransactionBatchRequest", "ResolveBatchColumnar",
+        # the sequencer's allotment RPCs are generation-fenced too: a
+        # fenced-out proxy must not receive grants (r19 scale-out)
+        "GetCommitVersionRequest", "ReportRawCommittedVersionRequest",
     }
 
 

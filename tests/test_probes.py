@@ -598,6 +598,18 @@ def test_every_declared_probe_fires():
     ctrl._elastic_check()
     assert ctrl.elastic_recruits == 1
 
+    # ...and the OFF direction (ISSUE 19): the recruit left resolvers
+    # above the declared baseline; a "workload"-binding streak (nothing
+    # structural binds) past the scale-down gate retires it
+    ctrl._needs_recovery = False
+    ctrl._rk_qos = {
+        "binding_streak": {"name": "workload",
+                           "intervals": ctrl.elastic_scale_down_streak},
+        "budget_stale": False,
+    }
+    ctrl._elastic_check()
+    assert ctrl.elastic_scale_downs == 1
+
     # -- autotune probes (ISSUE 15) ---------------------------------------
     # cache_hit: the second sweep over the same ledger resumes every
     # trial; roofline_stop: a trial achieving the (tiny) target frac of
