@@ -225,6 +225,11 @@ class CommitProxy:
         self.latency_bands = LatencyBands(
             "CommitLatencyMetrics", COMMIT_LATENCY_BANDS
         )
+        # busiest-write-tag sensor (ISSUE 20): committed mutation bytes
+        # per tag prefix, virtual-clock smoothed (deterministic)
+        from foundationdb_tpu.cluster.sampling import TagCounter
+
+        self.write_tags = TagCounter(clock=sched.now)
         self.failed: Optional[BaseException] = None
         # Ranges recently moved between resolvers (ResolutionBalancer):
         # the next batch injects a synthetic blind write over each so the
@@ -302,11 +307,18 @@ class CommitProxy:
             "batch_sizer": self.batch_sizer.as_dict(),
             # r19 scale-out sensors, shared schema with the wire proxy:
             # grants = GetCommitVersion round-trips to the sequencer;
-            # the sim proxy pushes through ONE log-system front (tag
-            # fan-out happens inside it), so partitioned stays False
+            # tag_partitioned reports the log front's REAL per-tag
+            # fan-out state (LogSystem.tag_partitioned — the PR-19
+            # remaining (b) fix), so the sensor means the same thing
+            # the wire pipeline's does
             "version_grants": self._request_num,
-            "tag_partitioned": False,
+            "tag_partitioned": bool(
+                getattr(self.tlog, "tag_partitioned", False)
+            ),
             "failed": self.failed is not None,
+            # busiest-write-tag (ISSUE 20): committed bytes by tag
+            # prefix as assigned to storage tags in _assign_mutations
+            "busiest_write_tag": self.write_tags.busiest(),
         }
 
     # -- client entry -----------------------------------------------------
@@ -793,6 +805,7 @@ class CommitProxy:
         # tags duplicate a mutation per team replica, which would
         # double-apply atomics on replay (BackupWorker's dedicated tags
         # exist for the same reason)
+        from foundationdb_tpu.cluster.sampling import tag_of_key
         from foundationdb_tpu.cluster.tlog import LOG_STREAM_TAG
 
         emit_stream = self.tlog.has_log_consumers()
@@ -831,6 +844,14 @@ class CommitProxy:
                     messages.setdefault(s, []).append(m)
                 if emit_stream:
                     messages.setdefault(LOG_STREAM_TAG, []).append(m)
+                # busiest-write-tag sensor (ISSUE 20): committed bytes
+                # by tag prefix, counted once per mutation (not per
+                # replica — the client wrote it once)
+                try:
+                    nb = 8 + len(m[1]) + len(m[2])
+                except Exception:
+                    nb = 32
+                self.write_tags.note(tag_of_key(span[0]), nb)
         return messages
 
 

@@ -160,7 +160,10 @@ class Cluster:
         )
         self.storage_servers = [
             StorageServer(
-                sched, self.tlog, tag=s, window_versions=cfg.window_versions
+                sched, self.tlog, tag=s, window_versions=cfg.window_versions,
+                # per-server byteSample seed, derived from the sim seed:
+                # deterministic per (seed, tag), distinct across servers
+                sample_seed=((cfg.sim_seed or 0) << 8) ^ s,
             )
             for s in range(cfg.n_storage)
         ]
@@ -341,6 +344,7 @@ class Cluster:
         new = StorageServer(
             self.sched, self.tlog, tag=s,
             window_versions=self.config.window_versions,
+            sample_seed=((self.config.sim_seed or 0) << 8) ^ s,
         )
         new.restore(old.snapshot())
         self.storage_servers[s] = new

@@ -200,6 +200,24 @@ class LogSystem:
     def has_log_consumers(self) -> bool:
         return any(t.has_log_consumers() for t in self._live_logs())
 
+    @property
+    def tag_partitioned(self) -> bool:
+        """The REAL per-tag fan-out state (ISSUE 20, PR-19 remaining
+        (b)): True once commits have fanned out to more than one
+        per-storage tag stream inside this log front. The wire pipeline
+        reports True when its tlogs are key-range partitioned; here the
+        partitioning lives inside the replicas' tag-keyed streams — the
+        sensor means "mutations are routed per tag" on both paths."""
+        from foundationdb_tpu.cluster.tlog import LOG_STREAM_TAG
+
+        tags: set = set()
+        for t, alive in zip(self.tlogs, self.live):
+            if alive:
+                tags.update(t._messages)
+                tags.update(t._spilled)
+        tags.discard(LOG_STREAM_TAG)
+        return len(tags) > 1
+
     def register_consumer(self, name: str) -> None:
         for t in self.tlogs + self.satellites:
             t.register_consumer(name)

@@ -678,6 +678,10 @@ class ResolverRole:
             "copies": 0,
             "decode_allocs": 0,
         }
+        # -- conflict-range key sample (ISSUE 20): the wire twin of the
+        # sim resolver's ResolutionBalancer sample — begin keys by touch
+        # count, decayed at the shared sampling.KEY_SAMPLE_LIMIT
+        self._key_sample: dict[bytes, int] = {}
         self.queue_depth = LatencySample("queueDepth")
         self.queue_wait_latency = LatencySample("queueWaitLatency")
         self.compute_time = LatencySample("computeTime")
@@ -927,9 +931,40 @@ class ResolverRole:
         self._trace_columnar_decode(req)
         return txns
 
+    def _note_key_sample(self, req) -> None:
+        """Feed the conflict-range key sample from BOTH frame kinds
+        without materializing transactions: the columnar blob's
+        canonical key order (read begins, read ends, write begins,
+        write ends — packing._KEY_ORDER_DOC) lets the begin keys slice
+        straight out of the key_lens offsets."""
+        from foundationdb_tpu.cluster import sampling as _sampling
+
+        sample = self._key_sample
+        if isinstance(req, codec.ResolveBatchColumnar):
+            cols = req.cols
+            if len(cols.key_lens) == 0:
+                return
+            import numpy as _np
+
+            offs = _np.concatenate(
+                ([0], _np.cumsum(cols.key_lens, dtype=_np.int64))
+            )
+            blob = bytes(cols.key_blob)
+            nr, nw = cols.n_reads, cols.n_writes
+            for i in (*range(nr), *range(2 * nr, 2 * nr + nw)):
+                b = blob[offs[i]:offs[i + 1]]
+                sample[b] = sample.get(b, 0) + 1
+        else:
+            for t in req.transactions:
+                for b, _e in t.read_conflict_ranges + t.write_conflict_ranges:
+                    sample[b] = sample.get(b, 0) + 1
+        if len(sample) > _sampling.KEY_SAMPLE_LIMIT:
+            _sampling.decay_key_sample(sample)
+
     def _resolve_now(self, req) -> ResolveTransactionBatchReply:
         columnar = isinstance(req, codec.ResolveBatchColumnar)
         stats = self.path_stats
+        self._note_key_sample(req)
         if columnar:
             stats["columnar_batches"] += 1
             stats["txns"] += req.cols.n_txns
@@ -1007,6 +1042,11 @@ class ResolverRole:
         # reads this to land the structural copy/alloc metrics
         qos["resolve_path"] = dict(self.path_stats)
         qos["stale_epoch_rejects"] = self.stale_epoch_rejects
+        # conflict-range key sample (ISSUE 20): identical block shape
+        # to the sim resolver's — sampling.key_sample_qos is shared
+        from foundationdb_tpu.cluster import sampling as _sampling
+
+        qos["key_sample"] = _sampling.key_sample_qos(self._key_sample)
         return {
             "role": "resolver",
             "version": self.version,
@@ -1585,6 +1625,14 @@ class StorageRole:
         self.smoothed_input_bytes = TimerSmoother(1.0)
         self.apply_batch_size = LatencySample("applyBatchMutations")
         self._applies = 0
+        # -- skew sensors (ISSUE 20): byteSample + busiest-tag pair.
+        # Wall-entropy seed and wall-clock smoothers (wire role — no
+        # virtual clock exists here, and nothing traced depends on it)
+        from foundationdb_tpu.cluster import sampling as _sampling
+
+        self.byte_sample = _sampling.ByteSample()
+        self.read_tags = _sampling.TagCounter()
+        self.write_tags = _sampling.TagCounter()
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
             _check_encryption_marker(data_dir, encryption)
@@ -1744,11 +1792,22 @@ class StorageRole:
             self._seq_by_version = kept
 
     def _apply_mutations(self, version: int, mutations) -> None:
+        from foundationdb_tpu.cluster.sampling import tag_of_key
+
         self._applies += 1
         self.apply_batch_size.sample(len(mutations))
         self.smoothed_input_bytes.add_delta(sum(
             8 + len(m.param1) + len(m.param2) for m in mutations
         ))
+        # skew sensors see every engine's apply stream (the byteSample
+        # estimates the LIVE keyspace; clears drop their span)
+        for m in mutations:
+            nb = 8 + len(m.param1) + len(m.param2)
+            self.write_tags.note(tag_of_key(m.param1), nb)
+            if m.op == self.MUT_SET:
+                self.byte_sample.note_write(m.param1, m.param2)
+            elif m.op == self.MUT_CLEAR_RANGE:
+                self.byte_sample.erase_range(m.param1, m.param2)
         if self._lsm is not None:
             # values arrive pre-sealed (seal-once in apply/catch-up);
             # keys stay plaintext for run ordering (crypto/at_rest.py)
@@ -2093,10 +2152,20 @@ class StorageRole:
                     self.smoothed_input_bytes.smooth_rate()
                 ),
                 "keys": len(self.history),
+                # skew sensors (ISSUE 20) — same schema as the sim
+                # storage's saturation() block
+                "sampled_bytes": self.byte_sample.total_bytes(),
+                "sample_keys": self.byte_sample.count,
+                "hot_ranges": self.byte_sample.hot_ranges(),
+                "busiest_read_tag": self.read_tags.busiest(),
+                "busiest_write_tag": self.write_tags.busiest(),
             },
         }
 
     async def get(self, req: StorageGet) -> StorageGetReply:
+        from foundationdb_tpu.cluster.sampling import tag_of_key
+
+        self.read_tags.note(tag_of_key(req.key), len(req.key))
         cond = self._cond_lazy()
         async with cond:
             await cond.wait_for(lambda: self.version >= req.version)
@@ -2150,6 +2219,10 @@ class StorageRole:
         every key served at ITS OWN requested version — exact MVCC
         semantics, one wire roundtrip for a whole event-loop turn's
         worth of proxy-process reads."""
+        from foundationdb_tpu.cluster.sampling import tag_of_key
+
+        for k in req.keys:
+            self.read_tags.note(tag_of_key(k), len(k))
         vmax = max(req.versions) if req.versions else 0
         cond = self._cond_lazy()
         async with cond:
@@ -4764,6 +4837,12 @@ class ProxyPipeline:
         self.smoothed_queue_depth = TimerSmoother(1.0)
         self.smoothed_grv_rate = TimerSmoother(1.0)
         self.grvs_served = 0
+        # busiest-write-tag tracker (ISSUE 20): the commit-side
+        # TransactionTagCounter twin — wall clock, like every other
+        # wire-role sensor
+        from foundationdb_tpu.cluster.sampling import TagCounter
+
+        self.write_tags = TagCounter()
 
     def start(self) -> None:
         self._loop = asyncio.get_event_loop()
@@ -4993,6 +5072,7 @@ class ProxyPipeline:
             "failed": self.failed is not None,
             "version_grants": self.version_grants,
             "tag_partitioned": self._tlog_ranges is not None,
+            "busiest_write_tag": self.write_tags.busiest(),
         }
 
     def grv_saturation(self) -> dict:
@@ -5034,6 +5114,22 @@ class ProxyPipeline:
                 )
             )
             return await fut
+        # busiest-write-tag sensor: note at the front door (per offered
+        # mutation, like the reference proxy's TransactionTagCounter —
+        # throttling decisions must see load BEFORE conflict verdicts)
+        from foundationdb_tpu.cluster.sampling import tag_of_key
+
+        for m in txn.mutations:
+            key = getattr(m, "param1", None)
+            if key is None and isinstance(m, (tuple, list)) and len(m) >= 3:
+                key = m[1]
+            if not isinstance(key, bytes):
+                continue
+            val = getattr(m, "param2", None)
+            if val is None and isinstance(m, (tuple, list)) and len(m) >= 3:
+                val = m[2]
+            nb = 8 + len(key) + (len(val) if isinstance(val, bytes) else 0)
+            self.write_tags.note(tag_of_key(key), nb)
         self._queue.append((txn, fut))
         return await fut
 

@@ -192,6 +192,83 @@ def qos_section(
     return out
 
 
+def sampling_rollup(
+    storages: dict[str, dict],
+    proxies: dict[str, dict],
+) -> dict[str, Any]:
+    """`cluster.busiest_tags` + `cluster.hot_ranges` from per-role qos
+    blocks (ISSUE 20) — ONE rollup path shared by the sim
+    `cluster_status()` and the wire `assemble_status`, so the
+    skew-attribution gate reads the same document shape on both.
+
+    Tag fractions are re-normalized GLOBALLY: each role's busiest-tag
+    row carries its LOCAL frac (share of that role's traffic), which
+    can be high on a storage role that merely owns few shards — so the
+    rollup reconstructs each role's total rate as `bytes_per_s / frac`
+    and divides the tag's summed rate by the summed totals. A uniform
+    workload therefore stays flat at cluster level even when individual
+    storage roles see locally-dominant tags."""
+    tag_rate: dict[str, float] = {}
+    denom = 0.0
+    rows = [
+        (q.get(field) or {})
+        for q in list(storages.values()) + list(proxies.values())
+        for field in ("busiest_read_tag", "busiest_write_tag")
+    ]
+    for row in rows:
+        rate = float(row.get("bytes_per_s") or 0.0)
+        frac = float(row.get("frac") or 0.0)
+        denom += rate / frac if frac > 1e-9 else rate
+        tag = row.get("tag")
+        if tag is not None:
+            tag_rate[tag] = tag_rate.get(tag, 0.0) + rate
+    busiest_tags = sorted(
+        (
+            {
+                "tag": t,
+                "bytes_per_s": round(r, 3),
+                "frac": round(r / denom, 4) if denom > 1e-9 else 0.0,
+            }
+            for t, r in tag_rate.items()
+        ),
+        key=lambda r: (-r["bytes_per_s"], r["tag"]),
+    )[:8]
+    # hot ranges: merge the storage samples' rows by range label —
+    # bytes sum, bounds widen, frac re-normalized over the merged total
+    ranges: dict[str, list] = {}
+    for q in storages.values():
+        for row in q.get("hot_ranges") or []:
+            label = row.get("range", "")
+            g = ranges.get(label)
+            b = int(row.get("bytes") or 0)
+            k = int(row.get("keys") or 0)
+            if g is None:
+                ranges[label] = [
+                    b, row.get("begin", ""), row.get("end", ""), k
+                ]
+            else:
+                g[0] += b
+                g[1] = min(g[1], row.get("begin", ""))
+                g[2] = max(g[2], row.get("end", ""))
+                g[3] += k
+    total = sum(g[0] for g in ranges.values())
+    hot_ranges = sorted(
+        (
+            {
+                "range": label,
+                "begin": g[1],
+                "end": g[2],
+                "bytes": g[0],
+                "keys": g[3],
+                "frac": round(g[0] / total, 4) if total > 0 else 0.0,
+            }
+            for label, g in ranges.items()
+        ),
+        key=lambda r: (-r["bytes"], r["range"]),
+    )[:8]
+    return {"busiest_tags": busiest_tags, "hot_ranges": hot_ranges}
+
+
 #: role kind (the per-process "role" field) -> the qos_section argument
 #: slot its block feeds; unknown kinds simply don't contribute pressure
 _QOS_SLOT = {
@@ -253,6 +330,9 @@ def assemble_status(
                 lag_target=lag_target, ratekeeper=ratekeeper,
             ),
             "processes": processes,
+            # keyspace-skew rollup (ISSUE 20): the skew-attribution
+            # gate's input, shared math with the sim path
+            **sampling_rollup(slots["storages"], slots["proxies"]),
         }
     }
     if cluster_extra:
@@ -392,6 +472,10 @@ def cluster_status(cluster) -> dict[str, Any]:
             # seconds, and per-signature compile times — the "why did
             # that batch stall" panel for cold-jit pathologies
             "compile_cache": _compile_cache_section(),
+            # keyspace-skew rollup (ISSUE 20): busiest_tags (globally
+            # re-normalized tag fractions) + hot_ranges (merged storage
+            # byte-sample density) — same math as the wire assembly
+            **sampling_rollup(storage_qos, proxy_qos),
             "processes": {},
         }
     }

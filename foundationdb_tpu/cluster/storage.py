@@ -27,6 +27,7 @@ from __future__ import annotations
 import bisect
 from typing import Any, Optional
 
+from foundationdb_tpu.cluster import sampling as _sampling
 from foundationdb_tpu.cluster.tlog import TLog
 from foundationdb_tpu.runtime.flow import ActorCancelled, Notified, Scheduler
 from foundationdb_tpu.utils import commit_debug as _cd
@@ -59,6 +60,7 @@ class StorageServer:
         recovery_version: int = 0,
         window_versions: int = 5_000_000,
         consumer: str = "storage",
+        sample_seed: int = 0,
     ):
         self.sched = sched
         self.tlog = tlog
@@ -126,6 +128,13 @@ class StorageServer:
         #: mutations applied by the last pull batch (the apply-queue
         #: depth proxy: a lagging replica catches up in huge batches)
         self.last_batch_mutations = 0
+        # -- skew sensors (ISSUE 20): the StorageMetrics byteSample and
+        # TransactionTagCounter pair. Seeded from the sim seed (via
+        # sample_seed) and clocked off the virtual clock, so every
+        # value they surface is bit-deterministic per seed.
+        self.byte_sample = _sampling.ByteSample(seed=sample_seed)
+        self.read_tags = _sampling.TagCounter(clock=sched.now)
+        self.write_tags = _sampling.TagCounter(clock=sched.now)
 
     def saturation(self) -> dict:
         """The storage server's qos sensor block: how far the apply
@@ -148,6 +157,13 @@ class StorageServer:
             ),
             "keys": self._live_count,
             "mvcc_window_versions": self.window_versions,
+            # -- skew sensors (ISSUE 20): the byteSample estimate, the
+            # keyspace heatmap rows and the busiest-tag pair
+            "sampled_bytes": self.byte_sample.total_bytes(),
+            "sample_keys": self.byte_sample.count,
+            "hot_ranges": self.byte_sample.hot_ranges(),
+            "busiest_read_tag": self.read_tags.busiest(),
+            "busiest_write_tag": self.write_tags.busiest(),
         }
 
     def start(self) -> None:
@@ -190,6 +206,11 @@ class StorageServer:
                         except Exception:
                             nb = 32
                         self.smoothed_input_bytes.add_delta(nb)
+                        # busiest-write-tag sensor: the TLog-fed client
+                        # write path only (shard-move replays don't
+                        # re-count traffic that already counted)
+                        key = m[2] if m[0] == "atomic" else m[1]
+                        self.write_tags.note(_sampling.tag_of_key(key), nb)
                     self.version.set(v)
                     if _trace.g_trace_batch.enabled:
                         # version-keyed (storage sits below the debug-id
@@ -280,6 +301,14 @@ class StorageServer:
             h.append((v, value))
         now_live = value is not None
         self._live_count += int(now_live) - int(was_live)
+        # the byteSample tracks the LIVE latest-version state: every
+        # state-changing path (client writes, shard installs, drops)
+        # funnels through here, so the sample can never drift from the
+        # store it estimates
+        if now_live:
+            self.byte_sample.note_write(k, value)
+        else:
+            self.byte_sample.erase(k)
 
     @staticmethod
     def _at_or_below(h: list, v: int) -> int:
@@ -474,6 +503,10 @@ class StorageServer:
             # holding stale location-cache entries (code-review r4)
             "dropped_ranges": list(self._dropped_ranges),
             "ceded_ranges": list(self._ceded_ranges),
+            # the byteSample is durable alongside the store it samples:
+            # a rebooted server must not restart skew sensing from an
+            # empty (and so wildly underestimating) sample
+            "byte_sample": self.byte_sample.snapshot(),
         }
 
     def restore(self, snap: dict) -> None:
@@ -487,6 +520,8 @@ class StorageServer:
         self._ceded_ranges = list(snap.get("ceded_ranges", []))
         self._last_gc = snap["oldest_version"]
         self.version = Notified(snap["durable_version"])
+        if "byte_sample" in snap:
+            self.byte_sample.restore(snap["byte_sample"])
 
     # -- read path -----------------------------------------------------------
 
@@ -535,7 +570,11 @@ class StorageServer:
         dt = self.sched.now() - t0
         self.read_latency.sample(dt)
         self.read_latency_bands.add(dt)
-        return self._value_at(key, version)
+        val = self._value_at(key, version)
+        self.read_tags.note(
+            _sampling.tag_of_key(key), len(key) + len(val or b"")
+        )
+        return val
 
     async def get_key_values(
         self, begin: bytes, end: bytes, version: int, *, limit: int = 1 << 30
@@ -558,6 +597,10 @@ class StorageServer:
                 out.append((k, v))
                 if len(out) >= limit:
                     break
+        self.read_tags.note(
+            _sampling.tag_of_key(begin),
+            sum(len(k) + len(v) for k, v in out) or len(begin),
+        )
         return out
 
     # test/inspection helper: the latest-version view of the data

@@ -408,11 +408,15 @@ class Resolver:
                 self.counters.add(
                     "resolvedWriteConflictRanges", len(tr.write_conflict_ranges)
                 )
-                if self.resolver_count > 1:
-                    for b, _e in tr.read_conflict_ranges + tr.write_conflict_ranges:
-                        self._key_sample[b] = self._key_sample.get(b, 0) + 1
-                    if len(self._key_sample) > KEY_SAMPLE_LIMIT:
-                        self._decay_key_sample()
+                # the ResolutionBalancer's key sample, armed ALWAYS
+                # (ISSUE 20 — it used to arm only under resolver_count
+                # > 1): the future balancer and today's hotspot sensors
+                # both need conflict-range density on single-resolver
+                # clusters too
+                for b, _e in tr.read_conflict_ranges + tr.write_conflict_ranges:
+                    self._key_sample[b] = self._key_sample.get(b, 0) + 1
+                if len(self._key_sample) > KEY_SAMPLE_LIMIT:
+                    self._decay_key_sample()
 
             if self.conflict_set is None:
                 self._route_backend(req.transactions)
@@ -596,6 +600,10 @@ class Resolver:
                 self.total_state_bytes / self.state_memory_limit
                 if self.state_memory_limit else 0.0
             ),
+            # the conflict-range key sample (ISSUE 20): the future
+            # ResolutionBalancer's split input, surfaced as a sensor —
+            # top conflict-range begin keys by touch count
+            "key_sample": self._key_sample_qos(),
         }
         # kernel panel: ALWAYS present so fdbtop/REQUIRED_SENSORS can
         # pin it — an unrouted or metrics-less backend reports the
@@ -617,6 +625,13 @@ class Resolver:
         from foundationdb_tpu.models.types import apply_state_mutation
 
         apply_state_mutation(self.txn_state_store, m)
+
+    def _key_sample_qos(self) -> dict:
+        """The key-sample sensor block (sampling.key_sample_qos so the
+        sim and wire resolvers can never report divergent shapes)."""
+        from foundationdb_tpu.cluster.sampling import key_sample_qos
+
+        return key_sample_qos(self._key_sample)
 
     def _decay_key_sample(self) -> None:
         """Halve all counts, dropping zeros; if the key set itself is too

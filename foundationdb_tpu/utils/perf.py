@@ -736,6 +736,63 @@ def saturation_report_to_record(rep: dict, *, imported_from: str = None,
     )
 
 
+def hotspot_report_to_record(rep: dict, *, imported_from: str = None,
+                             fingerprint: dict = None) -> dict:
+    """testing/hotspot report (one leg) -> ledger record: the sampling
+    overhead envelope. Only the SIM legs belong in the committed
+    history — the byte sample is a pure function of (seed, key, size)
+    and the tag counters run on the virtual clock, so every count here
+    is structural (exact-compared by perfcheck). Wire legs use
+    wall-entropy sampling seeds; ledger them only for local notes."""
+    if fingerprint is None:
+        fingerprint = {
+            "backend": "cpu", "device_kind": None, "device_count": 0,
+            "jax_version": None, "jaxlib_version": None,
+            "python_version": None, "machine": None,
+        }
+    samp = rep.get("sampling") or {}
+    skewed = rep.get("direction") == "zipf"
+    metrics = {
+        "sample_keys": metric(samp.get("sample_keys", 0), "keys", "lower",
+                              tier="structural"),
+        "sampled_bytes": metric(samp.get("sampled_bytes", 0), "bytes",
+                                "lower", tier="structural"),
+        "committed": metric(rep.get("committed", 0), "txns", "higher",
+                            tier="structural"),
+        # the verdict itself: the zipf leg is SUPPOSED to attribute,
+        # the uniform leg is supposed to stay quiet — direction is
+        # meaningful only per leg, encoded in workload
+        "attributed": metric(
+            int(bool((rep.get("attribution") or {}).get("attributed"))),
+            "bool", "higher" if skewed else "lower", tier="structural",
+        ),
+    }
+    for name, unit in (
+        ("byte_sample_writes", "writes"),
+        ("tag_counter_tags", "tags"),
+        ("tag_notes", "notes"),
+        ("tag_bytes_noted", "bytes"),
+        ("resolver_key_sample_keys", "keys"),
+    ):
+        if name in samp:
+            metrics[name] = metric(samp[name], unit, "lower",
+                                   tier="structural")
+    cfg = rep.get("config") or {}
+    return make_record(
+        "hotspot", metrics,
+        workload={
+            "spec": rep.get("spec", "hotspot"),
+            "seed": rep.get("seed"),
+            "path": rep.get("path"),
+            "direction": rep.get("direction"),
+            "txns": cfg.get("txns"),
+            "value_bytes": cfg.get("value_bytes"),
+        },
+        knobs=cfg,
+        fingerprint=fingerprint, imported_from=imported_from,
+    )
+
+
 def multichip_artifact_to_record(obj: dict, *, imported_from: str = None,
                                  fingerprint: dict = None) -> dict:
     """MULTICHIP_r0*.json (the 8-device lane's pass/fail artifact) ->

@@ -163,6 +163,19 @@ JAX_PLATFORMS=cpu python scripts/saturation.py --smoke
 t1=$(date +%s.%N)
 awk -v a="$t0" -v b="$t1" 'BEGIN {printf "saturation smoke wall time: %.1fs\n", b - a}'
 
+echo "== hotspot smoke (keyspace-skew attribution gate, all four legs: =="
+echo "== zipf mix MUST attribute the injected tenant top-1 and the      =="
+echo "== uniform mix must NOT flag, on BOTH the sim status path and     =="
+echo "== real wire role processes; sim legs emit structural sampling-   =="
+echo "== overhead ledger rows gated by perfcheck)                       =="
+t0=$(date +%s.%N)
+hotspot_row=$(mktemp /tmp/hotspotcheck_row.XXXXXX.jsonl)
+JAX_PLATFORMS=cpu python scripts/hotspot.py --smoke --perf-ledger "$hotspot_row"
+JAX_PLATFORMS=cpu python scripts/perfcheck.py --check "$hotspot_row" --tier structural
+rm -f "$hotspot_row"
+t1=$(date +%s.%N)
+awk -v a="$t0" -v b="$t1" 'BEGIN {printf "hotspot smoke wall time: %.1fs\n", b - a}'
+
 echo "== fdbtop smoke (bench_pipeline wire cluster held live, fdbtop  =="
 echo "== polls StatusRequest: every role must report its qos sensors   =="
 echo "== AND its resource-census block — conns/tasks/fds per process)  =="

@@ -71,7 +71,12 @@ HEADLINE = {
 #: gate; schema-pinned in tests/test_fdbtop.py)
 REQUIRED_SENSORS = {
     "log": ("queue_bytes", "smoothed_queue_bytes", "input_bytes_per_s"),
-    "storage": ("version_lag_versions", "input_bytes_per_s"),
+    # r20 hot-key telemetry: the byte-sample totals, the top-K tag
+    # trackers' busiest rows, and the heatmap's hot_ranges density rows
+    # — always present (zeros/None rows before traffic, never missing)
+    "storage": ("version_lag_versions", "input_bytes_per_s",
+                "sampled_bytes", "sample_keys", "hot_ranges",
+                "busiest_read_tag", "busiest_write_tag"),
     # "kernel" is the r10 kernel panel: compile-cache hits/misses, last
     # compile seconds, stage p99s (KernelStageMetrics.qos()) — present
     # on EVERY resolver backend, native included. Dotted keys descend
@@ -87,11 +92,16 @@ REQUIRED_SENSORS = {
                  # r14 range-path counters (sweep groups dispatched,
                  # pressure spills) — zeros on unconfigured kernels,
                  # never a missing key
-                 "kernel.spills", "kernel.sweep_groups"),
+                 "kernel.spills", "kernel.sweep_groups",
+                 # r20: the ResolutionBalancer's conflict-range key
+                 # sample (width + top begin keys by touch count)
+                 "key_sample"),
     "commit_proxy": ("queued_requests", "inflight_batches", "batch_sizer",
                      # r19 scale-out: grants consumed + whether this
                      # proxy pushes tag-partitioned (0/False legacy)
-                     "version_grants", "tag_partitioned"),
+                     "version_grants", "tag_partitioned",
+                     # r20: commit-side TransactionTagCounter top row
+                     "busiest_write_tag"),
     # r19: the sequencer role's allotment surface — grant count/rate,
     # the GRV notification floor, and the tag/proxy fan-out widths
     "sequencer": ("grants", "grants_per_s", "live_committed_version",
@@ -207,7 +217,9 @@ class _SimWorld:
         i = 0
         while not self._stop:
             txn = self.db.create_transaction()
-            key = b"fdbtop-%d-%06d" % (wid, int(self.rng.integers(4096)))
+            # tenant-prefixed keys so the demo exercises the r20 tag
+            # sensors: each workload is one tenant, rate-skewed by wid
+            key = b"t%d/fdbtop-%06d" % (wid, int(self.rng.integers(4096)))
             txn.set(key, b"x" * int(self.rng.integers(16, 512)))
             try:
                 await txn.commit()
@@ -249,10 +261,16 @@ def _row_metrics(role: str, block: dict) -> list[tuple[str, object]]:
             ("dur.lag", q.get("durability_lag_versions", 0)),
         ]
     if role == "storage":
+        # the hot-tag column (r20): this role's busiest read/write tag
+        # prefixes, '-' before any tagged traffic has flowed
+        rt = (q.get("busiest_read_tag") or {}).get("tag")
+        wt = (q.get("busiest_write_tag") or {}).get("tag")
         return [
             ("in B/s", q.get("input_bytes_per_s", 0.0)),
             ("fetch", q.get("fetch_backlog_ranges", 0)),
             ("keys", q.get("keys", block.get("keys", 0))),
+            ("sampB", q.get("sampled_bytes", 0)),
+            ("hot r/w", f"{rt or '-'}/{wt or '-'}"),
         ]
     if role == "resolver":
         # the kernel panel: cache hit/miss + last compile seconds catch
@@ -356,6 +374,43 @@ def _census_cols(block: dict) -> list[tuple[str, object]]:
     ]
 
 
+#: heatmap density ticks, lowest to highest
+_TICKS = "▁▂▃▄▅▆▇█"
+
+
+def _heatmap_lines(cl: dict) -> list[str]:
+    """The keyspace-heatmap panel (r20): one density bar over the
+    cluster's hot ranges (tick height = range's share of sampled bytes,
+    scaled to the hottest) plus the busiest-tag rollup — a skewed
+    workload reads as one tall tick and one dominant tag."""
+    lines = []
+    ranges = cl.get("hot_ranges") or []
+    if ranges:
+        peak = max(r.get("frac", 0.0) for r in ranges) or 1.0
+        bar = "".join(
+            _TICKS[min(
+                len(_TICKS) - 1,
+                int(r.get("frac", 0.0) / peak * (len(_TICKS) - 1) + 0.5),
+            )]
+            for r in ranges
+        )
+        labels = "  ".join(
+            f"{r.get('range', '?')}:{100 * r.get('frac', 0.0):.0f}%"
+            for r in ranges[:6]
+        )
+        lines.append(f"keyspace  {bar}  {labels}")
+    tags = cl.get("busiest_tags") or []
+    if tags:
+        lines.append(
+            "busiest tags: " + "  ".join(
+                f"{t.get('tag', '?')} {100 * t.get('frac', 0.0):.0f}% "
+                f"({t.get('bytes_per_s', 0.0):g} B/s)"
+                for t in tags[:4]
+            )
+        )
+    return lines
+
+
 def render(status: dict, histories: dict[str, MetricHistory],
            t: float) -> str:
     cl = status.get("cluster", {})
@@ -387,6 +442,7 @@ def render(status: dict, histories: dict[str, MetricHistory],
             f"{run_loop['steps']} steps, "
             f"{run_loop['slow_tasks']} slow tasks"
         )
+    lines.extend(_heatmap_lines(cl))
     lines.append(
         f"{'process':<14} {'role':<13} {'gauge':<8} {'value':>9}  "
         f"{'history':<24} detail"
@@ -450,6 +506,11 @@ def check_status(status: dict, require: list[str], *,
         "qos", {}
     ):
         problems.append("cluster.qos missing performance_limited_by")
+    # the r20 skew rollup: both keys must exist at cluster level (empty
+    # lists before traffic — absence means the rollup didn't run)
+    for key in ("busiest_tags", "hot_ranges"):
+        if key not in status.get("cluster", {}):
+            problems.append(f"cluster missing {key!r}")
     return problems
 
 

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Hotspot drill driver: the keyspace-skew attribution gate as a CLI.
+
+    python scripts/hotspot.py --smoke             # check.sh lane
+    python scripts/hotspot.py --full              # full-length drill
+    python scripts/hotspot.py --once --json       # one status JSON dump
+    python scripts/hotspot.py --watch             # live heatmap loop
+
+Runs testing/hotspot.run_hotspot_gate (the `[hotspot]` table of
+testing/specs/hotspot.toml) in BOTH directions on BOTH paths:
+
+* zipf direction    — a seeded zipf tenant mix MUST be attributed to
+  the injected hot tenant top-1 (cluster.busiest_tags / hot_ranges).
+* uniform direction — the SAME drill with a flat mix must NOT flag;
+  a skew detector that can't stay quiet on flat traffic is noise.
+
+and both against the in-sim cluster (deterministic virtual clock) and
+real role processes over UDS (wall clock, ratio-robust verdict).
+
+Exit status is nonzero if ANY leg lands wrong — a machine-checked
+attribution gate, not a dashboard screenshot.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _heat_lines(rep: dict) -> list[str]:
+    """The keyspace heatmap for one leg, fdbtop-style."""
+    ticks = "▁▂▃▄▅▆▇█"
+    lines = []
+    ranges = rep.get("hot_ranges") or []
+    if ranges:
+        peak = max(r.get("frac", 0.0) for r in ranges) or 1.0
+        bar = "".join(
+            ticks[min(7, int(r.get("frac", 0.0) / peak * 7))]
+            for r in ranges
+        )
+        labels = "  ".join(
+            f"{r.get('range', '?')}:{r.get('frac', 0.0) * 100:.0f}%"
+            for r in ranges[:6]
+        )
+        lines.append(f"  keyspace  {bar}  {labels}")
+    tags = rep.get("busiest_tags") or []
+    if tags:
+        lines.append("  busiest tags: " + "  ".join(
+            f"{t.get('tag', '?')} {t.get('frac', 0.0) * 100:.0f}%"
+            for t in tags[:4]
+        ))
+    return lines
+
+
+def _print_leg(rep: dict) -> None:
+    mark = "ok " if rep["ok"] else "BAD"
+    print(f"== {rep['path']:>4}/{rep['direction']:<7} [{mark}] "
+          f"committed {rep['committed']} failed {rep['failed']}  "
+          f"— {rep['why']}")
+    for line in _heat_lines(rep):
+        print(line)
+    attr = rep.get("attribution") or {}
+    ht, hr = attr.get("hot_tag"), attr.get("hot_range")
+    if ht or hr:
+        parts = []
+        if ht:
+            parts.append(f"tag {ht['tag']} @ {ht['frac']:.2f}")
+        if hr:
+            parts.append(f"range {hr['range']} @ {hr['frac']:.2f}")
+        print("  attributed: " + ", ".join(parts))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="quick drill (spec quick_txns), all four legs")
+    ap.add_argument("--full", action="store_true",
+                    help="full drill (spec txns), all four legs")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--spec", default="hotspot")
+    ap.add_argument("--sim", action="store_true",
+                    help="sim path only (deterministic virtual clock)")
+    ap.add_argument("--wire", action="store_true",
+                    help="wire path only (real role processes)")
+    ap.add_argument("--once", action="store_true",
+                    help="one zipf sim leg, print and exit (with --json: "
+                         "dump the full leg report as JSON)")
+    ap.add_argument("--watch", action="store_true",
+                    help="loop zipf sim legs over rolling seeds, "
+                         "redrawing the heatmap")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="--watch redraw interval (seconds)")
+    ap.add_argument("--json", action="store_true",
+                    help="with --once: emit the leg report as JSON")
+    ap.add_argument("--json-out", default=None,
+                    help="append all leg reports as JSON lines")
+    ap.add_argument("--perf-ledger", default=None,
+                    help="append the perf-ledger rows here "
+                         "(default: perf/history.jsonl)")
+    ap.add_argument("--no-perf", action="store_true",
+                    help="skip the perf-ledger append")
+    args = ap.parse_args()
+    quick = not args.full
+
+    from foundationdb_tpu.testing.hotspot import (
+        run_hotspot_gate,
+        run_hotspot_sim,
+    )
+
+    if args.once:
+        rep = run_hotspot_sim(seed=args.seed, skewed=True, quick=True,
+                              spec_name=args.spec)
+        if args.json:
+            print(json.dumps(rep, indent=2))
+        else:
+            _print_leg(rep)
+        return 0 if rep["ok"] else 1
+
+    if args.watch:
+        seed = args.seed
+        try:
+            while True:
+                rep = run_hotspot_sim(seed=seed, skewed=True, quick=True,
+                                      spec_name=args.spec)
+                print(f"\x1b[2J\x1b[Hhotspot --watch  seed {seed}  "
+                      f"{time.strftime('%H:%M:%S')}")
+                _print_leg(rep)
+                seed += 1
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+    if args.sim and args.wire:
+        paths = ("sim", "wire")
+    elif args.sim:
+        paths = ("sim",)
+    elif args.wire:
+        paths = ("wire",)
+    else:
+        paths = ("sim", "wire")
+
+    gate = run_hotspot_gate(seed=args.seed, quick=quick, paths=paths,
+                            spec_name=args.spec)
+    for rep in gate["legs"]:
+        _print_leg(rep)
+    rc = 0 if gate["ok"] else 1
+
+    if args.json_out:
+        with open(args.json_out, "a") as f:
+            for rep in gate["legs"]:
+                f.write(json.dumps(rep) + "\n")
+    if not args.no_perf:
+        # canonical perf-ledger rows, SIM legs only: the byte sample is
+        # a pure function of (seed, key, size) and the tag counters run
+        # on the virtual clock, so every count is structural (exact-
+        # compared by perfcheck). Wire legs use wall-entropy sampling
+        # seeds and stay out of the committed history. Smoke runs emit
+        # to a tempfile unless a ledger is named — the check.sh lane
+        # must not dirty the committed history on green runs.
+        from foundationdb_tpu.utils import perf
+
+        sim_legs = [r for r in gate["legs"] if r["path"] == "sim"]
+        if sim_legs:
+            if (quick and not args.perf_ledger
+                    and "FDBTPU_PERF_LEDGER" not in os.environ):
+                import tempfile
+
+                args.perf_ledger = os.path.join(
+                    tempfile.mkdtemp(prefix="hotspot_perf_"),
+                    "history.jsonl",
+                )
+            host_fp = perf.device_fingerprint()
+            path = None
+            for rep in sim_legs:
+                rec = perf.hotspot_report_to_record(rep, fingerprint=host_fp)
+                path = perf.append(rec, path=args.perf_ledger)
+            print(f"[perf] {len(sim_legs)} ledger row(s) appended to {path}")
+    print("hotspot gate ok" if rc == 0 else "hotspot gate FAILED")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

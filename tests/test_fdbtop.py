@@ -46,15 +46,24 @@ ROLE_QOS_KEYS = {
             "input_bytes_per_s", "durability_lag_versions"},
     "storage": {"apply_lag_versions", "input_bytes_per_s",
                 "fetch_backlog_ranges", "version_lag_versions",
-                "mvcc_window_versions"},
+                "mvcc_window_versions",
+                # r20 hot-key telemetry: byte-sample totals, heatmap
+                # rows, busiest-tag trackers
+                "sampled_bytes", "sample_keys", "hot_ranges",
+                "busiest_read_tag", "busiest_write_tag"},
     "resolver": {"queue_depth", "queue_depth_dist", "queue_wait_dist",
                  "compute_time_dist", "resolver_latency_dist",
                  "state_pressure", "occupancy",
                  # the r10 kernel panel (compile-cache counters, last
                  # compile seconds, stage p99s) — every backend
-                 "kernel"},
+                 "kernel",
+                 # r20: the conflict-range key sample sensor
+                 "key_sample"},
     "commit_proxy": {"inflight_batches", "queued_requests",
-                     "batches_started", "batch_sizer"},
+                     "batches_started", "batch_sizer",
+                     # r20: commit-side busiest write tag + the REAL
+                     # per-tag fan-out state (PR-19 remaining (b))
+                     "busiest_write_tag", "tag_partitioned"},
     "grv_proxy": {"queued_requests", "batch_sizer", "throttled_tags",
                   "sheds", "budget_stale", "max_queue"},
 }
@@ -74,6 +83,10 @@ CLUSTER_QOS_KEYS = {
     "lag_limit_versions", "tag_quotas", "auto_tag_quotas",
     "budget_limited_by", "budget_stale", "failsafe_tps",
 }
+
+#: cluster-LEVEL (next to qos, not inside it) r20 skew-rollup keys —
+#: the skew-attribution gate's input, present on both status paths
+CLUSTER_SAMPLING_KEYS = {"busiest_tags", "hot_ranges"}
 
 
 @pytest.fixture(scope="module")
@@ -100,6 +113,7 @@ def test_sim_status_qos_schema_pin(sim_status):
     keys; the cluster qos section carries worst-* + ratekeeper keys."""
     cl = sim_status["cluster"]
     assert CLUSTER_QOS_KEYS <= set(cl["qos"])
+    assert CLUSTER_SAMPLING_KEYS <= set(cl)
     json.dumps(sim_status)  # the whole document stays JSON-able
     seen_roles = set()
     for name, block in cl["processes"].items():
@@ -221,12 +235,23 @@ def test_fdbtop_check_status_gate_both_directions():
     good = {
         "cluster": {
             "qos": {"performance_limited_by": {"name": "workload"}},
+            # r20 skew rollup: the keys must exist at cluster level
+            # (empty before traffic)
+            "busiest_tags": [],
+            "hot_ranges": [],
             "processes": {
                 "tlog0": {"role": "log", "qos": {
                     "queue_bytes": 0, "smoothed_queue_bytes": 0.0,
                     "input_bytes_per_s": 0.0}},
                 "storage0": {"role": "storage", "qos": {
-                    "version_lag_versions": 0, "input_bytes_per_s": 0.0}},
+                    "version_lag_versions": 0, "input_bytes_per_s": 0.0,
+                    # r20 hot-key telemetry sensors
+                    "sampled_bytes": 0, "sample_keys": 0,
+                    "hot_ranges": [],
+                    "busiest_read_tag": {"tag": None, "bytes_per_s": 0.0,
+                                         "frac": 0.0},
+                    "busiest_write_tag": {"tag": None, "bytes_per_s": 0.0,
+                                          "frac": 0.0}}},
                 "resolver0": {"role": "resolver", "qos": {
                     "queue_depth": 0, "queue_wait_dist": {},
                     "compute_time_dist": {}, "occupancy": 0.0,
@@ -242,14 +267,19 @@ def test_fdbtop_check_status_gate_both_directions():
                                "collective_time_share": 0.0,
                                # r14 range-path counters
                                "spills": 0,
-                               "sweep_groups": 0}}},
+                               "sweep_groups": 0},
+                    # r20: the conflict-range key sample
+                    "key_sample": {"keys": 0, "top": []}}},
                 "proxy0": {"role": "commit_proxy", "qos": {
                     "queued_requests": 0, "inflight_batches": 0,
                     "batch_sizer": {},
                     # r19 scale-out: grants consumed + partition mode
                     # (0/False on the legacy single-proxy path, but the
                     # KEYS are always present)
-                    "version_grants": 0, "tag_partitioned": False}},
+                    "version_grants": 0, "tag_partitioned": False,
+                    # r20: commit-side busiest write tag
+                    "busiest_write_tag": {"tag": None, "bytes_per_s": 0.0,
+                                          "frac": 0.0}}},
                 "sequencer0": {"role": "sequencer", "qos": {
                     "grants": 0, "grants_per_s": 0.0,
                     "live_committed_version": 0, "tags": 2,
@@ -308,6 +338,23 @@ def test_fdbtop_check_status_gate_both_directions():
     nolim["cluster"]["qos"] = {}
     assert any("performance_limited_by" in p for p in
                fdbtop.check_status(nolim, require))
+    # r20: a storage that stopped reporting its sampling sensors fails
+    nosamp = json.loads(json.dumps(good))
+    del nosamp["cluster"]["processes"]["storage0"]["qos"][
+        "busiest_read_tag"
+    ]
+    assert any("busiest_read_tag" in p for p in
+               fdbtop.check_status(nosamp, require))
+    # r20: a resolver missing its key sample fails
+    nokeys = json.loads(json.dumps(good))
+    del nokeys["cluster"]["processes"]["resolver0"]["qos"]["key_sample"]
+    assert any("key_sample" in p for p in
+               fdbtop.check_status(nokeys, require))
+    # r20: a document assembled without the skew rollup fails
+    noroll = json.loads(json.dumps(good))
+    del noroll["cluster"]["busiest_tags"]
+    assert any("busiest_tags" in p for p in
+               fdbtop.check_status(noroll, require))
 
 
 def test_fdbtop_render_sim_status(sim_status):
@@ -480,13 +527,20 @@ def test_fdbtop_census_gate_and_columns():
     import fdbtop
 
     census = {"fds": 11, "connections": 2, "servers": 1, "tasks": 5}
+    none_tag = {"tag": None, "bytes_per_s": 0.0, "frac": 0.0}
     good = {
         "cluster": {
             "qos": {"performance_limited_by": {"name": "workload"}},
+            "busiest_tags": [],
+            "hot_ranges": [],
             "processes": {
                 "storage0": {"role": "storage", "census": dict(census),
                              "qos": {"version_lag_versions": 0,
-                                     "input_bytes_per_s": 0.0}},
+                                     "input_bytes_per_s": 0.0,
+                                     "sampled_bytes": 0, "sample_keys": 0,
+                                     "hot_ranges": [],
+                                     "busiest_read_tag": dict(none_tag),
+                                     "busiest_write_tag": dict(none_tag)}},
                 "grv_proxy0": {"role": "grv_proxy",
                                "qos": {"queued_requests": 0, "sheds": 0,
                                        "budget_stale": False}},

@@ -649,6 +649,34 @@ def test_every_declared_probe_fires():
         )
         assert rep.stopped == "roofline"
 
+    # -- sampling probes (ISSUE 20) ---------------------------------------
+    from foundationdb_tpu.cluster import sampling
+
+    # byte_sample_gc: factor=1/overhead=0 puts p >= 1 on every write, so
+    # a tiny capacity overflows and the deterministic halving GC runs
+    bs = sampling.ByteSample(seed=7, factor=1, overhead=0, capacity=8)
+    for i in range(64):
+        bs.note_write(b"gc/%03d" % i, b"v" * 64)
+    assert bs.gc_rounds >= 1
+
+    # tag_counter_rollover: a 5th distinct tag against a 4-slot table
+    # evicts the cold half first
+    vt = [0.0]
+    tc = sampling.TagCounter(capacity=4, clock=lambda: vt[0])
+    for i in range(5):
+        tc.note(f"tag{i}", 100)
+        vt[0] += 0.1
+    assert tc.rollovers >= 1
+
+    # hot_range_attributed: a dominant rolled-up tag names a hotspot
+    attr = sampling.attribute_hotspot({"cluster": {
+        "busiest_tags": [
+            {"tag": "tenant0", "bytes_per_s": 9e4, "frac": 0.8}
+        ],
+        "hot_ranges": [],
+    }})
+    assert attr["attributed"]
+
     assert probes.missed() == [], (
         f"declared CODE_PROBEs never fired: {probes.missed()}\n"
         f"fired: { {k: v for k, v in probes.snapshot().items() if v} }"
